@@ -1,0 +1,89 @@
+"""paddle_tpu: a TPU-native deep-learning framework with PaddlePaddle's API surface.
+
+Brand-new design over JAX/XLA/Pallas (see SURVEY.md for the reference map):
+eager Tensors dispatch per-op to jitted XLA executables, autograd is a
+define-by-run tape whose backward runs cached jitted vjps, and distributed
+training is GSPMD over a `jax.sharding.Mesh` instead of NCCL process groups.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (  # noqa: F401
+    bool_ as bool,
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128,
+    get_default_dtype, set_default_dtype,
+    CPUPlace, CUDAPlace, TPUPlace,
+    get_device, set_device, seed, get_rng_state, set_rng_state,
+    is_compiled_with_tpu,
+)
+from .core import Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .ops import *  # noqa: F401,F403
+from .ops import creation as _creation  # noqa: F401
+
+# paddle-compat: `paddle.Tensor` + creation entry point
+from .ops.creation import to_tensor  # noqa: F401
+
+
+def is_grad_enabled_():  # pragma: no cover - compat shim
+    return is_grad_enabled()
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=False,
+         only_inputs=True, allow_unused=False, no_grad_vars=None):
+    """Functional gradient (paddle.grad equivalent, reference: partial_grad_engine.cc).
+
+    Eager implementation: run backward on a copy of the graph and collect
+    .grad of the requested inputs without touching their existing .grad.
+    """
+    outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    saved = [t.grad for t in ins]
+    for t in ins:
+        t.grad = None
+    gouts = grad_outputs if isinstance(grad_outputs, (list, tuple)) else [grad_outputs] * len(outs)
+    for o, g in zip(outs, gouts):
+        o.backward(g, retain_graph=True)
+    results = []
+    for t, s in zip(ins, saved):
+        if t.grad is None and not allow_unused:
+            raise RuntimeError(f"grad: input {t.name} unused in graph")
+        results.append(t.grad)
+        t.grad = s
+    return results
+
+
+def disable_static(place=None):  # dygraph is the only mode; compat no-op
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for graph capture"
+    )
+
+
+def in_dynamic_mode():
+    return True
+
+
+in_dygraph_mode = in_dynamic_mode
+
+# Subpackages (each guarded so the core imports even mid-build).
+def _try_import(names):
+    import importlib
+
+    for n in names:
+        try:
+            globals()[n] = importlib.import_module(f".{n}", __name__)
+        except ImportError:
+            pass
+
+
+_try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision", "distributed"])
+
+try:
+    from .framework.io import save, load  # noqa: F401,E402
+except ImportError:
+    pass

@@ -1,0 +1,3 @@
+from .tensor import Tensor  # noqa: F401
+from .autograd import no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .dispatch import primitive, get_primitive, registry  # noqa: F401

@@ -1,0 +1,179 @@
+"""Define-by-run autograd engine.
+
+Mirrors the reference's eager autograd (GradNodeBase/Edge graph +
+queue-with-in-degree backward walk, paddle/fluid/eager/grad_node_info.h:77 and
+paddle/fluid/eager/backward.cc:79) — but each node's grad computation is a
+cached jitted ``jax.vjp`` of the recorded pure op (see core/dispatch.py), so
+backward math runs as compiled XLA, not hand-written kernels.
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import deque
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+_GRAD_ENABLED = [True]
+
+
+@contextlib.contextmanager
+def no_grad():
+    """paddle.no_grad equivalent: suspend tape recording."""
+    _GRAD_ENABLED.append(False)
+    try:
+        yield
+    finally:
+        _GRAD_ENABLED.pop()
+
+
+@contextlib.contextmanager
+def enable_grad():
+    _GRAD_ENABLED.append(True)
+    try:
+        yield
+    finally:
+        _GRAD_ENABLED.pop()
+
+
+def is_grad_enabled() -> bool:
+    return _GRAD_ENABLED[-1]
+
+
+def set_grad_enabled(mode: bool):
+    _GRAD_ENABLED[-1] = bool(mode)
+
+
+class GradNode:
+    """One recorded op on the tape.
+
+    ``inputs`` holds the input Tensors (edges, like egr::Edge); ``primals`` the
+    raw arrays saved for the vjp (TensorWrapper analogue); output metadata is
+    kept to synthesize zero cotangents for unused outputs.
+    """
+
+    __slots__ = (
+        "prim", "attrs", "primals", "inputs",
+        "out_avals", "n_outputs", "multi_output",
+    )
+
+    def __init__(self, prim, attrs, primals, inputs, outs, multi_output):
+        self.prim = prim
+        self.attrs = attrs
+        self.primals = primals
+        self.inputs = inputs  # list[Tensor]; aligned with primals positions that are tensors
+        self.multi_output = multi_output
+        self.out_avals = [(o.shape, o.dtype) for o in outs]
+        self.n_outputs = len(outs)
+
+    def run(self, out_cts: List[Optional[object]]):
+        cts = []
+        for ct, (shape, dtype) in zip(out_cts, self.out_avals):
+            if ct is None:
+                ct = jnp.zeros(shape, dtype)
+            cts.append(ct)
+        ct_struct = tuple(cts) if self.multi_output else cts[0]
+        bwd = self.prim.bwd(self.attrs)
+        return bwd(self.primals, ct_struct)
+
+
+def backward(root, grad=None, retain_graph: bool = False):
+    """Reverse-walk the tape from ``root``, accumulating into leaf ``.grad``.
+
+    Mirrors egr::RunBackward (paddle/fluid/eager/backward.cc:155-261): compute
+    in-degrees over reachable nodes, process a ready-queue, route each produced
+    cotangent either into a leaf Tensor's .grad or into the producer node's
+    pending output-cotangent slots.
+    """
+    from .tensor import Tensor
+
+    node = root._grad_node
+    if node is None:
+        if root.stop_gradient:
+            raise RuntimeError(
+                "backward() called on a tensor with stop_gradient=True and no grad graph"
+            )
+        # A leaf: d(root)/d(root) accumulates directly.
+        g = jnp.ones(root.shape, root.dtype) if grad is None else _raw(grad)
+        _accumulate_leaf(root, g)
+        return
+
+    grad_arr = jnp.ones(root.shape, root.dtype) if grad is None else _raw(grad)
+
+    # 1) discover reachable nodes + in-degrees (number of consumer edges).
+    #    An edge exists for each non-stopped input tensor that has a producer node.
+    indeg: Dict[int, int] = {}
+    nodes: Dict[int, GradNode] = {}
+    stack = [node]
+    seen = {id(node)}
+    nodes[id(node)] = node
+    indeg[id(node)] = 0
+    while stack:
+        n = stack.pop()
+        for t in n.inputs:
+            if t is None:
+                continue
+            up = t._grad_node
+            if up is None or t.stop_gradient:
+                continue
+            indeg[id(up)] = indeg.get(id(up), 0) + 1
+            if id(up) not in seen:
+                seen.add(id(up))
+                nodes[id(up)] = up
+                stack.append(up)
+
+    # 2) ready-queue walk.
+    pending_cts: Dict[int, List[Optional[object]]] = {
+        nid: [None] * n.n_outputs for nid, n in nodes.items()
+    }
+    pending_cts[id(node)][root._out_index] = grad_arr
+
+    queue = deque([node])
+    while queue:
+        n = queue.popleft()
+        in_cts = n.run(pending_cts[id(n)])
+        for t, g in zip(n.inputs, in_cts):
+            if t is None:
+                continue
+            up = t._grad_node
+            if up is None or t.stop_gradient:
+                # leaf or stopped: accumulate if a usable cotangent was produced
+                if up is None and not t.stop_gradient and g is not None and not _is_float0(g):
+                    _accumulate_leaf(t, g)
+                continue
+            # edge into an upstream node: always retire the edge, even if the
+            # cotangent is unusable, so the producer still gets scheduled.
+            if g is not None and not _is_float0(g):
+                slot = pending_cts[id(up)]
+                slot[t._out_index] = g if slot[t._out_index] is None else slot[t._out_index] + g
+            indeg[id(up)] -= 1
+            if indeg[id(up)] == 0:
+                queue.append(up)
+
+    if not retain_graph:
+        # free the graph like the reference does after backward
+        for n in nodes.values():
+            n.primals = None
+            n.inputs = ()
+        root._grad_node = None
+
+
+def _accumulate_leaf(t, g):
+    from .tensor import Tensor
+
+    if g.dtype != t.dtype:
+        g = g.astype(t.dtype)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad = Tensor(t.grad.data + g, stop_gradient=True)
+
+
+def _is_float0(g):
+    return getattr(g, "dtype", None) is not None and str(g.dtype) == "float0"
+
+
+def _raw(x):
+    from .tensor import Tensor
+
+    return x.data if isinstance(x, Tensor) else x

@@ -1,0 +1,126 @@
+"""Op dispatch: the PHI-kernel-registry equivalent, TPU-first.
+
+In the reference every eager op goes through Tracer::TraceOp -> KernelFactory
+(paddle/fluid/imperative/tracer.cc:172, paddle/phi/core/kernel_factory.h:222):
+a registry keyed by (op, backend, layout, dtype) picking a hand-written kernel.
+
+On TPU the kernel library is XLA, so the idiomatic equivalent is: each op is a
+pure jax function; "kernel selection + caching" is a per-(op, attrs) ``jax.jit``
+cache (XLA then caches per shape/dtype underneath, playing the role of the
+reference's KernelKey). Backward does not use per-op hand-written grad kernels:
+a cached jitted ``jax.vjp`` of the same pure function is the grad "kernel"
+(recompute-based, which XLA DCEs when the primal isn't needed) — the analogue of
+the reference's generated GradNode kernels (paddle/fluid/eager/auto_code_generator).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+
+# ---------------------------------------------------------------------------
+# caches
+# ---------------------------------------------------------------------------
+
+_FWD_CACHE: Dict[Tuple, Callable] = {}
+_BWD_CACHE: Dict[Tuple, Callable] = {}
+
+_REGISTRY: Dict[str, "Primitive"] = {}
+
+
+def _hashable(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, np.dtype):
+        return ("dtype", v.name)
+    if isinstance(v, np.ndarray):
+        return ("nda", v.tobytes(), v.shape, v.dtype.name)
+    return v
+
+
+def _attrs_key(attrs: dict) -> Tuple:
+    return tuple(sorted((k, _hashable(v)) for k, v in attrs.items()))
+
+
+class Primitive:
+    """A named pure-jax op: forward jit cache + vjp-backed backward jit cache.
+
+    ``fn(*arrays, **attrs)`` must be pure jax. ``nondiff=True`` marks ops whose
+    outputs never carry gradients (int outputs, comparisons, rng-int, ...).
+    A custom vjp rule may be registered with ``defvjp`` for ops where the
+    recompute-vjp fallback is wrong or wasteful; rule signature:
+    ``rule(ct, out, primals, **attrs) -> tuple_of_input_cotangents_or_None``.
+    """
+
+    def __init__(self, name: str, fn: Callable, nondiff: bool = False):
+        self.name = name
+        self.fn = fn
+        self.nondiff = nondiff
+        self.vjp_rule: Optional[Callable] = None
+        _REGISTRY[name] = self
+
+    def defvjp(self, rule: Callable) -> Callable:
+        self.vjp_rule = rule
+        return rule
+
+    # -- forward ------------------------------------------------------------
+    def fwd(self, attrs: dict) -> Callable:
+        key = (self.name, _attrs_key(attrs))
+        f = _FWD_CACHE.get(key)
+        if f is None:
+            f = jax.jit(functools.partial(self.fn, **attrs))
+            _FWD_CACHE[key] = f
+        return f
+
+    # -- backward -----------------------------------------------------------
+    def bwd(self, attrs: dict) -> Callable:
+        """jitted (primals, cotangents) -> input cotangents, via jax.vjp."""
+        key = (self.name, _attrs_key(attrs))
+        b = _BWD_CACHE.get(key)
+        if b is None:
+            if self.vjp_rule is not None:
+                rule = self.vjp_rule
+
+                def b(primals, ct, _rule=rule, _attrs=attrs):
+                    out = self.fn(*primals, **_attrs)
+                    return _rule(ct, out, primals, **_attrs)
+
+                b = jax.jit(b)
+            else:
+                pfn = functools.partial(self.fn, **attrs)
+
+                def b(primals, ct, _pfn=pfn):
+                    _out, vjp = jax.vjp(_pfn, *primals)
+                    return vjp(ct)
+
+                b = jax.jit(b)
+            _BWD_CACHE[key] = b
+        return b
+
+    def __call__(self, *args, **attrs):
+        from .tensor import dispatch  # local import: Tensor layer sits above dispatch
+
+        return dispatch(self, args, attrs)
+
+
+def primitive(name: str, nondiff: bool = False):
+    """Decorator registering a pure jax function as a framework op."""
+
+    def deco(fn: Callable) -> Primitive:
+        return Primitive(name, fn, nondiff=nondiff)
+
+    return deco
+
+
+def get_primitive(name: str) -> Primitive:
+    return _REGISTRY[name]
+
+
+def registry() -> Dict[str, Primitive]:
+    return _REGISTRY

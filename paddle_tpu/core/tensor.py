@@ -1,0 +1,216 @@
+"""The eager Tensor: a paddle-compatible facade over ``jax.Array``.
+
+Role of phi::DenseTensor + imperative::VarBase combined
+(paddle/phi/core/dense_tensor.h:38, paddle/fluid/imperative/layer.h:66): holds
+the device buffer (here an async jax.Array — dispatch is naturally non-blocking
+like the reference's stream-async kernels), autograd metadata (stop_gradient,
+.grad, producer GradNode edge) and the user-facing method surface.
+
+Tensors are registered as a jax pytree node so whole programs over Tensors can
+be captured by ``jax.jit`` (the @to_static path).
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from .autograd import GradNode, backward as _backward_engine, is_grad_enabled
+
+_name_counter = itertools.count()
+
+
+class Tensor:
+    __slots__ = (
+        "data", "stop_gradient", "grad", "name", "persistable",
+        "_grad_node", "_out_index", "trainable", "__weakref__",
+    )
+
+    def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
+        if isinstance(data, Tensor):
+            data = data.data
+        if not isinstance(data, jax.Array):
+            data = jnp.asarray(data)
+        self.data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.name = name or f"tensor_{next(_name_counter)}"
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._grad_node: Optional[GradNode] = None
+        self._out_index: int = 0
+
+    # -- metadata -----------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.data.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.data.dtype)
+
+    @property
+    def ndim(self):
+        return self.data.ndim
+
+    def dim(self):
+        return self.data.ndim
+
+    def rank(self):
+        return self.data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self.data.shape)) if self.data.shape else 1
+
+    def numel(self):
+        return self.size
+
+    @property
+    def place(self):
+        from ..framework import place as place_mod
+
+        devs = self.data.devices() if hasattr(self.data, "devices") else set()
+        dev = next(iter(devs)) if devs else jax.devices()[0]
+        plat = dev.platform.lower()
+        if plat == "cpu":
+            return place_mod.CPUPlace(dev.id)
+        if plat in ("gpu", "cuda", "rocm"):
+            return place_mod.CUDAPlace(dev.id)
+        return place_mod.TPUPlace(dev.id)
+
+    @property
+    def is_leaf(self):
+        return self._grad_node is None
+
+    # -- conversion ---------------------------------------------------------
+    def numpy(self):
+        return np.asarray(self.data)
+
+    def item(self):
+        return self.data.item()
+
+    def tolist(self):
+        return np.asarray(self.data).tolist()
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self.data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def __float__(self):
+        return float(self.data.item())
+
+    def __int__(self):
+        return int(self.data.item())
+
+    def __bool__(self):
+        if self.size != 1:
+            raise ValueError("The truth value of a multi-element Tensor is ambiguous")
+        return bool(self.data.item())
+
+    def __len__(self):
+        if not self.data.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.data.shape[0]
+
+    def __hash__(self):
+        return id(self)
+
+    # -- autograd -----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        _backward_engine(self, grad_tensor, retain_graph)
+
+    def clear_grad(self):
+        self.grad = None
+
+    clear_gradient = clear_grad
+
+    def detach(self) -> "Tensor":
+        return Tensor(self.data, stop_gradient=True, name=self.name + ".detach")
+
+    def detach_(self) -> "Tensor":
+        self._grad_node = None
+        self.stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import math as _m
+
+        return _m.assign(self)
+
+    # -- in-place plumbing ---------------------------------------------------
+    def _rebind(self, other: "Tensor"):
+        """Adopt another tensor's value + autograd identity (in-place op support)."""
+        self.data = other.data
+        self._grad_node = other._grad_node
+        self._out_index = other._out_index
+        self.stop_gradient = other.stop_gradient
+        return self
+
+    def set_value(self, value):
+        arr = value.data if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(arr.shape) != tuple(self.data.shape):
+            raise ValueError(
+                f"set_value shape mismatch: {arr.shape} vs {self.data.shape}")
+        self.data = arr.astype(self.data.dtype)
+        return self
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (
+            f"Tensor(shape={self.shape}, dtype={self.dtype.name}{grad_info},\n"
+            f"       {np.asarray(self.data)!r})"
+        )
+
+    # The op method surface (__add__, sum, reshape, matmul, ...) is attached by
+    # paddle_tpu/ops/_bind.py once the op corpus is defined.
+
+
+def dispatch(prim, args, attrs):
+    """Run one op: unwrap -> jitted forward -> (maybe) record GradNode.
+
+    This is the Tracer::TraceOp equivalent (paddle/fluid/imperative/tracer.cc:172):
+    forward dispatch + conditional tape recording in one place.
+    """
+    arrays = []
+    inputs = []
+    any_grad = False
+    for a in args:
+        if isinstance(a, Tensor):
+            arrays.append(a.data)
+            inputs.append(a)
+            if not a.stop_gradient:
+                any_grad = True
+        else:
+            arrays.append(a if isinstance(a, jax.Array) else jnp.asarray(a))
+            inputs.append(None)
+
+    out = prim.fwd(attrs)(*arrays)
+    multi = isinstance(out, (tuple, list))
+    outs_raw = tuple(out) if multi else (out,)
+
+    record = any_grad and is_grad_enabled() and not prim.nondiff
+    out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_raw]
+    if record:
+        node = GradNode(prim, attrs, tuple(arrays), inputs, outs_raw, multi)
+        for i, t in enumerate(out_tensors):
+            t._grad_node = node
+            t._out_index = i
+    return tuple(out_tensors) if multi else out_tensors[0]
+
+
+# -- pytree registration: lets jax.jit/tree_map see through Tensors -----------
+
+def _tensor_flatten(t: Tensor):
+    return (t.data,), (t.stop_gradient,)
+
+
+def _tensor_unflatten(aux, children):
+    return Tensor(children[0], stop_gradient=aux[0])
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

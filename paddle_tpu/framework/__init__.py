@@ -1,0 +1,10 @@
+from . import dtype, place, random  # noqa: F401
+from .dtype import (  # noqa: F401
+    bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+    float64, complex64, complex128, get_default_dtype, set_default_dtype,
+)
+from .place import (  # noqa: F401
+    CPUPlace, CUDAPlace, TPUPlace, Place, get_device, set_device,
+    is_compiled_with_tpu,
+)
+from .random import seed, get_rng_state, set_rng_state  # noqa: F401

@@ -1,0 +1,96 @@
+"""Dtype system.
+
+Mirrors the reference's dtype surface (paddle/phi/common/data_type.h and the
+Python-visible ``paddle.float32`` style constants) but is natively a thin veneer
+over JAX/numpy dtypes: a dtype here *is* a ``jnp.dtype``-compatible object, so
+tensors can flow into jax functions without conversion.
+"""
+from __future__ import annotations
+
+import jax
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype objects (np.dtype instances; bfloat16 comes from ml_dtypes via jnp).
+bool_ = np.dtype(np.bool_)
+uint8 = np.dtype(np.uint8)
+int8 = np.dtype(np.int8)
+int16 = np.dtype(np.int16)
+int32 = np.dtype(np.int32)
+int64 = np.dtype(np.int64)
+float16 = np.dtype(np.float16)
+bfloat16 = jnp.bfloat16.dtype if hasattr(jnp.bfloat16, "dtype") else np.dtype(jnp.bfloat16)
+float32 = np.dtype(np.float32)
+float64 = np.dtype(np.float64)
+complex64 = np.dtype(np.complex64)
+complex128 = np.dtype(np.complex128)
+
+_NAME_TO_DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_DEFAULT_DTYPE = [float32]
+
+
+def convert_dtype(dtype):
+    """Normalize a user-provided dtype (string / np / jnp dtype) to np.dtype.
+
+    64-bit ints/floats are canonicalized to 32-bit when jax runs without x64
+    (the TPU-native default): the reference's int64 indices are an artifact of
+    its CPU/GPU heritage; 32-bit is what XLA:TPU wants.
+    """
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _NAME_TO_DTYPE:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+        d = _NAME_TO_DTYPE[dtype]
+    else:
+        d = np.dtype(dtype)
+    if not jax.config.jax_enable_x64:
+        if d == np.dtype(np.int64):
+            return int32
+        if d == np.dtype(np.float64):
+            return float32
+        if d == np.dtype(np.uint64):
+            return np.dtype(np.uint32)
+        if d == np.dtype(np.complex128):
+            return complex64
+    return d
+
+
+def dtype_name(dtype) -> str:
+    d = convert_dtype(dtype)
+    return d.name
+
+
+def set_default_dtype(dtype):
+    d = convert_dtype(dtype)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _DEFAULT_DTYPE[0] = d
+
+
+def get_default_dtype():
+    return _DEFAULT_DTYPE[0]
+
+
+def is_floating(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    d = convert_dtype(dtype)
+    return jnp.issubdtype(d, jnp.integer) or d == bool_

@@ -1,0 +1,10 @@
+"""Op corpus: the PHI-kernels equivalent (paddle/phi/kernels -> pure jax fns)."""
+from . import creation, math, reduction, manipulation, linalg, comparison  # noqa: F401
+from . import _bind  # noqa: F401  (attaches Tensor methods)
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .reduction import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .comparison import *  # noqa: F401,F403

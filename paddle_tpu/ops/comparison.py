@@ -1,0 +1,70 @@
+"""Comparison / logic ops (paddle.tensor.logic equivalents). All nondiff."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import primitive, get_primitive
+from ..core.tensor import Tensor
+from .math import _scalar_operand
+
+_THIS = globals()
+
+_CMP = {
+    "equal": jnp.equal,
+    "not_equal": jnp.not_equal,
+    "greater_than": jnp.greater,
+    "greater_equal": jnp.greater_equal,
+    "less_than": jnp.less,
+    "less_equal": jnp.less_equal,
+}
+
+for _name, _jfn in _CMP.items():
+    primitive(_name, nondiff=True)(lambda x, y, _f=_jfn: _f(x, y))
+
+    def _make(pname):
+        def fn(x, y, name=None):
+            if not isinstance(x, Tensor) and isinstance(y, Tensor):
+                x = _scalar_operand(y, x)
+            if not isinstance(y, Tensor) and isinstance(x, Tensor):
+                y = _scalar_operand(x, y)
+            return get_primitive(pname)(x, y)
+
+        fn.__name__ = pname
+        return fn
+
+    _THIS[_name] = _make(_name)
+
+
+@primitive("allclose_op", nondiff=True)
+def _allclose(x, y, *, rtol, atol, equal_nan):
+    return jnp.allclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _allclose(x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+@primitive("isclose_op", nondiff=True)
+def _isclose(x, y, *, rtol, atol, equal_nan):
+    return jnp.isclose(x, y, rtol=rtol, atol=atol, equal_nan=equal_nan)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return _isclose(x, y, rtol=float(rtol), atol=float(atol), equal_nan=bool(equal_nan))
+
+
+@primitive("equal_all_op", nondiff=True)
+def _equal_all(x, y):
+    return jnp.array_equal(x, y)
+
+
+def equal_all(x, y, name=None):
+    return _equal_all(x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,321 @@
+"""Tensor creation ops (paddle.tensor.creation equivalents).
+
+Reference surface: python/paddle/tensor/creation.py. Here each op is a pure jax
+function; shapes/dtypes are static attrs so XLA sees fully static programs.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from ..framework import random as random_mod
+
+
+def _dt(dtype, default=None):
+    d = dtype_mod.convert_dtype(dtype)
+    if d is None:
+        d = default if default is not None else dtype_mod.get_default_dtype()
+    return d
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    if isinstance(data, Tensor):
+        arr = data.data
+    else:
+        arr = jnp.asarray(data)
+    if dtype is not None:
+        arr = arr.astype(dtype_mod.convert_dtype(dtype))
+    elif not isinstance(data, (jax.Array, np.ndarray, Tensor)):
+        # python scalars/lists: default-float like the reference's to_tensor
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            arr = arr.astype(dtype_mod.get_default_dtype())
+    return Tensor(arr, stop_gradient=stop_gradient)
+
+
+@primitive("full", nondiff=True)
+def _full(*, shape, fill_value, dtype):
+    return jnp.full(shape, fill_value, dtype)
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    d = _dt(dtype, dtype_mod.float32 if isinstance(fill_value, float) else None)
+    if dtype is None and isinstance(fill_value, (bool, int)):
+        d = dtype_mod.bool_ if isinstance(fill_value, bool) else dtype_mod.convert_dtype("int64")
+    return _full(shape=tuple(int(s) for s in shape), fill_value=fill_value, dtype=d)
+
+
+def zeros(shape, dtype=None, name=None):
+    return _full(shape=tuple(int(s) for s in shape), fill_value=0, dtype=_dt(dtype))
+
+
+def ones(shape, dtype=None, name=None):
+    return _full(shape=tuple(int(s) for s in shape), fill_value=1, dtype=_dt(dtype))
+
+
+@primitive("full_like", nondiff=True)
+def _full_like(x, *, fill_value, dtype):
+    return jnp.full(x.shape, fill_value, dtype or x.dtype)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return _full_like(x, fill_value=fill_value, dtype=dtype_mod.convert_dtype(dtype))
+
+
+def zeros_like(x, dtype=None, name=None):
+    return full_like(x, 0, dtype)
+
+
+def ones_like(x, dtype=None, name=None):
+    return full_like(x, 1, dtype)
+
+
+@primitive("arange", nondiff=True)
+def _arange(*, start, end, step, dtype):
+    return jnp.arange(start, end, step, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    for v in (start, end, step):
+        if isinstance(v, Tensor):
+            raise TypeError("arange bounds must be python numbers")
+    if dtype is None:
+        dtype = (
+            dtype_mod.int64
+            if all(isinstance(v, int) for v in (start, end, step))
+            else dtype_mod.get_default_dtype()
+        )
+    return _arange(start=start, end=end, step=step, dtype=dtype_mod.convert_dtype(dtype))
+
+
+@primitive("linspace", nondiff=True)
+def _linspace(*, start, stop, num, dtype):
+    return jnp.linspace(start, stop, num, dtype=dtype)
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    return _linspace(start=start, stop=stop, num=int(num), dtype=_dt(dtype))
+
+
+@primitive("eye", nondiff=True)
+def _eye(*, num_rows, num_columns, dtype):
+    return jnp.eye(num_rows, num_columns, dtype=dtype)
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return _eye(
+        num_rows=int(num_rows),
+        num_columns=int(num_columns) if num_columns is not None else int(num_rows),
+        dtype=_dt(dtype),
+    )
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+@primitive("tril")
+def _tril(x, *, diagonal):
+    return jnp.tril(x, diagonal)
+
+
+def tril(x, diagonal=0, name=None):
+    return _tril(x, diagonal=int(diagonal))
+
+
+@primitive("triu")
+def _triu(x, *, diagonal):
+    return jnp.triu(x, diagonal)
+
+
+def triu(x, diagonal=0, name=None):
+    return _triu(x, diagonal=int(diagonal))
+
+
+@primitive("diag")
+def _diag(x, *, offset):
+    return jnp.diag(x, offset)
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    if padding_value != 0:
+        raise NotImplementedError("diag padding_value != 0")
+    return _diag(x, offset=int(offset))
+
+
+@primitive("diagflat")
+def _diagflat(x, *, offset):
+    return jnp.diagflat(x, offset)
+
+
+def diagflat(x, offset=0, name=None):
+    return _diagflat(x, offset=int(offset))
+
+
+def meshgrid(*args, **kwargs):
+    from . import manipulation as _manip
+
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    return list(_meshgrid(*tensors))
+
+
+@primitive("meshgrid")
+def _meshgrid(*xs):
+    return tuple(jnp.meshgrid(*xs, indexing="ij"))
+
+
+def assign(x, output=None):
+    from . import math as _math
+
+    out = _math.assign(x)
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    from . import math as _math
+
+    return _math.assign(x)
+
+
+@primitive("tril_indices", nondiff=True)
+def _tril_indices(*, row, col, offset):
+    return jnp.stack(jnp.tril_indices(row, offset, col))
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    out = _tril_indices(row=int(row), col=int(col if col is not None else row), offset=int(offset))
+    from . import manipulation as _manip
+
+    return _manip.cast(out, dtype)
+
+
+@primitive("triu_indices", nondiff=True)
+def _triu_indices(*, row, col, offset):
+    return jnp.stack(jnp.triu_indices(row, offset, col))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    out = _triu_indices(row=int(row), col=int(col if col is not None else row), offset=int(offset))
+    from . import manipulation as _manip
+
+    return _manip.cast(out, dtype)
+
+
+def complex(real, imag, name=None):
+    from . import math as _math
+
+    return _complex(real, imag)
+
+
+@primitive("complex")
+def _complex(re, im):
+    return jax.lax.complex(re, im)
+
+
+# -- random creation ---------------------------------------------------------
+
+@primitive("uniform_random", nondiff=True)
+def _uniform(key, *, shape, dtype, min, max):
+    return jax.random.uniform(key, shape, dtype, minval=min, maxval=max)
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = random_mod.next_key() if seed == 0 else jax.random.key(seed)
+    return _uniform(key, shape=tuple(int(s) for s in shape), dtype=_dt(dtype), min=float(min), max=float(max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+@primitive("gaussian_random", nondiff=True)
+def _normal(key, *, shape, dtype, mean, std):
+    return mean + std * jax.random.normal(key, shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    assert shape is not None, "normal() requires shape"
+    return _normal(
+        random_mod.next_key(),
+        shape=tuple(int(s) for s in shape),
+        dtype=dtype_mod.get_default_dtype(),
+        mean=float(mean),
+        std=float(std),
+    )
+
+
+def randn(shape, dtype=None, name=None):
+    return _normal(
+        random_mod.next_key(),
+        shape=tuple(int(s) for s in shape),
+        dtype=_dt(dtype),
+        mean=0.0,
+        std=1.0,
+    )
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+@primitive("randint", nondiff=True)
+def _randint(key, *, low, high, shape, dtype):
+    return jax.random.randint(key, shape, low, high, dtype)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    return _randint(
+        random_mod.next_key(),
+        low=int(low),
+        high=int(high),
+        shape=tuple(int(s) for s in shape),
+        dtype=dtype_mod.convert_dtype(dtype) or dtype_mod.convert_dtype("int64"),
+    )
+
+
+@primitive("randperm", nondiff=True)
+def _randperm(key, *, n, dtype):
+    return jax.random.permutation(key, n).astype(dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return _randperm(random_mod.next_key(), n=int(n), dtype=dtype_mod.convert_dtype(dtype))
+
+
+@primitive("bernoulli", nondiff=True)
+def _bernoulli_p(p, key):
+    return jax.random.bernoulli(key, p).astype(p.dtype)
+
+
+def bernoulli(x, name=None):
+    return _bernoulli_p(x, random_mod.next_key())
+
+
+@primitive("multinomial", nondiff=True)
+def _multinomial(logp, key, *, num_samples, replacement):
+    return jax.random.categorical(key, logp, axis=-1, shape=logp.shape[:-1] + (num_samples,))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logp = jnp.log(jnp.asarray(x.data if isinstance(x, Tensor) else x))
+    return _multinomial(
+        Tensor(logp), random_mod.next_key(), num_samples=int(num_samples), replacement=bool(replacement)
+    )

@@ -1,0 +1,623 @@
+"""Shape / layout / indexing ops (paddle.tensor.manipulation equivalents)."""
+from __future__ import annotations
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+_DYN = "__dyn__"
+
+
+@primitive("cast")
+def _cast(x, *, dtype):
+    return x.astype(dtype)
+
+
+def cast(x, dtype):
+    return _cast(x, dtype=dtype_mod.convert_dtype(dtype))
+
+
+astype = cast
+
+
+@primitive("reshape")
+def _reshape(x, *, shape):
+    return jnp.reshape(x, shape)
+
+
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return _reshape(x, shape=tuple(int(s) for s in shape))
+
+
+@primitive("transpose2")
+def _transpose(x, *, perm):
+    return jnp.transpose(x, perm)
+
+
+def transpose(x, perm, name=None):
+    return _transpose(x, perm=tuple(int(p) for p in perm))
+
+
+def t(x, name=None):
+    if x.ndim < 2:
+        from . import math as _math
+
+        return _math.assign(x)
+    return transpose(x, [1, 0])
+
+
+@primitive("flatten_op")
+def _flatten(x, *, start_axis, stop_axis):
+    shape = x.shape
+    nd = len(shape)
+    s = start_axis % nd if nd else 0
+    e = stop_axis % nd if nd else 0
+    mid = 1
+    for d in shape[s : e + 1]:
+        mid *= d
+    return jnp.reshape(x, shape[:s] + (mid,) + shape[e + 1 :])
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    return _flatten(x, start_axis=int(start_axis), stop_axis=int(stop_axis))
+
+
+@primitive("squeeze_op")
+def _squeeze(x, *, axis):
+    if axis is None:
+        return jnp.squeeze(x)
+    axes = tuple(a % x.ndim for a in axis)
+    axes = tuple(a for a in axes if x.shape[a] == 1)
+    return jnp.squeeze(x, axes) if axes else x
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is not None and not isinstance(axis, (list, tuple)):
+        axis = [axis]
+    return _squeeze(x, axis=None if axis is None else tuple(int(a) for a in axis))
+
+
+@primitive("unsqueeze_op")
+def _unsqueeze(x, *, axis):
+    return jnp.expand_dims(x, axis)
+
+
+def unsqueeze(x, axis, name=None):
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if not isinstance(axis, (list, tuple)):
+        axis = [axis]
+    return _unsqueeze(x, axis=tuple(int(a) for a in axis))
+
+
+@primitive("concat_op")
+def _concat(*xs, axis):
+    return jnp.concatenate(xs, axis=axis)
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _concat(*x, axis=int(axis))
+
+
+@primitive("stack_op")
+def _stack(*xs, axis):
+    return jnp.stack(xs, axis=axis)
+
+
+def stack(x, axis=0, name=None):
+    return _stack(*x, axis=int(axis))
+
+
+@primitive("split_op")
+def _split(x, *, sections, axis):
+    if isinstance(sections, int):
+        return tuple(jnp.split(x, sections, axis))
+    # sections list: -1 entries are inferred
+    sections = list(sections)
+    total = x.shape[axis]
+    if -1 in sections:
+        known = sum(s for s in sections if s != -1)
+        sections[sections.index(-1)] = total - known
+    idx = np.cumsum(sections[:-1]).tolist()
+    return tuple(jnp.split(x, idx, axis))
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    if isinstance(num_or_sections, (list, tuple)):
+        sections = tuple(int(s) for s in num_or_sections)
+    else:
+        sections = int(num_or_sections)
+    return list(_split(x, sections=sections, axis=int(axis)))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, int(chunks), axis)
+
+
+@primitive("unbind_op")
+def _unbind(x, *, axis):
+    return tuple(jnp.moveaxis(x, axis, 0))
+
+
+def unbind(x, axis=0):
+    return list(_unbind(x, axis=int(axis)))
+
+
+@primitive("tile_op")
+def _tile(x, *, repeat_times):
+    return jnp.tile(x, repeat_times)
+
+
+def tile(x, repeat_times, name=None):
+    if isinstance(repeat_times, Tensor):
+        repeat_times = repeat_times.tolist()
+    return _tile(x, repeat_times=tuple(int(r) for r in repeat_times))
+
+
+@primitive("expand_op")
+def _expand(x, *, shape):
+    shape = tuple(
+        x.shape[i - (len(shape) - x.ndim)] if s in (-1,) else s for i, s in enumerate(shape)
+    )
+    return jnp.broadcast_to(x, shape)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    return _expand(x, shape=tuple(int(s) for s in shape))
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    shape = jnp.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [expand(t, shape) for t in inputs]
+
+
+@primitive("flip_op")
+def _flip(x, *, axis):
+    return jnp.flip(x, axis)
+
+
+def flip(x, axis, name=None):
+    if not isinstance(axis, (list, tuple)):
+        axis = [axis]
+    return _flip(x, axis=tuple(int(a) for a in axis))
+
+
+@primitive("roll_op")
+def _roll(x, *, shifts, axis):
+    return jnp.roll(x, shifts, axis)
+
+
+def roll(x, shifts, axis=None, name=None):
+    if isinstance(shifts, (list, tuple)):
+        shifts = tuple(int(s) for s in shifts)
+    else:
+        shifts = int(shifts)
+    if isinstance(axis, (list, tuple)):
+        axis = tuple(int(a) for a in axis)
+    elif axis is not None:
+        axis = int(axis)
+    return _roll(x, shifts=shifts, axis=axis)
+
+
+@primitive("rot90")
+def _rot90(x, *, k, axes):
+    return jnp.rot90(x, k, axes)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return _rot90(x, k=int(k), axes=tuple(int(a) for a in axes))
+
+
+@primitive("gather_op")
+def _gather(x, index, *, axis):
+    idx = index
+    if idx.ndim > 1:
+        idx = idx.reshape(-1)
+    return jnp.take(x, idx, axis=axis)
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return _gather(x, index, axis=int(axis))
+
+
+@primitive("gather_nd_op")
+def _gather_nd(x, index):
+    # index [..., k] indexes the first k dims of x
+    k = index.shape[-1]
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x[idx]
+
+
+def gather_nd(x, index, name=None):
+    return _gather_nd(x, index)
+
+
+@primitive("take_along_axis_op")
+def _take_along_axis(x, index, *, axis):
+    return jnp.take_along_axis(x, index, axis=axis)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return _take_along_axis(arr, indices, axis=int(axis))
+
+
+@primitive("put_along_axis_op")
+def _put_along_axis(x, index, value, *, axis, reduce):
+    if reduce == "assign":
+        return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
+    dims = list(range(x.ndim))
+    if reduce == "add":
+        # scatter-add along axis
+        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(index.shape)]
+        idx[axis] = index
+        return x.at[tuple(idx)].add(value)
+    raise NotImplementedError(reduce)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.broadcast_to(jnp.asarray(values, arr.dtype), indices.data.shape))
+    return _put_along_axis(arr, indices, values, axis=int(axis), reduce=reduce)
+
+
+@primitive("scatter_op")
+def _scatter(x, index, updates, *, overwrite):
+    if overwrite:
+        return x.at[index].set(updates)
+    return x.at[index].add(updates)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    return _scatter(x, index, updates, overwrite=bool(overwrite))
+
+
+@primitive("scatter_nd_add_op")
+def _scatter_nd_add(x, index, updates):
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return x.at[idx].add(updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return _scatter_nd_add(x, index, updates)
+
+
+@primitive("index_select_op")
+def _index_select(x, index, *, axis):
+    return jnp.take(x, index, axis=axis)
+
+
+def index_select(x, index, axis=0, name=None):
+    return _index_select(x, index, axis=int(axis))
+
+
+@primitive("index_sample_op")
+def _index_sample(x, index):
+    return jnp.take_along_axis(x, index, axis=1)
+
+
+def index_sample(x, index):
+    return _index_sample(x, index)
+
+
+@primitive("where_op")
+def _where(cond, x, y):
+    return jnp.where(cond, x, y)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return _where(condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    # Dynamic-shape op: must resolve on host (not jittable) — same constraint the
+    # reference hits with LoD/dynamic outputs; done via device_get.
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    idx = np.nonzero(arr)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i)) for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1)))
+
+
+def masked_select(x, mask, name=None):
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    m = np.asarray(mask.data if isinstance(mask, Tensor) else mask)
+    return Tensor(jnp.asarray(arr[m]))
+
+
+@primitive("masked_fill_op")
+def _masked_fill(x, mask, *, value):
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) else float(value)
+    return _masked_fill(x, mask, value=v)
+
+
+@primitive("top_k")
+def _topk_vals(x, *, k, axis, largest):
+    src = x if largest else -x
+    if axis not in (-1, x.ndim - 1):
+        src = jnp.moveaxis(src, axis, -1)
+    vals, idxs = jax.lax.top_k(src, k)
+    if not largest:
+        vals = -vals
+    if axis not in (-1, x.ndim - 1):
+        vals = jnp.moveaxis(vals, -1, axis)
+        idxs = jnp.moveaxis(idxs, -1, axis)
+    return vals, idxs.astype(jnp.int32)
+
+
+@_topk_vals.defvjp
+def _topk_vjp(ct, out, primals, *, k, axis, largest):
+    x = primals[0]
+    vals, idxs = out
+    ct_vals, _ = ct
+    g = jnp.zeros(x.shape, x.dtype)
+    if axis in (-1, x.ndim - 1):
+        g = jnp.put_along_axis(g, idxs.astype(jnp.int32), ct_vals.astype(x.dtype), axis=-1, inplace=False)
+    else:
+        gm = jnp.moveaxis(g, axis, -1)
+        gm = jnp.put_along_axis(
+            gm, jnp.moveaxis(idxs, axis, -1).astype(jnp.int32),
+            jnp.moveaxis(ct_vals, axis, -1).astype(x.dtype), axis=-1, inplace=False)
+        g = jnp.moveaxis(gm, -1, axis)
+    return (g,)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    return _topk_vals(x, k=int(k), axis=int(axis), largest=bool(largest))
+
+
+@primitive("argsort_op", nondiff=True)
+def _argsort(x, *, axis, descending):
+    idx = jnp.argsort(x, axis=axis)
+    if descending:
+        idx = jnp.flip(idx, axis=axis)
+    return idx.astype(jnp.int32)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    return _argsort(x, axis=int(axis), descending=bool(descending))
+
+
+@primitive("sort_op")
+def _sort(x, *, axis, descending):
+    out = jnp.sort(x, axis=axis)
+    if descending:
+        out = jnp.flip(out, axis=axis)
+    return out
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    return _sort(x, axis=int(axis), descending=bool(descending))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None, dtype="int64", name=None):
+    # dynamic-shape: host path
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    res = np.unique(
+        arr, return_index=return_index, return_inverse=return_inverse,
+        return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+@primitive("pad_op")
+def _pad(x, *, pad, mode, value):
+    if mode == "constant":
+        return jnp.pad(x, pad, mode="constant", constant_values=value)
+    return jnp.pad(x, pad, mode=mode)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+    pad = [int(p) for p in pad]
+    nd = x.ndim
+    if len(pad) == 2 * nd:
+        # full-form paddle pad: [d0_l, d0_r, d1_l, d1_r, ...]
+        widths = tuple((pad[2 * i], pad[2 * i + 1]) for i in range(nd))
+    else:
+        # NCHW-style: pad applies to the last len(pad)//2 spatial dims, reversed pairs
+        k = len(pad) // 2
+        widths = [(0, 0)] * (nd - k)
+        for i in range(k):
+            widths.append((pad[2 * (k - 1 - i)], pad[2 * (k - 1 - i) + 1]))
+        widths = tuple(widths)
+    mode_map = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}
+    return _pad(x, pad=widths, mode=mode_map[mode], value=float(value))
+
+
+@primitive("repeat_interleave_op")
+def _repeat_interleave(x, *, repeats, axis):
+    return jnp.repeat(x, repeats, axis=axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        repeats = tuple(repeats.tolist())
+    return _repeat_interleave(x, repeats=repeats, axis=None if axis is None else int(axis))
+
+
+@primitive("one_hot_op")
+def _one_hot(x, *, num_classes, dtype):
+    return jax.nn.one_hot(x, num_classes, dtype=dtype)
+
+
+def one_hot(x, num_classes, name=None):
+    return _one_hot(x, num_classes=int(num_classes), dtype=dtype_mod.get_default_dtype())
+
+
+@primitive("getitem")
+def _getitem_static(x, *, idx):
+    return x[idx]
+
+
+@primitive("getitem_dyn")
+def _getitem_dyn(x, *dyn, tmpl):
+    it = iter(dyn)
+    full = tuple(next(it) if e == _DYN else e for e in tmpl)
+    return x[full]
+
+
+class _Slice:
+    """Hashable stand-in for slice objects inside attr keys."""
+
+    __slots__ = ("start", "stop", "step")
+
+    def __init__(self, s):
+        self.start, self.stop, self.step = s.start, s.stop, s.step
+
+
+def _encode_idx(idx):
+    """Split an index tuple into (static template, dynamic tensor args)."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    tmpl, dyn = [], []
+    for e in idx:
+        if isinstance(e, Tensor):
+            dyn.append(e)
+            tmpl.append(_DYN)
+        elif isinstance(e, (np.ndarray, jax.Array)):
+            dyn.append(Tensor(jnp.asarray(e)))
+            tmpl.append(_DYN)
+        elif isinstance(e, (int, np.integer)):
+            tmpl.append(int(e))
+        elif isinstance(e, (builtins.slice, type(None), type(Ellipsis), bool)):
+            tmpl.append(e)
+        elif isinstance(e, (list,)):
+            dyn.append(Tensor(jnp.asarray(e)))
+            tmpl.append(_DYN)
+        else:
+            raise TypeError(f"Unsupported index element: {e!r}")
+    return tuple(tmpl), dyn
+
+
+def getitem(x, idx):
+    tmpl, dyn = _encode_idx(idx)
+    if dyn:
+        return _getitem_dyn(x, *dyn, tmpl=tmpl)
+    # slices aren't hashable keys pre-3.12; rebuild tuple inside via attr encoding
+    enc = tuple(("slice", e.start, e.stop, e.step) if isinstance(e, builtins.slice) else e for e in tmpl)
+    return _getitem_enc(x, idx=enc)
+
+
+@primitive("getitem_enc")
+def _getitem_enc(x, *, idx):
+    dec = tuple(builtins.slice(e[1], e[2], e[3]) if isinstance(e, tuple) and e and e[0] == "slice" else e for e in idx)
+    return x[dec]
+
+
+@primitive("setitem_enc")
+def _setitem_enc(x, v, *, idx):
+    dec = tuple(builtins.slice(e[1], e[2], e[3]) if isinstance(e, tuple) and e and e[0] == "slice" else e for e in idx)
+    return x.at[dec].set(v.astype(x.dtype))
+
+
+@primitive("setitem_dyn")
+def _setitem_dyn(x, v, *dyn, tmpl):
+    it = iter(dyn)
+    full = tuple(next(it) if e == _DYN else e for e in tmpl)
+    return x.at[full].set(v.astype(x.dtype))
+
+
+def setitem(x, idx, value):
+    if not isinstance(value, Tensor):
+        value = Tensor(jnp.asarray(value))
+    tmpl, dyn = _encode_idx(idx)
+    if dyn:
+        new = _setitem_dyn(x, value, *dyn, tmpl=tmpl)
+    else:
+        enc = tuple(("slice", e.start, e.stop, e.step) if isinstance(e, builtins.slice) else e for e in tmpl)
+        new = _setitem_enc(x, value, idx=enc)
+    x._rebind(new)
+    return x
+
+
+@primitive("as_real")
+def _as_real(x):
+    return jnp.stack([jnp.real(x), jnp.imag(x)], axis=-1)
+
+
+def as_real(x, name=None):
+    return _as_real(x)
+
+
+@primitive("as_complex")
+def _as_complex(x):
+    return jax.lax.complex(x[..., 0], x[..., 1])
+
+
+def as_complex(x, name=None):
+    return _as_complex(x)
+
+
+@primitive("moveaxis_op")
+def _moveaxis(x, *, source, destination):
+    return jnp.moveaxis(x, source, destination)
+
+
+def moveaxis(x, source, destination, name=None):
+    s = tuple(source) if isinstance(source, (list, tuple)) else int(source)
+    d = tuple(destination) if isinstance(destination, (list, tuple)) else int(destination)
+    return _moveaxis(x, source=s, destination=d)
+
+
+@primitive("slice_op")
+def _slice_op(x, *, axes, starts, ends):
+    out = x
+    for ax, st, en in zip(axes, starts, ends):
+        sl = [slice(None)] * x.ndim
+        sl[ax] = builtins.slice(st, en)
+        out = out[tuple(sl)]
+    return out
+
+
+def slice(x, axes, starts, ends):
+    return _slice_op(x, axes=tuple(int(a) for a in axes), starts=tuple(int(s) for s in starts),
+                     ends=tuple(int(e) for e in ends))
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int32))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    shard_size = (index_num + nshards - 1) // nshards
+    return _shard_index(input, shard_size=shard_size, shard_id=int(shard_id), ignore_value=int(ignore_value))
+
+
+@primitive("shard_index_op", nondiff=True)
+def _shard_index(x, *, shard_size, shard_id, ignore_value):
+    in_shard = (x // shard_size) == shard_id
+    return jnp.where(in_shard, x % shard_size, ignore_value)

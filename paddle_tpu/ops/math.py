@@ -1,0 +1,347 @@
+"""Elementwise / scalar math ops (paddle.tensor.math equivalents).
+
+Reference surface: python/paddle/tensor/math.py (dual-path _C_ops/append_op);
+here every op is one pure jax primitive dispatched through the jit cache.
+Binary ops follow the reference's scalar-promotion rule: a python scalar adopts
+the tensor's dtype when compatible (float scalar + int tensor promotes to the
+default float dtype).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+_THIS = globals()
+
+
+def _scalar_operand(x, other):
+    """Convert a python scalar operand to an array with paddle-style promotion."""
+    dt = x.dtype if isinstance(x, Tensor) else np.dtype(np.asarray(x).dtype)
+    if isinstance(other, bool):
+        return jnp.asarray(other)
+    if isinstance(other, int):
+        if dtype_mod.is_floating(dt) or dtype_mod.is_integer(dt):
+            return jnp.asarray(other, dt)
+        return jnp.asarray(other)
+    if isinstance(other, float):
+        if dtype_mod.is_floating(dt):
+            return jnp.asarray(other, dt)
+        return jnp.asarray(other, dtype_mod.get_default_dtype())
+    if isinstance(other, complex):
+        return jnp.asarray(other)
+    return other
+
+
+_BINARY = {
+    "add": jnp.add,
+    "subtract": jnp.subtract,
+    "multiply": jnp.multiply,
+    "divide": jnp.true_divide,
+    "floor_divide": jnp.floor_divide,
+    "remainder": jnp.remainder,
+    "pow_t": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "fmax": jnp.fmax,
+    "fmin": jnp.fmin,
+    "atan2": jnp.arctan2,
+    "heaviside": jnp.heaviside,
+    "logaddexp": jnp.logaddexp,
+    "hypot": jnp.hypot,
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+}
+
+for _name, _jfn in _BINARY.items():
+    _p = primitive(_name)(lambda x, y, _f=_jfn: _f(x, y))
+
+    def _make(pname):
+        from ..core.dispatch import get_primitive
+
+        def fn(x, y, name=None):
+            if not isinstance(x, Tensor) and isinstance(y, Tensor):
+                x = _scalar_operand(y, x)
+            if not isinstance(y, Tensor) and isinstance(x, Tensor):
+                y = _scalar_operand(x, y)
+            return get_primitive(pname)(x, y)
+
+        fn.__name__ = pname
+        return fn
+
+    _THIS[_name] = _make(_name)
+
+mod = _THIS["remainder"]
+floor_mod = _THIS["remainder"]
+
+
+def pow(x, y, name=None):
+    return _THIS["pow_t"](x, y)
+
+
+_UNARY = {
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "log": jnp.log,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "sqrt": jnp.sqrt,
+    "rsqrt": jax.lax.rsqrt,
+    "abs": jnp.abs,
+    "neg": jnp.negative,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "tanh": jnp.tanh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "reciprocal": jnp.reciprocal,
+    "square": jnp.square,
+    "erf": jax.lax.erf,
+    "erfinv": jax.lax.erf_inv,
+    "sigmoid": jax.nn.sigmoid,
+    "digamma": jax.scipy.special.digamma,
+    "lgamma": jax.scipy.special.gammaln,
+    "i0": jnp.i0,
+    "frac": lambda x: x - jnp.trunc(x),
+    "rad2deg": jnp.rad2deg,
+    "deg2rad": jnp.deg2rad,
+    "conj": jnp.conj,
+    "angle": jnp.angle,
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "assign": lambda x: x + 0 if jnp.issubdtype(x.dtype, jnp.number) else jnp.copy(x),
+    "logit": jax.scipy.special.logit,
+}
+
+for _name, _jfn in _UNARY.items():
+    _p = primitive(_name)(lambda x, _f=_jfn: _f(x))
+
+    def _make_u(pname):
+        from ..core.dispatch import get_primitive
+
+        def fn(x, name=None):
+            return get_primitive(pname)(x)
+
+        fn.__name__ = pname
+        return fn
+
+    _THIS[_name] = _make_u(_name)
+
+negative = _THIS["neg"]
+
+_UNARY_NONDIFF = {
+    "isnan": jnp.isnan,
+    "isinf": jnp.isinf,
+    "isfinite": jnp.isfinite,
+    "logical_not": jnp.logical_not,
+    "bitwise_not": jnp.bitwise_not,
+}
+for _name, _jfn in _UNARY_NONDIFF.items():
+    _p = primitive(_name, nondiff=True)(lambda x, _f=_jfn: _f(x))
+
+    def _make_un(pname):
+        from ..core.dispatch import get_primitive
+
+        def fn(x, name=None):
+            return get_primitive(pname)(x)
+
+        fn.__name__ = pname
+        return fn
+
+    _THIS[_name] = _make_un(_name)
+
+_BINARY_NONDIFF = {
+    "logical_and": jnp.logical_and,
+    "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+    "bitwise_and": jnp.bitwise_and,
+    "bitwise_or": jnp.bitwise_or,
+    "bitwise_xor": jnp.bitwise_xor,
+}
+for _name, _jfn in _BINARY_NONDIFF.items():
+    _p = primitive(_name, nondiff=True)(lambda x, y, _f=_jfn: _f(x, y))
+
+    def _make_bn(pname):
+        from ..core.dispatch import get_primitive
+
+        def fn(x, y, name=None):
+            return get_primitive(pname)(x, y)
+
+        fn.__name__ = pname
+        return fn
+
+    _THIS[_name] = _make_bn(_name)
+
+
+@primitive("scale")
+def _scale(x, *, scale, bias, bias_after_scale):
+    if bias_after_scale:
+        return scale * x + bias
+    return scale * (x + bias)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s = scale.item() if isinstance(scale, Tensor) else float(scale)
+    return _scale(x, scale=s, bias=float(bias), bias_after_scale=bool(bias_after_scale))
+
+
+@primitive("clip")
+def _clip(x, *, min, max):
+    return jnp.clip(x, min, max)
+
+
+def clip(x, min=None, max=None, name=None):
+    mn = min.item() if isinstance(min, Tensor) else min
+    mx = max.item() if isinstance(max, Tensor) else max
+    return _clip(x, min=mn, max=mx)
+
+
+@primitive("add_n")
+def _add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    return _add_n(*inputs)
+
+
+@primitive("cumsum")
+def _cumsum(x, *, axis):
+    if axis is None:
+        return jnp.cumsum(x.reshape(-1))
+    return jnp.cumsum(x, axis)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    out = _cumsum(x, axis=axis if axis is None else int(axis))
+    if dtype is not None:
+        from . import manipulation as _manip
+
+        out = _manip.cast(out, dtype)
+    return out
+
+
+@primitive("cumprod")
+def _cumprod(x, *, dim):
+    return jnp.cumprod(x, dim)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    out = _cumprod(x, dim=int(dim))
+    if dtype is not None:
+        from . import manipulation as _manip
+
+        out = _manip.cast(out, dtype)
+    return out
+
+
+@primitive("cummax")
+def _cummax(x, *, axis):
+    return jax.lax.cummax(x, axis=axis)
+
+
+@primitive("cummin")
+def _cummin(x, *, axis):
+    return jax.lax.cummin(x, axis=axis)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    vals = _cummax(x if axis is not None else x.reshape([-1]), axis=0 if axis is None else ax)
+    return vals
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    ax = -1 if axis is None else int(axis)
+    return _cummin(x if axis is not None else x.reshape([-1]), axis=0 if axis is None else ax)
+
+
+@primitive("lerp")
+def _lerp(x, y, w):
+    return x + w * (y - x)
+
+
+def lerp(x, y, weight, name=None):
+    if not isinstance(weight, Tensor):
+        weight = _scalar_operand(x, float(weight))
+    return _lerp(x, y, weight)
+
+
+@primitive("stanh")
+def _stanh(x, *, scale_a, scale_b):
+    return scale_b * jnp.tanh(scale_a * x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _stanh(x, scale_a=float(scale_a), scale_b=float(scale_b))
+
+
+@primitive("multiply_add")
+def _multiply_add(x, y, z):
+    return x * y + z
+
+
+def multiply_add(x, y, z):
+    return _multiply_add(x, y, z)
+
+
+@primitive("kron")
+def _kron(x, y):
+    return jnp.kron(x, y)
+
+
+def kron(x, y, name=None):
+    return _kron(x, y)
+
+
+@primitive("trace_op")
+def _trace(x, *, offset, axis1, axis2):
+    return jnp.trace(x, offset, axis1, axis2)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _trace(x, offset=int(offset), axis1=int(axis1), axis2=int(axis2))
+
+
+@primitive("diff")
+def _diff(x, *, n, axis):
+    return jnp.diff(x, n=n, axis=axis)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    if prepend is not None or append is not None:
+        raise NotImplementedError("diff prepend/append")
+    return _diff(x, n=int(n), axis=int(axis))
+
+
+@primitive("nan_to_num")
+def _nan_to_num(x, *, nan, posinf, neginf):
+    return jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _nan_to_num(x, nan=float(nan), posinf=posinf, neginf=neginf)

@@ -1,0 +1,127 @@
+"""Reduction ops (paddle.tensor.math reduce_* / stat equivalents)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+
+
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _make_reduce(name, jfn, nondiff=False):
+    p = primitive(name, nondiff=nondiff)(
+        lambda x, _f=jfn, *, axis, keepdim: _f(x, axis=axis, keepdims=keepdim)
+    )
+
+    def fn(x, axis=None, keepdim=False, name=None):
+        return p(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+    fn.__name__ = name
+    return fn
+
+
+sum = _make_reduce("reduce_sum", jnp.sum)
+mean = _make_reduce("reduce_mean", jnp.mean)
+prod = _make_reduce("reduce_prod", jnp.prod)
+max = _make_reduce("reduce_max", jnp.max)
+min = _make_reduce("reduce_min", jnp.min)
+amax = _make_reduce("reduce_amax", jnp.max)
+amin = _make_reduce("reduce_amin", jnp.min)
+all = _make_reduce("reduce_all", jnp.all, nondiff=True)
+any = _make_reduce("reduce_any", jnp.any, nondiff=True)
+nansum = _make_reduce("reduce_nansum", jnp.nansum)
+nanmean = _make_reduce("reduce_nanmean", jnp.nanmean)
+
+
+@primitive("logsumexp")
+def _logsumexp(x, *, axis, keepdim):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdim)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _logsumexp(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@primitive("reduce_std")
+def _std(x, *, axis, unbiased, keepdim):
+    return jnp.std(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _std(x, axis=_norm_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+@primitive("reduce_var")
+def _var(x, *, axis, unbiased, keepdim):
+    return jnp.var(x, axis=axis, ddof=1 if unbiased else 0, keepdims=keepdim)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _var(x, axis=_norm_axis(axis), unbiased=bool(unbiased), keepdim=bool(keepdim))
+
+
+@primitive("arg_max", nondiff=True)
+def _argmax(x, *, axis, keepdim, dtype):
+    if axis is None:
+        out = jnp.argmax(x.reshape(-1))
+        return out.astype(dtype)
+    out = jnp.argmax(x, axis=axis, keepdims=keepdim)
+    return out.astype(dtype)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmax(
+        x, axis=_norm_axis(axis), keepdim=bool(keepdim), dtype=dtype_mod.convert_dtype(dtype)
+    )
+
+
+@primitive("arg_min", nondiff=True)
+def _argmin(x, *, axis, keepdim, dtype):
+    if axis is None:
+        return jnp.argmin(x.reshape(-1)).astype(dtype)
+    return jnp.argmin(x, axis=axis, keepdims=keepdim).astype(dtype)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    return _argmin(
+        x, axis=_norm_axis(axis), keepdim=bool(keepdim), dtype=dtype_mod.convert_dtype(dtype)
+    )
+
+
+@primitive("median")
+def _median(x, *, axis, keepdim):
+    return jnp.median(x, axis=axis, keepdims=keepdim)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return _median(x, axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@primitive("quantile")
+def _quantile(x, *, q, axis, keepdim):
+    return jnp.quantile(x, jnp.asarray(q), axis=axis, keepdims=keepdim)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return _quantile(x, q=float(q) if np.isscalar(q) else tuple(q), axis=_norm_axis(axis), keepdim=bool(keepdim))
+
+
+@primitive("count_nonzero", nondiff=True)
+def _count_nonzero(x, *, axis, keepdim):
+    return jnp.count_nonzero(x, axis=axis, keepdims=keepdim).astype(jnp.int32)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _count_nonzero(x, axis=_norm_axis(axis), keepdim=bool(keepdim))

@@ -1,0 +1,15 @@
+"""Test harness config.
+
+Tests run on a virtual 8-device CPU mesh (the reference tests distributed code
+multi-process on one host, test_dist_base.py:783; we test multi-chip SPMD with
+XLA's forced host device count instead). Must run before jax creates backends.
+"""
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,159 @@
+"""Autograd engine tests: numeric gradients vs analytic (reference: check_grad
+finite-difference strategy in op_test.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar f at numpy point x."""
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        old = flat[i]
+        flat[i] = old + eps
+        fp = f(x)
+        flat[i] = old - eps
+        fm = f(x)
+        flat[i] = old
+        gf[i] = (fp - fm) / (2 * eps)
+    return g
+
+
+def check_grad(op, x_np, tol=1e-2):
+    t = paddle.to_tensor(x_np, stop_gradient=False)
+    y = op(t)
+    loss = y.sum()
+    loss.backward()
+
+    def f(xv):
+        return float(op(paddle.to_tensor(xv.astype("float32"))).sum().numpy())
+
+    ng = numeric_grad(f, x_np.astype("float64").copy())
+    np.testing.assert_allclose(t.grad.numpy(), ng, rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize(
+    "op",
+    [
+        lambda x: x * x,
+        lambda x: x.exp(),
+        lambda x: (x + 1.5).log(),
+        lambda x: x.tanh(),
+        lambda x: x.sigmoid(),
+        lambda x: (x * x + 1.0).sqrt(),
+        lambda x: x.abs(),
+        lambda x: x.square() * 0.5 + x * 2.0,
+    ],
+)
+def test_unary_grads(op):
+    rng = np.random.RandomState(0)
+    check_grad(op, rng.uniform(0.2, 1.5, (3, 4)).astype("float32"))
+
+
+def test_matmul_grad():
+    rng = np.random.RandomState(1)
+    a = rng.randn(3, 4).astype("float32")
+    b = rng.randn(4, 2).astype("float32")
+    x = paddle.to_tensor(a, stop_gradient=False)
+    y = paddle.to_tensor(b, stop_gradient=False)
+    out = paddle.matmul(x, y).sum()
+    out.backward()
+    go = np.ones((3, 2), dtype="float32")
+    np.testing.assert_allclose(x.grad.numpy(), go @ b.T, rtol=1e-5)
+    np.testing.assert_allclose(y.grad.numpy(), a.T @ go, rtol=1e-5)
+
+
+def test_broadcast_grad():
+    x = paddle.to_tensor(np.ones((3, 4), "float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.ones((4,), "float32"), stop_gradient=False)
+    (x + b).sum().backward()
+    np.testing.assert_array_equal(b.grad.numpy(), [3, 3, 3, 3])
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y1 = x * 3
+    y2 = x * 4
+    y1.backward()
+    y2.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+    x.clear_grad()
+    assert x.grad is None
+
+
+def test_shared_input_grad():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    (x * x).backward()  # d(x^2)/dx = 2x
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_stop_gradient_blocks():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = (x * 2).detach()
+    z = y * 3
+    assert z.stop_gradient
+
+
+def test_no_grad_context():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    y2 = x * 2
+    assert not y2.stop_gradient
+
+
+def test_multi_output_grad():
+    x = paddle.to_tensor(np.arange(6, dtype="float32"), stop_gradient=False)
+    parts = paddle.split(x, 3)
+    # only use one piece; other outputs get zero cotangents
+    parts[1].sum().backward()
+    np.testing.assert_array_equal(x.grad.numpy(), [0, 0, 1, 1, 0, 0])
+
+
+def test_reduction_grads():
+    x = paddle.to_tensor(np.random.rand(3, 4).astype("float32"), stop_gradient=False)
+    x.mean().backward()
+    np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 1 / 12), rtol=1e-6)
+
+    y = paddle.to_tensor(np.array([[1.0, 5.0], [7.0, 2.0]], "float32"), stop_gradient=False)
+    y.max().backward()
+    np.testing.assert_array_equal(y.grad.numpy(), [[0, 0], [1, 0]])
+
+
+def test_chain_deep():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = x
+    for _ in range(20):
+        y = y * 1.1
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.1**20], rtol=1e-4)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), [12.0], rtol=1e-5)
+    assert x.grad is None  # paddle.grad must not pollute .grad
+
+
+def test_backward_with_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.backward(paddle.to_tensor([1.0, 10.0]))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 20.0])
+
+
+def test_gather_embedding_style_grad():
+    w = paddle.to_tensor(np.random.rand(10, 4).astype("float32"), stop_gradient=False)
+    idx = paddle.to_tensor([1, 1, 3])
+    out = paddle.gather(w, idx)
+    out.sum().backward()
+    g = w.grad.numpy()
+    assert g[1].sum() == 8  # picked twice
+    assert g[3].sum() == 4
+    assert g[0].sum() == 0

@@ -1,0 +1,193 @@
+"""Op corpus vs numpy oracle (OpTest-style, reference op_test.py:284)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+rng = np.random.RandomState(7)
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(a, stop_gradient=sg)
+
+
+def test_binary_math():
+    a = rng.rand(3, 4).astype("float32") + 0.5
+    b = rng.rand(3, 4).astype("float32") + 0.5
+    np.testing.assert_allclose(paddle.add(t(a), t(b)).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose(paddle.subtract(t(a), t(b)).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose(paddle.multiply(t(a), t(b)).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose(paddle.divide(t(a), t(b)).numpy(), a / b, rtol=1e-6)
+    np.testing.assert_allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+    np.testing.assert_allclose(paddle.pow(t(a), 2.0).numpy(), a**2, rtol=1e-5)
+    np.testing.assert_allclose(paddle.atan2(t(a), t(b)).numpy(), np.arctan2(a, b), rtol=1e-5)
+
+
+def test_scalar_promotion_keeps_dtype():
+    x = t(np.ones((2, 2), "float32"))
+    assert (x + 1).dtype == paddle.float32
+    assert (x * 2.5).dtype == paddle.float32
+    xb = x.cast("bfloat16")
+    assert (xb + 1.5).dtype == paddle.bfloat16
+    xi = t(np.ones((2,), "int32"))
+    assert (xi + 1).dtype == paddle.int32
+
+
+def test_unary_math():
+    a = rng.rand(4, 3).astype("float32") + 0.1
+    np.testing.assert_allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-6)
+    np.testing.assert_allclose(paddle.rsqrt(t(a)).numpy(), 1 / np.sqrt(a), rtol=1e-5)
+    np.testing.assert_allclose(paddle.log(t(a)).numpy(), np.log(a), rtol=1e-5)
+    np.testing.assert_allclose(paddle.floor(t(a * 10)).numpy(), np.floor(a * 10))
+    np.testing.assert_allclose(paddle.erf(t(a)).numpy(), np.vectorize(_erf)(a), rtol=1e-5)
+    np.testing.assert_allclose(paddle.square(t(a)).numpy(), a * a, rtol=1e-6)
+
+
+def _erf(x):
+    import math
+
+    return math.erf(x)
+
+
+def test_reductions():
+    a = rng.rand(3, 4, 5).astype("float32")
+    np.testing.assert_allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.mean(t(a), axis=[0, 2], keepdim=True).numpy(),
+        a.mean((0, 2), keepdims=True),
+        rtol=1e-5,
+    )
+    np.testing.assert_allclose(paddle.max(t(a), axis=2).numpy(), a.max(2))
+    np.testing.assert_allclose(paddle.prod(t(a), axis=0).numpy(), a.prod(0), rtol=1e-5)
+    np.testing.assert_allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.var(t(a)).numpy(), a.var(ddof=1), rtol=1e-5)
+    np.testing.assert_allclose(paddle.logsumexp(t(a), axis=1).numpy(),
+                               np.log(np.exp(a).sum(1)), rtol=1e-5)
+    assert paddle.argmax(t(a)).item() == a.argmax()
+    np.testing.assert_array_equal(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+
+
+def test_manipulation():
+    a = np.arange(24).reshape(2, 3, 4).astype("float32")
+    np.testing.assert_array_equal(paddle.reshape(t(a), [4, 6]).numpy(), a.reshape(4, 6))
+    np.testing.assert_array_equal(
+        paddle.transpose(t(a), [2, 0, 1]).numpy(), a.transpose(2, 0, 1)
+    )
+    np.testing.assert_array_equal(paddle.flatten(t(a), 1).numpy(), a.reshape(2, 12))
+    np.testing.assert_array_equal(
+        paddle.squeeze(t(a.reshape(2, 1, 3, 4)), axis=1).numpy(), a.reshape(2, 3, 4)
+    )
+    np.testing.assert_array_equal(paddle.unsqueeze(t(a), 0).numpy(), a[None])
+    np.testing.assert_array_equal(
+        paddle.concat([t(a), t(a)], axis=1).numpy(), np.concatenate([a, a], 1)
+    )
+    np.testing.assert_array_equal(
+        paddle.stack([t(a), t(a)], axis=0).numpy(), np.stack([a, a])
+    )
+    parts = paddle.split(t(a), 3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == [2, 1, 4]
+    parts2 = paddle.split(t(a), [1, -1], axis=1)
+    assert parts2[1].shape == [2, 2, 4]
+    np.testing.assert_array_equal(paddle.tile(t(a[0]), [2, 1]).numpy(), np.tile(a[0], (2, 1)))
+    np.testing.assert_array_equal(
+        paddle.expand(t(np.ones((1, 4), "float32")), [3, 4]).numpy(), np.ones((3, 4))
+    )
+    np.testing.assert_array_equal(paddle.flip(t(a), [0]).numpy(), a[::-1])
+    np.testing.assert_array_equal(paddle.roll(t(a), 1, 0).numpy(), np.roll(a, 1, 0))
+
+
+def test_gather_scatter():
+    a = rng.rand(5, 3).astype("float32")
+    idx = np.array([0, 3], "int32")
+    np.testing.assert_array_equal(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+    nd_idx = np.array([[0, 1], [2, 2]], "int32")
+    np.testing.assert_array_equal(
+        paddle.gather_nd(t(a), t(nd_idx)).numpy(), a[[0, 2], [1, 2]]
+    )
+    base = np.zeros((5, 3), "float32")
+    upd = np.ones((2, 3), "float32")
+    out = paddle.scatter(t(base), t(idx), t(upd))
+    expect = base.copy()
+    expect[idx] = 1
+    np.testing.assert_array_equal(out.numpy(), expect)
+
+
+def test_where_sort_topk():
+    a = rng.rand(4, 5).astype("float32")
+    cond = a > 0.5
+    np.testing.assert_array_equal(
+        paddle.where(t(cond), t(a), t(-a)).numpy(), np.where(cond, a, -a)
+    )
+    np.testing.assert_array_equal(paddle.sort(t(a), axis=1).numpy(), np.sort(a, 1))
+    np.testing.assert_array_equal(paddle.argsort(t(a), axis=1).numpy(), np.argsort(a, 1))
+    v, i = paddle.topk(t(a), 2, axis=1)
+    np.testing.assert_allclose(v.numpy(), np.sort(a, 1)[:, ::-1][:, :2])
+
+
+def test_linalg():
+    a = rng.rand(3, 4).astype("float32")
+    b = rng.rand(4, 5).astype("float32")
+    np.testing.assert_allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-5
+    )
+    batch = rng.rand(2, 3, 4).astype("float32")
+    batch2 = rng.rand(2, 4, 5).astype("float32")
+    np.testing.assert_allclose(paddle.bmm(t(batch), t(batch2)).numpy(), batch @ batch2, rtol=1e-5)
+    np.testing.assert_allclose(paddle.t(t(a)).numpy(), a.T)
+    np.testing.assert_allclose(paddle.norm(t(a)).numpy(), np.linalg.norm(a), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", t(a), t(b)).numpy(), a @ b, rtol=1e-5
+    )
+    sym = a @ a.T + 3 * np.eye(3, dtype="float32")
+    np.testing.assert_allclose(
+        paddle.cholesky(t(sym)).numpy(), np.linalg.cholesky(sym), rtol=1e-4
+    )
+    np.testing.assert_allclose(
+        paddle.inverse(t(sym)).numpy(), np.linalg.inv(sym), rtol=1e-3, atol=1e-5
+    )
+
+
+def test_comparison_and_logic():
+    a = np.array([1.0, 2.0, 3.0], "float32")
+    b = np.array([2.0, 2.0, 2.0], "float32")
+    np.testing.assert_array_equal(paddle.equal(t(a), t(b)).numpy(), a == b)
+    np.testing.assert_array_equal(paddle.greater_than(t(a), t(b)).numpy(), a > b)
+    assert paddle.allclose(t(a), t(a)).item()
+    assert not paddle.equal_all(t(a), t(b)).item()
+    x = np.array([True, False])
+    y = np.array([True, True])
+    np.testing.assert_array_equal(paddle.logical_and(t(x), t(y)).numpy(), x & y)
+    np.testing.assert_array_equal(paddle.logical_not(t(x)).numpy(), ~x)
+
+
+def test_cumsum_clip_lerp():
+    a = rng.rand(3, 4).astype("float32")
+    np.testing.assert_allclose(paddle.cumsum(t(a), axis=1).numpy(), np.cumsum(a, 1), rtol=1e-5)
+    np.testing.assert_allclose(
+        paddle.clip(t(a), 0.2, 0.8).numpy(), np.clip(a, 0.2, 0.8)
+    )
+    np.testing.assert_allclose(
+        paddle.lerp(t(a), t(a * 2), 0.5).numpy(), a * 1.5, rtol=1e-6
+    )
+    np.testing.assert_allclose(paddle.add_n([t(a), t(a), t(a)]).numpy(), 3 * a, rtol=1e-6)
+
+
+def test_one_hot_pad():
+    labels = np.array([0, 2, 1], "int32")
+    oh = paddle.one_hot(t(labels), 3)
+    np.testing.assert_array_equal(oh.numpy(), np.eye(3, dtype="float32")[labels])
+    a = np.ones((1, 1, 2, 2), "float32")
+    padded = paddle.pad(t(a), [1, 1, 1, 1])
+    assert padded.shape == [1, 1, 4, 4]
+
+
+def test_host_dynamic_ops():
+    a = np.array([[0.0, 1.0], [2.0, 0.0]], "float32")
+    nz = paddle.nonzero(t(a))
+    np.testing.assert_array_equal(nz.numpy(), np.stack(np.nonzero(a), 1))
+    m = paddle.masked_select(t(a), t(a > 0))
+    np.testing.assert_array_equal(m.numpy(), a[a > 0])
+    u = paddle.unique(t(np.array([3, 1, 3, 2], "int32")))
+    np.testing.assert_array_equal(u.numpy(), [1, 2, 3])

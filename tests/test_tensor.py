@@ -1,0 +1,118 @@
+"""Tensor basics: creation, metadata, conversion, indexing.
+
+Models the reference's OpTest style (op_test.py:284): numpy is the oracle.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    a = np.random.rand(3, 4).astype("float32")
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert t.dtype == paddle.float32
+    np.testing.assert_allclose(t.numpy(), a)
+
+
+def test_scalar_tensor():
+    t = paddle.to_tensor(3.5)
+    assert t.item() == 3.5
+    assert float(t) == 3.5
+    assert t.shape == []
+
+
+def test_creation_ops():
+    np.testing.assert_array_equal(paddle.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+    np.testing.assert_array_equal(paddle.ones([2]).numpy(), np.ones(2))
+    np.testing.assert_array_equal(paddle.full([2, 2], 7).numpy(), np.full((2, 2), 7))
+    np.testing.assert_array_equal(paddle.arange(5).numpy(), np.arange(5))
+    np.testing.assert_array_equal(paddle.eye(3).numpy(), np.eye(3))
+    x = paddle.to_tensor([[1.0, 2.0], [3.0, 4.0]])
+    np.testing.assert_array_equal(paddle.zeros_like(x).numpy(), np.zeros((2, 2)))
+    np.testing.assert_array_equal(paddle.ones_like(x).numpy(), np.ones((2, 2)))
+    np.testing.assert_allclose(
+        paddle.linspace(0, 1, 5).numpy(), np.linspace(0, 1, 5), rtol=1e-6
+    )
+    np.testing.assert_array_equal(
+        paddle.tril(paddle.ones([3, 3])).numpy(), np.tril(np.ones((3, 3)))
+    )
+
+
+def test_random_creation_shapes():
+    assert paddle.rand([2, 3]).shape == [2, 3]
+    assert paddle.randn([4]).shape == [4]
+    r = paddle.randint(0, 10, [100])
+    assert (r.numpy() >= 0).all() and (r.numpy() < 10).all()
+    u = paddle.uniform([50], min=2.0, max=3.0)
+    assert (u.numpy() >= 2.0).all() and (u.numpy() <= 3.0).all()
+    p = paddle.randperm(10)
+    assert sorted(p.numpy().tolist()) == list(range(10))
+
+
+def test_seed_reproducibility():
+    paddle.seed(42)
+    a = paddle.randn([5]).numpy()
+    paddle.seed(42)
+    b = paddle.randn([5]).numpy()
+    np.testing.assert_array_equal(a, b)
+
+
+def test_getitem_static():
+    a = np.arange(24).reshape(2, 3, 4).astype("float32")
+    t = paddle.to_tensor(a)
+    np.testing.assert_array_equal(t[0].numpy(), a[0])
+    np.testing.assert_array_equal(t[1, 2].numpy(), a[1, 2])
+    np.testing.assert_array_equal(t[:, 1:, ::2].numpy(), a[:, 1:, ::2])
+    np.testing.assert_array_equal(t[..., -1].numpy(), a[..., -1])
+    np.testing.assert_array_equal(t[None].numpy(), a[None])
+
+
+def test_getitem_tensor_index():
+    a = np.arange(20).reshape(4, 5).astype("float32")
+    t = paddle.to_tensor(a)
+    idx = paddle.to_tensor([0, 2, 3])
+    np.testing.assert_array_equal(t[idx].numpy(), a[[0, 2, 3]])
+
+
+def test_setitem():
+    t = paddle.zeros([3, 3])
+    t[1] = paddle.ones([3])
+    assert t.numpy()[1].sum() == 3
+    t[0, 0] = 5.0
+    assert t.numpy()[0, 0] == 5.0
+
+
+def test_cast():
+    t = paddle.to_tensor([1.7, 2.3])
+    i = t.cast("int32")
+    assert i.dtype == paddle.int32
+    np.testing.assert_array_equal(i.numpy(), [1, 2])
+
+
+def test_inplace_ops():
+    t = paddle.ones([3])
+    t.add_(paddle.ones([3]))
+    np.testing.assert_array_equal(t.numpy(), [2, 2, 2])
+    t.zero_()
+    np.testing.assert_array_equal(t.numpy(), [0, 0, 0])
+    t.fill_(7)
+    np.testing.assert_array_equal(t.numpy(), [7, 7, 7])
+
+
+def test_comparison_dunders():
+    a = paddle.to_tensor([1.0, 2.0, 3.0])
+    b = paddle.to_tensor([2.0, 2.0, 2.0])
+    np.testing.assert_array_equal((a < b).numpy(), [True, False, False])
+    np.testing.assert_array_equal((a == b).numpy(), [False, True, False])
+    np.testing.assert_array_equal((a >= 2).numpy(), [False, True, True])
+
+
+def test_default_dtype():
+    assert paddle.get_default_dtype() == paddle.float32
+    paddle.set_default_dtype("bfloat16")
+    try:
+        assert paddle.ones([2]).dtype == paddle.bfloat16
+    finally:
+        paddle.set_default_dtype("float32")
