@@ -81,7 +81,10 @@ def _try_import(names):
             pass
 
 
-_try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision", "distributed"])
+_try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
+              "distributed", "regularizer", "autograd", "profiler", "text",
+              "distribution", "static", "incubate", "device"])
+from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 
 try:
     from .framework.io import save, load  # noqa: F401,E402

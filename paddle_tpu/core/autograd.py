@@ -170,7 +170,10 @@ def _accumulate_leaf(t, g):
 
 
 def _is_float0(g):
-    return getattr(g, "dtype", None) is not None and str(g.dtype) == "float0"
+    import jax
+
+    dt = getattr(g, "dtype", None)
+    return dt is not None and dt == jax.dtypes.float0
 
 
 def _raw(x):

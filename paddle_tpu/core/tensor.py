@@ -159,6 +159,29 @@ class Tensor:
         self.data = arr.astype(self.data.dtype)
         return self
 
+    def __deepcopy__(self, memo):
+        import copy
+
+        cls = type(self)
+        new = cls.__new__(cls)
+        memo[id(self)] = new
+        for holder in cls.__mro__:
+            for s in getattr(holder, "__slots__", ()):
+                if s == "__weakref__":
+                    continue
+                try:
+                    v = getattr(self, s)
+                except AttributeError:
+                    continue
+                if isinstance(v, jax.Array) or s in ("_grad_node",):
+                    object.__setattr__(new, s, v if s != "_grad_node" else None)
+                else:
+                    object.__setattr__(new, s, copy.deepcopy(v, memo))
+        # fresh identity: copies must not collide in name-keyed stores
+        # (optimizer state_dict keys are f"{param.name}_{slot}")
+        new.name = f"{self.name}.copy_{next(_name_counter)}"
+        return new
+
     def __repr__(self):
         grad_info = "" if self.stop_gradient else ", stop_gradient=False"
         return (

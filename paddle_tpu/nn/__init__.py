@@ -1,0 +1,35 @@
+"""paddle_tpu.nn (reference surface: python/paddle/nn/)."""
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+
+from .layer.layers import Layer, Parameter, ParamAttr  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Linear, Embedding, Dropout, Dropout2D, Flatten, Identity, Pad2D, Upsample,
+    PixelShuffle, CosineSimilarity, Bilinear,
+)
+from .layer.conv import Conv1D, Conv2D, Conv2DTranspose  # noqa: F401
+from .layer.norm import (  # noqa: F401
+    LayerNorm, RMSNorm, BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D,
+    SyncBatchNorm, GroupNorm, InstanceNorm2D, LocalResponseNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool2D, AvgPool2D, AdaptiveAvgPool2D, AdaptiveMaxPool2D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Silu, Swish, Mish, Hardswish, Hardsigmoid,
+    Softsign, Tanhshrink, LogSigmoid, LeakyReLU, ELU, SELU, CELU, Hardtanh,
+    Hardshrink, Softshrink, Softplus, ThresholdedReLU, Softmax, LogSoftmax,
+    PReLU, Maxout,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss,
+)
+from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
+

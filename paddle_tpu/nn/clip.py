@@ -1,0 +1,52 @@
+"""Gradient clipping (reference: python/paddle/fluid/clip.py —
+ClipGradByValue/Norm/GlobalNorm). Each exposes ``_apply_jax(list_of_grads)``,
+a pure function composed into the optimizer's fused jitted step."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class ClipGradBase:
+    def _apply_jax(self, grads):
+        raise NotImplementedError
+
+    def __call__(self, params_grads):
+        # static-graph style API compat: list of (param, grad) tensors
+        from ..core.tensor import Tensor
+
+        gs = [g.data for _, g in params_grads]
+        new = self._apply_jax(gs)
+        return [(p, Tensor(g)) for (p, _), g in zip(params_grads, new)]
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -float(max)
+
+    def _apply_jax(self, grads):
+        return [jnp.clip(g, self.min, self.max) for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _apply_jax(self, grads):
+        out = []
+        for g in grads:
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12), 1.0)
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group", auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _apply_jax(self, grads):
+        sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in grads)
+        gnorm = jnp.sqrt(sq)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+        return [(g.astype(jnp.float32) * scale).astype(g.dtype) for g in grads]

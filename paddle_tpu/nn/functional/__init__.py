@@ -1,0 +1,7 @@
+"""nn.functional: the functional op surface (reference: python/paddle/nn/functional/)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+
+from ...ops.manipulation import one_hot  # noqa: F401

@@ -1,0 +1,203 @@
+"""Activation functionals (reference: python/paddle/nn/functional/activation.py).
+
+Each is one fused jax primitive -> XLA fuses into surrounding matmuls (the role
+the reference's hand-fused CUDA activation kernels play).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive, get_primitive
+from ...core.tensor import Tensor
+
+_THIS = globals()
+
+_SIMPLE = {
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh_act": jnp.tanh,
+    "silu": jax.nn.silu,
+    "swish": jax.nn.silu,
+    "softplus_d": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "hardswish": jax.nn.hard_swish,
+    "hardsigmoid": lambda x: jnp.clip(x / 6.0 + 0.5, 0.0, 1.0),
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    "log_sigmoid": jax.nn.log_sigmoid,
+}
+
+for _name, _jfn in _SIMPLE.items():
+    primitive("act_" + _name)(lambda x, _f=_jfn: _f(x))
+
+    def _make(pname, public):
+        def fn(x, name=None):
+            return get_primitive(pname)(x)
+
+        fn.__name__ = public
+        return fn
+
+    _public = {"tanh_act": "tanh", "softplus_d": "softplus"}.get(_name, _name)
+    _THIS[_public] = _make("act_" + _name, _public)
+
+
+@primitive("act_gelu")
+def _gelu(x, *, approximate):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def gelu(x, approximate=False, name=None):
+    return _gelu(x, approximate=bool(approximate))
+
+
+@primitive("act_leaky_relu")
+def _leaky_relu(x, *, negative_slope):
+    return jax.nn.leaky_relu(x, negative_slope)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _leaky_relu(x, negative_slope=float(negative_slope))
+
+
+@primitive("act_elu")
+def _elu(x, *, alpha):
+    return jax.nn.elu(x, alpha)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _elu(x, alpha=float(alpha))
+
+
+@primitive("act_celu")
+def _celu(x, *, alpha):
+    return jax.nn.celu(x, alpha)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _celu(x, alpha=float(alpha))
+
+
+@primitive("act_selu")
+def _selu(x, *, scale, alpha):
+    return scale * jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _selu(x, scale=float(scale), alpha=float(alpha))
+
+
+@primitive("act_hardtanh")
+def _hardtanh(x, *, min, max):
+    return jnp.clip(x, min, max)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _hardtanh(x, min=float(min), max=float(max))
+
+
+@primitive("act_hardshrink")
+def _hardshrink(x, *, threshold):
+    return jnp.where(jnp.abs(x) > threshold, x, 0.0)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _hardshrink(x, threshold=float(threshold))
+
+
+@primitive("act_softshrink")
+def _softshrink(x, *, threshold):
+    return jnp.where(x > threshold, x - threshold, jnp.where(x < -threshold, x + threshold, 0.0))
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _softshrink(x, threshold=float(threshold))
+
+
+@primitive("act_thresholded_relu")
+def _thresholded_relu(x, *, threshold):
+    return jnp.where(x > threshold, x, 0.0)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return _thresholded_relu(x, threshold=float(threshold))
+
+
+@primitive("act_softmax")
+def _softmax(x, *, axis):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    out = _softmax(x, axis=int(axis))
+    if dtype is not None:
+        from ...ops import manipulation
+
+        out = manipulation.cast(out, dtype)
+    return out
+
+
+@primitive("act_log_softmax")
+def _log_softmax(x, *, axis):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return _log_softmax(x, axis=int(axis))
+
+
+@primitive("act_prelu")
+def _prelu(x, weight):
+    w = weight
+    if w.ndim == 1 and w.shape[0] > 1 and x.ndim > 1:
+        # per-channel (NCHW channel axis 1)
+        shape = [1] * x.ndim
+        shape[1] = w.shape[0]
+        w = w.reshape(shape)
+    return jnp.where(x > 0, x, w * x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    return _prelu(x, weight)
+
+
+@primitive("act_glu")
+def _glu(x, *, axis):
+    a, b = jnp.split(x, 2, axis=axis)
+    return a * jax.nn.sigmoid(b)
+
+
+def glu(x, axis=-1, name=None):
+    return _glu(x, axis=int(axis))
+
+
+@primitive("act_maxout")
+def _maxout(x, *, groups, axis):
+    shape = list(x.shape)
+    c = shape[axis]
+    shape[axis : axis + 1] = [c // groups, groups]
+    return jnp.max(x.reshape(shape), axis=axis + 1)
+
+
+def maxout(x, groups, axis=1, name=None):
+    return _maxout(x, groups=int(groups), axis=int(axis) % x.ndim)
+
+
+@primitive("act_gumbel_softmax", nondiff=False)
+def _gumbel_softmax(x, key, *, temperature, hard, axis):
+    g = -jnp.log(-jnp.log(jax.random.uniform(key, x.shape, x.dtype, 1e-20, 1.0)))
+    y = jax.nn.softmax((x + g) / temperature, axis=axis)
+    if hard:
+        onehot = jax.nn.one_hot(
+            jnp.argmax(y, axis=axis), x.shape[axis], axis=axis, dtype=y.dtype)
+        y = onehot + y - jax.lax.stop_gradient(y)  # straight-through estimator
+    return y
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as random_mod
+
+    return _gumbel_softmax(
+        x, random_mod.next_key(), temperature=float(temperature), hard=bool(hard), axis=int(axis)
+    )

@@ -1,0 +1,106 @@
+"""Attention functionals.
+
+The fused-attention hot op (reference: paddle/fluid/operators/fused/
+fused_attention_op.cu + fmha_ref.h) re-designed TPU-first: a single fused
+primitive that XLA maps onto MXU matmuls, with a Pallas flash-attention kernel
+(paddle_tpu/kernels/flash_attention.py) engaged on TPU for long sequences.
+
+Layout convention (paddle's): q/k/v are [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...framework import random as random_mod
+
+
+def _sdpa_xla(q, k, v, mask, *, causal, scale, dropout_p, key=None):
+    # [b, s, h, d] -> attention over s with batched heads
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)
+    if causal:
+        qs, ks = q.shape[1], k.shape[1]
+        causal_mask = jnp.tril(jnp.ones((qs, ks), bool), k=ks - qs)
+        logits = jnp.where(causal_mask, logits, -1e30)
+    if mask is not None:
+        logits = logits + mask.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    if dropout_p > 0.0 and key is not None:
+        keep = jax.random.bernoulli(key, 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), 0.0).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+@primitive("sdpa")
+def _sdpa(q, k, v, *, causal, scale):
+    return _flash_or_xla(q, k, v, None, causal=causal, scale=scale)
+
+
+@primitive("sdpa_mask")
+def _sdpa_mask(q, k, v, mask, *, causal, scale):
+    return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale, dropout_p=0.0)
+
+
+@primitive("sdpa_dropout")
+def _sdpa_dropout(q, k, v, rngkey, *, causal, scale, dropout_p):
+    return _sdpa_xla(q, k, v, None, causal=causal, scale=scale,
+                     dropout_p=dropout_p, key=rngkey)
+
+
+@primitive("sdpa_mask_dropout")
+def _sdpa_mask_dropout(q, k, v, mask, rngkey, *, causal, scale, dropout_p):
+    return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale,
+                     dropout_p=dropout_p, key=rngkey)
+
+
+def _flash_or_xla(q, k, v, mask, *, causal, scale):
+    """Route to the Pallas flash kernel when on TPU + shapes allow."""
+    if mask is None and _use_flash(q, k):
+        try:
+            from ...kernels.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:  # pragma: no cover - fall back if kernel unavailable
+            pass
+    return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale, dropout_p=0.0)
+
+
+def _use_flash(q, k):
+    if os.environ.get("PADDLE_TPU_DISABLE_FLASH", "0") == "1":
+        return False
+    try:
+        dev = jax.devices()[0].platform
+    except Exception:
+        return False
+    if dev == "cpu":
+        return False
+    # flash kernel wants seq multiples of its block size and head_dim >= 128-friendly
+    return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] in (64, 128, 256)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
+                                 is_causal=False, training=True, scale=None, name=None):
+    """q/k/v: [batch, seq, heads, head_dim]. attn_mask: additive float mask
+    broadcastable to [b, h, sq, sk]."""
+    d = query.shape[-1]
+    s = float(scale) if scale is not None else 1.0 / math.sqrt(d)
+    if dropout_p > 0.0 and training:
+        rk = random_mod.next_key()
+        if attn_mask is None:
+            return _sdpa_dropout(query, key, value, rk, causal=bool(is_causal),
+                                 scale=s, dropout_p=float(dropout_p))
+        return _sdpa_mask_dropout(query, key, value, attn_mask, rk,
+                                  causal=bool(is_causal), scale=s, dropout_p=float(dropout_p))
+    if attn_mask is None:
+        return _sdpa(query, key, value, causal=bool(is_causal), scale=s)
+    return _sdpa_mask(query, key, value, attn_mask, causal=bool(is_causal), scale=s)
+
+
+flash_attention = scaled_dot_product_attention  # paddle.nn.functional.flash_attention alias

@@ -1,0 +1,455 @@
+"""Common nn functionals: linear, embedding, dropout, normalization, pooling,
+interpolate (reference: python/paddle/nn/functional/{common,norm,pooling}.py).
+
+Convs/pools use lax.conv_general_dilated / lax.reduce_window directly — the MXU
+path for convs, fused window reductions for pools.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...framework import random as random_mod
+from ...framework import dtype as dtype_mod
+
+
+@primitive("linear_op")
+def _linear(x, w, b):
+    return jnp.matmul(x, w) + b
+
+
+@primitive("linear_nobias_op")
+def _linear_nb(x, w):
+    return jnp.matmul(x, w)
+
+
+def linear(x, weight, bias=None, name=None):
+    if bias is None:
+        return _linear_nb(x, weight)
+    return _linear(x, weight, bias)
+
+
+@primitive("embedding_op")
+def _embedding(w, ids, *, padding_idx):
+    out = jnp.take(w, ids, axis=0)
+    if padding_idx is not None:
+        mask = (ids == padding_idx)[..., None]
+        out = jnp.where(mask, 0.0, out)
+    return out
+
+
+@_embedding.defvjp
+def _embedding_vjp(ct, out, primals, *, padding_idx):
+    w, ids = primals
+    if padding_idx is not None:
+        ct = jnp.where((ids == padding_idx)[..., None], 0.0, ct)
+    gw = jnp.zeros_like(w).at[ids].add(ct.astype(w.dtype))
+    return (gw, None)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    return _embedding(weight, x, padding_idx=padding_idx)
+
+
+@primitive("dropout_op")
+def _dropout(x, key, *, p, upscale):
+    keep = jax.random.bernoulli(key, 1.0 - p, x.shape)
+    if upscale:
+        return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+    return jnp.where(keep, x, 0.0).astype(x.dtype)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            from ...ops import math as _math
+
+            return _math.scale(x, 1.0 - p)
+        return x
+    return _dropout(x, random_mod.next_key(), p=float(p), upscale=(mode == "upscale_in_train"))
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    if not training or p == 0.0:
+        return x
+    return _dropout2d(x, random_mod.next_key(), p=float(p), nchw=(data_format == "NCHW"))
+
+
+@primitive("dropout2d_op")
+def _dropout2d(x, key, *, p, nchw):
+    shape = (x.shape[0], x.shape[1], 1, 1) if nchw else (x.shape[0], 1, 1, x.shape[3])
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    return jnp.where(keep, x / (1.0 - p), 0.0).astype(x.dtype)
+
+
+# -- normalization -----------------------------------------------------------
+
+@primitive("layer_norm_op")
+def _layer_norm(x, w, b, *, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + eps)
+    return xn * w + b
+
+
+@primitive("layer_norm_nowb_op")
+def _layer_norm_nowb(x, *, eps, begin_axis):
+    axes = tuple(range(begin_axis, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + eps)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = [normalized_shape]
+    begin_axis = x.ndim - len(normalized_shape)
+    if weight is None:
+        return _layer_norm_nowb(x, eps=float(epsilon), begin_axis=begin_axis)
+    return _layer_norm(x, weight, bias, eps=float(epsilon), begin_axis=begin_axis)
+
+
+@primitive("rms_norm_op")
+def _rms_norm(x, w, *, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    xn = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (xn * w.astype(jnp.float32)).astype(x.dtype)
+
+
+def rms_norm(x, weight, epsilon=1e-6, name=None):
+    """RMSNorm (not in the reference snapshot; required by the Llama family)."""
+    return _rms_norm(x, weight, eps=float(epsilon))
+
+
+@primitive("batch_norm_infer_op")
+def _bn_infer(x, mean, var, w, b, *, eps, axis):
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    mean = mean.reshape(shape)
+    var = var.reshape(shape)
+    w = w.reshape(shape)
+    b = b.reshape(shape)
+    return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+
+@primitive("batch_norm_train_op")
+def _bn_train(x, w, b, *, eps, axis):
+    axes = tuple(i for i in range(x.ndim) if i != axis)
+    mean = jnp.mean(x, axis=axes)
+    var = jnp.var(x, axis=axes)
+    shape = [1] * x.ndim
+    shape[axis] = x.shape[axis]
+    xn = (x - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + eps)
+    return xn * w.reshape(shape) + b.reshape(shape), mean, var
+
+
+def batch_norm(x, running_mean, running_var, weight, bias, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    if use_global_stats is None:
+        use_global_stats = not training
+    if use_global_stats:
+        return _bn_infer(x, running_mean, running_var, weight, bias, eps=float(epsilon), axis=axis)
+    out, batch_mean, batch_var = _bn_train(x, weight, bias, eps=float(epsilon), axis=axis)
+    # update running stats in place (matches reference's batch_norm mean/var outputs)
+    if isinstance(running_mean, Tensor):
+        m = momentum
+        running_mean.set_value(m * running_mean.data + (1 - m) * batch_mean.data)
+        n = x.size // x.shape[axis]
+        unbiased = batch_var.data * (n / max(n - 1, 1))
+        running_var.set_value(m * running_var.data + (1 - m) * unbiased)
+    return out
+
+
+@primitive("group_norm_op")
+def _group_norm(x, w, b, *, groups, eps):
+    n, c = x.shape[0], x.shape[1]
+    gshape = (n, groups, c // groups) + x.shape[2:]
+    xg = x.reshape(gshape)
+    axes = tuple(range(2, xg.ndim))
+    mean = jnp.mean(xg, axis=axes, keepdims=True)
+    var = jnp.var(xg, axis=axes, keepdims=True)
+    xn = ((xg - mean) * jax.lax.rsqrt(var + eps)).reshape(x.shape)
+    shape = [1, c] + [1] * (x.ndim - 2)
+    return xn * w.reshape(shape) + b.reshape(shape)
+
+
+def group_norm(x, num_groups, weight=None, bias=None, epsilon=1e-5, data_format="NCHW", name=None):
+    from ...ops import creation
+
+    if weight is None:
+        weight = creation.ones([x.shape[1]], x.dtype)
+    if bias is None:
+        bias = creation.zeros([x.shape[1]], x.dtype)
+    return _group_norm(x, weight, bias, groups=int(num_groups), eps=float(epsilon))
+
+
+@primitive("instance_norm_op")
+def _instance_norm(x, w, b, *, eps):
+    axes = tuple(range(2, x.ndim))
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    xn = (x - mean) * jax.lax.rsqrt(var + eps)
+    shape = [1, x.shape[1]] + [1] * (x.ndim - 2)
+    return xn * w.reshape(shape) + b.reshape(shape)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    from ...ops import creation
+
+    if weight is None:
+        weight = creation.ones([x.shape[1]], x.dtype)
+    if bias is None:
+        bias = creation.zeros([x.shape[1]], x.dtype)
+    return _instance_norm(x, weight, bias, eps=float(eps))
+
+
+@primitive("l2_normalize_op")
+def _normalize(x, *, p, axis, eps):
+    norm = jnp.power(jnp.sum(jnp.power(jnp.abs(x), p), axis=axis, keepdims=True), 1.0 / p)
+    return x / jnp.maximum(norm, eps)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return _normalize(x, p=float(p), axis=int(axis), eps=float(epsilon))
+
+
+# -- convolution / pooling ---------------------------------------------------
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+@primitive("conv2d_op")
+def _conv2d(x, w, *, stride, padding, dilation, groups, nchw):
+    dn = ("NCHW", "OIHW", "NCHW") if nchw else ("NHWC", "HWIO", "NHWC")
+    if isinstance(padding, str):
+        pad = padding
+    else:
+        pad = [(p, p) for p in padding] if len(padding) == 2 else [
+            tuple(padding[0:2]), tuple(padding[2:4])]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=pad, rhs_dilation=dilation,
+        feature_group_count=groups, dimension_numbers=dn,
+    )
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    nchw = data_format == "NCHW"
+    if isinstance(padding, str):
+        pad = padding.upper()
+    else:
+        pad = _pair(padding) if not isinstance(padding, (list, tuple)) or len(padding) <= 4 else padding
+    out = _conv2d(
+        x, weight, stride=_pair(stride), padding=pad if isinstance(pad, str) else tuple(pad),
+        dilation=_pair(dilation), groups=int(groups), nchw=nchw,
+    )
+    if bias is not None:
+        from ...ops import manipulation
+
+        shape = [1, -1, 1, 1] if nchw else [1, 1, 1, -1]
+        out = out + manipulation.reshape(bias, shape)
+    return out
+
+
+@primitive("conv1d_op")
+def _conv1d(x, w, *, stride, padding, dilation, groups):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=[(padding, padding)],
+        rhs_dilation=(dilation,), feature_group_count=groups,
+        dimension_numbers=("NCH", "OIH", "NCH"),
+    )
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    out = _conv1d(x, weight, stride=int(stride), padding=int(padding),
+                  dilation=int(dilation), groups=int(groups))
+    if bias is not None:
+        from ...ops import manipulation
+
+        out = out + manipulation.reshape(bias, [1, -1, 1])
+    return out
+
+
+@primitive("conv2d_transpose_op")
+def _conv2d_transpose(x, w, *, stride, padding, groups):
+    # w layout IOHW (paddle conv_transpose stores [in, out//groups, kh, kw])
+    return jax.lax.conv_transpose(
+        x, w, strides=stride, padding=[(p, p) for p in padding],
+        dimension_numbers=("NCHW", "IOHW", "NCHW"), transpose_kernel=True,
+    )
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    if int(groups) != 1:
+        raise NotImplementedError("conv2d_transpose with groups > 1 is not supported yet")
+    if output_padding not in (0, [0, 0], (0, 0)) or dilation not in (1, [1, 1], (1, 1)):
+        raise NotImplementedError("conv2d_transpose output_padding/dilation")
+    out = _conv2d_transpose(x, weight, stride=_pair(stride), padding=_pair(padding), groups=int(groups))
+    if bias is not None:
+        from ...ops import manipulation
+
+        out = out + manipulation.reshape(bias, [1, -1, 1, 1])
+    return out
+
+
+@primitive("max_pool2d_op")
+def _max_pool2d(x, *, ksize, stride, padding, nchw):
+    window = (1, 1) + ksize if nchw else (1,) + ksize + (1,)
+    strides = (1, 1) + stride if nchw else (1,) + stride + (1,)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding) if nchw else \
+        ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window, strides, pads)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               return_mask=False, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    return _max_pool2d(x, ksize=ks, stride=st, padding=_pair(padding), nchw=data_format == "NCHW")
+
+
+@primitive("avg_pool2d_op")
+def _avg_pool2d(x, *, ksize, stride, padding, nchw, count_include_pad):
+    window = (1, 1) + ksize if nchw else (1,) + ksize + (1,)
+    strides = (1, 1) + stride if nchw else (1,) + stride + (1,)
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding) if nchw else \
+        ((0, 0),) + tuple((p, p) for p in padding) + ((0, 0),)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if count_include_pad or all(p == 0 for p in padding):
+        denom = np.prod(ksize)
+        return summed / denom
+    ones = jnp.ones_like(x)
+    counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides, pads)
+    return summed / counts
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    return _avg_pool2d(
+        x, ksize=ks, stride=st, padding=_pair(padding), nchw=data_format == "NCHW",
+        count_include_pad=not exclusive,
+    )
+
+
+@primitive("adaptive_avg_pool2d_op")
+def _adaptive_avg_pool2d(x, *, out_hw):
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    # restrict to the divisible case (covers the model zoo); general case later
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    return jnp.mean(x, axis=(3, 5))
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _pair(output_size)
+    h, w = x.shape[2], x.shape[3]
+    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
+        return _adaptive_avg_pool2d(x, out_hw=out_hw)
+    raise NotImplementedError("adaptive_avg_pool2d with non-divisible sizes")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _pair(output_size)
+    h, w = x.shape[2], x.shape[3]
+    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
+        return _adaptive_max_pool2d(x, out_hw=out_hw)
+    raise NotImplementedError("adaptive_max_pool2d with non-divisible sizes")
+
+
+@primitive("adaptive_max_pool2d_op")
+def _adaptive_max_pool2d(x, *, out_hw):
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
+    return jnp.max(x, axis=(3, 5))
+
+
+@primitive("interpolate_nearest_op")
+def _interp_nearest(x, *, size):
+    return jax.image.resize(x, x.shape[:2] + size, method="nearest")
+
+
+@primitive("interpolate_bilinear_op")
+def _interp_bilinear(x, *, size)  :
+    return jax.image.resize(x, x.shape[:2] + size, method="bilinear")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    if size is None:
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * 2
+        size = (int(x.shape[2] * sf[0]), int(x.shape[3] * sf[1]))
+    else:
+        if isinstance(size, Tensor):
+            size = size.tolist()
+        size = tuple(int(s) for s in size)
+    if mode == "nearest":
+        return _interp_nearest(x, size=tuple(size))
+    if mode in ("bilinear", "linear"):
+        return _interp_bilinear(x, size=tuple(size))
+    raise NotImplementedError(f"interpolate mode {mode}")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+@primitive("pixel_shuffle_op")
+def _pixel_shuffle(x, *, factor):
+    n, c, h, w = x.shape
+    r = factor
+    x = x.reshape(n, c // (r * r), r, r, h, w)
+    x = jnp.transpose(x, (0, 1, 4, 2, 5, 3))
+    return x.reshape(n, c // (r * r), h * r, w * r)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    return _pixel_shuffle(x, factor=int(upscale_factor))
+
+
+@primitive("unfold_op")
+def _unfold(x, *, ksize, stride, padding, dilation):
+    n, c, h, w = x.shape
+    patches = jax.lax.conv_general_dilated_patches(
+        x, filter_shape=ksize, window_strides=stride,
+        padding=[(padding[0], padding[0]), (padding[1], padding[1])],
+        rhs_dilation=dilation, dimension_numbers=("NCHW", "OIHW", "NCHW"),
+    )
+    return patches.reshape(n, patches.shape[1], -1)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    return _unfold(x, ksize=_pair(kernel_sizes), stride=_pair(strides),
+                   padding=_pair(paddings), dilation=_pair(dilations))
+
+
+@primitive("cosine_similarity_op")
+def _cosine_similarity(x1, x2, *, axis, eps):
+    dot = jnp.sum(x1 * x2, axis=axis)
+    n1 = jnp.sqrt(jnp.sum(jnp.square(x1), axis=axis))
+    n2 = jnp.sqrt(jnp.sum(jnp.square(x2), axis=axis))
+    return dot / jnp.maximum(n1 * n2, eps)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    return _cosine_similarity(x1, x2, axis=int(axis), eps=float(eps))
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops import manipulation
+
+    return manipulation.pad(x, pad, mode, value, data_format)

@@ -1,0 +1,189 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py).
+
+cross_entropy is the TP-shardable hot path: computed from log_softmax in one
+fused primitive so XLA keeps it on-device in one kernel cluster.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+@primitive("softmax_with_cross_entropy_op")
+def _softmax_ce(logits, labels, *, axis, soft_label, reduction, ignore_index):
+    logp = jax.nn.log_softmax(logits, axis=axis)
+    if soft_label:
+        loss = -jnp.sum(labels * logp, axis=axis)
+    else:
+        lab = labels
+        if lab.ndim == logits.ndim:
+            lab = jnp.squeeze(lab, axis)
+        mask = lab != ignore_index
+        safe_lab = jnp.where(mask, lab, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, axis), axis=axis)
+        loss = jnp.where(mask, -jnp.squeeze(picked, axis), 0.0)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    if weight is not None:
+        raise NotImplementedError("cross_entropy with class weights")
+    return _softmax_ce(
+        input, label, axis=int(axis), soft_label=bool(soft_label),
+        reduction=reduction, ignore_index=int(ignore_index),
+    )
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = _softmax_ce(logits, label, axis=int(axis), soft_label=bool(soft_label),
+                       reduction="none", ignore_index=int(ignore_index))
+    from .activation import softmax as _softmax
+
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+@primitive("nll_loss_op")
+def _nll_loss(logp, labels, *, reduction, ignore_index):
+    mask = labels != ignore_index
+    safe = jnp.where(mask, labels, 0).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
+    loss = jnp.where(mask, -jnp.squeeze(picked, -1), 0.0)
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    return _nll_loss(input, label, reduction=reduction, ignore_index=int(ignore_index))
+
+
+@primitive("mse_loss_op")
+def _mse(x, y, *, reduction):
+    return _reduce(jnp.square(x - y), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _mse(input, label, reduction=reduction)
+
+
+@primitive("l1_loss_op")
+def _l1(x, y, *, reduction):
+    return _reduce(jnp.abs(x - y), reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _l1(input, label, reduction=reduction)
+
+
+@primitive("smooth_l1_op")
+def _smooth_l1(x, y, *, reduction, delta):
+    d = jnp.abs(x - y)
+    loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+    return _reduce(loss, reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    return _smooth_l1(input, label, reduction=reduction, delta=float(delta))
+
+
+@primitive("bce_op")
+def _bce(p, y, *, reduction, eps):
+    p = jnp.clip(p, eps, 1.0 - eps)
+    loss = -(y * jnp.log(p) + (1.0 - y) * jnp.log(1.0 - p))
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    if weight is not None:
+        from ...ops import math as _m, reduction as _r
+
+        out = _m.multiply(_bce(input, label, reduction="none", eps=1e-12), weight)
+        if reduction == "mean":
+            return _r.mean(out)
+        if reduction == "sum":
+            return _r.sum(out)
+        return out
+    return _bce(input, label, reduction=reduction, eps=1e-12)
+
+
+@primitive("bce_logits_op")
+def _bce_logits(x, y, *, reduction):
+    # numerically-stable sigmoid CE: max(x,0) - x*y + log(1+exp(-|x|))
+    loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    return _reduce(loss, reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    if weight is not None or pos_weight is not None:
+        raise NotImplementedError("bce_with_logits weights")
+    return _bce_logits(logit, label, reduction=reduction)
+
+
+@primitive("kl_div_op")
+def _kl_div(x, y, *, reduction):
+    loss = y * (jnp.log(jnp.maximum(y, 1e-12)) - x)
+    if reduction == "batchmean":
+        return jnp.sum(loss) / x.shape[0]
+    return _reduce(loss, reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    return _kl_div(input, label, reduction=reduction)
+
+
+@primitive("margin_ranking_op")
+def _margin_ranking(x1, x2, y, *, margin, reduction):
+    return _reduce(jnp.maximum(0.0, -y * (x1 - x2) + margin), reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return _margin_ranking(input, other, label, margin=float(margin), reduction=reduction)
+
+
+@primitive("hinge_embedding_op")
+def _hinge_embedding(x, y, *, margin, reduction):
+    loss = jnp.where(y == 1, x, jnp.maximum(0.0, margin - x))
+    return _reduce(loss, reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _hinge_embedding(input, label, margin=float(margin), reduction=reduction)
+
+
+@primitive("cosine_embedding_op")
+def _cosine_embedding(x1, x2, y, *, margin, reduction):
+    cos = jnp.sum(x1 * x2, -1) / jnp.maximum(
+        jnp.linalg.norm(x1, axis=-1) * jnp.linalg.norm(x2, axis=-1), 1e-12)
+    loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+    return _reduce(loss, reduction)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean", name=None):
+    return _cosine_embedding(input1, input2, label, margin=float(margin), reduction=reduction)
+
+
+@primitive("ctc_like_square_op")
+def _square_error_cost(x, y):
+    return jnp.square(x - y)
+
+
+def square_error_cost(input, label):
+    return _square_error_cost(input, label)

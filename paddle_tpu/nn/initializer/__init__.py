@@ -1,0 +1,170 @@
+"""Weight initializers (reference: python/paddle/nn/initializer/, fluid initializers).
+
+Each initializer is a callable ``(shape, dtype) -> jax array`` drawing from the
+global threefry stream — functional keys under the hood, stateful seed API on top.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as random_mod
+from ...framework import dtype as dtype_mod
+
+
+def _fans(shape):
+    shape = tuple(shape)
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv kernels stored OIHW: fan_in = in_ch * k*k, fan_out = out_ch * k*k
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtype_mod.convert_dtype(dtype))
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype),
+            self.low, self.high)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return self.mean + self.std * jax.random.normal(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype))
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        return self.mean + self.std * jax.random.truncated_normal(
+            random_mod.next_key(), -2.0, 2.0, tuple(shape), dtype_mod.convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        limit = math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype),
+            -limit, limit)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None):
+        self._fan_in, self._fan_out = fan_in, fan_out
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        fo = self._fan_out if self._fan_out is not None else fo
+        std = math.sqrt(2.0 / (fi + fo))
+        return std * jax.random.normal(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        limit = math.sqrt(6.0 / fi)
+        return jax.random.uniform(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype),
+            -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self._fan_in = fan_in
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self._fan_in if self._fan_in is not None else fi
+        std = math.sqrt(2.0 / fi)
+        return std * jax.random.normal(
+            random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype))
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        from ...core.tensor import Tensor
+
+        v = self.value
+        if isinstance(v, Tensor):
+            arr = v.data
+        else:
+            arr = jnp.asarray(np.asarray(v))
+        assert tuple(arr.shape) == tuple(shape), f"Assign shape {arr.shape} != {shape}"
+        return arr.astype(dtype_mod.convert_dtype(dtype))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        init = jax.nn.initializers.orthogonal(self.gain)
+        return init(random_mod.next_key(), tuple(shape), dtype_mod.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        arr = np.zeros(shape, dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for i in range(min(oc, ic)):
+            arr[(i, i) + tuple(centers)] = 1.0
+        return jnp.asarray(arr, dtype_mod.convert_dtype(dtype))
+
+
+def calculate_gain(nonlinearity, param=None):
+    gains = {
+        "sigmoid": 1.0, "linear": 1.0, "conv2d": 1.0, "tanh": 5.0 / 3.0,
+        "relu": math.sqrt(2.0), "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a**2))
+    return gains.get(nonlinearity, 1.0)

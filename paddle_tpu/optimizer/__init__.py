@@ -1,0 +1,5 @@
+"""paddle_tpu.optimizer (reference: python/paddle/optimizer/)."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
+)

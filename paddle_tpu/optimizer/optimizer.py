@@ -1,0 +1,339 @@
+"""Optimizers.
+
+Reference surface: python/paddle/optimizer/optimizer.py:50 + the per-op CUDA
+kernels in paddle/fluid/operators/optimizers/. TPU-native redesign: each
+optimizer defines a *pure functional* update rule; Optimizer.step() applies it
+to ALL parameters in one fused jitted call over the whole parameter pytree
+(one XLA executable per step instead of one kernel launch per param — the
+multi_tensor/fused-optimizer trick the reference implements by hand in
+distributed_fused_lamb, for free from XLA).
+
+The functional core (``_rule``) is also the export used by the compiled
+train-step path (paddle_tpu.jit.compile_train_step) and ZeRO sharding.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Parameter
+from .lr import LRScheduler
+
+
+class Optimizer:
+    _hyper_defaults: Dict[str, float] = {}
+
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        if parameters is None:
+            raise ValueError("parameters must be provided (dygraph-style optimizer)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = _wd_value(weight_decay)
+        self._decoupled = False  # AdamW overrides
+        self._decay_param_fn = None  # AdamW apply_decay_param_fun / Lamb exclude fn
+        self._accumulators: Dict[int, Any] = {}
+        self._global_step = 0
+        self._jit_step_cache = {}
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate.get_lr())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr cannot override an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- functional rule (override) -----------------------------------------
+    def _init_state(self, p: jax.Array) -> Dict[str, jax.Array]:
+        return {}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        """Pure update: returns (new_p, new_state)."""
+        raise NotImplementedError
+
+    def _hyper(self) -> Dict[str, float]:
+        return dict(self._hyper_defaults)
+
+    # -- step ----------------------------------------------------------------
+    def step(self):
+        params = [p for p in self._parameter_list if not p.stop_gradient and p.grad is not None]
+        if not params:
+            self._finish_step()
+            return
+        for p in params:
+            if id(p) not in self._accumulators:
+                self._accumulators[id(p)] = self._init_state(p.data)
+        p_arrs = [p.data for p in params]
+        g_arrs = [p.grad.data for p in params]
+        states = [self._accumulators[id(p)] for p in params]
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        step_no = jnp.asarray(self._global_step + 1, jnp.int32)
+
+        wd_flags = tuple(
+            1.0 if (self._decay_param_fn is None or self._decay_param_fn(p)) else 0.0
+            for p in params
+        )
+        fused = self._get_fused(len(params), tuple(self._clip_key()), wd_flags)
+        new_ps, new_states = fused(p_arrs, g_arrs, states, lr, step_no)
+        for p, np_, ns in zip(params, new_ps, new_states):
+            p.data = np_
+            self._accumulators[id(p)] = ns
+        self._finish_step()
+
+    def _finish_step(self):
+        self._global_step += 1
+
+    def _clip_key(self):
+        c = self._grad_clip
+        return (type(c).__name__, getattr(c, "clip_norm", None),
+                getattr(c, "min", None), getattr(c, "max", None)) if c is not None else ("none",)
+
+    def _get_fused(self, n, clip_key, wd_flags):
+        key = (n, clip_key, wd_flags)
+        f = self._jit_step_cache.get(key)
+        if f is None:
+            rule = type(self)._rule
+            hyper = self._hyper()
+            wd = self._weight_decay
+            decoupled = self._decoupled
+            clip = self._grad_clip
+
+            def fused(p_arrs, g_arrs, states, lr, step_no):
+                if clip is not None:
+                    g_arrs = clip._apply_jax(g_arrs)
+                out_p, out_s = [], []
+                for p, g, s, flag in zip(p_arrs, g_arrs, states, wd_flags):
+                    g = g.astype(p.dtype)
+                    if wd and not decoupled and flag:
+                        g = g + wd * p
+                    hyper_i = hyper
+                    if "wd" in hyper and not flag:
+                        hyper_i = dict(hyper, wd=0.0)  # rule-internal decay (Lamb)
+                    np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+                    if wd and decoupled and flag:
+                        np_ = np_ - (lr * wd * p).astype(p.dtype)
+                    out_p.append(np_)
+                    out_s.append(ns)
+                return out_p, out_s
+
+            f = jax.jit(fused)
+            self._jit_step_cache[key] = f
+        return f
+
+    # -- misc API ------------------------------------------------------------
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        sd = {"global_step": self._global_step}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._parameter_list):
+            acc = self._accumulators.get(id(p))
+            if acc:
+                for k, v in acc.items():
+                    sd[f"{p.name}_{k}"] = Tensor(v)
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._global_step = int(state_dict.get("global_step", 0))
+        if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for p in self._parameter_list:
+            acc = {}
+            proto = self._init_state(p.data)
+            for k in proto:
+                key = f"{p.name}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    acc[k] = v.data if isinstance(v, Tensor) else jnp.asarray(v)
+                else:
+                    acc[k] = proto[k]
+            if acc:
+                self._accumulators[id(p)] = acc
+
+    @property
+    def _param_groups(self):
+        return self._parameter_list
+
+
+def _wd_value(weight_decay):
+    if weight_decay is None:
+        return 0.0
+    if hasattr(weight_decay, "_coeff"):  # regularizer.L2Decay
+        return float(weight_decay._coeff)
+    return float(weight_decay)
+
+
+class SGD(Optimizer):
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        return (p - lr.astype(p.dtype) * g), state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"momentum": float(momentum), "nesterov": float(use_nesterov)}
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        mu = hyper["momentum"]
+        v = mu * state["velocity"] + g
+        if hyper["nesterov"]:
+            update = g + mu * v
+        else:
+            update = v
+        return p - lr.astype(p.dtype) * update, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"eps": float(epsilon), "init": float(initial_accumulator_value)}
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p, self._hyper_defaults["init"])}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        m = state["moment"] + g * g
+        return p - lr.astype(p.dtype) * g / (jnp.sqrt(m) + hyper["eps"]), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"beta1": float(beta1), "beta2": float(beta2),
+                                "eps": float(epsilon)}
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["eps"]
+        gf = g.astype(jnp.float32)
+        m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * gf
+        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, t))
+        vhat = v / (1 - jnp.power(b2, t))
+        upd = lr * mhat / (jnp.sqrt(vhat) + eps)
+        return (p.astype(jnp.float32) - upd).astype(p.dtype), {
+            "moment1": m.astype(state["moment1"].dtype),
+            "moment2": v.astype(state["moment2"].dtype)}
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip)
+        self._decoupled = True
+        if apply_decay_param_fun is not None:
+            # paddle contract: fn(param.name) -> True means "apply decay"
+            self._decay_param_fn = lambda p: apply_decay_param_fun(p.name)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"beta1": float(beta1), "beta2": float(beta2), "eps": float(epsilon)}
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p), "inf_norm": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        b1, b2, eps = hyper["beta1"], hyper["beta2"], hyper["eps"]
+        m = b1 * state["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * state["inf_norm"], jnp.abs(g))
+        t = step.astype(jnp.float32)
+        lr_t = (lr / (1 - jnp.power(b1, t))).astype(p.dtype)
+        return p - lr_t * m / (u + eps), {"moment": m, "inf_norm": u}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"rho": float(rho), "eps": float(epsilon),
+                                "momentum": float(momentum), "centered": float(centered)}
+
+    def _init_state(self, p):
+        return {"mean_square": jnp.zeros_like(p), "mean_grad": jnp.zeros_like(p),
+                "velocity": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        rho, eps, mu = hyper["rho"], hyper["eps"], hyper["momentum"]
+        ms = rho * state["mean_square"] + (1 - rho) * g * g
+        if hyper["centered"]:
+            mg = rho * state["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - mg * mg + eps)
+        else:
+            mg = state["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        v = mu * state["velocity"] + lr.astype(p.dtype) * g / denom
+        return p - v, {"mean_square": ms, "mean_grad": mg, "velocity": v}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        # decay is folded into the trust-ratio rule (hyper["wd"]), not the base path
+        self._hyper_defaults = {"beta1": float(beta1), "beta2": float(beta2),
+                                "eps": float(epsilon), "wd": float(lamb_weight_decay)}
+        if exclude_from_weight_decay_fn is not None:
+            # paddle contract: fn(param) -> True means "exclude from decay"
+            self._decay_param_fn = lambda p: not exclude_from_weight_decay_fn(p)
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p), "moment2": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        b1, b2, eps, wd = hyper["beta1"], hyper["beta2"], hyper["eps"], hyper["wd"]
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        m = b1 * state["moment1"].astype(jnp.float32) + (1 - b1) * gf
+        v = b2 * state["moment2"].astype(jnp.float32) + (1 - b2) * gf * gf
+        t = step.astype(jnp.float32)
+        mhat = m / (1 - jnp.power(b1, t))
+        vhat = v / (1 - jnp.power(b2, t))
+        r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+        p_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((p_norm > 0) & (r_norm > 0), p_norm / r_norm, 1.0)
+        return (pf - lr * trust * r).astype(p.dtype), {
+            "moment1": m.astype(state["moment1"].dtype),
+            "moment2": v.astype(state["moment2"].dtype)}

@@ -1,0 +1,219 @@
+"""nn.Layer machinery + layer zoo tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_grad():
+    paddle.seed(1)
+    lin = nn.Linear(8, 4)
+    x = paddle.randn([2, 8])
+    y = lin(x)
+    assert y.shape == [2, 4]
+    y.sum().backward()
+    assert lin.weight.grad is not None and lin.weight.grad.shape == [8, 4]
+    assert lin.bias.grad is not None
+
+
+def test_layer_bookkeeping():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 4)
+            self.fc2 = nn.Linear(4, 2, bias_attr=False)
+
+        def forward(self, x):
+            return self.fc2(self.fc1(x))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight"]
+    assert len(net.sublayers()) == 2
+    net.eval()
+    assert not net.fc1.training
+    net.train()
+    assert net.fc1.training
+
+
+def test_state_dict_roundtrip():
+    paddle.seed(0)
+    net1 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    net2 = nn.Sequential(nn.Linear(3, 5), nn.ReLU(), nn.Linear(5, 2))
+    x = paddle.randn([4, 3])
+    assert not np.allclose(net1(x).numpy(), net2(x).numpy())
+    missing, unexpected = net2.set_state_dict(net1.state_dict())
+    assert not missing and not unexpected
+    np.testing.assert_allclose(net1(x).numpy(), net2(x).numpy(), rtol=1e-6)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[1, 2], [0, 3]], "int32"))
+    out = emb(ids)
+    assert out.shape == [2, 2, 4]
+    assert np.allclose(out.numpy()[1, 0], 0)  # padding_idx zeroed
+    out.sum().backward()
+    g = emb.weight.grad.numpy()
+    assert np.allclose(g[0], 0)  # no grad into padding row
+    assert not np.allclose(g[1], 0)
+
+
+def test_dropout_modes():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.eval()
+    np.testing.assert_array_equal(d(x).numpy(), x.numpy())
+    d.train()
+    out = d(x).numpy()
+    assert (out == 0).any()
+    # upscale keeps expectation
+    assert abs(out.mean() - 1.0) < 0.15
+
+
+def test_conv2d_vs_scipy():
+    paddle.seed(0)
+    conv = nn.Conv2D(1, 1, 3, padding=1, bias_attr=False)
+    w = np.zeros((1, 1, 3, 3), "float32")
+    w[0, 0, 1, 1] = 2.0  # identity * 2
+    conv.weight.set_value(w)
+    x = paddle.randn([1, 1, 5, 5])
+    out = conv(x)
+    np.testing.assert_allclose(out.numpy(), 2 * x.numpy(), rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 2, 2])
+    bn.train()
+    out = bn(x)
+    m = out.numpy().mean(axis=(0, 2, 3))
+    np.testing.assert_allclose(m, np.zeros(3), atol=1e-5)
+    # running stats moved
+    assert not np.allclose(bn._mean.numpy(), 0)
+    bn.eval()
+    out2 = bn(x)
+    assert out2.shape == [4, 3, 2, 2]
+
+
+def test_layernorm():
+    ln = nn.LayerNorm(8)
+    x = paddle.randn([2, 4, 8])
+    out = ln(x)
+    np.testing.assert_allclose(out.numpy().mean(-1), np.zeros((2, 4)), atol=1e-5)
+    np.testing.assert_allclose(out.numpy().std(-1), np.ones((2, 4)), atol=1e-2)
+
+
+def test_rmsnorm():
+    rn = nn.RMSNorm(8)
+    x = paddle.randn([2, 8])
+    out = rn(x)
+    rms = np.sqrt((out.numpy() ** 2).mean(-1))
+    np.testing.assert_allclose(rms, np.ones(2), atol=1e-2)
+
+
+def test_pools():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2)(x)
+    np.testing.assert_array_equal(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5], [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)(x)
+    assert aap.shape == [1, 1, 1, 1]
+
+
+def test_activations_match_numpy():
+    x_np = np.linspace(-3, 3, 13).astype("float32")
+    x = paddle.to_tensor(x_np)
+    np.testing.assert_allclose(F.relu(x).numpy(), np.maximum(x_np, 0))
+    np.testing.assert_allclose(
+        F.softmax(x).numpy(), np.exp(x_np) / np.exp(x_np).sum(), rtol=1e-5
+    )
+    np.testing.assert_allclose(
+        F.leaky_relu(x, 0.1).numpy(), np.where(x_np > 0, x_np, 0.1 * x_np), rtol=1e-6
+    )
+    s = F.sigmoid(x).numpy()
+    np.testing.assert_allclose(s, 1 / (1 + np.exp(-x_np)), rtol=1e-5)
+
+
+def test_cross_entropy_matches_manual():
+    logits_np = np.random.RandomState(0).randn(5, 7).astype("float32")
+    labels_np = np.array([0, 1, 2, 3, 4], "int32")
+    loss = F.cross_entropy(paddle.to_tensor(logits_np), paddle.to_tensor(labels_np))
+    # manual
+    e = np.exp(logits_np - logits_np.max(1, keepdims=True))
+    p = e / e.sum(1, keepdims=True)
+    manual = -np.log(p[np.arange(5), labels_np]).mean()
+    np.testing.assert_allclose(loss.item(), manual, rtol=1e-5)
+
+
+def test_cross_entropy_ignore_index():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.array([0, -100, 1, -100], "int32"))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    # only 2 valid rows averaged — compare vs explicit
+    l2 = F.cross_entropy(logits[paddle.to_tensor([0, 2])], labels[paddle.to_tensor([0, 2])])
+    np.testing.assert_allclose(loss.item(), l2.item(), rtol=1e-5)
+
+
+def test_mha_causal_consistency():
+    paddle.seed(3)
+    mha = nn.MultiHeadAttention(16, 2)
+    mha.eval()
+    x = paddle.randn([1, 6, 16])
+    full = mha(x)
+    assert full.shape == [1, 6, 16]
+
+
+def test_transformer_encoder():
+    enc_layer = nn.TransformerEncoderLayer(d_model=16, nhead=2, dim_feedforward=32)
+    enc = nn.TransformerEncoder(enc_layer, 2)
+    enc.eval()
+    x = paddle.randn([2, 5, 16])
+    out = enc(x)
+    assert out.shape == [2, 5, 16]
+    # clones must not share parameters
+    p0 = enc.layers[0].linear1.weight.numpy()
+    p1 = enc.layers[1].linear1.weight.numpy()
+    assert p0.shape == p1.shape
+
+
+def test_sdpa_matches_naive():
+    paddle.seed(0)
+    q = paddle.randn([2, 4, 2, 8])
+    k = paddle.randn([2, 4, 2, 8])
+    v = paddle.randn([2, 4, 2, 8])
+    out = F.scaled_dot_product_attention(q, k, v)
+    # naive
+    qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+    import math
+
+    logits = np.einsum("bqhd,bkhd->bhqk", qn, kn) / math.sqrt(8)
+    p = np.exp(logits - logits.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    ref = np.einsum("bhqk,bkhd->bqhd", p, vn)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_containers():
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    seq = nn.Sequential(nn.Linear(2, 4), nn.ReLU())
+    assert isinstance(seq[0], nn.Linear)
+    pl = nn.ParameterList([nn.Parameter(np.zeros((2, 2), "float32"))])
+    assert len(pl.parameters()) == 1
+
+
+def test_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(lambda l, i, o: calls.append(1))
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    lin(paddle.randn([1, 2]))
+    assert calls == [1]
