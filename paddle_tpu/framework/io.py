@@ -1,0 +1,66 @@
+"""Object checkpoint save/load (reference: python/paddle/framework/io.py:568
+paddle.save/paddle.load — pickled state_dicts with tensor<->numpy conversion).
+
+Distributed/sharded checkpointing lives in paddle_tpu.distributed.checkpoint
+(orbax-style per-shard files, mesh-reshardable); this module is the
+single-process object path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+
+
+def _to_saveable(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj.data), obj.name, obj.stop_gradient)
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_to_saveable(v) for v in obj)
+    return obj
+
+
+def _from_saveable(obj: Any, return_numpy=False) -> Any:
+    if isinstance(obj, _TensorPayload):
+        if return_numpy:
+            return obj.array
+        t = Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient)
+        t.name = obj.name
+        return t
+    if isinstance(obj, dict):
+        return {k: _from_saveable(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_from_saveable(v, return_numpy) for v in obj)
+    return obj
+
+
+class _TensorPayload:
+    __slots__ = ("array", "name", "stop_gradient")
+
+    def __init__(self, array, name, stop_gradient):
+        self.array = array
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        data = pickle.load(f)
+    return _from_saveable(data, return_numpy=return_numpy)

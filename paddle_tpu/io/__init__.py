@@ -1,0 +1,371 @@
+"""paddle_tpu.io: datasets + DataLoader.
+
+Reference: python/paddle/io/ + fluid/dataloader/ (multiprocess workers feeding
+a LoDTensorBlockingQueue). TPU-native redesign: the loader is a host-side numpy
+pipeline with a background-thread prefetcher that overlaps batch assembly with
+device compute (device transfer is async under jax); multiprocess workers are
+unnecessary because TPU input pipelines are host-CPU bound on decode, which
+numpy/threads handle, and the heavy lifting (augment) vectorizes.
+"""
+from __future__ import annotations
+
+import bisect
+import itertools
+import queue
+import threading
+from typing import Any, Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+
+
+class Dataset:
+    """Map-style dataset (reference: python/paddle/io/Dataset)."""
+
+    def __getitem__(self, idx):
+        raise NotImplementedError
+
+    def __len__(self):
+        raise NotImplementedError
+
+
+class IterableDataset(Dataset):
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        raise RuntimeError("IterableDataset is not subscriptable")
+
+    def __len__(self):
+        raise RuntimeError("IterableDataset has no len()")
+
+
+class TensorDataset(Dataset):
+    def __init__(self, tensors: Sequence[Tensor]):
+        lens = {t.shape[0] for t in tensors}
+        assert len(lens) == 1, "all tensors must share dim 0"
+        self.tensors = tensors
+
+    def __getitem__(self, idx):
+        return tuple(np.asarray(t.data[idx]) for t in self.tensors)
+
+    def __len__(self):
+        return self.tensors[0].shape[0]
+
+
+class ComposeDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        assert all(len(d) == len(self.datasets[0]) for d in self.datasets)
+
+    def __getitem__(self, idx):
+        out = []
+        for d in self.datasets:
+            sample = d[idx]
+            out.extend(sample if isinstance(sample, (tuple, list)) else [sample])
+        return tuple(out)
+
+    def __len__(self):
+        return len(self.datasets[0])
+
+
+class ChainDataset(IterableDataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+
+    def __iter__(self):
+        for d in self.datasets:
+            yield from d
+
+
+class ConcatDataset(Dataset):
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        self.cumulative_sizes = list(itertools.accumulate(len(d) for d in self.datasets))
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        ds_idx = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = 0 if ds_idx == 0 else self.cumulative_sizes[ds_idx - 1]
+        return self.datasets[ds_idx][idx - prev]
+
+
+class Subset(Dataset):
+    def __init__(self, dataset, indices):
+        self.dataset = dataset
+        self.indices = list(indices)
+
+    def __getitem__(self, idx):
+        return self.dataset[self.indices[idx]]
+
+    def __len__(self):
+        return len(self.indices)
+
+
+def random_split(dataset, lengths, generator=None):
+    total = len(dataset)
+    if all(isinstance(l, float) for l in lengths):
+        lengths = [int(total * l) for l in lengths]
+        lengths[-1] = total - sum(lengths[:-1])
+    assert sum(lengths) == total
+    g = generator or random_mod.default_generator()
+    perm = np.asarray(
+        __import__("jax").random.permutation(g.next_key(), total)
+    ).tolist()
+    out, off = [], 0
+    for l in lengths:
+        out.append(Subset(dataset, perm[off : off + l]))
+        off += l
+    return out
+
+
+# -- samplers ----------------------------------------------------------------
+
+class Sampler:
+    def __init__(self, data_source=None):
+        self.data_source = data_source
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.data_source)
+
+
+class SequenceSampler(Sampler):
+    def __iter__(self):
+        return iter(range(len(self.data_source)))
+
+
+class RandomSampler(Sampler):
+    def __init__(self, data_source, replacement=False, num_samples=None, generator=None):
+        super().__init__(data_source)
+        self.replacement = replacement
+        self._num_samples = num_samples
+        self.generator = generator
+
+    @property
+    def num_samples(self):
+        return self._num_samples or len(self.data_source)
+
+    def __iter__(self):
+        n = len(self.data_source)
+        g = self.generator or random_mod.default_generator()
+        import jax
+
+        if self.replacement:
+            idx = np.asarray(jax.random.randint(g.next_key(), (self.num_samples,), 0, n))
+        else:
+            idx = np.asarray(jax.random.permutation(g.next_key(), n))[: self.num_samples]
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+class BatchSampler(Sampler):
+    """Reference: python/paddle/fluid/dataloader/batch_sampler.py."""
+
+    def __init__(self, dataset=None, sampler=None, shuffle=False, batch_size=1,
+                 drop_last=False):
+        assert (dataset is None) != (sampler is None), "exactly one of dataset/sampler"
+        if sampler is None:
+            sampler = RandomSampler(dataset) if shuffle else SequenceSampler(dataset)
+        self.sampler = sampler
+        self.batch_size = int(batch_size)
+        self.drop_last = drop_last
+
+    def __iter__(self):
+        batch = []
+        for idx in self.sampler:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        n = len(self.sampler)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+
+class DistributedBatchSampler(BatchSampler):
+    """Rank-sharded batches (reference: dataloader/batch_sampler.py
+    DistributedBatchSampler). Under SPMD data parallel the 'rank' is a
+    data-mesh coordinate; see paddle_tpu.distributed."""
+
+    def __init__(self, dataset, batch_size, num_replicas=None, rank=None,
+                 shuffle=False, drop_last=False):
+        self.dataset = dataset
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        if num_replicas is None or rank is None:
+            from .. import distributed as dist
+
+            num_replicas = num_replicas if num_replicas is not None else dist.get_world_size()
+            rank = rank if rank is not None else dist.get_rank()
+        self.nranks = num_replicas
+        self.local_rank = rank
+        self.epoch = 0
+        self.num_samples = (len(dataset) + self.nranks - 1) // self.nranks
+        self.total_size = self.num_samples * self.nranks
+
+    def __iter__(self):
+        n = len(self.dataset)
+        if self.shuffle:
+            import jax
+
+            key = jax.random.key(self.epoch)
+            indices = np.asarray(jax.random.permutation(key, n)).tolist()
+        else:
+            indices = list(range(n))
+        indices += indices[: self.total_size - n]  # pad to even
+        local = indices[self.local_rank : self.total_size : self.nranks]
+        batch = []
+        for idx in local:
+            batch.append(idx)
+            if len(batch) == self.batch_size:
+                yield batch
+                batch = []
+        if batch and not self.drop_last:
+            yield batch
+
+    def __len__(self):
+        if self.drop_last:
+            return self.num_samples // self.batch_size
+        return (self.num_samples + self.batch_size - 1) // self.batch_size
+
+    def set_epoch(self, epoch):
+        self.epoch = epoch
+
+
+class WeightedRandomSampler(Sampler):
+    def __init__(self, weights, num_samples, replacement=True):
+        self.weights = np.asarray(weights, dtype=np.float64)
+        self.num_samples = num_samples
+        self.replacement = replacement
+
+    def __iter__(self):
+        p = self.weights / self.weights.sum()
+        idx = np.random.choice(len(p), self.num_samples, replace=self.replacement, p=p)
+        return iter(idx.tolist())
+
+    def __len__(self):
+        return self.num_samples
+
+
+# -- collate + loader --------------------------------------------------------
+
+def default_collate_fn(batch: List[Any]):
+    sample = batch[0]
+    if isinstance(sample, (np.ndarray, np.generic)):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+
+        return Tensor(jnp.stack([t.data for t in batch]))
+    if isinstance(sample, (int, float)):
+        return Tensor(np.asarray(batch))
+    if isinstance(sample, (list, tuple)):
+        return tuple(default_collate_fn([b[i] for b in batch]) for i in range(len(sample)))
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch]) for k in sample}
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    """Reference: python/paddle/fluid/reader.py:146 DataLoader. Host pipeline +
+    background-thread prefetch (the py_reader double-buffering role)."""
+
+    def __init__(self, dataset, feed_list=None, places=None, return_list=True,
+                 batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
+                 collate_fn=None, num_workers=0, use_buffer_reader=True,
+                 prefetch_factor=2, use_shared_memory=True, timeout=0,
+                 worker_init_fn=None, persistent_workers=False):
+        self.dataset = dataset
+        self.collate_fn = collate_fn or default_collate_fn
+        self.num_workers = num_workers
+        self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        if self._iterable_mode:
+            self.batch_sampler = None
+            self.batch_size = batch_size
+            self.drop_last = drop_last
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        else:
+            self.batch_sampler = BatchSampler(
+                dataset=dataset, shuffle=shuffle, batch_size=batch_size,
+                drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset DataLoader has no len()")
+        return len(self.batch_sampler)
+
+    def _batches(self) -> Iterator[Any]:
+        if self._iterable_mode:
+            batch = []
+            for sample in self.dataset:
+                batch.append(sample)
+                if len(batch) == self.batch_size:
+                    yield self.collate_fn(batch)
+                    batch = []
+            if batch and not self.drop_last:
+                yield self.collate_fn(batch)
+        else:
+            for idx_batch in self.batch_sampler:
+                yield self.collate_fn([self.dataset[i] for i in idx_batch])
+
+    def __iter__(self):
+        if self.prefetch == 0 and self.num_workers == 0:
+            yield from self._batches()
+            return
+        yield from _PrefetchIterator(self._batches(), self.prefetch or 2)
+
+
+class _PrefetchIterator:
+    """Background-thread double buffering (py_reader analogue)."""
+
+    _SENTINEL = object()
+
+    def __init__(self, source, depth):
+        self.q: "queue.Queue" = queue.Queue(maxsize=depth)
+        self.err = None
+
+        def worker():
+            try:
+                for item in source:
+                    self.q.put(item)
+            except BaseException as e:  # propagate to consumer
+                self.err = e
+            finally:
+                self.q.put(self._SENTINEL)
+
+        self.t = threading.Thread(target=worker, daemon=True)
+        self.t.start()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self.q.get()
+        if item is self._SENTINEL:
+            if self.err is not None:
+                raise self.err
+            raise StopIteration
+        return item
+
+
+def get_worker_info():
+    return None

@@ -1,0 +1,133 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+
+Zero-egress environment: loaders read local files when present (same on-disk
+formats as the reference: MNIST idx files, CIFAR pickle tarballs) and raise a
+clear error otherwise. FakeData provides deterministic synthetic samples for
+tests/benchmarks.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset (CIFAR-like by default)."""
+
+    def __init__(self, sample_shape=(3, 32, 32), num_samples=1024, num_classes=10,
+                 transform=None, seed=0):
+        self.shape = tuple(sample_shape)
+        self.n = num_samples
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(self.seed + idx)
+        label = idx % self.num_classes
+        # class-dependent mean so models can actually learn from it
+        img = (rng.rand(*self.shape) + 0.25 * label).astype("float32")
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int32(label)
+
+    def __len__(self):
+        return self.n
+
+
+class MNIST(Dataset):
+    """Reads standard idx-format files from `image_path`/`label_path`."""
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if download and (image_path is None or not os.path.exists(image_path)):
+            raise RuntimeError(
+                "MNIST download is unavailable in this environment; provide "
+                "image_path/label_path to local idx files")
+        self.transform = transform
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            assert magic == 2051, "bad MNIST image magic"
+            data = np.frombuffer(f.read(), dtype=np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            magic, n = struct.unpack(">II", f.read(8))
+            assert magic == 2049, "bad MNIST label magic"
+            return np.frombuffer(f.read(), dtype=np.uint8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    """Reads the standard python-pickle CIFAR tarball from `data_file`."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=False,
+                 backend="cv2"):
+        if download and (data_file is None or not os.path.exists(data_file)):
+            raise RuntimeError(
+                "CIFAR download is unavailable in this environment; provide "
+                "data_file pointing at cifar-10-python.tar.gz")
+        self.transform = transform
+        self.data, self.labels = self._load(data_file, mode)
+
+    def _load(self, path, mode):
+        names = ([f"data_batch_{i}" for i in range(1, 6)] if mode == "train"
+                 else ["test_batch"])
+        xs, ys = [], []
+        with tarfile.open(path, "r:*") as tf:
+            for member in tf.getmembers():
+                base = os.path.basename(member.name)
+                if base in names:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"labels"])
+        data = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        return data, np.asarray(ys, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.data[idx]
+        if self.transform:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Cifar100(Cifar10):
+    def _load(self, path, mode):
+        name = "train" if mode == "train" else "test"
+        with tarfile.open(path, "r:*") as tf:
+            for member in tf.getmembers():
+                if os.path.basename(member.name) == name:
+                    d = pickle.load(tf.extractfile(member), encoding="bytes")
+                    data = d[b"data"].reshape(-1, 3, 32, 32)
+                    return data, np.asarray(d[b"fine_labels"], dtype=np.int64)
+        raise FileNotFoundError(name)

@@ -1,0 +1,8 @@
+from .resnet import (  # noqa: F401
+    ResNet, BasicBlock, BottleneckBlock, resnet18, resnet34, resnet50,
+    resnet101, resnet152, wide_resnet50_2, resnext50_32x4d,
+)
+from .small import (  # noqa: F401
+    LeNet, AlexNet, alexnet, VGG, vgg11, vgg13, vgg16, vgg19,
+    MobileNetV2, mobilenet_v2,
+)

@@ -1,0 +1,168 @@
+"""Vision transforms (reference: python/paddle/vision/transforms/).
+
+Numpy host-side pipeline (HWC uint8 in, CHW float out by ToTensor) — the data
+path stays on CPU until the DataLoader ships the batch to the TPU.
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+from ...core.tensor import Tensor
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def __call__(self, data):
+        for t in self.transforms:
+            data = t(data)
+        return data
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    """HWC uint8/float -> CHW float32 in [0,1] numpy (collate makes it a Tensor)."""
+
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if arr.dtype == np.uint8:
+            arr = arr.astype("float32") / 255.0
+        else:
+            arr = arr.astype("float32")
+        if self.data_format == "CHW":
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False, keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean] * 3
+        if isinstance(std, numbers.Number):
+            std = [std] * 3
+        self.mean = np.asarray(mean, "float32")
+        self.std = np.asarray(std, "float32")
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, "float32")
+        if self.data_format == "CHW":
+            return (arr - self.mean[:, None, None]) / self.std[:, None, None]
+        return (arr - self.mean) / self.std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        import jax
+        import jax.numpy as jnp
+
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3)
+        a = jnp.asarray(arr, jnp.float32)
+        if arr.ndim == 2:
+            out = jax.image.resize(a, self.size, "bilinear")
+        elif chw:
+            out = jax.image.resize(a, (arr.shape[0],) + self.size, "bilinear")
+        else:
+            out = jax.image.resize(a, self.size + (arr.shape[2],), "bilinear")
+        out = np.asarray(out)
+        return out.astype(arr.dtype) if arr.dtype == np.uint8 else out
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        h, w = arr.shape[:2] if arr.ndim == 2 or arr.shape[2] in (1, 3) else arr.shape[1:3]
+        th, tw = self.size
+        i = max((h - th) // 2, 0)
+        j = max((w - tw) // 2, 0)
+        if arr.ndim == 3 and arr.shape[0] in (1, 3) and arr.shape[2] not in (1, 3):
+            return arr[:, i : i + th, j : j + tw]
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=None, pad_if_needed=False, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if self.padding:
+            p = self.padding if isinstance(self.padding, int) else self.padding[0]
+            if arr.ndim == 2:
+                arr = np.pad(arr, p, mode="constant")
+            else:
+                arr = np.pad(arr, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return arr[i : i + th, j : j + tw]
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            return arr[:, ::-1] if arr.ndim == 2 else arr[:, ::-1, :]
+        return np.asarray(img)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            return np.asarray(img)[::-1]
+        return np.asarray(img)
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        return np.asarray(img).transpose(self.order)
+
+
+def to_tensor(pic, data_format="CHW"):
+    return Tensor(ToTensor(data_format)(pic))
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = img.numpy() if isinstance(img, Tensor) else np.asarray(img)
+    out = Normalize(mean, std, data_format)(arr)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    return Resize(size, interpolation)(img)
+
+
+def hflip(img):
+    arr = np.asarray(img)
+    return arr[:, ::-1] if arr.ndim == 2 else arr[:, ::-1, :]
