@@ -1,0 +1,113 @@
+"""AMP: auto mixed precision.
+
+Reference: python/paddle/amp/auto_cast.py + imperative/amp_auto_cast.cc (O1
+per-op cast with white/black lists, O2 pure-fp16) and grad_scaler.py (dynamic
+loss scaling via check_finite_and_unscale/update_loss_scaling ops).
+
+TPU-native stance: bf16 is the native mixed-precision dtype (MXU runs bf16
+natively, and bf16 has fp32's exponent range so loss scaling is a no-op).
+The cast hook lives in the eager dispatch layer; under level='O1' matmul-class
+ops run in bf16 and reductions stay fp32, mirroring the reference lists
+(fluid/contrib/mixed_precision/fp16_lists.py).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..framework import dtype as dtype_mod
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+# ops that benefit from low precision (MXU-bound)
+WHITE_LIST = {
+    "matmul_v2", "linear_op", "linear_nobias_op", "conv2d_op", "conv1d_op",
+    "conv2d_transpose_op", "einsum_op", "addmm_op", "sdpa", "sdpa_mask",
+    "sdpa_dropout", "sdpa_mask_dropout", "embedding_op",
+}
+# numerically sensitive: force fp32
+BLACK_LIST = {
+    "reduce_sum", "reduce_mean", "softmax_with_cross_entropy_op", "act_softmax",
+    "act_log_softmax", "layer_norm_op", "layer_norm_nowb_op", "rms_norm_op",
+    "batch_norm_train_op", "batch_norm_infer_op", "p_norm", "logsumexp",
+    "exp", "log", "reduce_std", "reduce_var", "nll_loss_op", "bce_op",
+    "bce_logits_op", "mse_loss_op", "cumsum",
+}
+
+_STATE = {"enabled": False, "dtype": None, "level": "O1",
+          "white": WHITE_LIST, "black": BLACK_LIST}
+
+
+def amp_state():
+    return _STATE
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    """paddle.amp.auto_cast (reference: amp/auto_cast.py:21)."""
+    old = dict(_STATE)
+    _STATE["enabled"] = bool(enable)
+    _STATE["dtype"] = dtype_mod.convert_dtype(dtype)
+    _STATE["level"] = level
+    _STATE["white"] = WHITE_LIST | set(custom_white_list or ())
+    _STATE["black"] = (BLACK_LIST | set(custom_black_list or ())) - set(custom_white_list or ())
+    try:
+        yield
+    finally:
+        _STATE.update(old)
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_inputs(prim_name: str, arrays):
+    """Dispatch-layer hook: cast float inputs per the active AMP state."""
+    if not _STATE["enabled"]:
+        return arrays
+    amp_dtype = _STATE["dtype"]
+    level = _STATE["level"]
+    if level == "O2":
+        # pure low-precision except black list
+        if prim_name in _STATE["black"]:
+            target = jnp.float32
+        else:
+            target = amp_dtype
+    else:  # O1
+        if prim_name in _STATE["white"]:
+            target = amp_dtype
+        elif prim_name in _STATE["black"]:
+            target = jnp.float32
+        else:
+            return arrays
+    out = []
+    for a in arrays:
+        if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) \
+                and a.dtype != jnp.dtype(target):
+            out.append(a.astype(target))
+        else:
+            out.append(a)
+    return out
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate: cast model params to the AMP dtype (O2 path)."""
+    d = dtype_mod.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    for m in model_list:
+        m.to(dtype=d)
+    if optimizers is None:
+        return models if single else model_list
+    return (models if single else model_list), optimizers
+
+
+def is_bfloat16_supported(device=None):
+    return True
+
+
+def is_float16_supported(device=None):
+    return True

@@ -1,0 +1,125 @@
+"""GradScaler: dynamic loss scaling.
+
+Reference: python/paddle/amp/grad_scaler.py:26 -> fluid loss_scaler.py:40
+(AmpScaler with check_finite_and_unscale + update_loss_scaling kernels).
+
+On TPU with bf16 the scaler is mathematically a no-op (bf16 keeps fp32's
+exponent), but the API and the dynamic-scale state machine are preserved for
+fp16 use and drop-in compatibility: scale -> backward -> step unscales,
+checks finiteness in one jitted reduction, skips the step and shrinks the
+scale on overflow, grows it after `incr_every_n_steps` clean steps.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+
+
+@jax.jit
+def _all_finite(arrays):
+    flags = [jnp.isfinite(a).all() for a in arrays]
+    out = flags[0]
+    for f in flags[1:]:
+        out = out & f
+    return out
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=65536.0, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling) if enable else 1.0
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n_nan_or_inf = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        from ..ops import math as _m
+
+        return _m.scale(var, self._scale)
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            self._found_inf = False
+            return
+        params = [p for p in optimizer._parameter_list
+                  if not p.stop_gradient and p.grad is not None]
+        if not params:
+            self._found_inf = False
+            return
+        garrs = [p.grad.data for p in params]
+        inv = 1.0 / self._scale
+        unscaled = [g.astype(jnp.float32) * inv for g in garrs]
+        finite = bool(_all_finite(unscaled))
+        self._found_inf = not finite
+        if finite:
+            for p, g in zip(params, unscaled):
+                p.grad = Tensor(g.astype(p.dtype))
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self._update_scale()
+
+    def minimize(self, optimizer, scaled_loss):
+        self.step(optimizer)
+
+    def update(self):
+        pass  # folded into step(); kept for API parity
+
+    def _update_scale(self):
+        if not self._dynamic:
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n_nan_or_inf:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._scale = float(v)
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
+                "bad_steps": self._bad_steps}
+
+    def load_state_dict(self, sd):
+        self._scale = sd["scale"]
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+    set_state_dict = load_state_dict
+
+
+AmpScaler = GradScaler

@@ -71,6 +71,10 @@ class GradNode:
         for ct, (shape, dtype) in zip(out_cts, self.out_avals):
             if ct is None:
                 ct = jnp.zeros(shape, dtype)
+            elif ct.dtype != dtype:
+                # AMP boundaries: downstream may produce cotangents in a
+                # different float dtype than this op's output
+                ct = ct.astype(dtype)
             cts.append(ct)
         ct_struct = tuple(cts) if self.multi_output else cts[0]
         bwd = self.prim.bwd(self.attrs)

@@ -212,6 +212,12 @@ def dispatch(prim, args, attrs):
             arrays.append(a if isinstance(a, jax.Array) else jnp.asarray(a))
             inputs.append(None)
 
+    # AMP O1/O2 auto-cast hook (reference: tracer.cc:209-226 AMP pass)
+    from ..amp import amp_state, maybe_cast_inputs
+
+    if amp_state()["enabled"]:
+        arrays = maybe_cast_inputs(prim.name, arrays)
+
     out = prim.fwd(attrs)(*arrays)
     multi = isinstance(out, (tuple, list))
     outs_raw = tuple(out) if multi else (out,)

@@ -1,0 +1,236 @@
+"""Capture-and-compile: the @to_static / CINN-role subsystem.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/ (AST transpiler ->
+ProgramDesc -> executor) and paddle2cinn (subgraph JIT). TPU-native redesign:
+capture IS tracing — `jax.jit` over the eager op layer. The same eager ops run
+under an outer trace, so there is no separate program IR to maintain; XLA is
+the compiled executor (InterpreterCore role), and donation replaces the
+memory-optimize pass.
+
+Two entry points:
+- ``to_static(layer_or_fn)``: compiled forward (inference / eval path).
+- ``TrainStep(model, loss_fn, optimizer)``: whole-train-step compilation —
+  forward + backward (jax.grad at array level) + fused optimizer update in ONE
+  XLA executable with donated buffers. This is the TPU-performance path; the
+  eager tape is bypassed entirely.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd
+from ..framework import random as random_mod
+from ..nn.layer.layers import Layer
+
+
+def _collect_params(layer: Layer):
+    named = list(layer.named_parameters())
+    buffers = list(layer.named_buffers())
+    return named, buffers
+
+
+class _Binder:
+    """Temporarily swap Layer parameter/buffer .data with traced arrays."""
+
+    def __init__(self, tensors: List[Tensor]):
+        self.tensors = tensors
+        self.saved = None
+
+    def __enter__(self):
+        self.saved = [t.data for t in self.tensors]
+        return self
+
+    def bind(self, arrays):
+        for t, a in zip(self.tensors, arrays):
+            t.data = a
+
+    def __exit__(self, *exc):
+        for t, a in zip(self.tensors, self.saved):
+            t.data = a
+        return False
+
+
+class StaticLayer:
+    """Compiled forward wrapper (TranslatedLayer/StaticFunction analogue)."""
+
+    def __init__(self, layer_or_fn, input_spec=None, full_graph=True):
+        self._is_layer = isinstance(layer_or_fn, Layer)
+        self._target = layer_or_fn
+        self._cache = {}
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            raise NotImplementedError("to_static call with kwargs")
+        arrays = [a.data if isinstance(a, Tensor) else a for a in args]
+        if self._is_layer:
+            named, buffers = _collect_params(self._target)
+            tensors = [p for _, p in named] + [b for _, b in buffers]
+            key = ("layer", self._target.training, len(tensors))
+        else:
+            tensors = []
+            key = ("fn",)
+        jitted = self._cache.get(key)
+        if jitted is None:
+            target, is_layer = self._target, self._is_layer
+
+            def run(param_arrays, input_arrays, rngkey):
+                random_mod.default_generator().set_trace_key(rngkey)
+                try:
+                    if is_layer:
+                        named, buffers = _collect_params(target)
+                        ts = [p for _, p in named] + [b for _, b in buffers]
+                        with _Binder(ts) as b:
+                            b.bind(param_arrays)
+                            with autograd.no_grad():
+                                out = target(*[Tensor(a) for a in input_arrays])
+                    else:
+                        with autograd.no_grad():
+                            out = target(*[Tensor(a) for a in input_arrays])
+                finally:
+                    random_mod.default_generator().clear_trace_key()
+                return jax.tree_util.tree_map(
+                    lambda t: t.data if isinstance(t, Tensor) else t, out,
+                    is_leaf=lambda t: isinstance(t, Tensor))
+
+            jitted = jax.jit(run)
+            self._cache[key] = jitted
+        param_arrays = [t.data for t in tensors]
+        out = jitted(param_arrays, arrays, random_mod.next_key())
+        return jax.tree_util.tree_map(Tensor, out)
+
+    # paddle API-compat
+    @property
+    def forward(self):
+        return self.__call__
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
+              **kwargs):
+    """@paddle.jit.to_static equivalent (reference: fluid/dygraph/jit.py:163)."""
+    if function is None:
+        return lambda f: to_static(f, input_spec)
+    return StaticLayer(function, input_spec)
+
+
+class TrainStep:
+    """Whole-step compiler: the hybrid of InterpreterCore + generated grad ops.
+
+    usage::
+        step = paddle_tpu.jit.TrainStep(model, loss_fn, optimizer)
+        loss = step(x, y)            # one XLA executable: fwd+bwd+update
+
+    loss_fn(model, *batch) -> scalar loss Tensor.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer, donate=True):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.donate = donate
+        self._jitted = None
+        self._init_opt_state()
+
+    def _init_opt_state(self):
+        opt = self.optimizer
+        self.train_params = [p for p in opt._parameter_list if not p.stop_gradient]
+        named, buffers = _collect_params(self.model)
+        train_ids = {id(p) for p in self.train_params}
+        self.frozen = [p for _, p in named if id(p) not in train_ids] + \
+            [b for _, b in buffers]
+        for p in self.train_params:
+            if id(p) not in opt._accumulators:
+                opt._accumulators[id(p)] = opt._init_state(p.data)
+
+    def _build(self):
+        opt = self.optimizer
+        model, loss_fn = self.model, self.loss_fn
+        rule = type(opt)._rule
+        hyper = opt._hyper()
+        wd = opt._weight_decay
+        decoupled = opt._decoupled
+        clip = opt._grad_clip
+        train_params = self.train_params
+        frozen = self.frozen
+        wd_flags = tuple(
+            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
+            for p in train_params)
+
+        def step(params, states, frozen_arrays, lr, step_no, rngkey, *batch):
+            random_mod.default_generator().set_trace_key(rngkey)
+            try:
+                def loss_of(param_arrays):
+                    ts = train_params + frozen
+                    with _Binder(ts) as b:
+                        b.bind(list(param_arrays) + list(frozen_arrays))
+                        with autograd.no_grad():
+                            loss = loss_fn(model, *[Tensor(a) for a in batch])
+                    return loss.data.astype(jnp.float32)
+
+                loss_val, grads = jax.value_and_grad(loss_of)(tuple(params))
+                grads = list(grads)
+                if clip is not None:
+                    grads = clip._apply_jax(grads)
+                new_p, new_s = [], []
+                for p, g, s, flag in zip(params, grads, states, wd_flags):
+                    g = g.astype(p.dtype)
+                    if wd and not decoupled and flag:
+                        g = g + wd * p
+                    hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
+                    np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+                    if wd and decoupled and flag:
+                        np_ = np_ - (lr * wd * p).astype(p.dtype)
+                    new_p.append(np_)
+                    new_s.append(ns)
+                return loss_val, new_p, new_s
+            finally:
+                random_mod.default_generator().clear_trace_key()
+
+        donate = (0, 1) if self.donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._jitted = self._build()
+        opt = self.optimizer
+        params = [p.data for p in self.train_params]
+        states = [opt._accumulators[id(p)] for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+        arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        loss, new_p, new_s = self._jitted(
+            params, states, frozen_arrays, lr, step_no, random_mod.next_key(), *arrays)
+        for p, a in zip(self.train_params, new_p):
+            p.data = a
+        for p, s in zip(self.train_params, new_s):
+            opt._accumulators[id(p)] = s
+        opt._global_step += 1
+        return Tensor(loss)
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save: persist weights + a marker (AOT export comes with the
+    inference subsystem; reference: fluid/dygraph/jit.py jit.save)."""
+    from ..framework import io as fio
+
+    target = layer._target if isinstance(layer, StaticLayer) else layer
+    fio.save(target.state_dict(), path + ".pdparams")
+
+
+def load(path, **configs):
+    raise NotImplementedError(
+        "jit.load requires the inference subsystem (planned); "
+        "use paddle.load + set_state_dict")
+
+
+def not_to_static(fn=None):
+    return fn if fn is not None else (lambda f: f)
+
+
+def ignore_module(modules):
+    return None

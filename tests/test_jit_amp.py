@@ -1,0 +1,140 @@
+"""to_static capture, TrainStep whole-step compilation, AMP."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import amp, jit
+
+
+def test_to_static_layer_matches_eager():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.GELU(), nn.Linear(8, 2))
+    net.eval()
+    x = paddle.randn([3, 4])
+    eager = net(x).numpy()
+    static = jit.to_static(net)
+    out = static(x)
+    np.testing.assert_allclose(out.numpy(), eager, rtol=1e-5)
+    # params updated after capture must be picked up (not baked constants)
+    net[0].weight.set_value(net[0].weight.numpy() * 2)
+    eager2 = net(x).numpy()
+    np.testing.assert_allclose(static(x).numpy(), eager2, rtol=1e-5)
+    assert not np.allclose(eager, eager2)
+
+
+def test_to_static_function():
+    @jit.to_static
+    def f(a, b):
+        return a * 2 + b.sum()
+
+    x = paddle.to_tensor([1.0, 2.0])
+    y = paddle.to_tensor([3.0])
+    np.testing.assert_allclose(f(x, y).numpy(), [5.0, 7.0])
+
+
+def test_to_static_dropout_varies():
+    net = nn.Dropout(0.5)
+    net.train()
+    static = jit.to_static(net)
+    paddle.seed(0)
+    a = static(paddle.ones([256])).numpy()
+    b = static(paddle.ones([256])).numpy()
+    assert not np.array_equal(a, b), "dropout mask must differ across compiled calls"
+
+
+def test_train_step_matches_eager_path():
+    def make():
+        paddle.seed(7)
+        net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 1))
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        return net, o
+
+    x_np = np.random.RandomState(0).rand(8, 6).astype("float32")
+    y_np = np.random.RandomState(1).rand(8, 1).astype("float32")
+
+    # eager reference
+    net_e, opt_e = make()
+    for _ in range(3):
+        loss_e = F.mse_loss(net_e(paddle.to_tensor(x_np)), paddle.to_tensor(y_np))
+        loss_e.backward()
+        opt_e.step()
+        opt_e.clear_grad()
+
+    # compiled
+    net_c, opt_c = make()
+    step = jit.TrainStep(net_c, lambda m, x, y: F.mse_loss(m(x), y), opt_c)
+    for _ in range(3):
+        loss_c = step(paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+
+    np.testing.assert_allclose(loss_c.item(), loss_e.item(), rtol=1e-4)
+    for pe, pc in zip(net_e.parameters(), net_c.parameters()):
+        np.testing.assert_allclose(pe.numpy(), pc.numpy(), rtol=1e-4, atol=1e-6)
+
+
+def test_train_step_adam_converges():
+    paddle.seed(0)
+    net = nn.Linear(3, 1)
+    o = opt.Adam(learning_rate=0.1, parameters=net.parameters())
+    step = jit.TrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), o)
+    x = paddle.randn([16, 3])
+    y = (x.numpy() @ np.array([[1.0], [2.0], [3.0]], "float32")).astype("float32")
+    yt = paddle.to_tensor(y)
+    losses = [float(step(x, yt)) for _ in range(40)]
+    assert losses[-1] < losses[0] * 0.05
+    assert o._global_step == 40
+
+
+def test_amp_o1_casts_matmul():
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        out = paddle.matmul(a, b)
+        assert out.dtype == paddle.bfloat16
+        # black-list op stays fp32
+        s = paddle.nn.functional.softmax(a)
+        assert s.dtype == paddle.float32
+    # outside context: fp32 again
+    out2 = paddle.matmul(a, b)
+    assert out2.dtype == paddle.float32
+
+
+def test_amp_training_converges():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+    o = opt.Adam(learning_rate=0.02, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=1024.0)
+    x = paddle.randn([32, 8])
+    y = paddle.randn([32, 1])
+    first = last = None
+    for _ in range(30):
+        with amp.auto_cast(level="O1"):
+            loss = F.mse_loss(net(x), y)
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(o)
+        o.clear_grad()
+        if first is None:
+            first = float(loss)
+        last = float(loss)
+    assert last < first
+
+
+def test_grad_scaler_skips_on_inf():
+    w = nn.Parameter(np.ones(2, "float32"))
+    o = opt.SGD(learning_rate=0.1, parameters=[w])
+    scaler = amp.GradScaler(init_loss_scaling=4.0, decr_every_n_nan_or_inf=1)
+    loss = (w * float("inf")).sum()
+    scaler.scale(loss).backward()
+    scaler.step(o)
+    np.testing.assert_array_equal(w.numpy(), [1, 1])  # step skipped
+    assert scaler.get_loss_scaling().item() == 2.0  # scale halved
+
+
+def test_jit_save(tmp_path):
+    net = nn.Linear(2, 2)
+    jit.save(net, str(tmp_path / "model"))
+    sd = paddle.load(str(tmp_path / "model.pdparams"))
+    assert "weight" in sd
