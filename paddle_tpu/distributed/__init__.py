@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed: the fleet/collective stack, GSPMD-native.
+
+Reference: python/paddle/distributed/ (SURVEY §2.3). NCCL process groups are
+replaced by ONE jax.sharding.Mesh over ICI/DCN; collectives are XLA ops; the
+launcher bootstraps jax.distributed instead of exchanging NCCL unique ids.
+"""
+from . import fleet  # noqa: F401
+from .mesh import init_mesh, auto_mesh, get_mesh_env, MeshEnv, reset_mesh  # noqa: F401
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, is_initialized, init_parallel_env,
+    get_rank, get_world_size, all_reduce, all_gather, broadcast, reduce,
+    reduce_scatter, alltoall, scatter, barrier, send, recv,
+    psum, pmean, ppermute, axis_index, all_to_all_axis,
+)
+from .parallel import DataParallel, ShardedTrainStep, place_model  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+from .utils_recompute import recompute  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, **kwargs):
+    """reference spawn.py: single-controller SPMD needs no process spawn on one
+    host; multi-host uses the launch module. Runs func once."""
+    func(*args)
+
+
+class ParallelEnv:
+    """reference parallel.py ParallelEnv env-var view."""
+
+    def __init__(self):
+        import jax
+
+        self.world_size = jax.process_count()
+        self.rank = jax.process_index()
+        self.local_rank = 0
+        self.device_id = 0
+        self.nranks = self.world_size
+        self.current_endpoint = ""
+        self.trainer_endpoints = []

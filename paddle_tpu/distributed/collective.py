@@ -1,0 +1,318 @@
+"""Collective communication API.
+
+Reference: python/paddle/distributed/collective.py:208-1631 (new_group,
+all_reduce/all_gather/broadcast/... over NCCL process groups) and the C++
+ProcessGroup contract (collective/ProcessGroup.h:60).
+
+TPU-native semantics (single-controller SPMD): there is one Python process
+driving all chips, so "each rank's local tensor" is represented as ONE global
+tensor whose leading dim indexes ranks of the group ("stacked layout"). Each
+collective is a jitted ``shard_map`` over the group's mesh axis, so it executes
+as a real XLA collective on ICI — not a host emulation. Inside an active
+``shard_map``/pjit trace the same functions lower to ``lax.p*`` directly.
+
+This dual nature mirrors the reference's two API generations (static collective
+ops with ring ids vs dygraph ProcessGroup objects) collapsed into one.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..core.tensor import Tensor
+from .mesh import MeshEnv, get_mesh_env, require_mesh_env
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A named mesh axis (the process-group analogue)."""
+
+    def __init__(self, axis: str, env: MeshEnv, id: int = 0):
+        self.axis = axis
+        self.env = env
+        self.id = id
+
+    @property
+    def nranks(self) -> int:
+        return self.env.get_dim(self.axis)
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    @property
+    def rank(self) -> int:
+        return 0  # single controller drives all shards
+
+    def get_group_rank(self, rank):
+        return rank
+
+    def __repr__(self):
+        return f"Group(axis={self.axis!r}, nranks={self.nranks})"
+
+
+_DEFAULT_GROUP: Optional[Group] = None
+
+
+def _default_group() -> Group:
+    global _DEFAULT_GROUP
+    if _DEFAULT_GROUP is None:
+        env = require_mesh_env()
+        # the world group: the dp axis by default
+        _DEFAULT_GROUP = Group("dp", env)
+    return _DEFAULT_GROUP
+
+
+def new_group(ranks=None, backend=None, axis: str = None):
+    """Reference collective.py:208. Groups ARE axes here; `axis` selects one."""
+    env = require_mesh_env()
+    return Group(axis or "dp", env)
+
+
+def get_group(id=0):
+    return _default_group()
+
+
+def is_initialized() -> bool:
+    return get_mesh_env() is not None
+
+
+def init_parallel_env(**kwargs):
+    """Reference: python/paddle/distributed/parallel.py init_parallel_env.
+    Single-host: build the mesh over local devices. Multi-host: callers run
+    paddle_tpu.distributed.launch which handles jax.distributed.initialize."""
+    require_mesh_env()
+    return _default_group()
+
+
+def get_world_size(group: Optional[Group] = None) -> int:
+    env = get_mesh_env()
+    if env is None:
+        return 1
+    return (group or _default_group()).nranks
+
+
+def get_rank(group: Optional[Group] = None) -> int:
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# collectives — stacked-global layout, executed as shard_map'ed XLA collectives
+# ---------------------------------------------------------------------------
+
+_JIT_CACHE = {}
+
+
+def _axis_jit(kind, group: Group, **kw):
+    key = (kind, group.axis, id(group.env), tuple(sorted(kw.items())))
+    f = _JIT_CACHE.get(key)
+    if f is None:
+        mesh = group.env.mesh
+        ax = group.axis
+
+        if kind == "all_reduce":
+            op = kw["op"]
+
+            def body(x):
+                red = {"sum": jax.lax.psum, "max": jax.lax.pmax, "min": jax.lax.pmin}[
+                    "sum" if op == "avg" else op]
+                y = red(x, ax)
+                if op == "avg":
+                    y = y / jax.lax.psum(jnp.ones((), x.dtype), ax)
+                return y
+
+        elif kind == "all_gather":
+            def body(x):
+                return jax.lax.all_gather(x, ax, axis=0, tiled=True)
+
+        elif kind == "reduce_scatter":
+            def body(x):
+                return jax.lax.psum_scatter(x, ax, scatter_dimension=0, tiled=True)
+
+        elif kind == "broadcast":
+            src = kw["src"]
+
+            def body(x):
+                idx = jax.lax.axis_index(ax)
+                full = jax.lax.all_gather(x, ax, axis=0)
+                return full[src]
+
+        elif kind == "alltoall":
+            def body(x):
+                # x local: [world, ...]; swap rank/world dims
+                return jax.lax.all_to_all(x, ax, split_axis=0, concat_axis=0, tiled=True)
+
+        else:
+            raise ValueError(kind)
+
+        f = jax.jit(
+            shard_map(body, mesh=mesh, in_specs=P(ax), out_specs=_out_spec(kind, ax, **kw))
+        )
+        _JIT_CACHE[key] = f
+    return f
+
+
+def _out_spec(kind, ax, **kw):
+    if kind in ("all_reduce", "broadcast"):
+        return P(ax)  # every rank holds the result -> stacked layout preserved
+    if kind == "all_gather":
+        return P(ax)
+    if kind == "reduce_scatter":
+        return P(ax)
+    if kind == "alltoall":
+        return P(ax)
+    raise ValueError(kind)
+
+
+def _in_axis_context() -> Optional[str]:
+    """True when called under shard_map/pjit trace with our axes bound."""
+    try:
+        frame = jax.core.get_axis_env() if hasattr(jax.core, "get_axis_env") else None
+    except Exception:
+        frame = None
+    return None
+
+
+def _prep(tensor, group):
+    g = group or _default_group()
+    arr = tensor.data if isinstance(tensor, Tensor) else jnp.asarray(tensor)
+    n = g.nranks
+    if arr.shape[0] % n != 0:
+        raise ValueError(
+            f"stacked collective needs dim0 divisible by group size {n}, got {arr.shape}")
+    return arr, g
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Stacked layout: in [world*b, ...] sharded by rank; out same shape, every
+    rank's slice replaced by the reduction."""
+    arr, g = _prep(tensor, group)
+    if g.nranks == 1:
+        out = arr
+    else:
+        out = _axis_jit("all_reduce", g, op=op)(arr)
+    if isinstance(tensor, Tensor):
+        tensor.data = out
+        return tensor
+    return Tensor(out)
+
+
+def all_gather(tensor_list: Optional[List], tensor=None, group=None, sync_op=True):
+    """paddle signature: fills tensor_list with every rank's shard.
+    Stacked layout: input [world, ...] -> list of world tensors (each [...])."""
+    if tensor is None:  # functional style: all_gather(tensor)
+        tensor, tensor_list = tensor_list, None
+    arr, g = _prep(tensor, group)
+    n = g.nranks
+    per = arr.shape[0] // n
+    shards = [Tensor(arr[i * per : (i + 1) * per]) for i in range(n)]
+    if tensor_list is not None:
+        tensor_list.clear()
+        tensor_list.extend(shards)
+        return tensor_list
+    return shards
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    arr, g = _prep(tensor, group)
+    if g.nranks > 1:
+        per = arr.shape[0] // g.nranks
+        src_slice = arr[src * per : (src + 1) * per]
+        out = jnp.tile(src_slice, (g.nranks,) + (1,) * (arr.ndim - 1))
+    else:
+        out = arr
+    if isinstance(tensor, Tensor):
+        tensor.data = out
+        return tensor
+    return Tensor(out)
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # single-controller: reduce == all_reduce then conceptually only dst uses it
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
+    arr, g = _prep(tensor, group)
+    if g.nranks == 1:
+        return Tensor(arr)
+    out = _axis_jit("reduce_scatter", g)(arr)
+    return Tensor(out)
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    """Stacked: input list of per-rank tensors (or [world, ...] tensor)."""
+    if isinstance(in_tensor_list, (list, tuple)):
+        arr = jnp.stack([t.data if isinstance(t, Tensor) else jnp.asarray(t)
+                         for t in in_tensor_list])
+        g = group or _default_group()
+    else:
+        arr, g = _prep(in_tensor_list, group)
+    if g.nranks > 1:
+        flat = arr.reshape((-1,) + arr.shape[2:]) if isinstance(in_tensor_list, (list, tuple)) else arr
+        out = _axis_jit("alltoall", g)(flat)
+    else:
+        out = arr
+    if out_tensor_list is not None:
+        n = g.nranks
+        per = out.shape[0] // n
+        out_tensor_list.clear()
+        out_tensor_list.extend(Tensor(out[i * per : (i + 1) * per]) for i in range(n))
+        return out_tensor_list
+    return Tensor(out)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    arr, g = _prep(tensor, group)
+    return Tensor(arr)  # single-controller: data already placed
+
+
+def barrier(group=None):
+    env = get_mesh_env()
+    if env is not None:
+        jax.block_until_ready(jnp.zeros(()))
+    return None
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv maps to pipeline ppermute; use "
+        "paddle_tpu.distributed.meta_parallel pipeline utilities")
+
+
+recv = send
+
+
+# -- in-trace collectives (for shard_map bodies: TP/PP/EP internals) ---------
+
+def psum(x, axis: str):
+    return jax.lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return jax.lax.pmean(x, axis)
+
+
+def ppermute(x, axis: str, perm):
+    return jax.lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return jax.lax.axis_index(axis)
+
+
+def all_to_all_axis(x, axis: str, split_axis: int, concat_axis: int):
+    return jax.lax.all_to_all(x, axis, split_axis=split_axis,
+                              concat_axis=concat_axis, tiled=True)

@@ -1,0 +1,15 @@
+"""fleet: the distributed-training facade.
+
+Reference: fleet/base/fleet_base.py (init:170, distributed_model:896,
+distributed_optimizer:839), distributed_strategy.py:109, topology.py.
+"""
+from .base import (  # noqa: F401
+    init, is_initialized, distributed_model, distributed_optimizer,
+    get_hybrid_communicate_group, worker_index, worker_num, DistributedStrategy,
+)
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from ..meta_parallel import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from ..utils_recompute import recompute  # noqa: F401

@@ -1,0 +1,166 @@
+"""4-D+ topology facade over the mesh.
+
+Reference: fleet/base/topology.py — CommunicateTopology (:36) builds the
+cartesian rank grid, HybridCommunicateGroup (:117) builds per-axis NCCL groups
+with the degree-product check (:191). Here the mesh IS the topology; this class
+answers the same queries (degrees, per-axis groups) against MeshEnv.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List
+
+import numpy as np
+
+from ..mesh import MeshEnv, get_mesh_env, init_mesh
+from ..collective import Group
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding", "model"),
+                 dims=(1, 1, 1, 1)):
+        self._names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self._world = np.arange(math.prod(dims)).reshape(dims)
+
+    def get_hybrid_group_names(self):
+        return self._names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(self._world.size)
+
+    def get_rank(self, **axis_coords):
+        idx = tuple(axis_coords[n] for n in self._names)
+        return int(self._world[idx])
+
+    def get_coord(self, rank):
+        coords = np.unravel_index(rank, self._world.shape)
+        return tuple(int(c) for c in coords)
+
+    def get_axis_list(self, axis_name, index):
+        ax = self._names.index(axis_name)
+        sl = [slice(None)] * len(self._names)
+        sl[ax] = index
+        return sorted(int(r) for r in self._world[tuple(sl)].reshape(-1))
+
+    def get_comm_list(self, axis_name):
+        ax = self._names.index(axis_name)
+        moved = np.moveaxis(self._world, ax, -1).reshape(-1, self._dims[ax])
+        return [list(map(int, row)) for row in moved]
+
+
+_PADDLE2MESH = {"data": "dp", "pipe": "pp", "sharding": "sdp", "model": "mp",
+                "context": "cp", "expert": "ep"}
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:117. Wraps MeshEnv; per-axis 'groups' are axis
+    handles; rank queries are single-controller (always coordinate 0 — SPMD
+    sees all shards at once)."""
+
+    def __init__(self, topology: CommunicateTopology = None, strategy=None):
+        env = get_mesh_env()
+        if env is None:
+            degrees = {}
+            if strategy is not None:
+                h = strategy.hybrid_configs
+                degrees = dict(dp=h["dp_degree"], mp=h["mp_degree"],
+                               pp=h["pp_degree"], sharding=h["sharding_degree"],
+                               cp=h.get("cp_degree", 1), ep=h.get("ep_degree", 1))
+            env = init_mesh(**degrees) if degrees else init_mesh()
+        self._env = env
+        self._topo = topology or CommunicateTopology(
+            ("data", "pipe", "sharding", "model"),
+            (env.get_dim("dp"), env.get_dim("pp"), env.get_dim("sdp"), env.get_dim("mp")))
+
+    @property
+    def mesh_env(self) -> MeshEnv:
+        return self._env
+
+    def get_parallel_mode(self):
+        from . import base
+
+        if self._env.get_dim("pp") > 1:
+            return base.ParallelMode.PIPELINE_PARALLEL
+        if self._env.get_dim("sdp") > 1:
+            return base.ParallelMode.SHARDING_PARALLEL
+        if self._env.get_dim("mp") > 1:
+            return base.ParallelMode.TENSOR_PARALLEL
+        return base.ParallelMode.DATA_PARALLEL
+
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self):
+        return 0
+
+    def get_rank_from_stage(self, stage_id, **kwargs):
+        return stage_id
+
+    # degrees
+    def get_data_parallel_world_size(self):
+        return self._env.get_dim("dp")
+
+    def get_model_parallel_world_size(self):
+        return self._env.get_dim("mp")
+
+    def get_pipe_parallel_world_size(self):
+        return self._env.get_dim("pp")
+
+    def get_sharding_parallel_world_size(self):
+        return self._env.get_dim("sdp")
+
+    def get_context_parallel_world_size(self):
+        return self._env.get_dim("cp")
+
+    def get_expert_parallel_world_size(self):
+        return self._env.get_dim("ep")
+
+    # single-controller coordinates
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # groups = axis handles
+    def get_data_parallel_group(self) -> Group:
+        return Group("dp", self._env)
+
+    def get_model_parallel_group(self) -> Group:
+        return Group("mp", self._env)
+
+    def get_pipe_parallel_group(self) -> Group:
+        return Group("pp", self._env)
+
+    def get_sharding_parallel_group(self) -> Group:
+        return Group("sdp", self._env)
+
+    def get_context_parallel_group(self) -> Group:
+        return Group("cp", self._env)
+
+    def get_expert_parallel_group(self) -> Group:
+        return Group("ep", self._env)
+
+    def get_check_parallel_group(self):
+        return Group("dp", self._env)
+
+    def get_data_parallel_group_src_rank(self):
+        return 0
+
+    def get_model_parallel_group_src_rank(self):
+        return 0
+
+    def get_p2p_groups(self):
+        return None

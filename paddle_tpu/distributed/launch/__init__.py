@@ -1,0 +1,79 @@
+"""Launcher (reference: python/paddle/distributed/launch.py + fleet/launch.py
++ distributed/run/ controllers).
+
+The reference spawns one process per GPU and wires PADDLE_TRAINER_* env +
+NCCL id exchange. On TPU, one process drives all local chips (single
+controller), so the launcher's job collapses to:
+  - single host: exec the training script unchanged;
+  - multi-host (TPU pod slices): call jax.distributed.initialize with the
+    coordinator address (the TCPStore/gen_comm_id rendezvous role) before
+    exec'ing the script on every host.
+Env parsing mirrors PaddleCloudRoleMaker (fleet/base/role_maker.py:519):
+PADDLE_MASTER / PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM are honored, as are
+the JAX-native COORDINATOR_ADDRESS / NUM_PROCESSES / PROCESS_ID.
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import sys
+
+
+def _env(*names, default=None):
+    for n in names:
+        v = os.environ.get(n)
+        if v:
+            return v
+    return default
+
+
+def init_from_env():
+    """Initialize jax.distributed from launcher env (multi-host only)."""
+    import jax
+
+    coord = _env("PADDLE_MASTER", "COORDINATOR_ADDRESS", "MASTER_ADDR")
+    nprocs = _env("PADDLE_TRAINERS_NUM", "NUM_PROCESSES", "WORLD_SIZE")
+    pid = _env("PADDLE_TRAINER_ID", "PROCESS_ID", "RANK")
+    if coord and nprocs and int(nprocs) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coord,
+            num_processes=int(nprocs),
+            process_id=int(pid or 0),
+        )
+        return True
+    return False
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="Launch a training script on TPU (single controller per host)")
+    parser.add_argument("--master", default=None,
+                        help="coordinator host:port for multi-host jobs")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--rank", type=int, default=None, help="this host's index")
+    parser.add_argument("--devices", default=None,
+                        help="accepted for reference-compat; chips are auto-discovered")
+    parser.add_argument("--nproc_per_node", default=None, help="reference-compat; ignored")
+    parser.add_argument("--log_dir", default=None)
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+
+    if args.master:
+        os.environ.setdefault("PADDLE_MASTER", args.master)
+        os.environ.setdefault("PADDLE_TRAINERS_NUM", str(args.nnodes))
+        if args.rank is not None:
+            os.environ.setdefault("PADDLE_TRAINER_ID", str(args.rank))
+    if args.nnodes > 1:
+        init_from_env()
+
+    sys.argv = [args.script] + args.script_args
+    runpy.run_path(args.script, run_name="__main__")
+
+
+def launch():
+    main()

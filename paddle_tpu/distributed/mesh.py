@@ -1,0 +1,94 @@
+"""Device mesh environment: the NCCL-comm-registry replacement.
+
+Reference: fleet/base/topology.py (CommunicateTopology:36 cartesian rank mesh,
+HybridCommunicateGroup:117 building NCCL groups per axis) + platform
+collective_helper.h NCCLCommContext. TPU-native: ONE `jax.sharding.Mesh` whose
+named axes are the parallelism dimensions; "creating a comm group" becomes
+naming an axis; collectives are XLA ops lowered over ICI/DCN.
+
+Axes (superset of the reference's ['data','pipe','sharding','model'] — we add
+the context/expert axes the reference lacked, SURVEY §5 long-context note):
+    dp   data parallel
+    pp   pipeline stages
+    sdp  ZeRO sharding (parameter/optimizer-state sharding)
+    mp   tensor (model) parallel
+    cp   context/sequence parallel
+    ep   expert parallel
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXES = ("dp", "pp", "sdp", "mp", "cp", "ep")
+
+_GLOBAL: Dict[str, Optional[object]] = {"env": None}
+
+
+class MeshEnv:
+    """The live mesh + axis degrees (HybridCommunicateGroup role)."""
+
+    def __init__(self, degrees: Dict[str, int], devices: Optional[Sequence] = None):
+        devices = list(devices if devices is not None else jax.devices())
+        full = {ax: int(degrees.get(ax, 1)) for ax in AXES}
+        n = math.prod(full.values())
+        if n != len(devices):
+            raise ValueError(
+                f"product of axis degrees {full} = {n} != device count {len(devices)}")
+        self.degrees = full
+        # Axis order chooses ICI locality: mp (heaviest traffic) innermost.
+        self.axis_names = tuple(ax for ax in ("pp", "dp", "sdp", "ep", "cp", "mp"))
+        shape = tuple(full[ax] for ax in self.axis_names)
+        dev_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(dev_array, self.axis_names)
+
+    # -- queries (CommunicateTopology API shape) ----------------------------
+    def get_dim(self, axis: str) -> int:
+        return self.degrees[axis]
+
+    @property
+    def nranks(self) -> int:
+        return math.prod(self.degrees.values())
+
+    def sharding_for(self, spec: PartitionSpec) -> NamedSharding:
+        return NamedSharding(self.mesh, spec)
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def __repr__(self):
+        used = {k: v for k, v in self.degrees.items() if v > 1}
+        return f"MeshEnv({used or 'single-device'}, devices={self.nranks})"
+
+
+def init_mesh(dp=1, mp=1, pp=1, sharding=1, cp=1, ep=1, devices=None) -> MeshEnv:
+    """Create + install the global mesh (fleet._init_hybrid_parallel_env role)."""
+    env = MeshEnv({"dp": dp, "mp": mp, "pp": pp, "sdp": sharding, "cp": cp, "ep": ep},
+                  devices)
+    _GLOBAL["env"] = env
+    return env
+
+
+def auto_mesh(devices=None) -> MeshEnv:
+    """All devices on dp (pure data parallel) — the default world."""
+    devices = list(devices if devices is not None else jax.devices())
+    return init_mesh(dp=len(devices), devices=devices)
+
+
+def get_mesh_env() -> Optional[MeshEnv]:
+    return _GLOBAL["env"]
+
+
+def require_mesh_env() -> MeshEnv:
+    env = _GLOBAL["env"]
+    if env is None:
+        env = auto_mesh()
+    return env
+
+
+def reset_mesh():
+    _GLOBAL["env"] = None

@@ -1,0 +1,10 @@
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy,
+)
+from .wrappers import (  # noqa: F401
+    TensorParallel, ShardingParallel, PipelineParallel, HybridParallelOptimizer,
+    HybridParallelGradScaler,
+)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401
+from .random_ctl import get_rng_state_tracker, model_parallel_random_seed  # noqa: F401

@@ -1,0 +1,62 @@
+"""Model-parallel RNG control.
+
+Reference: fleet/meta_parallel/parallel_layers/random.py —
+model_parallel_random_seed + RNGStatesTracker giving each mp rank a distinct
+dropout stream while keeping replicated streams identical.
+
+TPU-native: threefry keys are splittable by design; per-axis streams are
+fold_in(global_key, axis_tag). Under SPMD a dropout inside a sharded region is
+already decorrelated per shard when the mask shape is sharded; the tracker
+exists for explicit paddle-style control.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...framework import random as random_mod
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+
+    def reset(self):
+        self.states.clear()
+
+    def add(self, name, seed):
+        if name in self.states:
+            raise ValueError(f"rng state {name} already exists")
+        self.states[name] = random_mod.Generator(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name="model_parallel_rng"):
+        if name not in self.states:
+            self.add(name, hash(name) % (2**31))
+        gen = self.states[name]
+        global_gen = random_mod._GLOBAL_GENERATOR
+        saved = random_mod._GLOBAL_GENERATOR
+        random_mod._GLOBAL_GENERATOR = gen
+        try:
+            yield
+        finally:
+            random_mod._GLOBAL_GENERATOR = saved
+
+
+_TRACKER = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _TRACKER
+
+
+def model_parallel_random_seed(seed=None):
+    """reference random.py model_parallel_random_seed: seed global + per-axis
+    streams deterministically."""
+    seed = seed if seed is not None else 0
+    random_mod.seed(seed)
+    _TRACKER.reset()
+    _TRACKER.add("global_seed", seed)
+    _TRACKER.add("model_parallel_rng", seed + 1024)
+    _TRACKER.add("local_seed", seed + 2048)

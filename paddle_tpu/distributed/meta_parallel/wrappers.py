@@ -1,0 +1,135 @@
+"""Model wrappers per parallel mode + hybrid optimizer.
+
+Reference: fleet/meta_parallel/{tensor_parallel.py:25, sharding_parallel.py,
+pipeline_parallel.py:152} and fleet/meta_optimizers/dygraph_optimizer/
+hybrid_parallel_optimizer.py.
+
+Under GSPMD the wrappers annotate instead of communicate: broadcast-at-init,
+grad all-reduce, and sharding-stage partitioning are all consequences of the
+parameter/batch shard specs once a step is compiled over the mesh.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ...nn.layer.layers import Layer
+from ...core.tensor import Tensor
+
+
+class _MetaParallelBase(Layer):
+    def __init__(self, layers: Layer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def forward(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    # delegate bookkeeping to the wrapped model
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, *a, **k):
+        return self._layers.set_state_dict(*a, **k)
+
+
+class TensorParallel(_MetaParallelBase):
+    """reference tensor_parallel.py:25 — broadcasts params in the mp group at
+    init. Single-controller: parameters are globally consistent by
+    construction; what remains is applying the mp shard specs at placement."""
+
+
+class ShardingParallel(_MetaParallelBase):
+    """ZeRO sharding wrapper: annotates every trainable param (and via the
+    optimizer, its state) with a 'sdp'-axis spec (stage-3 style full sharding;
+    reference sharding/sharding_stage3.py:50)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+        apply_sharding_specs(layers, hcg.mesh_env)
+
+
+def apply_sharding_specs(model: Layer, env, axis="sdp"):
+    """Pick the largest divisible dim of each param and shard it over `axis`
+    (the param->rank partition of sharding_optimizer_stage2.py:43, expressed
+    as a placement spec)."""
+    deg = env.get_dim(axis)
+    if deg <= 1:
+        return
+    for _, p in model.named_parameters():
+        if p.dist_spec is not None:
+            continue  # TP spec wins; ZeRO shards the rest
+        shape = p.shape
+        best = None
+        for i, s in enumerate(shape):
+            if s % deg == 0 and (best is None or s > shape[best]):
+                best = i
+        if best is not None:
+            spec = [None] * len(shape)
+            spec[best] = axis
+            p.dist_spec = P(*spec)
+
+
+class PipelineParallel(_MetaParallelBase):
+    """Pipeline wrapper; see pp_layers.PipelineLayer for the stage machinery.
+    train_batch keeps the reference API (pipeline_parallel.py:152)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__(layers, hcg, strategy)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ...nn import functional as F
+
+        x, y = data
+        loss = self._layers.compute_loss(x, y) if hasattr(self._layers, "compute_loss") \
+            else F.cross_entropy(self._layers(x), y)
+        if scaler is not None:
+            scaler.scale(loss).backward()
+            scaler.step(optimizer)
+        else:
+            loss.backward()
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
+
+
+class HybridParallelOptimizer:
+    """reference hybrid_parallel_optimizer.py: wraps the inner optimizer; grad
+    sync across mp/sharding groups is a compiled-step concern under SPMD, so
+    step() delegates; the wrapper keeps API + grad-clip semantics."""
+
+    def __init__(self, optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def clear_grad(self):
+        self._inner_opt.clear_grad()
+
+    def minimize(self, *a, **k):
+        return self._inner_opt.minimize(*a, **k)
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

@@ -1,0 +1,67 @@
+"""Activation recompute (reference: fleet/utils/recompute.py — PyLayer-based
+re-forward with RNG-state tracking).
+
+TPU-native: `jax.checkpoint` (remat) IS recompute — XLA schedules the
+re-forward inside the backward pass, trading FLOPs for HBM exactly like the
+reference's re-forward, but fused into the compiled graph. The RNG key is
+passed as an array input so dropout masks vary per step yet are identical
+between the forward and its backward replay (the RNGStatesTracker guarantee).
+"""
+from __future__ import annotations
+
+import jax
+
+from ..core.tensor import Tensor
+from ..core.dispatch import Primitive
+from ..core import autograd
+from ..framework import random as random_mod
+
+_REMAT_CACHE = {}
+
+
+def recompute(function, *args, **kwargs):
+    """fleet.utils.recompute(fn, *inputs): don't store fn's intermediates;
+    recompute them during backward."""
+    kwargs.pop("preserve_rng_state", True)
+    if kwargs:
+        raise NotImplementedError("recompute with extra kwargs")
+    if not all(isinstance(a, Tensor) for a in args):
+        return function(*args)
+    if all(t.stop_gradient for t in args) or not autograd.is_grad_enabled():
+        return function(*args)
+
+    from ..nn.layer.layers import Layer
+
+    params = list(function.parameters()) if isinstance(function, Layer) else []
+
+    cached = _REMAT_CACHE.get(id(function))
+    if cached is None:
+        n_args = len(args)
+
+        def raw(key, *arrays):
+            gen = random_mod.default_generator()
+            gen.set_trace_key(key)
+            saved = [p.data for p in params]
+            try:
+                # bind params as traced inputs so their grads flow through the
+                # tape and updated weights are seen (not baked constants)
+                for p, a in zip(params, arrays[n_args:]):
+                    p.data = a
+                call_args = [Tensor(a) for a in arrays[:n_args]]
+                with autograd.no_grad():
+                    out = function(*call_args)
+            finally:
+                for p, a in zip(params, saved):
+                    p.data = a
+                gen.clear_trace_key()
+            if isinstance(out, Tensor):
+                return out.data
+            return jax.tree_util.tree_map(
+                lambda t: t.data if isinstance(t, Tensor) else t, out,
+                is_leaf=lambda t: isinstance(t, Tensor))
+
+        prim = Primitive(f"recompute_{id(function)}", jax.checkpoint(raw))
+        cached = (prim, function)  # hold fn ref so id() stays unique
+        _REMAT_CACHE[id(function)] = cached
+    prim = cached[0]
+    return prim(random_mod.next_key(), *args, *params)

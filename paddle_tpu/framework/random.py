@@ -25,28 +25,30 @@ class Generator:
         self._seed = int(seed)
         self._key = jax.random.key(self._seed)
         self._counter = 0
-        self._trace_key = None
+        self._trace_keys = []
         self._trace_counter = 0
         return self
 
     # Under a jit trace, stateful key-splitting would bake a constant key into
     # the executable. The capture path (paddle_tpu.jit) installs a traced key
-    # here so dropout etc. stay random across compiled calls.
+    # here so dropout etc. stay random across compiled calls. A stack, because
+    # traces nest (recompute inside a compiled train step).
     def set_trace_key(self, key):
-        self._trace_key = key
+        self._trace_keys.append(key)
         self._trace_counter = 0
 
     def clear_trace_key(self):
-        self._trace_key = None
+        if self._trace_keys:
+            self._trace_keys.pop()
 
     def initial_seed(self) -> int:
         return self._seed
 
     def next_key(self):
         with self._lock:
-            if self._trace_key is not None:
+            if self._trace_keys:
                 self._trace_counter += 1
-                return jax.random.fold_in(self._trace_key, self._trace_counter)
+                return jax.random.fold_in(self._trace_keys[-1], self._trace_counter)
             self._counter += 1
             return jax.random.fold_in(self._key, self._counter)
 

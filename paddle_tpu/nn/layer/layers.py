@@ -17,9 +17,15 @@ from ...framework import dtype as dtype_mod
 
 
 class Parameter(Tensor):
-    """Trainable tensor (ParamBase analogue, fluid/framework.py:6274)."""
+    """Trainable tensor (ParamBase analogue, fluid/framework.py:6274).
 
-    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip", "is_distributed")
+    ``dist_spec`` holds a jax PartitionSpec: the GSPMD placement of this
+    parameter on the active mesh (the DistAttribute/dims_mapping analogue,
+    reference auto_parallel/dist_attribute.py). None = replicated.
+    """
+
+    __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip",
+                 "is_distributed", "dist_spec")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)
@@ -29,6 +35,7 @@ class Parameter(Tensor):
         self.do_model_average = None
         self.need_clip = True
         self.is_distributed = False
+        self.dist_spec = None
 
 
 class Layer:

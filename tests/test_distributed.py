@@ -1,0 +1,253 @@
+"""Distributed stack tests on the virtual 8-device CPU mesh.
+
+Reference strategy: collective_*_api.py 2-proc tests + hybrid-parallel parity
+tests (test_parallel_dygraph_tensor_parallel.py). Here SPMD single-controller:
+numerics of sharded compiled steps must match single-device eager exactly.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    yield
+    dist.reset_mesh()
+    import paddle_tpu.distributed.collective as coll
+
+    coll._DEFAULT_GROUP = None
+    import paddle_tpu.distributed.fleet.base as fb
+
+    fb._STATE.initialized = False
+    fb._STATE.hcg = None
+
+
+def test_mesh_degrees_check():
+    with pytest.raises(ValueError):
+        dist.init_mesh(dp=3, mp=4)  # 12 != 8
+    env = dist.init_mesh(dp=2, mp=2, pp=2)
+    assert env.nranks == 8
+    assert env.get_dim("mp") == 2
+
+
+def test_all_reduce_sum_and_avg():
+    dist.init_mesh(dp=4, mp=2)
+    g = dist.new_group(axis="dp")
+    t = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    out = dist.all_reduce(t, group=g)
+    col_sums = np.arange(8, dtype="float32").reshape(4, 2).sum(0)
+    np.testing.assert_allclose(out.numpy(), np.tile(col_sums, (4, 1)))
+    t2 = paddle.to_tensor(np.arange(8, dtype="float32").reshape(4, 2))
+    out2 = dist.all_reduce(t2, op=dist.ReduceOp.AVG, group=g)
+    np.testing.assert_allclose(out2.numpy(), np.tile(col_sums / 4, (4, 1)))
+
+
+def test_all_gather_broadcast():
+    dist.init_mesh(dp=2, mp=4)
+    g = dist.new_group(axis="dp")
+    t = paddle.to_tensor(np.arange(4, dtype="float32").reshape(2, 2))
+    shards = []
+    dist.all_gather(shards, t, group=g)
+    assert len(shards) == 2
+    np.testing.assert_array_equal(shards[1].numpy(), [[2, 3]])
+    b = dist.broadcast(paddle.to_tensor(np.array([[1.0], [2.0]])), src=0, group=g)
+    np.testing.assert_allclose(b.numpy(), [[1.0], [1.0]])
+
+
+def test_reduce_scatter_alltoall():
+    dist.init_mesh(dp=1, mp=8)
+    g = dist.new_group(axis="mp")
+    rs = dist.reduce_scatter(paddle.to_tensor(np.ones((64,), "float32")), group=g)
+    assert rs.shape == [8]
+    np.testing.assert_allclose(rs.numpy(), 8.0)
+    a2a = dist.alltoall(paddle.to_tensor(np.arange(64, dtype="float32")), group=g)
+    blocks = np.arange(64, dtype="float32").reshape(8, 8)
+    np.testing.assert_allclose(a2a.numpy().reshape(8, 8), blocks.T)
+
+
+def test_fleet_init_and_topology():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+                               "sharding_degree": 1, "cp_degree": 1, "ep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    assert hcg.get_model_parallel_world_size() == 2
+    assert hcg.get_pipe_parallel_world_size() == 2
+    assert hcg.get_data_parallel_world_size() == 2
+    topo = hcg.topology()
+    assert topo.world_size() == 8
+    comm = topo.get_comm_list("model")
+    assert len(comm) == 4 and all(len(c) == 2 for c in comm)
+
+
+def test_fleet_auto_dp_fill():
+    fleet.init(is_collective=True)  # no strategy: all 8 devices on dp
+    env = dist.get_mesh_env()
+    assert env.get_dim("dp") == 8
+
+
+def _tp_mlp():
+    class MLP(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.up = ColumnParallelLinear(8, 16, gather_output=False)
+            self.down = RowParallelLinear(16, 8, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.down(F.gelu(self.up(x)))
+
+    return MLP()
+
+
+@pytest.mark.dist
+def test_tp_sharded_step_matches_eager():
+    paddle.seed(3)
+    dist.init_mesh(dp=2, mp=4)
+    net = _tp_mlp()
+    snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+    o = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+    step = dist.ShardedTrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), o)
+    x = np.random.RandomState(0).rand(8, 8).astype("float32")
+    y = np.random.RandomState(1).rand(8, 8).astype("float32")
+    sharded = [float(step(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(4)]
+
+    dist.reset_mesh()
+    net2 = _tp_mlp()
+    net2.set_state_dict(snap)
+    o2 = opt.Adam(learning_rate=0.05, parameters=net2.parameters())
+    eager = []
+    for _ in range(4):
+        loss = F.mse_loss(net2(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        eager.append(float(loss))
+    np.testing.assert_allclose(sharded, eager, rtol=2e-4)
+
+
+@pytest.mark.dist
+def test_zero_sharding_matches_eager():
+    paddle.seed(11)
+    dist.init_mesh(sharding=8)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+    snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+    o = opt.AdamW(learning_rate=0.02, parameters=net.parameters())
+    model, o = dist.group_sharded_parallel(net, o, level="p_g_os")
+    # params got sdp specs
+    specs = [p.dist_spec for p in net.parameters()]
+    assert any(s is not None for s in specs)
+    step = dist.ShardedTrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), o)
+    x = np.random.RandomState(2).rand(8, 16).astype("float32")
+    y = np.random.RandomState(3).rand(8, 16).astype("float32")
+    sharded = [float(step(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(4)]
+
+    dist.reset_mesh()
+    net2 = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+    net2.set_state_dict(snap)
+    o2 = opt.AdamW(learning_rate=0.02, parameters=net2.parameters())
+    eager = []
+    for _ in range(4):
+        loss = F.mse_loss(net2(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        eager.append(float(loss))
+    np.testing.assert_allclose(sharded, eager, rtol=2e-4)
+
+
+@pytest.mark.dist
+def test_vocab_parallel_embedding():
+    paddle.seed(0)
+    dist.init_mesh(mp=8)
+    emb = VocabParallelEmbedding(64, 16)
+    ids = paddle.to_tensor(np.array([[1, 5, 63], [0, 2, 8]], "int32"))
+    out = emb(ids)
+    assert out.shape == [2, 3, 16]
+    ref = emb.weight.numpy()[ids.numpy()]
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-6)
+
+
+def test_data_parallel_wrapper():
+    dist.init_mesh(dp=8)
+    net = nn.Linear(4, 4)
+    dp = dist.DataParallel(net)
+    x = paddle.randn([8, 4])
+    out = dp(x)
+    assert out.shape == [8, 4]
+    with dp.no_sync():
+        assert not dp._grad_sync_enabled
+    assert dp._grad_sync_enabled
+    assert len(dp.parameters()) == 2
+
+
+def test_distributed_model_dispatch():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 4, "mp_degree": 2, "pp_degree": 1,
+                               "sharding_degree": 1, "cp_degree": 1, "ep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    net = _tp_mlp()
+    wrapped = fleet.distributed_model(net)
+    from paddle_tpu.distributed.meta_parallel import TensorParallel
+
+    assert isinstance(wrapped, TensorParallel)
+    o = fleet.distributed_optimizer(opt.Adam(learning_rate=0.01,
+                                             parameters=net.parameters()))
+    out = wrapped(paddle.randn([4, 8]))
+    out.mean().backward()
+    o.step()
+    o.clear_grad()
+
+
+def test_pipeline_layer_segmentation():
+    from paddle_tpu.distributed.meta_parallel import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(nn.Linear, 8, 8) for _ in range(7)]
+    pipe = PipelineLayer(layers=descs, num_stages=4)
+    parts = pipe.segment_parts
+    assert parts == [0, 2, 4, 6, 7]
+    assert len(pipe.get_stage_layers(0)) == 2
+    assert len(pipe.get_stage_layers(3)) == 1
+    out = pipe(paddle.randn([2, 8]))
+    assert out.shape == [2, 8]
+
+
+def test_shared_layer_desc_ties_weights():
+    from paddle_tpu.distributed.meta_parallel import SharedLayerDesc, PipelineLayer
+
+    descs = [
+        SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+        nn.ReLU(),
+        SharedLayerDesc("emb", nn.Linear, None, "weight", 8, 8),
+    ]
+    pipe = PipelineLayer(layers=descs, num_stages=1)
+    params = pipe.parameters()
+    # shared layer counted once: 1 weight + 1 bias (+0 from relu)
+    assert len(params) == 2
+
+
+def test_recompute_matches_direct():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 8))
+    x = paddle.randn([4, 8])
+    x.stop_gradient = False
+    direct = net(x)
+    direct.sum().backward()
+    g_direct = x.grad.numpy().copy()
+    w_direct = net[0].weight.grad.numpy().copy()
+    net.clear_gradients()
+    x2 = paddle.to_tensor(x.numpy())
+    x2.stop_gradient = False
+    out = dist.recompute(net, x2)
+    np.testing.assert_allclose(out.numpy(), direct.numpy(), rtol=1e-5)
+    out.sum().backward()
+    np.testing.assert_allclose(x2.grad.numpy(), g_direct, rtol=1e-4)
+    np.testing.assert_allclose(net[0].weight.grad.numpy(), w_direct, rtol=1e-4)
