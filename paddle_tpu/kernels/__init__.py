@@ -1,0 +1,1 @@
+"""Pallas TPU kernels: the fused-op library (operators/fused/ role)."""

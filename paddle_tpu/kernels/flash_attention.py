@@ -1,0 +1,104 @@
+"""Flash attention — Pallas TPU kernel.
+
+The fused_attention_op.cu / fmha_ref.h analogue (reference:
+paddle/fluid/operators/fused/), re-designed for the MXU: q-blocked attention
+with fp32 accumulation computed entirely in VMEM. Each grid step owns one
+(batch*head, q-block) tile; K/V stream in as whole-sequence VMEM blocks (fits
+to ~8k tokens at d=128 in bf16), logits never touch HBM.
+
+Backward is a recompute vjp (XLA attention math) registered via custom_vjp —
+memory-efficient fwd + standard bwd; a full Pallas bwd kernel is the planned
+upgrade. For very long sequences the cp-axis ring attention in
+paddle_tpu.distributed.context_parallel composes with this kernel per-shard.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 256
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
+    qi = pl.program_id(1)
+    q = q_ref[0]  # [block_q, d]
+    k = k_ref[0]  # [s, d]
+    v = v_ref[0]
+    logits = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
+        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
+        logits = jnp.where(qpos >= kpos, logits, -1e30)
+    m = jnp.max(logits, axis=-1, keepdims=True)
+    p = jnp.exp(logits - m)
+    denom = jnp.sum(p, axis=-1, keepdims=True)
+    p = (p / denom).astype(v.dtype)
+    o_ref[0] = jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+
+
+def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    grid = (bh, sq // block_q)
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+    )(q, k, v)
+
+
+def _xla_ref_bhsd(q, k, v, causal, scale):
+    logits = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        sq, sk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_bhsd(q, k, v, causal, scale, block_q):
+    return _flash_fwd_bhsd(q, k, v, causal, scale, block_q)
+
+
+def _flash_bhsd_fwd(q, k, v, causal, scale, block_q):
+    return _flash_fwd_bhsd(q, k, v, causal, scale, block_q), (q, k, v)
+
+
+def _flash_bhsd_bwd(causal, scale, block_q, res, ct):
+    q, k, v = res
+    _, vjp = jax.vjp(lambda a, b, c: _xla_ref_bhsd(a, b, c, causal, scale), q, k, v)
+    return vjp(ct)
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def flash_attention(q, k, v, causal: bool = False, scale: float = None,
+                    block_q: int = DEFAULT_BLOCK_Q):
+    """q/k/v: [batch, seq, heads, head_dim] (paddle layout). Differentiable."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    qm = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
+    km = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
+    vm = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
+    om = _flash_bhsd(qm, km, vm, bool(causal), float(scale), int(block_q))
+    return jnp.moveaxis(om.reshape(b, h, sq, d), 1, 2)

@@ -1,0 +1,361 @@
+"""Llama-family causal LM — the flagship model (BASELINE.md config 3).
+
+Built TPU-first on the framework's own layers:
+- tensor parallel via Column/RowParallelLinear + VocabParallelEmbedding
+  (GSPMD shard specs over the 'mp' axis),
+- sequence/context parallel via activation shard constraints on the 'cp' axis,
+- attention through F.scaled_dot_product_attention -> Pallas flash kernel,
+- activation recompute per decoder layer (jax.checkpoint),
+- GQA (num_key_value_heads < num_attention_heads).
+
+No counterpart exists in the reference snapshot (it predates Llama); the layer
+recipe follows the public architecture, expressed in this framework's API.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from ..nn import functional as F
+from ..ops import creation, manipulation
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding, mark_sharding,
+)
+from ..distributed.mesh import get_mesh_env
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_recompute: bool = False
+    scan_layers: bool = True  # lax.scan over decoder stack: O(1) compile in depth
+    dtype: str = "bfloat16"
+
+    @staticmethod
+    def llama2_7b(**overrides):
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+            max_position_embeddings=4096), **overrides})
+
+    @staticmethod
+    def llama3_8b(**overrides):
+        return LlamaConfig(**{**dict(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=8,
+            max_position_embeddings=8192, rope_theta=500000.0), **overrides})
+
+    @staticmethod
+    def tiny(**overrides):
+        return LlamaConfig(**{**dict(
+            vocab_size=256, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, dtype="float32"), **overrides})
+
+
+@primitive("rope_apply")
+def _rope(x, *, theta, pos_offset):
+    # x: [b, s, h, d]; rotate-half RoPE in fp32
+    b, s, h, d = x.shape
+    pos = jnp.arange(pos_offset, pos_offset + s, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(pos, inv)  # [s, d/2]
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2 :]
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def apply_rotary_pos_emb(x: Tensor, theta: float = 10000.0, pos_offset: int = 0) -> Tensor:
+    return _rope(x, theta=float(theta), pos_offset=int(pos_offset))
+
+
+def _cp_axes():
+    env = get_mesh_env()
+    if env is None:
+        return None
+    data = tuple(ax for ax in ("dp", "sdp") if env.get_dim(ax) > 1) or None
+    cp = "cp" if env.get_dim("cp") > 1 else None
+    return data, cp
+
+
+def _mark_seq(h: Tensor) -> Tensor:
+    """Constrain [b, s, d] activations: batch over dp/sdp, seq over cp."""
+    axes = _cp_axes()
+    if axes is None:
+        return h
+    data, cp = axes
+    if data is None and cp is None:
+        return h
+    return mark_sharding(h, data, cp, None)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        self.q_proj = ColumnParallelLinear(h, self.num_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.k_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.v_proj = ColumnParallelLinear(h, self.num_kv_heads * self.head_dim,
+                                           has_bias=False, gather_output=False)
+        self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, h,
+                                        has_bias=False, input_is_parallel=True)
+
+    def forward(self, hidden, cache=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        q = manipulation.reshape(self.q_proj(hidden), [b, s, self.num_heads, self.head_dim])
+        k = manipulation.reshape(self.k_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
+        v = manipulation.reshape(self.v_proj(hidden), [b, s, self.num_kv_heads, self.head_dim])
+        pos = 0 if cache is None else cache[0].shape[1]
+        q = apply_rotary_pos_emb(q, self.config.rope_theta, pos)
+        k = apply_rotary_pos_emb(k, self.config.rope_theta, pos)
+        if cache is not None:
+            k = manipulation.concat([cache[0], k], axis=1)
+            v = manipulation.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+        else:
+            new_cache = None
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = manipulation.repeat_interleave(k, rep, axis=2)
+            v = manipulation.repeat_interleave(v, rep, axis=2)
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=cache is None,
+                                             training=self.training)
+        out = manipulation.reshape(out, [b, s, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        return (out, new_cache) if cache is not None else out
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        h, i = config.hidden_size, config.intermediate_size
+        self.gate_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+        self.up_proj = ColumnParallelLinear(h, i, has_bias=False, gather_output=False)
+        self.down_proj = RowParallelLinear(i, h, has_bias=False, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, hidden):
+        hidden = _mark_seq(hidden)
+        residual = hidden
+        hidden = residual + self.self_attn(self.input_layernorm(hidden))
+        residual = hidden
+        hidden = residual + self.mlp(self.post_attention_layernorm(hidden))
+        return _mark_seq(hidden)
+
+
+class ScanDecoderStack(nn.Layer):
+    """The decoder stack as ONE lax.scan over stacked per-layer parameters.
+
+    TPU-first: compile time and program size are O(1) in depth (an unrolled
+    32-layer graph breaks compile budgets), weights for layer l live in the
+    leading dim of each stacked parameter — which shards over 'pp' when that
+    axis is active (stage-placed weights, the GSPMD pipeline idiom).
+    """
+
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        L = config.num_hidden_layers
+        # template layer supplies structure + math; its params are replaced by
+        # slices of the stacked params at each scan step
+        template = LlamaDecoderLayer(config)
+        self._template = [template]  # hidden from the sublayer registry
+        self._names = []
+        env = get_mesh_env()
+        pp = env.get_dim("pp") if env is not None else 1
+        from ..nn.layer.layers import Parameter
+        from jax.sharding import PartitionSpec as P
+
+        # init each layer independently (distinct RNG draws), stack on dim 0
+        protos = [template] + [LlamaDecoderLayer(config) for _ in range(L - 1)]
+        proto_params = [dict(pl.named_parameters()) for pl in protos]
+        for name, p in template.named_parameters():
+            stacked = Parameter(jnp.stack([pp_[name].data for pp_ in proto_params]))
+            base_spec = tuple(p.dist_spec) if p.dist_spec is not None else (None,) * p.ndim
+            stacked.dist_spec = P(*((("pp" if pp > 1 else None),) + base_spec))
+            safe = name.replace(".", "__")
+            self.add_parameter(safe, stacked)
+            self._names.append((safe, name))
+        _STACK_REGISTRY[id(self)] = self
+
+    def forward(self, hidden):
+        stacked = [self._parameters[safe] for safe, _ in self._names]
+        return _scan_stack(
+            hidden, *stacked,
+            _stack_id=id(self), use_recompute=self.config.use_recompute and self.training)
+
+
+_STACK_REGISTRY = {}
+
+
+@primitive("llama_scan_stack")
+def _scan_stack_fn(hidden, *stacked, _stack_id, use_recompute):
+    import jax
+
+    stack = _STACK_REGISTRY[_stack_id]
+    template = stack._template[0]
+    tparams = [dict(template.named_parameters())[orig] for _, orig in stack._names]
+
+    def body(carry, slices):
+        saved = [p.data for p in tparams]
+        try:
+            for p, s in zip(tparams, slices):
+                p.data = s
+            from ..core import autograd
+
+            with autograd.no_grad():
+                out = template(Tensor(carry)).data
+        finally:
+            for p, a in zip(tparams, saved):
+                p.data = a
+        return out, None
+
+    if use_recompute:
+        body = jax.checkpoint(body)
+    out, _ = jax.lax.scan(body, hidden, tuple(stacked))
+    return out
+
+
+def _scan_stack(hidden, *stacked, _stack_id, use_recompute):
+    return _scan_stack_fn(hidden, *stacked, _stack_id=_stack_id,
+                          use_recompute=use_recompute)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        if config.scan_layers:
+            self.layers = ScanDecoderStack(config)
+        else:
+            self.layers = nn.LayerList(
+                [LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        hidden = self.embed_tokens(input_ids)
+        hidden = _mark_seq(hidden)
+        if self.config.scan_layers:
+            hidden = self.layers(hidden)
+        else:
+            for layer in self.layers:
+                if self.config.use_recompute and self.training:
+                    from ..distributed.utils_recompute import recompute
+
+                    hidden = recompute(layer, hidden)
+                else:
+                    hidden = layer(hidden)
+        return self.norm(hidden)
+
+
+@primitive("fused_linear_ce")
+def _fused_linear_ce(hidden2d, w, labels1d, *, chunk, ignore_index):
+    """lm_head matmul + softmax CE scanned over token chunks: the [N, vocab]
+    logits tensor never materializes (compile-size + HBM win for 32k+ vocabs;
+    plays the c_softmax_with_cross_entropy fused-kernel role)."""
+    import jax
+
+    n = hidden2d.shape[0]
+    n_chunks = max(n // chunk, 1)
+    c = n // n_chunks
+    h3 = hidden2d[: n_chunks * c].reshape(n_chunks, c, hidden2d.shape[1])
+    l2 = labels1d[: n_chunks * c].reshape(n_chunks, c)
+
+    def body(acc, xs):
+        h, lab = xs
+        logits = jnp.matmul(h, w).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        mask = lab != ignore_index
+        safe = jnp.where(mask, lab, 0).astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, safe[:, None], axis=1)[:, 0]
+        loss_sum = -jnp.sum(jnp.where(mask, picked, 0.0))
+        cnt = jnp.sum(mask)
+        return (acc[0] + loss_sum, acc[1] + cnt), None
+
+    (total, count), _ = jax.lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)), (h3, l2))
+    return total / jnp.maximum(count, 1)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        self.lm_head = ColumnParallelLinear(
+            config.hidden_size, config.vocab_size, has_bias=False, gather_output=False)
+        if config.tie_word_embeddings:
+            self.lm_head.weight = self.llama.embed_tokens.weight
+        if config.dtype == "bfloat16":
+            self.to(dtype="bfloat16")
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        if labels is not None:
+            # fused chunked lm_head+CE: full logits never hit HBM
+            h = hidden[:, :-1, :]
+            lab = labels[:, 1:]
+            h2 = manipulation.reshape(h, [-1, self.config.hidden_size])
+            lab1 = manipulation.reshape(lab, [-1])
+            return _fused_linear_ce(h2, self.lm_head.weight, lab1,
+                                    chunk=2048, ignore_index=-100)
+        return self.lm_head(hidden)
+
+    def loss_from_logits(self, logits, labels):
+        v = self.config.vocab_size
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        flat_logits = manipulation.reshape(shift_logits, [-1, v])
+        flat_labels = manipulation.reshape(shift_labels, [-1])
+        flat_logits = manipulation.cast(flat_logits, "float32")
+        return F.cross_entropy(flat_logits, flat_labels)
+
+
+def llama_flops_per_token(config: LlamaConfig, seq_len: int) -> float:
+    """Model FLOPs per token (fwd+bwd, standard 6N + attention term) for MFU."""
+    n_params = llama_param_count(config)
+    attn = 12 * config.num_hidden_layers * config.hidden_size * seq_len
+    return 6 * n_params + attn
+
+
+def llama_param_count(config: LlamaConfig) -> int:
+    h, i, v, L = (config.hidden_size, config.intermediate_size,
+                  config.vocab_size, config.num_hidden_layers)
+    kvh = config.num_key_value_heads * (h // config.num_attention_heads)
+    per_layer = h * h + 2 * h * kvh + h * h + 3 * h * i + 2 * h
+    return L * per_layer + 2 * v * h + h
