@@ -34,6 +34,7 @@ BLACK_LIST = {
     "batch_norm_train_op", "batch_norm_infer_op", "p_norm", "logsumexp",
     "exp", "log", "reduce_std", "reduce_var", "nll_loss_op", "bce_op",
     "bce_logits_op", "mse_loss_op", "cumsum",
+    "softmax_ce_weighted_op", "nll_loss_weighted_op",
 }
 
 _STATE = {"enabled": False, "dtype": None, "level": "O1",

@@ -54,7 +54,7 @@ class GradNode:
 
     __slots__ = (
         "prim", "attrs", "primals", "inputs",
-        "out_avals", "n_outputs", "multi_output",
+        "out_avals", "n_outputs", "multi_output", "__weakref__",
     )
 
     def __init__(self, prim, attrs, primals, inputs, outs, multi_output):
@@ -165,6 +165,10 @@ def backward(root, grad=None, retain_graph: bool = False):
 def _accumulate_leaf(t, g):
     from .tensor import Tensor
 
+    # in-place proxies route their gradient to the live (mutated) tensor
+    target = getattr(t, "_grad_target", None)
+    if target is not None:
+        t = target
     if g.dtype != t.dtype:
         g = g.astype(t.dtype)
     if t.grad is None:

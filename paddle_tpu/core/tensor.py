@@ -12,6 +12,7 @@ be captured by ``jax.jit`` (the @to_static path).
 from __future__ import annotations
 
 import itertools
+import weakref
 from typing import Any, Optional
 
 import jax
@@ -27,7 +28,8 @@ _name_counter = itertools.count()
 class Tensor:
     __slots__ = (
         "data", "stop_gradient", "grad", "name", "persistable",
-        "_grad_node", "_out_index", "trainable", "__weakref__",
+        "_grad_node", "_out_index", "_grad_target", "_edges", "_edges_cap",
+        "trainable", "__weakref__",
     )
 
     def __init__(self, data, stop_gradient: bool = True, name: Optional[str] = None):
@@ -43,6 +45,8 @@ class Tensor:
         self.trainable = not stop_gradient
         self._grad_node: Optional[GradNode] = None
         self._out_index: int = 0
+        self._grad_target: Optional["Tensor"] = None
+        self._edges = None  # list[(weakref(GradNode), slot)] consumers of this tensor
 
     # -- metadata -----------------------------------------------------------
     @property
@@ -144,11 +148,44 @@ class Tensor:
 
     # -- in-place plumbing ---------------------------------------------------
     def _rebind(self, other: "Tensor"):
-        """Adopt another tensor's value + autograd identity (in-place op support)."""
+        """Adopt another tensor's value + autograd identity (in-place op support).
+
+        Every live GradNode edge that references *this* tensor (the in-place
+        op's own node AND any earlier consumer) must be repointed at the
+        pre-mutation version, otherwise backward either deadlocks on a
+        self-referential edge or chains earlier consumers through the in-place
+        node and multiplies their cotangent by it (mirrors eager TensorWrapper
+        snapshotting, paddle/fluid/eager/tensor_wrapper.h).
+        """
+        if self._edges:
+            proxy = None
+            for ref, slot in self._edges:
+                node = ref()
+                if node is None or not node.inputs:
+                    continue
+                if slot < len(node.inputs) and node.inputs[slot] is self:
+                    if proxy is None:
+                        proxy = Tensor.__new__(Tensor)
+                        proxy.data = self.data  # pre-mutation buffer
+                        proxy.stop_gradient = self.stop_gradient
+                        proxy.grad = None
+                        proxy.name = self.name + ".prev"
+                        proxy.persistable = False
+                        proxy.trainable = self.trainable
+                        proxy._grad_node = self._grad_node
+                        proxy._out_index = self._out_index
+                        proxy._edges = None
+                        # leaves keep accumulating into the live tensor's .grad
+                        proxy._grad_target = self if self._grad_node is None else None
+                    node.inputs[slot] = proxy
+        self._edges = other._edges
         self.data = other.data
         self._grad_node = other._grad_node
         self._out_index = other._out_index
-        self.stop_gradient = other.stop_gradient
+        if other._grad_node is not None:
+            self.stop_gradient = other.stop_gradient
+        # else (e.g. in-place under no_grad): keep our own flag so a mutated
+        # parameter stays trainable afterwards
         return self
 
     def set_value(self, value):
@@ -173,8 +210,8 @@ class Tensor:
                     v = getattr(self, s)
                 except AttributeError:
                     continue
-                if isinstance(v, jax.Array) or s in ("_grad_node",):
-                    object.__setattr__(new, s, v if s != "_grad_node" else None)
+                if isinstance(v, jax.Array) or s in ("_grad_node", "_edges"):
+                    object.__setattr__(new, s, v if s not in ("_grad_node", "_edges") else None)
                 else:
                     object.__setattr__(new, s, copy.deepcopy(v, memo))
         # fresh identity: copies must not collide in name-keyed stores
@@ -226,6 +263,26 @@ def dispatch(prim, args, attrs):
     out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_raw]
     if record:
         node = GradNode(prim, attrs, tuple(arrays), inputs, outs_raw, multi)
+        ref = weakref.ref(node)
+        for slot, t in enumerate(inputs):
+            if t is None:
+                continue
+            # consumer-edge backrefs so in-place mutation (_rebind) can repoint
+            # every recorded edge at the pre-mutation version
+            if t._edges is None:
+                t._edges = []
+                t._edges_cap = 32
+            elif len(t._edges) >= t._edges_cap:
+                live = []
+                for r, s in t._edges:
+                    n = r()
+                    if n is not None and n.inputs:
+                        live.append((r, s))
+                t._edges = live
+                # double the threshold when pruning freed little, so a tensor
+                # consumed n times in one forward costs O(n), not O(n^2)
+                t._edges_cap = max(32, 2 * len(live) + 16)
+            t._edges.append((ref, slot))
         for i, t in enumerate(out_tensors):
             t._grad_node = node
             t._out_index = i

@@ -159,9 +159,9 @@ def batch_norm(x, running_mean, running_var, weight, bias, training=False,
     if isinstance(running_mean, Tensor):
         m = momentum
         running_mean.set_value(m * running_mean.data + (1 - m) * batch_mean.data)
-        n = x.size // x.shape[axis]
-        unbiased = batch_var.data * (n / max(n - 1, 1))
-        running_var.set_value(m * running_var.data + (1 - m) * unbiased)
+        # reference accumulates the *biased* saved variance
+        # (paddle/phi/kernels/cpu/batch_norm_kernel.cc running_var update)
+        running_var.set_value(m * running_var.data + (1 - m) * batch_var.data)
     return out
 
 
@@ -281,21 +281,55 @@ def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
 
 @primitive("conv2d_transpose_op")
-def _conv2d_transpose(x, w, *, stride, padding, groups):
-    # w layout IOHW (paddle conv_transpose stores [in, out//groups, kh, kw])
-    return jax.lax.conv_transpose(
-        x, w, strides=stride, padding=[(p, p) for p in padding],
-        dimension_numbers=("NCHW", "IOHW", "NCHW"), transpose_kernel=True,
+def _conv2d_transpose(x, w, *, stride, padding, dilation, out_pad, groups):
+    # paddle stores the transpose kernel as [in, out//groups, kh, kw]
+    # (python/paddle/nn/layer/conv.py Conv2DTranspose). Express the op as the
+    # gradient of a forward conv: flip spatial dims, swap I/O per group, then a
+    # fractionally-strided (lhs_dilated) conv with gradient padding
+    # lo = hi = dilation*(k-1) - p, plus output_padding on the high side —
+    # matching paddle's out = (H-1)*s - 2p + d*(k-1) + 1 + op.
+    g = groups
+    cin, cog, kh, kw = w.shape
+    w = jnp.flip(w, axis=(2, 3))
+    w = w.reshape(g, cin // g, cog, kh, kw)
+    w = jnp.transpose(w, (0, 2, 1, 3, 4)).reshape(g * cog, cin // g, kh, kw)
+    pads = [
+        (dilation[i] * (k - 1) - padding[i],
+         dilation[i] * (k - 1) - padding[i] + out_pad[i])
+        for i, k in enumerate((kh, kw))
+    ]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation, feature_group_count=g,
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
     )
 
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
-    if int(groups) != 1:
-        raise NotImplementedError("conv2d_transpose with groups > 1 is not supported yet")
-    if output_padding not in (0, [0, 0], (0, 0)) or dilation not in (1, [1, 1], (1, 1)):
-        raise NotImplementedError("conv2d_transpose output_padding/dilation")
-    out = _conv2d_transpose(x, weight, stride=_pair(stride), padding=_pair(padding), groups=int(groups))
+    if data_format != "NCHW":
+        raise NotImplementedError("conv2d_transpose only supports NCHW")
+    st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
+    op = _pair(output_padding)
+    if output_size is not None:
+        if op != (0, 0):
+            raise ValueError(
+                "output_padding and output_size can not be both set")
+        if isinstance(output_size, Tensor):
+            output_size = output_size.tolist()
+        osz = _pair(output_size)
+        kh, kw = weight.shape[2], weight.shape[3]
+        op = tuple(
+            osz[i] - ((x.shape[2 + i] - 1) * st[i] - 2 * pd[i] + dl[i] * (k - 1) + 1)
+            for i, k in enumerate((kh, kw))
+        )
+        for i in range(2):
+            if not 0 <= op[i] < st[i]:
+                raise ValueError(
+                    f"output_size[{i}]={osz[i]} is out of the legal range "
+                    f"[min, min+stride) for the given input/kernel/stride")
+    out = _conv2d_transpose(x, weight, stride=st, padding=pd, dilation=dl,
+                            out_pad=op, groups=int(groups))
     if bias is not None:
         from ...ops import manipulation
 

@@ -20,31 +20,70 @@ def _reduce(out, reduction):
     return out
 
 
-@primitive("softmax_with_cross_entropy_op")
-def _softmax_ce(logits, labels, *, axis, soft_label, reduction, ignore_index):
-    logp = jax.nn.log_softmax(logits, axis=axis)
-    if soft_label:
-        loss = -jnp.sum(labels * logp, axis=axis)
+def _ce_core(logits, labels, axis, soft_label, ignore_index, use_softmax):
+    """Shared CE math. use_softmax=False: input is already softmax
+    probabilities and loss_j = -log(P[label_j]) (reference loss.py:1427-1433
+    docs; softmax_with_cross_entropy_op.h:82 skips the softmax step).
+    Returns (per-sample loss, mask, safe labels) — mask/safe are None for
+    soft labels."""
+    if use_softmax:
+        logp = jax.nn.log_softmax(logits, axis=axis)
     else:
-        lab = labels
-        if lab.ndim == logits.ndim:
-            lab = jnp.squeeze(lab, axis)
-        mask = lab != ignore_index
-        safe_lab = jnp.where(mask, lab, 0).astype(jnp.int32)
-        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, axis), axis=axis)
-        loss = jnp.where(mask, -jnp.squeeze(picked, axis), 0.0)
-        if reduction == "mean":
-            return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+        logp = jnp.log(jnp.maximum(logits, 1e-30))
+    if soft_label:
+        return -jnp.sum(labels * logp, axis=axis), None, None
+    lab = labels
+    if lab.ndim == logits.ndim:
+        lab = jnp.squeeze(lab, axis)
+    mask = lab != ignore_index
+    safe_lab = jnp.where(mask, lab, 0).astype(jnp.int32)
+    picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_lab, axis), axis=axis)
+    loss = jnp.where(mask, -jnp.squeeze(picked, axis), 0.0)
+    return loss, mask, safe_lab
+
+
+@primitive("softmax_with_cross_entropy_op")
+def _softmax_ce(logits, labels, *, axis, soft_label, reduction, ignore_index,
+                use_softmax=True):
+    loss, mask, _ = _ce_core(logits, labels, axis, soft_label, ignore_index,
+                             use_softmax)
+    if mask is not None and reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
+    return _reduce(loss, reduction)
+
+
+@primitive("softmax_ce_weighted_op")
+def _softmax_ce_weighted(logits, labels, weight, *, axis, soft_label, reduction,
+                         ignore_index, use_softmax):
+    # per-class weights: hard labels gather weight[label] (zeroed at
+    # ignore_index); mean divides by the summed gathered weights — matching
+    # reference loss.py weighted-mean semantics.
+    loss, mask, safe_lab = _ce_core(logits, labels, axis, soft_label,
+                                    ignore_index, use_softmax)
+    if soft_label:
+        wg = jnp.tensordot(labels.astype(weight.dtype), weight,
+                           axes=[[axis], [0]])
+    else:
+        wg = jnp.take(weight, safe_lab) * mask.astype(weight.dtype)
+    loss = loss * wg
+    if reduction == "mean":
+        denom = jnp.sum(wg)
+        return jnp.sum(loss) / (denom + (denom == 0.0))
     return _reduce(loss, reduction)
 
 
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, name=None):
     if weight is not None:
-        raise NotImplementedError("cross_entropy with class weights")
+        return _softmax_ce_weighted(
+            input, label, weight, axis=int(axis), soft_label=bool(soft_label),
+            reduction=reduction, ignore_index=int(ignore_index),
+            use_softmax=bool(use_softmax),
+        )
     return _softmax_ce(
         input, label, axis=int(axis), soft_label=bool(soft_label),
         reduction=reduction, ignore_index=int(ignore_index),
+        use_softmax=bool(use_softmax),
     )
 
 
@@ -59,18 +98,41 @@ def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-10
     return loss
 
 
-@primitive("nll_loss_op")
-def _nll_loss(logp, labels, *, reduction, ignore_index):
+def _nll_core(logp, labels, ignore_index):
+    """Shared gather: class axis is 1 for K-dim input (N, C, d1, ...) per the
+    reference nll_loss contract; returns (per-elem loss, mask, safe labels)."""
+    if logp.ndim > 2:
+        logp = jnp.moveaxis(logp, 1, -1)
     mask = labels != ignore_index
     safe = jnp.where(mask, labels, 0).astype(jnp.int32)
     picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
     loss = jnp.where(mask, -jnp.squeeze(picked, -1), 0.0)
+    return loss, mask, safe
+
+
+@primitive("nll_loss_op")
+def _nll_loss(logp, labels, *, reduction, ignore_index):
+    loss, mask, _ = _nll_core(logp, labels, ignore_index)
     if reduction == "mean":
         return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1)
     return _reduce(loss, reduction)
 
 
+@primitive("nll_loss_weighted_op")
+def _nll_loss_weighted(logp, labels, weight, *, reduction, ignore_index):
+    loss, mask, safe = _nll_core(logp, labels, ignore_index)
+    wg = jnp.take(weight, safe) * mask.astype(weight.dtype)
+    loss = loss * wg
+    if reduction == "mean":
+        denom = jnp.sum(wg)
+        return jnp.sum(loss) / (denom + (denom == 0.0))
+    return _reduce(loss, reduction)
+
+
 def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    if weight is not None:
+        return _nll_loss_weighted(input, label, weight, reduction=reduction,
+                                  ignore_index=int(ignore_index))
     return _nll_loss(input, label, reduction=reduction, ignore_index=int(ignore_index))
 
 

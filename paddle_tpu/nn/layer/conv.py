@@ -73,6 +73,8 @@ class Conv2DTranspose(Layer):
             kernel_size = (kernel_size, kernel_size)
         self._stride = stride
         self._padding = padding
+        self._output_padding = output_padding
+        self._dilation = dilation
         self._groups = groups
         fan_in = in_channels * kernel_size[0] * kernel_size[1]
         self.weight = self.create_parameter(
@@ -85,5 +87,8 @@ class Conv2DTranspose(Layer):
             self.bias = self.create_parameter([out_channels], attr=bias_attr, is_bias=True)
 
     def forward(self, x, output_size=None):
-        return F.conv2d_transpose(x, self.weight, self.bias, self._stride,
-                                  self._padding, groups=self._groups)
+        return F.conv2d_transpose(
+            x, self.weight, self.bias, self._stride, self._padding,
+            output_padding=0 if output_size is not None else self._output_padding,
+            groups=self._groups, dilation=self._dilation,
+            output_size=output_size)

@@ -44,11 +44,13 @@ class L1Loss(Layer):
 class NLLLoss(Layer):
     def __init__(self, weight=None, ignore_index=-100, reduction="mean", name=None):
         super().__init__()
+        self.weight = weight
         self.ignore_index = ignore_index
         self.reduction = reduction
 
     def forward(self, input, label):
-        return F.nll_loss(input, label, ignore_index=self.ignore_index,
+        return F.nll_loss(input, label, weight=self.weight,
+                          ignore_index=self.ignore_index,
                           reduction=self.reduction)
 
 
