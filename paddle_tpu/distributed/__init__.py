@@ -15,6 +15,8 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel, ShardedTrainStep, place_model  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .utils_recompute import recompute  # noqa: F401
+from . import models  # noqa: F401
+from .models.moe import global_scatter, global_gather  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, **kwargs):

@@ -39,13 +39,36 @@ def mark_sharding(x: Tensor, *spec) -> Tensor:
     return _shard_constraint(x, spec=tuple(spec), _env_id=id(env))
 
 
-@primitive("shard_constraint")
-def _shard_constraint(x, *, spec, _env_id):
+def constrain_spec(arr, spec):
+    """with_sharding_constraint on a raw array, robust to being inside a
+    partial-manual shard_map (the pp pipeline): constraints there must be
+    built on the context AbstractMesh with its Manual axes stripped (pp
+    handoff is explicit)."""
     env = get_mesh_env()
     if env is None:
-        return x
-    ns = NamedSharding(env.mesh, P(*spec))
-    return jax.lax.with_sharding_constraint(x, ns)
+        return arr
+    am = jax.sharding.get_abstract_mesh()
+    if am is not None and not am.empty and am._any_axis_manual:
+        manual = {name for name, ty in zip(am.axis_names, am.axis_types)
+                  if "Manual" in str(ty)}
+
+        def strip(entry):
+            if entry is None:
+                return None
+            if isinstance(entry, (tuple, list)):
+                kept = tuple(e for e in entry if e not in manual)
+                return kept or None
+            return None if entry in manual else entry
+
+        ns = NamedSharding(am, P(*(strip(e) for e in spec)))
+    else:
+        ns = NamedSharding(env.mesh, P(*spec))
+    return jax.lax.with_sharding_constraint(arr, ns)
+
+
+@primitive("shard_constraint")
+def _shard_constraint(x, *, spec, _env_id):
+    return constrain_spec(x, spec)
 
 
 class VocabParallelEmbedding(nn.Layer):

@@ -74,6 +74,24 @@ def param_sharding(p, env: MeshEnv) -> NamedSharding:
     return env.sharding_for(spec) if spec is not None else env.replicated()
 
 
+def zero_partition_spec(shape, env: MeshEnv, axis="sdp") -> Optional[P]:
+    """Largest-divisible-dim sharding over the ZeRO axis — the param->rank
+    partition of sharding_optimizer_stage2.py:43 expressed as a spec. Returns
+    None when nothing divides (that param's state stays replicated)."""
+    deg = env.get_dim(axis)
+    if deg <= 1:
+        return None
+    best = None
+    for i, s in enumerate(shape):
+        if s % deg == 0 and (best is None or s > shape[best]):
+            best = i
+    if best is None:
+        return None
+    spec = [None] * len(shape)
+    spec[best] = axis
+    return P(*spec)
+
+
 def place_model(model: Layer, env: Optional[MeshEnv] = None):
     """Materialize every parameter/buffer at its mesh placement (the
     broadcast-at-init of TensorParallel/DataParallel wrappers)."""
@@ -114,12 +132,25 @@ class ShardedTrainStep:
             if id(p) not in opt._accumulators:
                 opt._accumulators[id(p)] = opt._init_state(p.data)
         place_model(model, self.env)
-        # place optimizer state like its param (ZeRO: state shards with param)
+        # ZeRO stage from group_sharded_parallel: 1 = optimizer state sharded
+        # over sdp, 2 = + gradients reduce-scattered, 3 = + params sharded
+        # (stage 3 arrives via dist_spec; stages 1/2 shard state while the
+        # param stays replicated)
+        self.zero_stage = int(getattr(optimizer, "_zero_stage", 0))
+        # place optimizer state at its (possibly ZeRO-sharded) placement
         for p in self.train_params:
             st = opt._accumulators[id(p)]
-            sh = param_sharding(p, self.env)
+            sh = self._state_sharding(p)
             opt._accumulators[id(p)] = {k: jax.device_put(v, sh) if v.shape == p.data.shape
                                         else v for k, v in st.items()}
+
+    def _state_sharding(self, p) -> NamedSharding:
+        """Optimizer-state placement: like the param, except ZeRO stage 1/2
+        shards the state of replicated params over sdp."""
+        if getattr(p, "dist_spec", None) is not None or self.zero_stage < 1:
+            return param_sharding(p, self.env)
+        spec = zero_partition_spec(p.shape, self.env)
+        return self.env.sharding_for(spec) if spec is not None else self.env.replicated()
 
     def _default_batch_spec(self, arr):
         data_axes = [ax for ax in ("dp", "sdp") if self.env.get_dim(ax) > 1]
@@ -157,6 +188,12 @@ class ShardedTrainStep:
 
                 loss_val, grads = jax.value_and_grad(loss_of)(tuple(params))
                 grads = list(grads)
+                if zero2_shardings is not None:
+                    # ZeRO-2: constrain each grad to the optimizer-state shard
+                    # spec so XLA emits a reduce-scatter (not all-reduce) and
+                    # the update math runs on 1/sdp of each grad
+                    grads = [g if sh is None else jax.lax.with_sharding_constraint(g, sh)
+                             for g, sh in zip(grads, zero2_shardings)]
                 if clip is not None:
                     grads = clip._apply_jax(grads)
                 new_p, new_s = [], []
@@ -174,9 +211,16 @@ class ShardedTrainStep:
             finally:
                 random_mod.default_generator().clear_trace_key()
 
+        zero2_shardings = None
+        if self.zero_stage >= 2:
+            zero2_shardings = [
+                None if getattr(p, "dist_spec", None) is not None
+                else self._state_sharding(p)
+                for p in train_params
+            ]
         param_sh = [param_sharding(p, env) for p in train_params]
         state_sh = [
-            {k: (param_sharding(p, env) if v.shape == p.data.shape else env.replicated())
+            {k: (self._state_sharding(p) if v.shape == p.data.shape else env.replicated())
              for k, v in opt._accumulators[id(p)].items()}
             for p in train_params
         ]

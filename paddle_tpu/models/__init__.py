@@ -1,5 +1,5 @@
 """Flagship model zoo (NLP side; vision lives in paddle_tpu.vision.models)."""
 from .llama import (  # noqa: F401
-    LlamaConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer,
+    LlamaConfig, LlamaMoEConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer,
     llama_param_count, llama_flops_per_token, apply_rotary_pos_emb,
 )

@@ -43,6 +43,7 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_recompute: bool = False
     scan_layers: bool = True  # lax.scan over decoder stack: O(1) compile in depth
+    pp_microbatches: int = 0  # microbatches for the pp pipeline (0 = 2*pp)
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -65,6 +66,25 @@ class LlamaConfig:
             vocab_size=256, hidden_size=128, intermediate_size=256,
             num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
             max_position_embeddings=256, dtype="float32"), **overrides})
+
+
+@dataclass
+class LlamaMoEConfig(LlamaConfig):
+    """DeepSeekMoE/Qwen2-MoE-style config (BASELINE config 5): every MLP is a
+    top-k routed expert layer over the 'ep' mesh axis."""
+    num_experts: int = 8
+    top_k: int = 2
+    moe_intermediate_size: int = 0  # 0 = intermediate_size
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+    @staticmethod
+    def tiny(**overrides):
+        return LlamaMoEConfig(**{**dict(
+            vocab_size=256, hidden_size=128, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            max_position_embeddings=256, dtype="float32",
+            num_experts=4, top_k=2), **overrides})
 
 
 @primitive("rope_apply")
@@ -164,7 +184,15 @@ class LlamaDecoderLayer(nn.Layer):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.self_attn = LlamaAttention(config)
-        self.mlp = LlamaMLP(config)
+        if getattr(config, "num_experts", 0) > 1:
+            from ..nn.layer.moe import MoELayer
+
+            self.mlp = MoELayer(
+                config.hidden_size, config.num_experts,
+                intermediate_size=config.moe_intermediate_size or config.intermediate_size,
+                top_k=config.top_k, capacity_factor=config.capacity_factor)
+        else:
+            self.mlp = LlamaMLP(config)
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
@@ -214,17 +242,25 @@ class ScanDecoderStack(nn.Layer):
 
     def forward(self, hidden):
         stacked = [self._parameters[safe] for safe, _ in self._names]
-        return _scan_stack(
-            hidden, *stacked,
-            _stack_id=id(self), use_recompute=self.config.use_recompute and self.training)
+        has_moe = getattr(self.config, "num_experts", 0) > 1
+        out = _scan_stack(
+            hidden, *stacked, _stack_id=id(self), has_moe=has_moe,
+            use_recompute=self.config.use_recompute and self.training)
+        if has_moe:
+            from ..nn.layer import moe as moe_mod
+
+            out, aux = out
+            moe_mod.record_aux(aux)
+        return out
 
 
 _STACK_REGISTRY = {}
 
 
 @primitive("llama_scan_stack")
-def _scan_stack_fn(hidden, *stacked, _stack_id, use_recompute):
+def _scan_stack_fn(hidden, *stacked, _stack_id, use_recompute, has_moe=False):
     import jax
+    from ..nn.layer import moe as moe_mod
 
     stack = _STACK_REGISTRY[_stack_id]
     template = stack._template[0]
@@ -237,22 +273,52 @@ def _scan_stack_fn(hidden, *stacked, _stack_id, use_recompute):
                 p.data = s
             from ..core import autograd
 
-            with autograd.no_grad():
+            with moe_mod.collect_aux() as bucket, autograd.no_grad():
                 out = template(Tensor(carry)).data
         finally:
             for p, a in zip(tparams, saved):
                 p.data = a
-        return out, None
+        aux = sum((t.data for t in bucket), jnp.zeros((), jnp.float32))
+        return out, aux
+
+    env = get_mesh_env()
+    pp = env.get_dim("pp") if env is not None else 1
+    if pp > 1:
+        # compiled microbatch pipeline: manual over 'pp' (ppermute handoffs),
+        # auto/GSPMD over dp/mp/cp/sdp inside each stage. Each device's local
+        # slice of the stacked params is its stage's L/pp layers, applied by
+        # an inner scan per tick.
+        from ..distributed.meta_parallel.pipeline import (
+            microbatch, pipeline_shard_map, unmicrobatch)
+
+        L = stack.config.num_hidden_layers
+        if L % pp != 0:
+            raise ValueError(
+                f"num_hidden_layers={L} must be divisible by pp={pp} "
+                f"(each pipeline stage holds L/pp layers)")
+        M = stack.config.pp_microbatches or 2 * pp
+
+        def stage_fn(h, *stacked_local):
+            out, aux = jax.lax.scan(body, h, tuple(stacked_local))
+            return out, jnp.sum(aux)
+
+        x_mb = microbatch(hidden, M)
+        piped = pipeline_shard_map(stage_fn, env, len(stacked),
+                                   remat=use_recompute, with_aux=True)
+        out_mb, aux = piped(x_mb, *stacked)
+        out = unmicrobatch(out_mb)
+        # per-microbatch aux values average to the full-batch value
+        return (out, aux / M) if has_moe else out
 
     if use_recompute:
         body = jax.checkpoint(body)
-    out, _ = jax.lax.scan(body, hidden, tuple(stacked))
-    return out
+    out, aux = jax.lax.scan(body, hidden, tuple(stacked))
+    return (out, jnp.sum(aux)) if has_moe else out
 
 
-def _scan_stack(hidden, *stacked, _stack_id, use_recompute):
+def _scan_stack(hidden, *stacked, _stack_id, use_recompute, has_moe=False):
     return _scan_stack_fn(hidden, *stacked, _stack_id=_stack_id,
-                          use_recompute=use_recompute)
+                          use_recompute=use_recompute, has_moe=has_moe)
 
 
 class LlamaModel(nn.Layer):
@@ -325,15 +391,24 @@ class LlamaForCausalLM(nn.Layer):
             self.to(dtype="bfloat16")
 
     def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+        from ..nn.layer import moe as moe_mod
+
+        with moe_mod.collect_aux() as bucket:
+            hidden = self.llama(input_ids)
+        aux = moe_mod.drain_aux(bucket)
         if labels is not None:
             # fused chunked lm_head+CE: full logits never hit HBM
             h = hidden[:, :-1, :]
             lab = labels[:, 1:]
             h2 = manipulation.reshape(h, [-1, self.config.hidden_size])
             lab1 = manipulation.reshape(lab, [-1])
-            return _fused_linear_ce(h2, self.lm_head.weight, lab1,
+            loss = _fused_linear_ce(h2, self.lm_head.weight, lab1,
                                     chunk=2048, ignore_index=-100)
+            if aux is not None:
+                loss = loss + getattr(self.config, "aux_loss_weight", 0.0) * aux
+            return loss
+        if aux is not None:
+            moe_mod.record_aux(aux)  # re-raise for an outer collector
         return self.lm_head(hidden)
 
     def loss_from_logits(self, logits, labels):

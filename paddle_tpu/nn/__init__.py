@@ -27,6 +27,7 @@ from .layer.loss import (  # noqa: F401
     CosineEmbeddingLoss,
 )
 from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
+from .layer.moe import MoELayer, ExpertMLP  # noqa: F401
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

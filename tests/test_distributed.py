@@ -251,3 +251,173 @@ def test_recompute_matches_direct():
     out.sum().backward()
     np.testing.assert_allclose(x2.grad.numpy(), g_direct, rtol=1e-4)
     np.testing.assert_allclose(net[0].weight.grad.numpy(), w_direct, rtol=1e-4)
+
+
+def _zero_stage_run(level, seed=21):
+    """Run 4 sharded steps at the given ZeRO level; return (losses, step)."""
+    paddle.seed(seed)
+    dist.reset_mesh()
+    dist.init_mesh(dp=2, sharding=4)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+    snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+    o = opt.AdamW(learning_rate=0.02, parameters=net.parameters())
+    model, o = dist.group_sharded_parallel(net, o, level=level)
+    step = dist.ShardedTrainStep(net, lambda m, x, y: F.mse_loss(m(x), y), o)
+    x = np.random.RandomState(4).rand(8, 16).astype("float32")
+    y = np.random.RandomState(5).rand(8, 16).astype("float32")
+    losses = [float(step(paddle.to_tensor(x), paddle.to_tensor(y))) for _ in range(4)]
+
+    dist.reset_mesh()
+    paddle.seed(seed)
+    net2 = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+    net2.set_state_dict(snap)
+    o2 = opt.AdamW(learning_rate=0.02, parameters=net2.parameters())
+    eager = []
+    for _ in range(4):
+        loss = F.mse_loss(net2(paddle.to_tensor(x)), paddle.to_tensor(y))
+        loss.backward()
+        o2.step()
+        o2.clear_grad()
+        eager.append(float(loss))
+    return losses, eager, step
+
+
+@pytest.mark.dist
+@pytest.mark.parametrize("level", ["os", "os_g"])
+def test_zero_stage12_parity_and_state_sharding(level):
+    losses, eager, step = _zero_stage_run(level)
+    np.testing.assert_allclose(losses, eager, rtol=2e-4)
+    # params stay replicated in stages 1/2
+    for p in step.train_params:
+        assert p.dist_spec is None
+        shard = p.data.addressable_shards[0].data
+        assert shard.shape == p.data.shape
+    # optimizer moment state is sharded over sdp (4x smaller per device)
+    opt_ = step.optimizer
+    sharded_any = False
+    for p in step.train_params:
+        for k, v in opt_._accumulators[id(p)].items():
+            if v.shape == tuple(p.shape):
+                frac = v.addressable_shards[0].data.size / v.size
+                if frac <= 0.25 + 1e-6:
+                    sharded_any = True
+    assert sharded_any, "no optimizer state was sharded over sdp"
+
+
+@pytest.mark.dist
+def test_pp_pipeline_matches_sequential():
+    """The compiled ppermute pipeline (pp=2) must match the pp=1 sequential
+    scan bit-for-bit (same math, different schedule)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    def run(pp):
+        dist.reset_mesh()
+        dist.init_mesh(pp=pp, dp=8 // pp)
+        paddle.seed(7)
+        cfg = LlamaConfig.tiny(num_hidden_layers=4, hidden_size=64,
+                               intermediate_size=128, num_attention_heads=4,
+                               num_key_value_heads=4, vocab_size=128)
+        model = LlamaForCausalLM(cfg)
+        snap = {k: v.numpy().copy() for k, v in model.state_dict().items()}
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        step = dist.ShardedTrainStep(model, lambda m, x, y: m(x, labels=y), o)
+        ids = paddle.to_tensor(
+            np.random.RandomState(0).randint(0, 128, (8, 16)).astype("int32"))
+        losses = [float(step(ids, ids)) for _ in range(3)]
+        return snap, losses
+
+    snap1, seq_losses = run(1)
+    snap2, pp_losses = run(2)
+    # identical init (same seed) => identical training trajectory
+    for k in snap1:
+        np.testing.assert_allclose(snap1[k], snap2[k], rtol=0, atol=0)
+    np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-5)
+
+
+@pytest.mark.dist
+def test_moe_ep_sharded_training():
+    """MoE Llama on an ep2·mp2·dp2 mesh: expert weights sharded over ep, loss
+    decreases, aux load-balance loss flows gradients to the router."""
+    from paddle_tpu.models import LlamaMoEConfig, LlamaForCausalLM
+
+    dist.reset_mesh()
+    dist.init_mesh(ep=2, mp=2, dp=2)
+    paddle.seed(0)
+    cfg = LlamaMoEConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                              intermediate_size=128, num_attention_heads=4,
+                              num_key_value_heads=4, vocab_size=128,
+                              num_experts=4, top_k=2)
+    model = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=5e-3, parameters=model.parameters())
+    step = dist.ShardedTrainStep(model, lambda m, x, y: m(x, labels=y), o)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16)).astype("int32"))
+    losses = [float(step(ids, ids)) for _ in range(8)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+    # expert weights sharded over ep: per-device shard is half the expert dim
+    stack = model.llama.layers
+    for safe, orig in stack._names:
+        if orig.endswith("experts.gate"):
+            p = stack._parameters[safe]
+            shard = p.data.addressable_shards[0].data
+            assert shard.shape[1] == p.shape[1] // 2  # E dim split over ep2
+            break
+    else:
+        raise AssertionError("no stacked expert param found")
+    dist.reset_mesh()
+
+
+@pytest.mark.dist
+def test_moe_eager_matches_sharded():
+    """Same seed MoE model: eager single-device loss == ep-sharded first-step
+    loss (routing and einsum dispatch are placement-independent)."""
+    from paddle_tpu.models import LlamaMoEConfig, LlamaForCausalLM
+
+    def first_loss(use_mesh):
+        dist.reset_mesh()
+        if use_mesh:
+            dist.init_mesh(ep=2, dp=4)
+        paddle.seed(3)
+        cfg = LlamaMoEConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                                  intermediate_size=128, num_attention_heads=4,
+                                  num_key_value_heads=4, vocab_size=128,
+                                  num_experts=4, top_k=2)
+        model = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 128, (4, 16)).astype("int32"))
+        if use_mesh:
+            o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+            step = dist.ShardedTrainStep(model, lambda m, x, y: m(x, labels=y), o)
+            return float(step(ids, ids))
+        return float(model(ids, labels=ids))
+
+    eager = first_loss(False)
+    sharded = first_loss(True)
+    np.testing.assert_allclose(sharded, eager, rtol=2e-5)
+    dist.reset_mesh()
+
+
+@pytest.mark.dist
+def test_global_scatter_gather_roundtrip():
+    dist.reset_mesh()
+    dist.init_mesh(ep=4, dp=2)
+    # [src_rank=4, n_expert=4, capacity=2, d=8]
+    x_np = np.arange(4 * 4 * 2 * 8, dtype="float32").reshape(4, 4, 2, 8)
+    x = paddle.to_tensor(x_np)
+    counts = paddle.to_tensor(np.full((4,), 2, dtype="int64"))
+    y = dist.global_scatter(x, counts, counts)
+    # out[r, s*(E/ep)+j] == x[s, r*(E/ep)+j]; here E/ep == 1
+    for r in range(4):
+        for s in range(4):
+            np.testing.assert_allclose(y.numpy()[r, s], x_np[s, r])
+    z = dist.global_gather(y, counts, counts)
+    np.testing.assert_allclose(z.numpy(), x_np)
+    # scatter actually permutes data across ep ranks (a2a, not identity)
+    assert not np.allclose(y.numpy(), x_np)
+    # ragged counts mask overflow slots: count=1 zeroes capacity slot 1
+    ragged = paddle.to_tensor(np.full((4,), 1, dtype="int64"))
+    y2 = dist.global_scatter(x, ragged, ragged)
+    assert np.allclose(y2.numpy()[:, :, 1, :], 0.0)
+    assert not np.allclose(y2.numpy()[:, :, 0, :], 0.0)
+    dist.reset_mesh()
