@@ -1,0 +1,128 @@
+"""Context parallelism: ring attention over the 'cp' mesh axis.
+
+SURVEY §5 long-context mandate — the reference snapshot predates CP entirely
+(no ring attention / Ulysses; grep yields nothing), so this is designed
+TPU-native rather than ported: the sequence dim is sharded over 'cp', each
+rank keeps its Q shard resident and the K/V shards ride the ICI ring via
+`lax.ppermute`, one hop per step. Per-step partial attention uses the Pallas
+flash kernel (kernels/flash_attention.py) with a global-position offset for
+causality across chunks, and partial results merge in log-sum-exp space — so
+attention memory per chip stays O((s/cp)·d) no matter the global sequence.
+
+Backward rides jax.checkpoint per ring step: activations are recomputed
+step-by-step in reverse, and the K/V gradient shards travel the ring back to
+their owners through ppermute's transpose.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import MeshEnv, get_mesh_env
+
+
+def _merge(o1, lse1, o2, lse2):
+    """Combine two partial attentions of the same queries in lse space."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return (o1 * w1 + o2 * w2).astype(o1.dtype), lse
+
+
+def _ring_local(q, k, v, cp, causal, scale, axis):
+    """Per-device body (inside shard_map manual over `axis`).
+
+    q/k/v: [bh, s_loc, d] — this rank's sequence chunk.
+    """
+    from ..kernels.flash_attention import flash_attention_with_lse
+
+    idx = lax.axis_index(axis)
+    s_loc = q.shape[1]
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+
+    def partial_attn(k_cur, v_cur, r):
+        # k_cur holds the chunk that started on rank (idx - r) mod cp
+        src = (idx - r) % cp
+        if causal:
+            # global causality: qpos = idx*s_loc + i, kpos = src*s_loc + j
+            # => mask i + (idx-src)*s_loc >= j. Chunks entirely in the future
+            # ((idx-src)*s_loc <= -s_loc) come out fully masked -> lse=-inf-ish
+            offset = (idx - src) * s_loc
+            return flash_attention_with_lse(q, k_cur, v_cur, offset=offset,
+                                            causal=True, scale=scale)
+        return flash_attention_with_lse(q, k_cur, v_cur, offset=0,
+                                        causal=False, scale=scale)
+
+    o0, lse0 = partial_attn(k, v, 0)
+
+    def step(carry, r):
+        o, lse, k_cur, v_cur = carry
+        k_cur = lax.ppermute(k_cur, axis, perm)
+        v_cur = lax.ppermute(v_cur, axis, perm)
+        o_r, lse_r = partial_attn(k_cur, v_cur, r)
+        o, lse = _merge(o, lse, o_r, lse_r)
+        return (o, lse, k_cur, v_cur), None
+
+    if cp > 1:
+        step = jax.checkpoint(step)
+        (o, lse, _, _), _ = lax.scan(step, (o0, lse0, k, v),
+                                     jnp.arange(1, cp))
+    else:
+        o, lse = o0, lse0
+    return o
+
+
+def ring_attention_bhsd(q, k, v, causal=True, scale=None,
+                        env: MeshEnv = None, axis: str = "cp"):
+    """q/k/v: [bh, s, d] with s sharded over `axis`. Returns [bh, s, d]."""
+    env = env or get_mesh_env()
+    cp = env.get_dim(axis) if env is not None else 1
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if cp <= 1:
+        from ..kernels.flash_attention import flash_attention_with_lse
+
+        o, _ = flash_attention_with_lse(q, k, v, offset=0, causal=causal,
+                                        scale=scale)
+        return o
+
+    def local(ql, kl, vl):
+        return _ring_local(ql, kl, vl, cp, causal, float(scale), axis)
+
+    return jax.shard_map(
+        local, mesh=env.mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), axis_names={axis}, check_vma=False,
+    )(q, k, v)
+
+
+def ring_attention(q, k, v, causal=True, scale=None, env: MeshEnv = None):
+    """Paddle layout [b, s, h, d], seq sharded over 'cp'. Differentiable."""
+    from ..core.tensor import Tensor
+
+    if isinstance(q, Tensor):
+        return _ring_attention_prim(q, k, v, causal=bool(causal),
+                                    scale=scale if scale is None else float(scale))
+    return _ring_bshd(q, k, v, causal, scale, env)
+
+
+def _ring_bshd(q, k, v, causal, scale, env=None):
+    b, s, h, d = q.shape
+    qm = jnp.moveaxis(q, 2, 1).reshape(b * h, s, d)
+    km = jnp.moveaxis(k, 2, 1).reshape(b * h, s, d)
+    vm = jnp.moveaxis(v, 2, 1).reshape(b * h, s, d)
+    om = ring_attention_bhsd(qm, km, vm, causal=causal, scale=scale, env=env)
+    return jnp.moveaxis(om.reshape(b, h, s, d), 1, 2)
+
+
+from ..core.dispatch import primitive  # noqa: E402  (Tensor-level op wrapper)
+
+
+@primitive("ring_attention")
+def _ring_attention_prim(q, k, v, *, causal, scale):
+    return _ring_bshd(q, k, v, causal, scale)

@@ -61,9 +61,7 @@ def _global_a2a(x, local_count, global_count):
     env = require_mesh_env()
     ep = env.get_dim("ep")
     arr = x.data if isinstance(x, Tensor) else jnp.asarray(x)
-    if ep <= 1:
-        return x if isinstance(x, Tensor) else Tensor(arr)
-    if arr.shape[0] != ep or arr.shape[1] % ep != 0:
+    if ep > 1 and (arr.shape[0] != ep or arr.shape[1] % ep != 0):
         raise ValueError(
             f"global_scatter/gather expects [ep={ep}, n_expert%ep==0, ...], "
             f"got {arr.shape}")
@@ -73,15 +71,19 @@ def _global_a2a(x, local_count, global_count):
 @primitive("global_alltoall")
 def _global_a2a_p(x, local_count, global_count, *, _env_id):
     env = require_mesh_env()
+    ep = env.get_dim("ep")
     # counts -> validity mask: slot c of bucket (s, e) is real iff
     # c < local_count[e] (or local_count[s, e]); garbage beyond the count is
-    # zeroed before it crosses the wire (the ragged-a2a contract, densified)
+    # zeroed before it crosses the wire (the ragged-a2a contract, densified).
+    # Applied on every mesh size so 1-rank and n-rank results agree.
     cap = x.shape[2]
     lc = local_count
     if lc.ndim == 1:
         lc = jnp.broadcast_to(lc[None, :], x.shape[:2])
     mask = jnp.arange(cap)[None, None, :] < lc[:, :, None]  # [ep, E, C]
     x = x * mask[..., None].astype(x.dtype)
+    if ep <= 1:
+        return x
 
     def local(xl, lcl, gcl):
         # xl: [1, n_expert, capacity, d] — this rank's buckets for everyone

@@ -1,15 +1,24 @@
-"""Flash attention — Pallas TPU kernel.
+"""Flash attention — Pallas TPU kernels (forward + backward).
 
 The fused_attention_op.cu / fmha_ref.h analogue (reference:
-paddle/fluid/operators/fused/), re-designed for the MXU: q-blocked attention
-with fp32 accumulation computed entirely in VMEM. Each grid step owns one
-(batch*head, q-block) tile; K/V stream in as whole-sequence VMEM blocks (fits
-to ~8k tokens at d=128 in bf16), logits never touch HBM.
+paddle/fluid/operators/fused/), re-designed for the MXU:
 
-Backward is a recompute vjp (XLA attention math) registered via custom_vjp —
-memory-efficient fwd + standard bwd; a full Pallas bwd kernel is the planned
-upgrade. For very long sequences the cp-axis ring attention in
-paddle_tpu.distributed.context_parallel composes with this kernel per-shard.
+- forward: q-block × k-block grid with online softmax — fp32 accumulators in
+  VMEM scratch persist across the (sequential) k-block grid steps, logits
+  never touch HBM, K/V stream one block at a time so VMEM use is
+  O(block_q·d + block_k·d) at any sequence length. Also emits the per-row
+  log-sum-exp (lse) needed by the backward kernels and by ring-attention
+  block merging.
+- backward: two Pallas kernels (dk/dv with a q-block inner grid, dq with a
+  k-block inner grid) using the saved lse — the standard flash backward; the
+  full [sq, sk] probability matrix is never materialized in HBM.
+- `q_offset`: global-position offset added to q positions for the causal
+  mask, so a context-parallel rank can attend a remote K/V chunk with the
+  correct global causality (paddle_tpu.distributed.context_parallel rides
+  this; offset lands in SMEM as a scalar input).
+
+On CPU (tests / virtual meshes) the same kernels run in Pallas interpret
+mode, so one code path is exercised everywhere.
 """
 from __future__ import annotations
 
@@ -19,79 +28,324 @@ import math
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 512
+_NEG = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q):
-    qi = pl.program_id(1)
-    q = q_ref[0]  # [block_q, d]
-    k = k_ref[0]  # [s, d]
-    v = v_ref[0]
-    logits = jax.lax.dot_general(
-        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32)
-    logits = logits * scale
+def _interpret() -> bool:
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def _pick_block(s: int, pref: int) -> int:
+    """Largest block <= pref that divides s (so no rows/keys are dropped)."""
+    b = min(pref, s)
+    if s % b == 0:
+        return b
+    for cand in (512, 256, 128, 64, 32, 16, 8):
+        if cand <= pref and s % cand == 0:
+            return cand
+    raise ValueError(
+        f"flash attention needs the sequence length ({s}) divisible by a "
+        f"block size that is a multiple of 8; pad the sequence")
+
+
+# -- forward ------------------------------------------------------------------
+
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, scale, causal, block_q, block_k):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_start + off_ref[0] + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
     if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, logits.shape, 0)
-        kpos = jax.lax.broadcasted_iota(jnp.int32, logits.shape, 1)
-        logits = jnp.where(qpos >= kpos, logits, -1e30)
-    m = jnp.max(logits, axis=-1, keepdims=True)
-    p = jnp.exp(logits - m)
-    denom = jnp.sum(p, axis=-1, keepdims=True)
-    p = (p / denom).astype(v.dtype)
-    o_ref[0] = jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ())),
-        preferred_element_type=jnp.float32).astype(o_ref.dtype)
+        # skip k blocks fully above the (offset) diagonal
+        @pl.when(k_start <= q_start + off_ref[0] + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        l = l_ref[:, :1]
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+        lse_ref[0] = m_ref[:, :1] + jnp.log(jnp.maximum(l, 1e-30))
 
 
-def _flash_fwd_bhsd(q, k, v, causal: bool, scale: float, block_q: int):
+def _flash_fwd(q, k, v, offset, causal, scale, block_q, block_k):
     bh, sq, d = q.shape
     sk = k.shape[1]
-    block_q = min(block_q, sq)
-    grid = (bh, sq // block_q)
-    return pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, block_q=block_q),
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    grid = (bh, sq // block_q, sk // block_k)
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
+    out, lse3 = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, sk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
-    )(q, k, v)
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, sq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(off, q, k, v)
+    return out, lse3[..., 0]
 
 
-def _xla_ref_bhsd(q, k, v, causal, scale):
-    logits = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * scale
+# -- backward -----------------------------------------------------------------
+
+def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal,
+                    block_q, block_k):
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = qi * block_q
+    k_start = pl.program_id(1) * block_k
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_start + off_ref[0] + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)  # [bq, bk] f32
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q.astype(jnp.float32), (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
     if causal:
-        sq, sk = q.shape[1], k.shape[1]
-        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
-        logits = jnp.where(mask, logits, -1e30)
-    p = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("bqk,bkd->bqd", p, v)
+        @pl.when(q_start + off_ref[0] + block_q - 1 >= k_start)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(qi == nq - 1)
+    def _():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _flash_bhsd(q, k, v, causal, scale, block_q):
-    return _flash_fwd_bhsd(q, k, v, causal, scale, block_q)
+def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                   dq_ref, dq_acc, *, scale, causal, block_q, block_k):
+    ki = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = pl.program_id(1) * block_q
+    k_start = ki * block_k
+
+    def compute():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [block_q, 1]
+        delta = delta_ref[0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_start + off_ref[0] + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, _NEG)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(k_start <= q_start + off_ref[0] + block_q - 1)
+        def _():
+            compute()
+    else:
+        compute()
+
+    @pl.when(ki == nk - 1)
+    def _():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bhsd_fwd(q, k, v, causal, scale, block_q):
-    return _flash_fwd_bhsd(q, k, v, causal, scale, block_q), (q, k, v)
+def _flash_bwd(q, k, v, o, lse, do, dlse, offset, causal, scale,
+               block_q, block_k):
+    bh, sq, d = q.shape
+    sk = k.shape[1]
+    block_q = _pick_block(sq, block_q)
+    block_k = _pick_block(sk, block_k)
+    off = jnp.asarray(offset, jnp.int32).reshape(1)
+    # delta_i = sum_d dO*O - dlse folds the lse cotangent into the same ds
+    # formula (d lse/d s_ij = p_ij)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
+    lse = lse[..., None]
+    delta = delta[..., None]
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, sk // block_k, sq // block_q),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(off, q, k, v, do, lse, delta)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(bh, sq // block_q, sk // block_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=_interpret(),
+    )(off, q, k, v, do, lse, delta)
+    return dq, dk, dv
 
 
-def _flash_bhsd_bwd(causal, scale, block_q, res, ct):
-    q, k, v = res
-    _, vjp = jax.vjp(lambda a, b, c: _xla_ref_bhsd(a, b, c, causal, scale), q, k, v)
-    return vjp(ct)
+# -- differentiable wrapper (bh, s, d layout) ---------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash_lse_bhsd(q, k, v, offset, causal, scale, block_q, block_k):
+    return _flash_fwd(q, k, v, offset, causal, scale, block_q, block_k)
 
 
-_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+def _flash_lse_fwd(q, k, v, offset, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, offset, causal, scale, block_q, block_k)
+    return (o, lse), (q, k, v, o, lse, offset)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, res, cts):
+    q, k, v, o, lse, offset = res
+    do, dlse = cts
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, dlse, offset, causal, scale,
+                            block_q, block_k)
+    return dq, dk, dv, None
+
+
+_flash_lse_bhsd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, offset=0, causal=False, scale=None,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K):
+    """q/k/v: [bh, s, d]. Returns (out [bh, sq, d], lse [bh, sq] fp32).
+    `offset` shifts q's global positions for the causal mask (ring attention)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_lse_bhsd(q, k, v, jnp.asarray(offset, jnp.int32),
+                           bool(causal), float(scale), int(block_q),
+                           int(block_k))
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
-                    block_q: int = DEFAULT_BLOCK_Q):
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout). Differentiable."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
@@ -100,5 +354,8 @@ def flash_attention(q, k, v, causal: bool = False, scale: float = None,
     qm = jnp.moveaxis(q, 2, 1).reshape(b * h, sq, d)
     km = jnp.moveaxis(k, 2, 1).reshape(b * h, sk, d)
     vm = jnp.moveaxis(v, 2, 1).reshape(b * h, sk, d)
-    om = _flash_bhsd(qm, km, vm, bool(causal), float(scale), int(block_q))
+    # self-attention with sk>=sq: rows see the key prefix plus the diagonal
+    offset = sk - sq if causal else 0
+    om, _ = flash_attention_with_lse(qm, km, vm, offset, causal, float(scale),
+                                     block_q, block_k)
     return jnp.moveaxis(om.reshape(b, h, sq, d), 1, 2)

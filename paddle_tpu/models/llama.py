@@ -161,8 +161,15 @@ class LlamaAttention(nn.Layer):
             rep = self.num_heads // self.num_kv_heads
             k = manipulation.repeat_interleave(k, rep, axis=2)
             v = manipulation.repeat_interleave(v, rep, axis=2)
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=cache is None,
-                                             training=self.training)
+        env = get_mesh_env()
+        if cache is None and env is not None and env.get_dim("cp") > 1:
+            # context parallel: K/V ring over the cp axis, O((s/cp)^2) memory
+            from ..distributed.context_parallel import ring_attention
+
+            out = ring_attention(q, k, v, causal=True)
+        else:
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=cache is None,
+                                                 training=self.training)
         out = manipulation.reshape(out, [b, s, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         return (out, new_cache) if cache is not None else out

@@ -85,7 +85,7 @@ def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor, ep_degree):
     pos_in_e = jnp.sum(pos, axis=-1)  # [k*n]
     keep = (pos_in_e < cap).astype(jnp.float32)[:, None] * oh  # [k*n, e]
     # dispatch/combine [k*n, e, cap]
-    cap_oh = jax.nn.one_hot(pos_in_e, cap, dtype=jnp.float32)
+    cap_oh = jax.nn.one_hot(pos_in_e.astype(jnp.int32), cap, dtype=jnp.float32)
     disp = keep[:, :, None] * cap_oh[:, None, :]
     disp = disp.reshape(top_k, n, e, cap).transpose(1, 0, 2, 3)  # [n, k, e, cap]
     combine = disp * gate_v[:, :, None, None]

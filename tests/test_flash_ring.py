@@ -1,0 +1,135 @@
+"""Flash attention Pallas kernels (interpret mode on CPU) + ring attention CP."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.optimizer as opt
+from paddle_tpu.kernels.flash_attention import flash_attention_with_lse, flash_attention
+
+
+def _xla_ref(q, k, v, causal, offset=0):
+    s = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) / np.sqrt(q.shape[-1])
+    if causal:
+        qp = offset + jnp.arange(q.shape[1])[:, None]
+        kp = jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(qp >= kp, s, -1e30)
+    p = jax.nn.softmax(s, -1).astype(q.dtype)
+    return jnp.einsum("bqk,bkd->bqd", p, v), jax.nn.logsumexp(s, -1)
+
+
+@pytest.fixture
+def qkv():
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(3, 256, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(3, 256, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(3, 256, 64), jnp.float32)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_fwd_and_lse(qkv, causal):
+    q, k, v = qkv
+    o, lse = flash_attention_with_lse(q, k, v, causal=causal,
+                                      block_q=128, block_k=128)
+    ro, rlse = _xla_ref(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ro), rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(rlse), rtol=1e-4)
+
+
+def test_flash_pallas_backward(qkv):
+    q, k, v = qkv
+
+    def f_flash(q, k, v):
+        o, lse = flash_attention_with_lse(q, k, v, causal=True,
+                                          block_q=128, block_k=128)
+        return jnp.sum(o ** 2) + 0.1 * jnp.sum(lse)
+
+    def f_ref(q, k, v):
+        o, lse = _xla_ref(q, k, v, True)
+        return jnp.sum(o ** 2) + 0.1 * jnp.sum(lse)
+
+    g1 = jax.grad(f_flash, (0, 1, 2))(q, k, v)
+    g2 = jax.grad(f_ref, (0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-4)
+
+
+def test_flash_cross_attention_offset(qkv):
+    q, k, v = qkv
+    q_short = q[:, :128]
+    # decode-style: 128 queries attending a 256 prefix causally
+    o, _ = flash_attention_with_lse(q_short, k, v, offset=128, causal=True,
+                                    block_q=64, block_k=64)
+    ro, _ = _xla_ref(q_short, k, v, True, offset=128)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(ro), rtol=2e-4, atol=2e-5)
+
+
+def test_flash_bshd_layout():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    k = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    v = jnp.asarray(rng.randn(2, 128, 4, 64), jnp.float32)
+    o = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    qm = jnp.moveaxis(q, 2, 1).reshape(8, 128, 64)
+    km = jnp.moveaxis(k, 2, 1).reshape(8, 128, 64)
+    vm = jnp.moveaxis(v, 2, 1).reshape(8, 128, 64)
+    ro, _ = _xla_ref(qm, km, vm, True)
+    np.testing.assert_allclose(
+        np.asarray(jnp.moveaxis(o, 2, 1).reshape(8, 128, 64)),
+        np.asarray(ro), rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.dist
+class TestRingAttention:
+    def test_parity_and_grads_cp4(self):
+        dist.reset_mesh()
+        env = dist.init_mesh(cp=4, dp=2)
+        from paddle_tpu.distributed.context_parallel import ring_attention_bhsd
+
+        rng = np.random.RandomState(0)
+        q = jnp.asarray(rng.randn(4, 128, 64), jnp.float32)
+        k = jnp.asarray(rng.randn(4, 128, 64), jnp.float32)
+        v = jnp.asarray(rng.randn(4, 128, 64), jnp.float32)
+
+        ro = jax.jit(lambda a, b, c: ring_attention_bhsd(
+            a, b, c, causal=True, env=env))(q, k, v)
+        fo, _ = _xla_ref(q, k, v, True)
+        np.testing.assert_allclose(np.asarray(ro), np.asarray(fo),
+                                   rtol=2e-4, atol=2e-5)
+
+        g1 = jax.jit(jax.grad(lambda a, b, c: jnp.sum(ring_attention_bhsd(
+            a, b, c, causal=True, env=env) ** 2), (0, 1, 2)))(q, k, v)
+        g2 = jax.grad(lambda a, b, c: jnp.sum(_xla_ref(a, b, c, True)[0] ** 2),
+                      (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+        dist.reset_mesh()
+
+    def test_llama_cp_matches_nocp(self):
+        """Same weights: cp2 ring-attention training step == dp-only step."""
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        import paddle_tpu.nn.functional as F
+
+        def run(cp):
+            dist.reset_mesh()
+            dist.init_mesh(cp=cp, dp=8 // cp)
+            paddle.seed(5)
+            cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                                   intermediate_size=128, num_attention_heads=4,
+                                   num_key_value_heads=4, vocab_size=128)
+            m = LlamaForCausalLM(cfg)
+            o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+            step = dist.ShardedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+            ids = paddle.to_tensor(
+                np.random.RandomState(0).randint(0, 128, (8, 64)).astype("int32"))
+            return [float(step(ids, ids)) for _ in range(3)]
+
+        no_cp = run(1)
+        with_cp = run(2)
+        np.testing.assert_allclose(with_cp, no_cp, rtol=2e-5)
+        dist.reset_mesh()
