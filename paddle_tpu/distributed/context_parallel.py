@@ -27,11 +27,12 @@ from .mesh import MeshEnv, get_mesh_env
 
 
 def _merge(o1, lse1, o2, lse2):
-    """Combine two partial attentions of the same queries in lse space."""
+    """Combine two partial attentions of the same queries in lse space.
+    Accumulates in fp32 — the caller casts back once after the ring."""
     lse = jnp.logaddexp(lse1, lse2)
     w1 = jnp.exp(lse1 - lse)[..., None]
     w2 = jnp.exp(lse2 - lse)[..., None]
-    return (o1 * w1 + o2 * w2).astype(o1.dtype), lse
+    return o1.astype(jnp.float32) * w1 + o2.astype(jnp.float32) * w2, lse
 
 
 def _ring_local(q, k, v, cp, causal, scale, axis):
@@ -59,6 +60,7 @@ def _ring_local(q, k, v, cp, causal, scale, axis):
                                         causal=False, scale=scale)
 
     o0, lse0 = partial_attn(k, v, 0)
+    o0 = o0.astype(jnp.float32)
 
     def step(carry, r):
         o, lse, k_cur, v_cur = carry
@@ -74,7 +76,7 @@ def _ring_local(q, k, v, cp, causal, scale, axis):
                                      jnp.arange(1, cp))
     else:
         o, lse = o0, lse0
-    return o
+    return o.astype(q.dtype)
 
 
 def ring_attention_bhsd(q, k, v, causal=True, scale=None,

@@ -85,7 +85,9 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
         m_prev = m_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        # zero masked entries explicitly: for a fully-masked row m_new stays at
+        # _NEG and exp(s - m_new) would be 1, turning the row into mean(V)
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - m_new), 0.0)
         l_new = alpha * l_ref[:, :1] + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -173,7 +175,7 @@ def _bwd_dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        p = jnp.exp(s - lse)  # [bq, bk] f32
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lse), 0.0)  # [bq, bk] f32
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(
@@ -223,7 +225,7 @@ def _bwd_dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             kpos = k_start + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(qpos >= kpos, s, _NEG)
-        p = jnp.exp(s - lse)
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v.astype(jnp.float32), (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
