@@ -16,7 +16,7 @@ from .framework import (  # noqa: F401
     get_default_dtype, set_default_dtype,
     CPUPlace, CUDAPlace, TPUPlace,
     get_device, set_device, seed, get_rng_state, set_rng_state,
-    is_compiled_with_tpu,
+    is_compiled_with_tpu, set_flags, get_flags,
 )
 from .core import Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
 from .ops import *  # noqa: F401,F403

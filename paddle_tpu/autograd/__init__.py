@@ -1,0 +1,155 @@
+"""paddle.autograd: PyLayer custom-gradient ops + functional backward.
+
+Reference: python/paddle/autograd/py_layer.py:202 (PyLayer/PyLayerContext with
+ctx.save_for_backward / saved_tensor, staticmethod forward/backward), plus
+paddle.autograd.backward (backward_mode.py).
+
+TPU-native integration: PyLayer.apply runs the user's forward eagerly with the
+tape suspended, then records a single ``PyLayerNode`` on the tape. The node
+duck-types core.autograd.GradNode (inputs / n_outputs / run / primals), so the
+engine's in-degree queue walk schedules user backward code exactly like a
+jitted-vjp op — user backward runs eager paddle ops, which themselves dispatch
+to compiled XLA.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..core import autograd as _engine
+from ..core.autograd import no_grad, is_grad_enabled
+from ..core.tensor import Tensor
+
+__all__ = ["PyLayer", "PyLayerContext", "backward"]
+
+
+class PyLayerContext:
+    """ctx object passed to forward/backward (py_layer.py PyLayerContext)."""
+
+    def __init__(self):
+        self._saved: List[Tensor] = []
+        self.materialize_grads = True
+        self._non_differentiable = set()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return tuple(self._saved)
+
+    def mark_non_differentiable(self, *tensors):
+        for t in tensors:
+            self._non_differentiable.add(id(t))
+
+    def set_materialize_grads(self, value: bool):
+        self.materialize_grads = bool(value)
+
+
+class PyLayerNode:
+    """Tape node wrapping a user backward. Interface-compatible with GradNode."""
+
+    def __init__(self, cls, ctx, inputs, outs):
+        self.cls = cls
+        self.ctx = ctx
+        self.inputs = inputs  # list[Optional[Tensor]] aligned with grads returned
+        self.primals = ()  # engine frees this after backward
+        self.multi_output = len(outs) > 1
+        self.out_avals = [(o.shape, o.dtype) for o in outs]
+        self.n_outputs = len(outs)
+
+    def run(self, out_cts: List[Optional[object]]):
+        cts = []
+        for ct, (shape, dtype) in zip(out_cts, self.out_avals):
+            if ct is None:
+                if self.ctx.materialize_grads:
+                    ct = jnp.zeros(shape, dtype)
+                else:
+                    cts.append(None)
+                    continue
+            cts.append(Tensor(ct, stop_gradient=True))
+        with no_grad():
+            grads = self.cls.backward(self.ctx, *cts)
+        if not isinstance(grads, (tuple, list)):
+            grads = (grads,)
+        n_tensor_in = sum(1 for t in self.inputs if t is not None)
+        if len(grads) != n_tensor_in:
+            raise ValueError(
+                f"{self.cls.__name__}.backward returned {len(grads)} gradients "
+                f"but forward had {n_tensor_in} tensor inputs")
+        out, it = [], iter(grads)
+        for t in self.inputs:
+            if t is None:
+                out.append(None)
+            else:
+                g = next(it)
+                out.append(g.data if isinstance(g, Tensor) else g)
+        return out
+
+
+class PyLayer:
+    """Base class for user-defined autograd ops (py_layer.py:202).
+
+    Subclass with ``@staticmethod forward(ctx, *args)`` and
+    ``@staticmethod backward(ctx, *grad_outputs)``; call via ``apply``.
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *args):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        multi = isinstance(outs, (tuple, list))
+        out_list = list(outs) if multi else [outs]
+        for o in out_list:
+            if not isinstance(o, Tensor):
+                raise TypeError("PyLayer.forward must return Tensor(s)")
+
+        tensor_inputs = [a if isinstance(a, Tensor) else None for a in args]
+        record = (is_grad_enabled() and
+                  any(t is not None and not t.stop_gradient for t in tensor_inputs))
+        if record:
+            node = PyLayerNode(cls, ctx, tensor_inputs, out_list)
+            ref = weakref.ref(node)
+            new_outs = []
+            for i, o in enumerate(out_list):
+                t = Tensor(o.data, stop_gradient=id(o) in ctx._non_differentiable)
+                if not t.stop_gradient:
+                    t._grad_node = node
+                    t._out_index = i
+                new_outs.append(t)
+            # consumer-edge backrefs so in-place mutation repoints these edges
+            for slot, t in enumerate(tensor_inputs):
+                if t is None:
+                    continue
+                if t._edges is None:
+                    t._edges = []
+                    t._edges_cap = 32
+                t._edges.append((ref, slot))
+            out_list = new_outs
+        else:
+            out_list = [Tensor(o.data, stop_gradient=True) for o in out_list]
+        return tuple(out_list) if multi else out_list[0]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """paddle.autograd.backward: multi-root backward (backward_mode.py)."""
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    for t, g in zip(tensors, grad_tensors):
+        _engine.backward(t, g, retain_graph=True)
+    if not retain_graph:
+        for t in tensors:
+            t._grad_node = None

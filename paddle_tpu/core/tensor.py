@@ -230,6 +230,18 @@ class Tensor:
     # paddle_tpu/ops/_bind.py once the op corpus is defined.
 
 
+def _check_nan_inf(op_name, outs):
+    """FLAGS_check_nan_inf per-op guard (nan_inf_utils_detail.* equivalent)."""
+    for i, o in enumerate(outs):
+        if not hasattr(o, "dtype") or not jnp.issubdtype(o.dtype, jnp.inexact):
+            continue
+        bad = int(jnp.sum(~jnp.isfinite(o)))
+        if bad:
+            raise RuntimeError(
+                f"check_nan_inf: op '{op_name}' output {i} contains {bad} "
+                f"nan/inf values (shape={tuple(o.shape)}, dtype={o.dtype})")
+
+
 def dispatch(prim, args, attrs):
     """Run one op: unwrap -> jitted forward -> (maybe) record GradNode.
 
@@ -255,9 +267,28 @@ def dispatch(prim, args, attrs):
     if amp_state()["enabled"]:
         arrays = maybe_cast_inputs(prim.name, arrays)
 
+    from ..framework import flags as _flags
+    from .. import profiler as _profiler
+
+    _prof = _profiler.is_recording()
+    _t0 = None
+    if _prof:
+        import time as _time
+
+        _t0 = _time.perf_counter() * 1e6
+
     out = prim.fwd(attrs)(*arrays)
     multi = isinstance(out, (tuple, list))
     outs_raw = tuple(out) if multi else (out,)
+
+    if _flags.flag("benchmark") or _flags.flag("check_nan_inf"):
+        for o in outs_raw:
+            if hasattr(o, "block_until_ready"):
+                o.block_until_ready()
+        if _flags.flag("check_nan_inf"):
+            _check_nan_inf(prim.name, outs_raw)
+    if _prof:
+        _profiler.record_op_span(prim.name, _t0)
 
     record = any_grad and is_grad_enabled() and not prim.nondiff
     out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_raw]

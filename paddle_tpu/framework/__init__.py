@@ -8,3 +8,5 @@ from .place import (  # noqa: F401
     is_compiled_with_tpu,
 )
 from .random import seed, get_rng_state, set_rng_state  # noqa: F401
+from . import flags  # noqa: F401
+from .flags import set_flags, get_flags  # noqa: F401

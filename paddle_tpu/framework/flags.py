@@ -1,0 +1,89 @@
+"""Global flag registry: the gflags tier of the reference's config system.
+
+Reference: paddle/fluid/platform/flags.cc (49 PADDLE_DEFINE_EXPORTED_* flags)
+surfaced to Python via pybind/global_value_getter_setter.cc and settable by
+``FLAGS_*`` env vars or ``paddle.set_flags``.
+
+TPU-native design: flags are plain typed Python values in a process-global
+registry. Env vars named ``FLAGS_<name>`` override the default at first import
+(same contract as the reference's gflags env pickup). A handful of flags are
+*live*: consumers read them per call (e.g. ``FLAGS_check_nan_inf`` is read by
+core.dispatch on every op), so ``set_flags`` takes effect immediately without
+re-tracing.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Iterable, Union
+
+_DEFS: Dict[str, dict] = {}
+_VALUES: Dict[str, Any] = {}
+
+
+def _parse(raw: str, typ):
+    if typ is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return typ(raw)
+
+
+def define_flag(name: str, default, doc: str = ""):
+    """Register a flag (PADDLE_DEFINE_EXPORTED_* equivalent, flags.cc)."""
+    typ = type(default)
+    _DEFS[name] = {"default": default, "type": typ, "doc": doc}
+    env = os.environ.get(f"FLAGS_{name}")
+    _VALUES[name] = _parse(env, typ) if env is not None else default
+    return name
+
+
+def set_flags(flags: Dict[str, Any]):
+    """paddle.set_flags: update registered flags (global_value_getter_setter.cc)."""
+    for k, v in flags.items():
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _DEFS:
+            raise ValueError(f"unknown flag {k!r}; known: {sorted(_DEFS)}")
+        _VALUES[name] = _parse(v, _DEFS[name]["type"]) if isinstance(v, str) else _DEFS[name]["type"](v)
+
+
+def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
+    """paddle.get_flags: read one, several, or all flags."""
+    if flags is None:
+        return {f"FLAGS_{k}": v for k, v in _VALUES.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for k in flags:
+        name = k[6:] if k.startswith("FLAGS_") else k
+        if name not in _DEFS:
+            raise ValueError(f"unknown flag {k!r}")
+        out[f"FLAGS_{name}"] = _VALUES[name]
+    return out
+
+
+def flag(name: str):
+    """Fast internal read for hot paths."""
+    return _VALUES[name]
+
+
+# -- the registry (TPU-relevant subset of flags.cc, same semantics) -----------
+define_flag("check_nan_inf", False,
+            "Assert every op's outputs are finite; raises naming the op "
+            "(reference: framework/details/nan_inf_utils_detail.*).")
+define_flag("benchmark", False,
+            "Block on every op so host timings are true device timings "
+            "(reference: flags.cc FLAGS_benchmark).")
+define_flag("low_precision_op_list", False,
+            "Record which ops ran in bf16 under AMP.")
+define_flag("use_pallas_flash_attention", True,
+            "Route nn.functional attention through the Pallas flash kernel.")
+define_flag("allocator_strategy", "auto_growth",
+            "Kept for API parity; XLA/PJRT owns device memory on TPU.")
+define_flag("fraction_of_gpu_memory_to_use", 0.92,
+            "Kept for API parity; maps to XLA_PYTHON_CLIENT_MEM_FRACTION.")
+define_flag("cudnn_deterministic", False,
+            "Determinism toggle; maps to XLA deterministic-ops mode.")
+define_flag("max_inplace_grad_add", 0,
+            "Kept for API parity with the reference's grad-accumulation flag.")
+define_flag("call_stack_level", 1,
+            "Error-report verbosity (reference: enforce.h FLAGS_call_stack_level).")
+define_flag("profiler_host_spans", True,
+            "Record host-side RecordEvent spans while a Profiler is active.")

@@ -1,0 +1,301 @@
+"""paddle.profiler: host-span tracing + device (XPlane) capture + summaries.
+
+Reference: python/paddle/profiler/profiler.py:224 (Profiler with scheduler
+states CLOSED/READY/RECORD/RECORD_AND_RETURN), platform/profiler/host_tracer.cc
+(RecordEvent spans into lock-free per-thread buffers), chrometracing_logger.cc
+(chrome-trace export), profiler_statistic.py (op summary tables).
+
+TPU-native split: device-side timing belongs to XLA — when ``timer_only`` is
+False and a trace dir is set, the Profiler drives ``jax.profiler`` so traces
+carry real TPU timelines (XPlane, viewable in TensorBoard/Perfetto). Host-side
+``RecordEvent`` spans (op dispatch, dataloader, user scopes) are recorded in a
+process-global buffer and exported as chrome-trace JSON; summaries aggregate
+those spans per op name. Under FLAGS_benchmark each dispatched op blocks until
+the device result is ready, so host spans become true op timings.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Iterable, Optional
+
+__all__ = [
+    "ProfilerState", "ProfilerTarget", "RecordEvent", "Profiler",
+    "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+]
+
+
+class ProfilerState(Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class ProfilerTarget(Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class _HostEventRecorder:
+    """Process-global span buffer (host_event_recorder.h equivalent)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.events = []  # (name, tid, start_us, dur_us, category)
+        self.active = False
+
+    def record(self, name, start_us, dur_us, category):
+        if not self.active:
+            return
+        tid = threading.get_ident() & 0xFFFF
+        with self._lock:
+            self.events.append((name, tid, start_us, dur_us, category))
+
+    def drain(self):
+        with self._lock:
+            ev, self.events = self.events, []
+        return ev
+
+
+_RECORDER = _HostEventRecorder()
+
+
+def _now_us() -> float:
+    return time.perf_counter() * 1e6
+
+
+class RecordEvent:
+    """User-instrumented span (platform/profiler/event_tracing.h RecordEvent).
+
+    Usable as a context manager or begin()/end() pair::
+
+        with profiler.RecordEvent("data_augment"):
+            ...
+    """
+
+    def __init__(self, name: str, event_type: str = "UserDefined"):
+        self.name = name
+        self.event_type = event_type
+        self._t0 = None
+
+    def begin(self):
+        self._t0 = _now_us()
+
+    def end(self):
+        if self._t0 is not None:
+            _RECORDER.record(self.name, self._t0, _now_us() - self._t0, self.event_type)
+            self._t0 = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+def record_op_span(name: str, t0_us: float):
+    """Called by core.dispatch per op while a profiler is recording."""
+    _RECORDER.record(name, t0_us, _now_us() - t0_us, "Operator")
+
+
+def is_recording() -> bool:
+    return _RECORDER.active
+
+
+def make_scheduler(*, closed: int, ready: int, record: int, repeat: int = 0,
+                   skip_first: int = 0) -> Callable[[int], ProfilerState]:
+    """Step-state scheduler (profiler.py make_scheduler, same state machine)."""
+    period = closed + ready + record
+
+    def schedule(step: int) -> ProfilerState:
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * period:
+            return ProfilerState.CLOSED
+        pos = s % period
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        return (ProfilerState.RECORD_AND_RETURN if pos == period - 1
+                else ProfilerState.RECORD)
+
+    return schedule
+
+
+def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
+    """on_trace_ready callback writing chrome-trace JSON (chrometracing_logger.cc)."""
+
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        name = worker_name or f"host_{os.getpid()}"
+        path = os.path.join(dir_name, f"{name}_time_{int(time.time())}.paddle_trace.json")
+        prof._export_chrome(path)
+        return path
+
+    return handler
+
+
+def load_profiler_result(path: str):
+    with open(path) as f:
+        return json.load(f)
+
+
+class _OpSummary:
+    __slots__ = ("calls", "total_us", "max_us", "min_us")
+
+    def __init__(self):
+        self.calls = 0
+        self.total_us = 0.0
+        self.max_us = 0.0
+        self.min_us = float("inf")
+
+    def add(self, dur):
+        self.calls += 1
+        self.total_us += dur
+        self.max_us = max(self.max_us, dur)
+        self.min_us = min(self.min_us, dur)
+
+
+class Profiler:
+    """paddle.profiler.Profiler (profiler.py:224) over host spans + jax.profiler.
+
+    ``targets`` selects device capture: if ProfilerTarget.TPU (or GPU) is
+    requested and ``trace_dir`` given (or an on_trace_ready from
+    export_chrome_tracing), jax.profiler.start_trace captures XPlane device
+    timelines alongside the host spans.
+    """
+
+    def __init__(self, *, targets: Optional[Iterable[ProfilerTarget]] = None,
+                 scheduler=None, on_trace_ready=None, timer_only: bool = False,
+                 trace_dir: Optional[str] = None):
+        self.targets = set(targets) if targets else {ProfilerTarget.CPU, ProfilerTarget.TPU}
+        if scheduler is None:
+            self.scheduler = lambda step: ProfilerState.RECORD
+        elif isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self.scheduler = make_scheduler(closed=lo, ready=0, record=hi - lo, repeat=1)
+        else:
+            self.scheduler = scheduler
+        self.on_trace_ready = on_trace_ready
+        self.timer_only = timer_only
+        self.trace_dir = trace_dir
+        self.step_num = 0
+        self.state = ProfilerState.CLOSED
+        self._events = []
+        self._jax_tracing = False
+        self._t_start = None
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self):
+        self.state = self.scheduler(self.step_num)
+        self._t_start = time.perf_counter()
+        if self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            self._begin_record()
+        return self
+
+    def stop(self):
+        if _RECORDER.active:
+            self._events.extend(_RECORDER.drain())
+            _RECORDER.active = False
+        self._stop_jax()
+        if self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if self.on_trace_ready:
+                self.on_trace_ready(self)
+        self.state = ProfilerState.CLOSED
+
+    def step(self, num_samples: Optional[int] = None):
+        """Advance the scheduler one training step."""
+        if _RECORDER.active:
+            self._events.extend(_RECORDER.drain())
+        prev = self.state
+        self.step_num += 1
+        self.state = self.scheduler(self.step_num)
+        recording = prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        should = self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+        if prev == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
+            self.on_trace_ready(self)
+        if should and not recording:
+            self._begin_record()
+        elif recording and not should:
+            _RECORDER.active = False
+            self._stop_jax()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _begin_record(self):
+        from ..framework.flags import flag
+
+        if flag("profiler_host_spans"):
+            _RECORDER.active = True
+        if not self.timer_only and self.trace_dir and not self._jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.start_trace(self.trace_dir)
+                self._jax_tracing = True
+            except Exception:
+                self._jax_tracing = False
+
+    def _stop_jax(self):
+        if self._jax_tracing:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_tracing = False
+
+    # -- reporting ----------------------------------------------------------
+    def _export_chrome(self, path: str):
+        trace = {"traceEvents": [
+            {"name": n, "ph": "X", "ts": ts, "dur": dur, "pid": os.getpid(),
+             "tid": tid, "cat": cat}
+            for (n, tid, ts, dur, cat) in self._events
+        ]}
+        with open(path, "w") as f:
+            json.dump(trace, f)
+        return path
+
+    def export(self, path: str, format: str = "json"):
+        return self._export_chrome(path)
+
+    def summary(self, sorted_by: str = "total", op_detail: bool = True,
+                thread_sep: bool = False, time_unit: str = "ms") -> str:
+        """Per-op aggregate table (profiler_statistic.py equivalent)."""
+        agg = {}
+        for (name, _tid, _ts, dur, cat) in self._events:
+            agg.setdefault((cat, name), _OpSummary()).add(dur)
+        div = {"s": 1e6, "ms": 1e3, "us": 1.0}[time_unit]
+        rows = sorted(agg.items(), key=lambda kv: -kv[1].total_us)
+        total = sum(s.total_us for _, s in rows) or 1.0
+        lines = [
+            f"{'Name':<40}{'Calls':>8}{'Total(' + time_unit + ')':>14}"
+            f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}{'Ratio%':>8}",
+            "-" * 94,
+        ]
+        for (cat, name), s in rows:
+            lines.append(
+                f"{name[:39]:<40}{s.calls:>8}{s.total_us / div:>14.3f}"
+                f"{s.total_us / s.calls / div:>12.3f}{s.max_us / div:>12.3f}"
+                f"{100.0 * s.total_us / total:>8.2f}")
+        return "\n".join(lines)
+
+    @property
+    def events(self):
+        return list(self._events)
