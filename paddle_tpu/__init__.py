@@ -83,7 +83,13 @@ def _try_import(names):
 
 _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "distributed", "regularizer", "autograd", "profiler", "text",
-              "distribution", "static", "incubate", "device"])
+              "distribution", "static", "incubate", "device", "hapi",
+              "inference", "utils"])
+try:
+    from .hapi import Model, summary  # noqa: F401,E402
+    from .hapi import callbacks  # noqa: F401,E402
+except ImportError:
+    pass
 from .nn.layer.layers import ParamAttr  # noqa: E402,F401
 
 try:

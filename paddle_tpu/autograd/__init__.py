@@ -148,6 +148,10 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
         grad_tensors = [None] * len(tensors)
     elif not isinstance(grad_tensors, (list, tuple)):
         grad_tensors = [grad_tensors]
+    if len(grad_tensors) != len(tensors):
+        raise ValueError(
+            f"grad_tensors length ({len(grad_tensors)}) must match tensors "
+            f"length ({len(tensors)})")
     for t, g in zip(tensors, grad_tensors):
         _engine.backward(t, g, retain_graph=True)
     if not retain_graph:

@@ -242,6 +242,9 @@ def _check_nan_inf(op_name, outs):
                 f"nan/inf values (shape={tuple(o.shape)}, dtype={o.dtype})")
 
 
+_HOT = None  # lazily-bound (amp_state, maybe_cast_inputs, flags, profiler, time)
+
+
 def dispatch(prim, args, attrs):
     """Run one op: unwrap -> jitted forward -> (maybe) record GradNode.
 
@@ -262,19 +265,22 @@ def dispatch(prim, args, attrs):
             inputs.append(None)
 
     # AMP O1/O2 auto-cast hook (reference: tracer.cc:209-226 AMP pass)
-    from ..amp import amp_state, maybe_cast_inputs
+    global _HOT
+    if _HOT is None:  # one-time late bind (amp/flags/profiler import this module)
+        from ..amp import amp_state, maybe_cast_inputs
+        from ..framework import flags
+        from .. import profiler
+        import time
+
+        _HOT = (amp_state, maybe_cast_inputs, flags, profiler, time)
+    amp_state, maybe_cast_inputs, _flags, _profiler, _time = _HOT
 
     if amp_state()["enabled"]:
         arrays = maybe_cast_inputs(prim.name, arrays)
 
-    from ..framework import flags as _flags
-    from .. import profiler as _profiler
-
     _prof = _profiler.is_recording()
     _t0 = None
     if _prof:
-        import time as _time
-
         _t0 = _time.perf_counter() * 1e6
 
     out = prim.fwd(attrs)(*arrays)

@@ -1,0 +1,10 @@
+"""High-level API (reference: python/paddle/hapi/).
+
+`Model` wraps an ``nn.Layer`` with fit/evaluate/predict loops driven by host
+Python; every batch still executes through the eager per-op jit dispatch, so
+the device math is identical to hand-written loops. Callbacks mirror the
+reference's callback zoo (callbacks.py) with the same hook points.
+"""
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+from . import callbacks  # noqa: F401

@@ -1,0 +1,394 @@
+"""Training callbacks (reference: python/paddle/hapi/callbacks.py).
+
+Same hook contract as the reference CallbackList: on_{train,eval,predict}_
+{begin,end}, on_epoch_{begin,end}, on_{mode}_batch_{begin,end}. All state the
+hooks read lives in ``callback.params`` (epochs/steps/metrics/verbose), set by
+``config_callbacks`` exactly like the reference's.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import time
+import warnings
+
+from .progressbar import ProgressBar
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = callbacks if callbacks is not None else []
+    cbks = cbks if isinstance(cbks, (list, tuple)) else [cbks]
+    if not any(isinstance(k, ProgBarLogger) for k in cbks) and verbose:
+        cbks = [ProgBarLogger(log_freq, verbose=verbose)] + list(cbks)
+    if not any(isinstance(k, ModelCheckpoint) for k in cbks):
+        cbks = list(cbks) + [ModelCheckpoint(save_freq, save_dir)]
+    if not any(isinstance(k, LRScheduler) for k in cbks):
+        cbks = list(cbks) + [LRScheduler()]
+    for k in cbks:
+        if isinstance(k, EarlyStopping) and k.save_dir is None:
+            k.save_dir = save_dir
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    metrics = metrics or []
+    params = {
+        "batch_size": batch_size,
+        "epochs": epochs,
+        "steps": steps,
+        "verbose": verbose,
+        "metrics": metrics,
+    }
+    cbk_list.set_params(params)
+    return cbk_list
+
+
+class CallbackList:
+    def __init__(self, callbacks=None):
+        self.callbacks = [c for c in (callbacks or [])]
+        self.params = {}
+        self.model = None
+
+    def append(self, callback):
+        self.callbacks.append(callback)
+
+    def __iter__(self):
+        return iter(self.callbacks)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+        self.params = params
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+        self.model = model
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            fn = getattr(c, name, None)
+            if fn is not None:
+                fn(*args)
+
+    def on_begin(self, mode, logs=None):
+        self._call(f"on_{mode}_begin", logs or {})
+
+    def on_end(self, mode, logs=None):
+        self._call(f"on_{mode}_end", logs or {})
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self._call("on_epoch_begin", epoch, logs or {})
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._call("on_epoch_end", epoch, logs or {})
+
+    def on_batch_begin(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_begin", step, logs or {})
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._call(f"on_{mode}_batch_end", step, logs or {})
+
+
+class Callback:
+    """Base class (reference callbacks.py:127)."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class ProgBarLogger(Callback):
+    """Loss/metric console logger (reference callbacks.py:297)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self.epochs = None
+        self.steps = None
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.train_metrics = self.params.get("metrics", [])
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.steps = self.params.get("steps")
+        self.epoch = epoch
+        self.train_step = 0
+        if self.epochs and self.verbose:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.train_progbar = ProgressBar(num=self.steps, verbose=self.verbose)
+
+    def _updates(self, logs, bar, step):
+        values = [(k, logs[k]) for k in self.params.get("metrics", []) if k in logs]
+        bar.update(step, values)
+
+    def on_train_batch_end(self, step, logs=None):
+        self.train_step += 1
+        if self.verbose and self.train_step % self.log_freq == 0:
+            self._updates(logs or {}, self.train_progbar, self.train_step)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self._updates(logs or {}, self.train_progbar, self.train_step)
+
+    def on_eval_begin(self, logs=None):
+        logs = logs or {}
+        self.eval_steps = logs.get("steps")
+        self.eval_step = 0
+        if self.verbose:
+            print("Eval begin...")
+        self.eval_progbar = ProgressBar(num=self.eval_steps, verbose=self.verbose)
+
+    def on_eval_batch_end(self, step, logs=None):
+        self.eval_step += 1
+        if self.verbose and self.eval_step % self.log_freq == 0:
+            self._updates(logs or {}, self.eval_progbar, self.eval_step)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            self._updates(logs or {}, self.eval_progbar, self.eval_step)
+            print("Eval samples: %d" % (logs or {}).get("samples", 0))
+
+    def on_predict_begin(self, logs=None):
+        logs = logs or {}
+        self.test_steps = logs.get("steps")
+        self.test_step = 0
+        if self.verbose:
+            print("Predict begin...")
+        self.test_progbar = ProgressBar(num=self.test_steps, verbose=self.verbose)
+
+    def on_predict_batch_end(self, step, logs=None):
+        self.test_step += 1
+        if self.verbose and self.test_step % self.log_freq == 0:
+            self.test_progbar.update(self.test_step, [])
+
+    def on_predict_end(self, logs=None):
+        if self.verbose:
+            self.test_progbar.update(self.test_step, [])
+            print("Predict samples: %d" % (logs or {}).get("samples", 0))
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save (reference callbacks.py:533)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+
+    def _is_save(self):
+        return self.model is not None and self.save_dir is not None
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self._is_save() and (self.epoch + 1) % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self._is_save():
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (reference callbacks.py:598)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _step(self):
+        from ..optimizer.lr import LRScheduler as Sched
+
+        opt = getattr(self.model, "_optimizer", None)
+        if opt is not None and isinstance(opt._learning_rate, Sched):
+            opt._learning_rate.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            self._step()
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            self._step()
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference callbacks.py:689)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        self.save_dir = None
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"EarlyStopping mode {mode} unknown, falling back to auto")
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in self.monitor):
+            self.monitor_op = lambda cur, best: cur < best - self.min_delta
+            self.best_value = float("inf")
+        else:
+            self.monitor_op = lambda cur, best: cur > best + self.min_delta
+            self.best_value = -float("inf")
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        self.stopped_epoch += 1  # evals happen once per epoch under fit()
+        if self.monitor not in logs:
+            warnings.warn(f"Monitor of EarlyStopping should be loss or metric name; "
+                          f"{self.monitor} missing from eval logs")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if isinstance(current, numbers.Number):
+            if self.monitor_op(current, self.best_value):
+                self.best_value = current
+                self.wait_epoch = 0
+                if self.save_best_model and self.save_dir is not None:
+                    self.model.save(os.path.join(self.save_dir, "best_model"))
+            else:
+                self.wait_epoch += 1
+            if self.wait_epoch > self.patience:
+                self.model.stop_training = True
+                if self.verbose:
+                    print(f"Epoch {self.stopped_epoch}: Early stopping.")
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce LR when a metric plateaus (reference callbacks.py:958)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        super().__init__()
+        self.monitor = monitor
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a factor >= 1.0")
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.cooldown_counter = 0
+        self.min_lr = min_lr
+        self.wait = 0
+        if mode == "min" or (mode == "auto" and "acc" not in self.monitor):
+            self.monitor_op = lambda a, b: a < b - self.min_delta
+            self.best = float("inf")
+        else:
+            self.monitor_op = lambda a, b: a > b + self.min_delta
+            self.best = -float("inf")
+
+    def in_cooldown(self):
+        return self.cooldown_counter > 0
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        current = logs.get(self.monitor)
+        if current is None:
+            warnings.warn(f"ReduceLROnPlateau monitor {self.monitor} missing from logs")
+            return
+        if isinstance(current, (list, tuple)):
+            current = current[0]
+        if self.in_cooldown():
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self.monitor_op(current, self.best):
+            self.best = current
+            self.wait = 0
+        elif not self.in_cooldown():
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                from ..optimizer.lr import LRScheduler as Sched
+
+                if isinstance(opt._learning_rate, Sched):
+                    warnings.warn("ReduceLROnPlateau needs a float lr, found scheduler")
+                    return
+                old_lr = opt.get_lr()
+                new_lr = max(old_lr * self.factor, self.min_lr)
+                if old_lr - new_lr > 1e-12:
+                    opt.set_lr(new_lr)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: reducing learning rate to {new_lr}.")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
+class VisualDL(Callback):
+    """Scalar logging (reference callbacks.py:843). Writes a plain JSONL log
+    (the VisualDL wire format needs the visualdl package, not in this image)."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self._fh = None
+
+    def _write(self, mode, step, logs):
+        import json
+
+        if self._fh is None:
+            os.makedirs(self.log_dir, exist_ok=True)
+            self._fh = open(os.path.join(self.log_dir, "scalars.jsonl"), "a")
+        record = {"mode": mode, "step": step}
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple)) and v and isinstance(v[0], numbers.Number):
+                record[k] = float(v[0])
+            elif isinstance(v, numbers.Number):
+                record[k] = float(v)
+        self._fh.write(json.dumps(record) + "\n")
+        self._fh.flush()
+
+    def on_train_batch_end(self, step, logs=None):
+        self._write("train", step, logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", 0, logs)
+
+    def on_train_end(self, logs=None):
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None

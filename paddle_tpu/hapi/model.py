@@ -1,0 +1,299 @@
+"""hapi.Model: fit/evaluate/predict loops (reference: python/paddle/hapi/model.py:1014).
+
+TPU-native stance: there is exactly one execution adapter — the eager dygraph
+path whose every op is a cached jitted XLA executable — so the reference's
+StaticGraphAdapter/DynamicGraphAdapter split (model.py:252,667) collapses into
+Model itself. Distributed fit() composes with paddle_tpu.distributed the same
+way hand loops do (DistributedBatchSampler + GSPMD-annotated layers).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import io as fio
+from ..metric import Metric
+from .callbacks import config_callbacks
+
+
+def to_list(value):
+    if value is None:
+        return []
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+def _as_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(np.asarray(x))
+
+
+def _scalar(t):
+    return float(np.asarray(t.data if isinstance(t, Tensor) else t))
+
+
+class Model:
+    """A Layer + optimizer + loss + metrics bundle with training loops.
+
+    Reference: python/paddle/hapi/model.py:1014 (class Model). Same public
+    surface: prepare / fit / evaluate / predict / train_batch / eval_batch /
+    predict_batch / save / load / parameters / summary.
+    """
+
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._inputs = to_list(inputs)
+        self._labels = to_list(labels)
+        self._loss = None
+        self._metrics = []
+        self._optimizer = None
+        self.mode = "train"
+        self.stop_training = False
+
+    # -- single-batch APIs ---------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None):
+        self._optimizer = optimizer
+        if loss is not None and not callable(loss):
+            raise TypeError("loss must be a callable (Layer or function)")
+        self._loss = loss
+        metrics = metrics or []
+        for m in to_list(metrics):
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
+        self._metrics = to_list(metrics)
+        self._amp_configs = amp_configs
+        return self
+
+    def _compute_loss(self, outputs, labels):
+        losses = to_list(self._loss(*(to_list(outputs) + labels)))
+        return losses
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        self.mode = "train"
+        inputs = [_as_tensor(x) for x in to_list(inputs)]
+        labels = [_as_tensor(x) for x in to_list(labels)]
+        outputs = self.network(*inputs)
+        losses = self._compute_loss(outputs, labels)
+        total = losses[0]
+        for extra in losses[1:]:
+            total = total + extra
+        total.backward()
+        if update and self._optimizer is not None:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            metric_outs = m.compute(*(to_list(outputs) + labels))
+            metrics.append(m.update(*[np.asarray(
+                t.data if isinstance(t, Tensor) else t) for t in to_list(metric_outs)]))
+        loss_vals = [_scalar(l) for l in losses]
+        if metrics:
+            return loss_vals, metrics[0] if len(metrics) == 1 else metrics
+        return loss_vals
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        self.mode = "eval"
+        from ..core import no_grad
+
+        inputs = [_as_tensor(x) for x in to_list(inputs)]
+        labels = [_as_tensor(x) for x in to_list(labels)]
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss_vals = []
+            if self._loss is not None:
+                loss_vals = [_scalar(l) for l in self._compute_loss(outputs, labels)]
+        metrics = []
+        for m in self._metrics:
+            metric_outs = m.compute(*(to_list(outputs) + labels))
+            metrics.append(m.update(*[np.asarray(
+                t.data if isinstance(t, Tensor) else t) for t in to_list(metric_outs)]))
+        if metrics:
+            return loss_vals, metrics[0] if len(metrics) == 1 else metrics
+        return loss_vals
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        self.mode = "test"
+        from ..core import no_grad
+
+        inputs = [_as_tensor(x) for x in to_list(inputs)]
+        with no_grad():
+            outputs = self.network(*inputs)
+        return [np.asarray(o.data) for o in to_list(outputs)]
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    # -- checkpoint ----------------------------------------------------------
+    def save(self, path, training=True):
+        """Save `<path>.pdparams` (+ `.pdopt` when training). For deployment
+        (training=False) export the traced program via paddle_tpu.jit.save."""
+        if not training:
+            from .. import jit
+
+            jit.save(self.network, path, input_spec=self._inputs or None)
+            return
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        fio.save(self.network.state_dict(), path + ".pdparams")
+        if self._optimizer is not None:
+            fio.save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        param_state = fio.load(path + ".pdparams")
+        missing, unexpected = self.network.set_state_dict(param_state)
+        if not skip_mismatch and (missing or unexpected):
+            raise ValueError(
+                f"state dict mismatch: missing keys {missing}, "
+                f"unexpected keys {unexpected} (pass skip_mismatch=True to ignore)")
+        if not reset_optimizer and self._optimizer is not None \
+                and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+        return self
+
+    # -- loops ---------------------------------------------------------------
+    def _make_loader(self, data, batch_size, shuffle, num_workers):
+        from ..io import DataLoader, Dataset
+
+        if data is None:
+            return None
+        if isinstance(data, DataLoader):
+            return data
+        if isinstance(data, Dataset):
+            return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                              num_workers=num_workers)
+        return data  # any iterable of batches
+
+    def _split_batch(self, batch):
+        batch = batch if isinstance(batch, (list, tuple)) else [batch]
+        if self._loss is not None and len(batch) > 1:
+            # convention: last element(s) are labels (reference model.py:1986)
+            n_labels = max(1, len(self._labels)) if self._labels else 1
+            return list(batch[:-n_labels]), list(batch[-n_labels:])
+        return list(batch), []
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        assert train_data is not None, "train_data must be given"
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        steps = len(loader) if hasattr(loader, "__len__") else None
+        metric_names = ["loss"] + [n for m in self._metrics for n in to_list(m.name())]
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
+            save_freq=save_freq, save_dir=save_dir, verbose=verbose,
+            metrics=metric_names)
+        self.stop_training = False
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbks.on_epoch_begin(epoch)
+            logs = self._run_one_epoch(loader, cbks, "train",
+                                       accumulate_grad_batches, num_iters)
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                eval_logs = {"steps": len(eval_loader) if hasattr(eval_loader, "__len__") else None,
+                             "metrics": metric_names}
+                cbks.on_begin("eval", eval_logs)
+                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                cbks.on_end("eval", eval_logs)
+        cbks.on_end("train")
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        metric_names = ["loss"] + [n for m in self._metrics for n in to_list(m.name())]
+        cbks = config_callbacks(callbacks, model=self, log_freq=log_freq,
+                                verbose=verbose, metrics=metric_names)
+        logs = {"steps": len(loader) if hasattr(loader, "__len__") else None,
+                "metrics": metric_names}
+        cbks.on_begin("eval", logs)
+        logs = self._run_one_epoch(loader, cbks, "eval", num_iters=num_iters)
+        cbks.on_end("eval", logs)
+        return {k: v for k, v in logs.items() if k in metric_names}
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False, num_workers)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose, metrics=[])
+        logs = {"steps": len(loader) if hasattr(loader, "__len__") else None}
+        cbks.on_begin("predict", logs)
+        outputs: List[List[np.ndarray]] = []
+        count = 0
+        for step, batch in enumerate(loader):
+            inputs, _labels = self._split_batch(batch)  # drop labels if present
+            cbks.on_batch_begin("predict", step, {})
+            outs = self.predict_batch(inputs)
+            outputs.append(outs)
+            count += outs[0].shape[0] if outs and hasattr(outs[0], "shape") else 1
+            cbks.on_batch_end("predict", step, {})
+        # regroup from per-batch to per-output (reference model.py:1960)
+        n_out = len(outputs[0]) if outputs else 0
+        grouped = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            grouped = [np.concatenate(g, axis=0) for g in grouped]
+        cbks.on_end("predict", {"samples": count})
+        return grouped
+
+    def _run_one_epoch(self, loader, cbks, mode, accumulate_grad_batches=1,
+                       num_iters=None):
+        for m in self._metrics:
+            m.reset()
+        logs = {}
+        count = 0
+        for step, batch in enumerate(loader):
+            if num_iters is not None and step >= num_iters:
+                break
+            inputs, labels = self._split_batch(batch)
+            cbks.on_batch_begin(mode, step, logs)
+            if mode == "train" and self.stop_training:
+                break
+            if mode == "train":
+                update = (step + 1) % accumulate_grad_batches == 0
+                outs = self.train_batch(inputs, labels, update=update)
+            else:
+                outs = self.eval_batch(inputs, labels)
+            if self._metrics and self._loss is not None:
+                loss_vals, metric_vals = outs
+            elif self._loss is not None:
+                loss_vals, metric_vals = outs, None
+            else:
+                loss_vals, metric_vals = None, outs
+            if loss_vals:
+                logs["loss"] = loss_vals[0] if len(loss_vals) == 1 else loss_vals
+            if metric_vals is not None:
+                names = [n for m in self._metrics for n in to_list(m.name())]
+                vals = to_list(metric_vals)
+                for n, v in zip(names, vals if len(vals) == len(names) else vals * len(names)):
+                    logs[n] = v
+            bsz = inputs[0].shape[0] if inputs and hasattr(inputs[0], "shape") else 1
+            count += bsz
+            logs["batch_size"] = bsz
+            cbks.on_batch_end(mode, step, logs)
+        for m in self._metrics:
+            res = m.accumulate()
+            for n, v in zip(to_list(m.name()), to_list(res)):
+                logs[n] = v
+        logs["samples"] = count
+        return logs
+
+    def summary(self, input_size=None, dtype=None):
+        from .model_summary import summary
+
+        sizes = input_size
+        if sizes is None and self._inputs:
+            sizes = [tuple(s.shape) for s in self._inputs]
+        assert sizes is not None, "input_size must be given (no InputSpec provided)"
+        return summary(self.network, sizes, dtypes=dtype)

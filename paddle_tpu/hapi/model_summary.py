@@ -1,0 +1,98 @@
+"""paddle.summary (reference: python/paddle/hapi/model_summary.py).
+
+Runs one forward pass with forward-post hooks recording each leaf layer's
+output shape and parameter count, then prints the familiar table.
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def _normalize_sizes(input_size):
+    if isinstance(input_size, tuple) and all(isinstance(x, numbers.Number) or x is None
+                                             for x in input_size):
+        return [tuple(input_size)]
+    if isinstance(input_size, (list, tuple)):
+        return [tuple(s) for s in input_size]
+    raise TypeError(f"unsupported input_size: {input_size!r}")
+
+
+def summary(net: Layer, input_size, dtypes=None, input=None):
+    """Print a per-layer summary; returns {'total_params', 'trainable_params'}."""
+    sizes = _normalize_sizes(input_size)
+    dtypes = dtypes or ["float32"] * len(sizes)
+    if isinstance(dtypes, str):
+        dtypes = [dtypes] * len(sizes)
+    if input is not None:
+        inputs = [input] if isinstance(input, Tensor) else list(input)
+    else:
+        inputs = []
+        for s, dt in zip(sizes, dtypes):
+            s = tuple(1 if (d is None or (isinstance(d, int) and d < 0)) else d
+                      for d in s)
+            if dt in ("int32", "int64"):
+                inputs.append(Tensor(np.zeros(s, dt)))
+            else:
+                inputs.append(Tensor(np.random.default_rng(0).standard_normal(s).astype(dt)))
+
+    rows = []
+    hooks = []
+
+    def register(layer, name):
+        def hook(l, ins, outs):
+            out = outs[0] if isinstance(outs, (list, tuple)) else outs
+            shape = list(out.shape) if hasattr(out, "shape") else []
+            n_params = sum(int(np.prod(p.shape)) for p in l._parameters.values()
+                           if p is not None)
+            rows.append((f"{type(l).__name__}-{len(rows) + 1}", name, shape, n_params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers(include_self=False):
+        if not sub._sub_layers:  # leaf layers only
+            register(sub, name)
+    if not rows and not hooks:
+        register(net, "")
+
+    was_training = net.training
+    net.eval()
+    try:
+        from ..core import no_grad
+
+        with no_grad():
+            net(*inputs)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total_params = 0
+    trainable_params = 0
+    seen = set()
+    for _, p in net.named_parameters():
+        if id(p) in seen:
+            continue
+        seen.add(id(p))
+        n = int(np.prod(p.shape))
+        total_params += n
+        if p.trainable:
+            trainable_params += n
+
+    header = f"{'Layer (type)':<28}{'Output Shape':<26}{'Param #':>12}"
+    line = "-" * len(header)
+    print(line)
+    print(header)
+    print("=" * len(header))
+    for lname, _, shape, n_params in rows:
+        print(f"{lname:<28}{str(shape):<26}{n_params:>12,}")
+    print("=" * len(header))
+    print(f"Total params: {total_params:,}")
+    print(f"Trainable params: {trainable_params:,}")
+    print(f"Non-trainable params: {total_params - trainable_params:,}")
+    print(line)
+    return {"total_params": total_params, "trainable_params": trainable_params}

@@ -1,0 +1,45 @@
+"""Terminal progress bar (reference: python/paddle/hapi/progressbar.py role)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self.file = file
+        self._start = time.time()
+        self._last_update = 0.0
+
+    def update(self, current_num, values=None):
+        values = values or []
+        now = time.time()
+        msg = []
+        if self._num is not None:
+            msg.append(f"step {current_num}/{self._num}")
+        else:
+            msg.append(f"step {current_num}")
+        for k, v in values:
+            if isinstance(v, (list, tuple)):
+                v = " ".join(f"{x:.4f}" for x in v)
+            elif isinstance(v, float):
+                v = f"{v:.4f}"
+            msg.append(f"{k}: {v}")
+        elapsed = now - self._start
+        if current_num:
+            msg.append(f"{1e3 * elapsed / current_num:.0f}ms/step")
+        line = " - ".join(msg)
+        if self._verbose == 1:
+            self.file.write("\r" + line)
+            if self._num is not None and current_num >= self._num:
+                self.file.write("\n")
+            self.file.flush()
+        elif self._verbose == 2:
+            self.file.write(line + "\n")
+            self.file.flush()
+
+    def start(self):
+        self._start = time.time()

@@ -28,6 +28,10 @@ from .layer.loss import (  # noqa: F401
 )
 from .layer.container import Sequential, LayerList, LayerDict, ParameterList  # noqa: F401
 from .layer.moe import MoELayer, ExpertMLP  # noqa: F401
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN,
+    SimpleRNN, LSTM, GRU,
+)
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
     TransformerDecoderLayer, TransformerDecoder, Transformer,

@@ -3,5 +3,7 @@ from .activation import *  # noqa: F401,F403
 from .common import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from . import rnn as rnn_mod  # noqa: F401
+from .rnn import rnn, birnn  # noqa: F401
 
 from ...ops.manipulation import one_hot  # noqa: F401

@@ -1,0 +1,147 @@
+"""Recurrent ops, TPU-first (reference: python/paddle/nn/layer/rnn.py cells +
+fluid.layers.rnn / the cuDNN rnn_op fused path, paddle/fluid/operators/rnn_op.h).
+
+Design: one ``rnn_layer_scan`` primitive runs a whole (layer, direction) pass as
+a single ``lax.scan`` — the input projection for every timestep is hoisted into
+one big MXU matmul, only the [B,H]x[H,G] recurrent matmul lives inside the scan
+body. Backward is jax's scan-vjp (the fused cuDNN-backward role). Multi-layer /
+bidirectional stacks are short host loops over jitted per-layer calls so that
+inter-layer dropout stays on the eager RNG path.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...core.dispatch import primitive
+
+
+def _cell_step(mode, h, c, xg_t, w_hh, b_hh):
+    """One recurrence step from precomputed input gates xg_t [B, G]."""
+    if mode == "LSTM":
+        gates = xg_t + jnp.matmul(h, w_hh.T) + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        c_new = f * c + i * jnp.tanh(g)
+        h_new = o * jnp.tanh(c_new)
+        return h_new, c_new
+    hg = jnp.matmul(h, w_hh.T) + b_hh
+    if mode == "GRU":
+        x_r, x_z, x_c = jnp.split(xg_t, 3, axis=-1)
+        h_r, h_z, h_c = jnp.split(hg, 3, axis=-1)
+        r = jax.nn.sigmoid(x_r + h_r)
+        z = jax.nn.sigmoid(x_z + h_z)
+        cand = jnp.tanh(x_c + r * h_c)  # reset gate applied after the matmul
+        h_new = (h - cand) * z + cand
+        return h_new, c
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    h_new = act(xg_t + hg)
+    return h_new, c
+
+
+@primitive("rnn_layer_scan")
+def rnn_layer_scan(x, h0, c0, w_ih, w_hh, b_ih, b_hh, seq_len,
+                   mode="LSTM", reverse=False, time_major=False):
+    """Full-sequence single-(layer,direction) recurrence.
+
+    x: [B,T,I] (or [T,B,I] when time_major). seq_len: [B] int32; steps at or
+    beyond a row's length carry state through and emit zero outputs (matching
+    the reference rnn op's sequence_length masking, fluid/layers/rnn.py mask
+    semantics). Returns (outputs, h_T, c_T).
+    """
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # [T,B,I]
+    T = x.shape[0]
+    xg = jnp.matmul(x, w_ih.T) + b_ih  # [T,B,G]: all timesteps, one MXU matmul
+    step_ids = jnp.arange(T)
+    if reverse:
+        xg = xg[::-1]
+        step_ids = step_ids[::-1]
+    valid = (step_ids[:, None] < seq_len[None, :]).astype(x.dtype)  # [T,B]
+
+    def step(carry, inp):
+        h, c = carry
+        xg_t, m = inp
+        h_new, c_new = _cell_step(mode, h, c, xg_t, w_hh, b_hh)
+        m = m[:, None]
+        h2 = m * h_new + (1.0 - m) * h
+        c2 = m * c_new + (1.0 - m) * c
+        return (h2, c2), m * h_new
+
+    (h_t, c_t), ys = lax.scan(step, (h0, c0), (xg, valid))
+    if reverse:
+        ys = ys[::-1]
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, h_t, c_t
+
+
+def _map_structure(fn, s):
+    if isinstance(s, (list, tuple)):
+        return type(s)(_map_structure(fn, x) for x in s)
+    return fn(s)
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Generic cell-driven recurrence (reference: fluid/layers/rnn.py rnn()).
+
+    Runs an arbitrary RNNCellBase over the time dim with host-side unrolling —
+    the path for user-defined cells; the stock SimpleRNN/LSTM/GRU layers use
+    the fused rnn_layer_scan primitive instead.
+    """
+    from ...ops import manipulation as M
+
+    batch_axis = 1 if time_major else 0
+    time_axis = 0 if time_major else 1
+    T = inputs.shape[time_axis]
+    if initial_states is None:
+        initial_states = cell.get_initial_states(inputs, batch_dim_idx=batch_axis)
+    states = initial_states
+    outputs = []
+    steps = range(T - 1, -1, -1) if is_reverse else range(T)
+    mask = None
+    if sequence_length is not None:
+        import numpy as np
+
+        seq = sequence_length.numpy() if hasattr(sequence_length, "numpy") \
+            else np.asarray(sequence_length)
+        mask = seq
+    for t in steps:
+        x_t = M.squeeze(M.slice(inputs, [time_axis], [t], [t + 1]), [time_axis])
+        out, new_states = cell(x_t, states, **kwargs)
+        if mask is not None:
+            from ...ops import creation
+
+            m = M.unsqueeze(creation.to_tensor((t < mask).astype("float32")), [-1])
+            out = out * m
+            olds = states if isinstance(states, (list, tuple)) else [states]
+            if isinstance(new_states, (list, tuple)):
+                new_states = type(new_states)(
+                    ns * m + os * (1.0 - m) for ns, os in zip(new_states, olds))
+            else:
+                new_states = new_states * m + states * (1.0 - m)
+        outputs.append(out)
+        states = new_states
+    if is_reverse:
+        outputs = outputs[::-1]
+    stacked = M.stack(outputs, axis=time_axis)
+    return stacked, states
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None, sequence_length=None,
+          time_major=False, **kwargs):
+    """Bidirectional generic recurrence (reference: fluid/layers/rnn.py birnn())."""
+    from ...ops import manipulation as M
+
+    if initial_states is None:
+        states_fw, states_bw = None, None
+    else:
+        states_fw, states_bw = initial_states
+    out_fw, st_fw = rnn(cell_fw, inputs, states_fw, sequence_length,
+                        time_major=time_major, is_reverse=False, **kwargs)
+    out_bw, st_bw = rnn(cell_bw, inputs, states_bw, sequence_length,
+                        time_major=time_major, is_reverse=True, **kwargs)
+    outputs = M.concat([out_fw, out_bw], axis=-1)
+    return outputs, (st_fw, st_bw)

@@ -1,0 +1,426 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+Cells are eager Layers (each step is a couple of dispatched matmul ops) for
+user-composed recurrences; the stock SimpleRNN/LSTM/GRU layers instead call the
+fused ``rnn_layer_scan`` primitive (functional/rnn.py) — one lax.scan per
+(layer, direction), the TPU equivalent of the reference's cuDNN fused rnn_op.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import functional as F
+from ..functional import rnn_mod as F_rnn
+from .. import initializer as I
+from .layers import Layer
+from .container import LayerList
+
+
+def split_states(states, bidirectional=False, state_components=1):
+    """[L*D, B, H]-packed states -> nested per-layer (per-direction) states
+    (reference rnn.py:44)."""
+    if state_components == 1:
+        states = [states[i] for i in range(states.shape[0])]
+        if not bidirectional:
+            return states
+        return [(states[i], states[i + 1]) for i in range(0, len(states), 2)]
+    comps = [[s[i] for i in range(s.shape[0])] for s in states]
+    packed = list(zip(*comps))  # [(h_i, c_i), ...]
+    if not bidirectional:
+        return packed
+    return [(packed[i], packed[i + 1]) for i in range(0, len(packed), 2)]
+
+
+def concat_states(states, bidirectional=False, state_components=1):
+    """Inverse of split_states (reference rnn.py:97)."""
+    from ...ops import manipulation as M
+
+    if state_components == 1:
+        flat = []
+        for s in states:
+            flat.extend(s if isinstance(s, (list, tuple)) else [s])
+        return M.stack(flat, axis=0)
+    flat = []
+    for s in states:
+        if bidirectional:
+            flat.extend(list(s[0]) + list(s[1]))
+        else:
+            flat.extend(list(s))
+    comps = [flat[i::state_components] for i in range(state_components)]
+    return tuple(M.stack(c, axis=0) for c in comps)
+
+
+class RNNCellBase(Layer):
+    """Base cell (reference rnn.py:139)."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops import creation
+
+        if isinstance(batch_ref, (list, tuple)):
+            batch_ref = batch_ref[0]
+        batch = batch_ref.shape[batch_dim_idx]
+        shape = shape if shape is not None else self.state_shape
+        dtype = dtype or "float32"
+
+        def make(s):
+            if isinstance(s, (list, tuple)) and s and isinstance(s[0], (list, tuple)):
+                return type(s)(make(x) for x in s)
+            dims = [batch] + [int(d) for d in (s if isinstance(s, (list, tuple)) else [s])]
+            return creation.full(dims, init_value, dtype=dtype)
+
+        if isinstance(shape, (list, tuple)) and shape and isinstance(shape[0], (list, tuple)):
+            return type(shape)(make(s) for s in shape)
+        return make(shape)
+
+    def _std_init(self, attr, shape, hidden_size):
+        std = 1.0 / math.sqrt(hidden_size)
+        return self.create_parameter(
+            shape, attr=attr, default_initializer=I.Uniform(-std, std))
+
+
+class SimpleRNNCell(RNNCellBase):
+    r"""h' = act(W_ih x + b_ih + W_hh h + b_hh) (reference rnn.py:263)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+        self.weight_ih = self._std_init(weight_ih_attr, [hidden_size, input_size], hidden_size)
+        self.weight_hh = self._std_init(weight_hh_attr, [hidden_size, hidden_size], hidden_size)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self._std_init(bias_ih_attr, [hidden_size], hidden_size)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self._std_init(bias_hh_attr, [hidden_size], hidden_size)
+        if bias_ih_attr is False:
+            self._parameters["bias_ih"] = None
+        if bias_hh_attr is False:
+            self._parameters["bias_hh"] = None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        from ...ops import linalg as M
+
+        i2h = M.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            i2h = i2h + self.bias_ih
+        h2h = M.matmul(states, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h2h = h2h + self.bias_hh
+        act = F.tanh if self.activation == "tanh" else F.relu
+        h = act(i2h + h2h)
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class LSTMCell(RNNCellBase):
+    r"""i,f,g,o-gated cell (reference rnn.py:399)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self._std_init(weight_ih_attr, [4 * hidden_size, input_size], hidden_size)
+        self.weight_hh = self._std_init(weight_hh_attr, [4 * hidden_size, hidden_size], hidden_size)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self._std_init(bias_ih_attr, [4 * hidden_size], hidden_size)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self._std_init(bias_hh_attr, [4 * hidden_size], hidden_size)
+        if bias_ih_attr is False:
+            self._parameters["bias_ih"] = None
+        if bias_hh_attr is False:
+            self._parameters["bias_hh"] = None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h, pre_c = states
+        from ...ops import linalg as M, manipulation as Man
+
+        gates = M.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            gates = gates + self.bias_ih
+        gates = gates + M.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            gates = gates + self.bias_hh
+        i, f, g, o = Man.split(gates, 4, axis=-1)
+        i, f, o = F.sigmoid(i), F.sigmoid(f), F.sigmoid(o)
+        c = f * pre_c + i * F.tanh(g)
+        h = o * F.tanh(c)
+        return h, (h, c)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class GRUCell(RNNCellBase):
+    r"""r,z,c-gated cell, reset gate applied after the matmul (reference rnn.py:556)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.weight_ih = self._std_init(weight_ih_attr, [3 * hidden_size, input_size], hidden_size)
+        self.weight_hh = self._std_init(weight_hh_attr, [3 * hidden_size, hidden_size], hidden_size)
+        self.bias_ih = None if bias_ih_attr is False else \
+            self._std_init(bias_ih_attr, [3 * hidden_size], hidden_size)
+        self.bias_hh = None if bias_hh_attr is False else \
+            self._std_init(bias_hh_attr, [3 * hidden_size], hidden_size)
+        if bias_ih_attr is False:
+            self._parameters["bias_ih"] = None
+        if bias_hh_attr is False:
+            self._parameters["bias_hh"] = None
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        pre_h = states
+        from ...ops import linalg as M, manipulation as Man
+
+        x_gates = M.matmul(inputs, self.weight_ih, transpose_y=True)
+        if self.bias_ih is not None:
+            x_gates = x_gates + self.bias_ih
+        h_gates = M.matmul(pre_h, self.weight_hh, transpose_y=True)
+        if self.bias_hh is not None:
+            h_gates = h_gates + self.bias_hh
+        x_r, x_z, x_c = Man.split(x_gates, 3, axis=-1)
+        h_r, h_z, h_c = Man.split(h_gates, 3, axis=-1)
+        r = F.sigmoid(x_r + h_r)
+        z = F.sigmoid(x_z + h_z)
+        c = F.tanh(x_c + r * h_c)
+        h = (pre_h - c) * z + c
+        return h, h
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def extra_repr(self):
+        return f"{self.input_size}, {self.hidden_size}"
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (reference rnn.py:707)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        if not hasattr(self.cell, "call"):
+            self.cell.call = self.cell.forward
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        return F_rnn.rnn(self.cell, inputs, initial_states=initial_states,
+                         sequence_length=sequence_length,
+                         time_major=self.time_major, is_reverse=self.is_reverse,
+                         **kwargs)
+
+
+class BiRNN(Layer):
+    """Forward + backward cells over a sequence (reference rnn.py:782)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        if cell_fw.input_size != cell_bw.input_size:
+            raise ValueError("input size of forward and backward cells must match")
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None, **kwargs):
+        if isinstance(initial_states, (list, tuple)):
+            assert len(initial_states) == 2, \
+                "length of initial_states should be 2 when it is a list/tuple"
+        return F_rnn.birnn(self.cell_fw, self.cell_bw, inputs, initial_states,
+                           sequence_length, self.time_major, **kwargs)
+
+
+class RNNBase(LayerList):
+    """Stacked (bi)directional recurrence over the fused scan primitive
+    (reference rnn.py:861; the could_use_cudnn fused path is the default here)."""
+
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        bidirectional_list = ("bidirectional", "bidirect")
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.dropout = dropout
+        self.num_directions = 2 if direction in bidirectional_list else 1
+        self.time_major = time_major
+        self.num_layers = num_layers
+        self.state_components = 2 if mode == "LSTM" else 1
+        self._has_bias = (bias_ih_attr is not False, bias_hh_attr is not False)
+        kwargs = {
+            "weight_ih_attr": weight_ih_attr,
+            "weight_hh_attr": weight_hh_attr,
+            "bias_ih_attr": bias_ih_attr,
+            "bias_hh_attr": bias_hh_attr,
+        }
+        if mode == "LSTM":
+            rnn_cls = LSTMCell
+        elif mode == "GRU":
+            rnn_cls = GRUCell
+        else:
+            rnn_cls = SimpleRNNCell
+            kwargs["activation"] = self.activation
+
+        if direction not in ("forward",) + bidirectional_list:
+            raise ValueError(
+                f"direction should be forward or bidirect (or bidirectional), "
+                f"received direction = {direction}")
+        if direction == "forward":
+            self.append(RNN(rnn_cls(input_size, hidden_size, **kwargs),
+                            False, time_major))
+            for _ in range(1, num_layers):
+                self.append(RNN(rnn_cls(hidden_size, hidden_size, **kwargs),
+                                False, time_major))
+        else:
+            self.append(BiRNN(rnn_cls(input_size, hidden_size, **kwargs),
+                              rnn_cls(input_size, hidden_size, **kwargs), time_major))
+            for _ in range(1, num_layers):
+                self.append(BiRNN(rnn_cls(2 * hidden_size, hidden_size, **kwargs),
+                                  rnn_cls(2 * hidden_size, hidden_size, **kwargs),
+                                  time_major))
+
+        # flat-name aliases (weight_ih_l0, ... as in the reference's cudnn view)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                cell = self._cell(layer, d)
+                suffix = "_reverse" if d == 1 else ""
+                object.__setattr__(self, f"weight_ih_l{layer}{suffix}", cell.weight_ih)
+                object.__setattr__(self, f"weight_hh_l{layer}{suffix}", cell.weight_hh)
+                if cell.bias_ih is not None:
+                    object.__setattr__(self, f"bias_ih_l{layer}{suffix}", cell.bias_ih)
+                if cell.bias_hh is not None:
+                    object.__setattr__(self, f"bias_hh_l{layer}{suffix}", cell.bias_hh)
+
+    def _cell(self, layer, direction):
+        wrapper = self[layer]
+        if self.num_directions == 1:
+            return wrapper.cell
+        return wrapper.cell_fw if direction == 0 else wrapper.cell_bw
+
+    def _scan_mode(self):
+        if self.mode in ("LSTM", "GRU"):
+            return self.mode
+        return "RNN_TANH" if self.activation == "tanh" else "RNN_RELU"
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import creation, manipulation as M
+
+        batch_axis = 1 if self.time_major else 0
+        batch = inputs.shape[batch_axis]
+        dtype = str(inputs.dtype)
+        LD = self.num_layers * self.num_directions
+        if initial_states is None:
+            zero = lambda: creation.zeros([LD, batch, self.hidden_size], dtype=dtype)
+            initial_states = (zero(), zero()) if self.state_components == 2 else zero()
+        states = initial_states if isinstance(initial_states, (list, tuple)) \
+            else (initial_states,)
+
+        if sequence_length is None:
+            T = inputs.shape[0 if self.time_major else 1]
+            seq_len = creation.full([batch], T, dtype="int32")
+        else:
+            seq_len = sequence_length
+
+        mode = self._scan_mode()
+        x = inputs
+        finals_h, finals_c = [], []
+        for layer in range(self.num_layers):
+            outs = []
+            for d in range(self.num_directions):
+                idx = layer * self.num_directions + d
+                cell = self._cell(layer, d)
+                h0 = states[0][idx]
+                c0 = states[1][idx] if self.state_components == 2 else \
+                    creation.zeros([batch, self.hidden_size], dtype=dtype)
+                b_ih = cell.bias_ih if cell.bias_ih is not None else \
+                    creation.zeros([cell.weight_ih.shape[0]], dtype=dtype)
+                b_hh = cell.bias_hh if cell.bias_hh is not None else \
+                    creation.zeros([cell.weight_hh.shape[0]], dtype=dtype)
+                ys, h_t, c_t = F_rnn.rnn_layer_scan(
+                    x, h0, c0, cell.weight_ih, cell.weight_hh, b_ih, b_hh,
+                    seq_len, mode=mode, reverse=bool(d == 1),
+                    time_major=self.time_major)
+                outs.append(ys)
+                finals_h.append(h_t)
+                finals_c.append(c_t)
+            x = outs[0] if len(outs) == 1 else M.concat(outs, axis=-1)
+            if self.dropout > 0.0 and layer < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+        h_n = M.stack(finals_h, axis=0)
+        if self.state_components == 2:
+            final = (h_n, M.stack(finals_c, axis=0))
+        else:
+            final = h_n
+        return x, final
+
+    def extra_repr(self):
+        s = f"{self.input_size}, {self.hidden_size}"
+        if self.num_layers != 1:
+            s += f", num_layers={self.num_layers}"
+        if self.num_directions == 2:
+            s += ", direction=bidirect"
+        return s
+
+
+class SimpleRNN(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        if activation not in ("tanh", "relu"):
+            raise ValueError("activation must be tanh or relu")
+        self.activation = activation
+        super().__init__("RNN", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class LSTM(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)
+
+
+class GRU(RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, weight_ih_attr, weight_hh_attr,
+                         bias_ih_attr, bias_hh_attr)

@@ -597,7 +597,7 @@ def moveaxis(x, source, destination, name=None):
 def _slice_op(x, *, axes, starts, ends):
     out = x
     for ax, st, en in zip(axes, starts, ends):
-        sl = [slice(None)] * x.ndim
+        sl = [builtins.slice(None)] * x.ndim
         sl[ax] = builtins.slice(st, en)
         out = out[tuple(sl)]
     return out

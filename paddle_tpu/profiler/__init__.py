@@ -209,8 +209,10 @@ class Profiler:
             _RECORDER.active = False
         self._stop_jax()
         if self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
-            if self.on_trace_ready:
+            # RECORD_AND_RETURN already delivered this cycle's events in step()
+            if self.on_trace_ready and self._events:
                 self.on_trace_ready(self)
+                self._events = []
         self.state = ProfilerState.CLOSED
 
     def step(self, num_samples: Optional[int] = None):
@@ -224,6 +226,7 @@ class Profiler:
         should = self.state in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
         if prev == ProfilerState.RECORD_AND_RETURN and self.on_trace_ready:
             self.on_trace_ready(self)
+            self._events = []  # fresh buffer per cycle (repeat>1 schedulers)
         if should and not recording:
             self._begin_record()
         elif recording and not should:
