@@ -1,0 +1,453 @@
+"""paddle.distribution (reference: python/paddle/distribution/).
+
+Distributions are host-side parameter holders; sampling draws keys from the
+framework RNG (framework/random.py) and runs jax.random under the hood, while
+log_prob/entropy are built from dispatched Tensor ops so they stay on the
+autograd tape (pathwise gradients through loc/scale work like the reference's
+reparameterized samples).
+"""
+from __future__ import annotations
+
+import math
+import numbers
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import random as random_mod
+from ..ops import creation, math as M, manipulation as Man, reduction as R
+
+__all__ = ["Beta", "Categorical", "Dirichlet", "Distribution",
+           "ExponentialFamily", "Multinomial", "Normal", "Uniform",
+           "Bernoulli", "kl_divergence", "register_kl"]
+
+
+def _t(x, dtype="float32"):
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(np.asarray(x, dtype)))
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, numbers.Integral):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+class Distribution:
+    """Base class (reference distribution/distribution.py:54)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = _shape(batch_shape)
+        self._event_shape = _shape(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return M.exp(self.log_prob(_t(value)))
+
+    def probs(self, value):
+        return self.prob(value)
+
+    def _extend_shape(self, sample_shape):
+        return _shape(sample_shape) + self._batch_shape + self._event_shape
+
+
+class ExponentialFamily(Distribution):
+    """Exp-family marker (reference distribution/exponential_family.py)."""
+
+
+class Normal(Distribution):
+    """N(loc, scale) (reference distribution/normal.py)."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            tuple(self.loc.shape), tuple(self.scale.shape))))
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return self.scale * self.scale
+
+    def sample(self, shape=(), seed=0):
+        shp = self._extend_shape(shape)
+        eps = jax.random.normal(random_mod.next_key(), shp, jnp.float32)
+        return self.loc + self.scale * Tensor(eps)
+
+    rsample = sample
+
+    def entropy(self):
+        c = 0.5 + 0.5 * math.log(2 * math.pi)
+        return c + M.log(self.scale) + creation.zeros(list(self._batch_shape))
+
+    def log_prob(self, value):
+        value = _t(value)
+        var = self.scale * self.scale
+        return (-((value - self.loc) * (value - self.loc)) / (2.0 * var)
+                - M.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+
+class Uniform(Distribution):
+    """U[low, high) (reference distribution/uniform.py)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            tuple(self.low.shape), tuple(self.high.shape))))
+
+    @property
+    def mean(self):
+        return (self.low + self.high) / 2.0
+
+    @property
+    def variance(self):
+        d = self.high - self.low
+        return d * d / 12.0
+
+    def sample(self, shape=(), seed=0):
+        shp = self._extend_shape(shape)
+        u = Tensor(jax.random.uniform(random_mod.next_key(), shp, jnp.float32))
+        return self.low + (self.high - self.low) * u
+
+    rsample = sample
+
+    def entropy(self):
+        return M.log(self.high - self.low)
+
+    def log_prob(self, value):
+        value = _t(value)
+        inside = (value.data >= self.low.data) & (value.data < self.high.data)
+        lp = -M.log(self.high - self.low)
+        neg_inf = Tensor(jnp.full(jnp.broadcast_shapes(
+            tuple(value.shape), tuple(lp.shape)), -jnp.inf, jnp.float32))
+        return Man.where(Tensor(inside), lp + 0.0 * value, neg_inf)
+
+
+class Categorical(Distribution):
+    """Categorical over unnormalized logits (reference distribution/categorical.py)."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _t(logits)
+        super().__init__(batch_shape=tuple(self.logits.shape[:-1]))
+        self._num_events = self.logits.shape[-1]
+
+    @property
+    def _probs(self):
+        from ..nn import functional as F
+
+        return F.softmax(self.logits, axis=-1)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self._batch_shape
+        idx = jax.random.categorical(random_mod.next_key(), self.logits.data,
+                                     axis=-1, shape=shp)
+        return Tensor(idx.astype(jnp.int64))
+
+    def entropy(self):
+        from ..nn import functional as F
+
+        logp = F.log_softmax(self.logits, axis=-1)
+        return -R.sum(self._probs * logp, axis=-1)
+
+    def _gather(self, dist_vals, value):
+        """dist_vals: Tensor batch_shape+(N,); value: int Tensor of category
+        ids. One-hot selection through dispatched ops so the result stays on
+        the autograd tape (pathwise grads to logits for REINFORCE-style use)."""
+        onehot = Man.one_hot(value, self._num_events)  # float, nondiff input
+        return R.sum(dist_vals * onehot, axis=-1)
+
+    def probs(self, value):
+        return self._gather(self._probs, _t(value))
+
+    def log_prob(self, value):
+        from ..nn import functional as F
+
+        logp = F.log_softmax(self.logits, axis=-1)
+        return self._gather(logp, _t(value))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Bernoulli(ExponentialFamily):
+    """Bernoulli(probs) (newer-paddle surface; kept for API completeness)."""
+
+    def __init__(self, probs, name=None):
+        self.probs_param = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs_param.shape))
+
+    @property
+    def mean(self):
+        return self.probs_param
+
+    @property
+    def variance(self):
+        return self.probs_param * (1.0 - self.probs_param)
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        u = jax.random.uniform(random_mod.next_key(), shp, jnp.float32)
+        return Tensor((u < self.probs_param.data).astype(jnp.float32))
+
+    def entropy(self):
+        eps = 1e-7
+        pc = M.clip(self.probs_param, eps, 1 - eps)  # stays on the tape
+        return -(pc * M.log(pc) + (1.0 - pc) * M.log(1.0 - pc))
+
+    def log_prob(self, value):
+        value = _t(value)
+        eps = 1e-7
+        pc = M.clip(self.probs_param, eps, 1 - eps)
+        return value * M.log(pc) + (1.0 - value) * M.log(1.0 - pc)
+
+
+class Beta(ExponentialFamily):
+    """Beta(alpha, beta) (reference distribution/beta.py)."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(batch_shape=tuple(jnp.broadcast_shapes(
+            tuple(self.alpha.shape), tuple(self.beta.shape))))
+
+    @property
+    def mean(self):
+        return self.alpha / (self.alpha + self.beta)
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return self.alpha * self.beta / (s * s * (s + 1.0))
+
+    def sample(self, shape=()):
+        shp = self._extend_shape(shape)
+        out = jax.random.beta(random_mod.next_key(), self.alpha.data,
+                              self.beta.data, shape=shp)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        value = _t(value)
+        a, b = self.alpha, self.beta
+        log_beta = M.lgamma(a) + M.lgamma(b) - M.lgamma(a + b)
+        return ((a - 1.0) * M.log(value) + (b - 1.0) * M.log(1.0 - value)
+                - log_beta)
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        s = a + b
+        log_beta = M.lgamma(a) + M.lgamma(b) - M.lgamma(s)
+        return (log_beta - (a - 1.0) * M.digamma(a) - (b - 1.0) * M.digamma(b)
+                + (s - 2.0) * M.digamma(s))
+
+
+class Dirichlet(ExponentialFamily):
+    """Dirichlet(concentration) (reference distribution/dirichlet.py)."""
+
+    def __init__(self, concentration):
+        self.concentration = _t(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError("concentration must be at least 1-D")
+        super().__init__(batch_shape=tuple(self.concentration.shape[:-1]),
+                         event_shape=tuple(self.concentration.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.concentration / R.sum(self.concentration, axis=-1, keepdim=True)
+
+    @property
+    def variance(self):
+        a0 = R.sum(self.concentration, axis=-1, keepdim=True)
+        m = self.concentration / a0
+        return m * (1.0 - m) / (a0 + 1.0)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self._batch_shape
+        out = jax.random.dirichlet(random_mod.next_key(), self.concentration.data,
+                                   shape=shp if shp else None)
+        return Tensor(out)
+
+    def log_prob(self, value):
+        value = _t(value)
+        c = self.concentration
+        return (R.sum((c - 1.0) * M.log(value), axis=-1)
+                + M.lgamma(R.sum(c, axis=-1))
+                - R.sum(M.lgamma(c), axis=-1))
+
+    def entropy(self):
+        c = self.concentration
+        a0 = R.sum(c, axis=-1)
+        k = float(c.shape[-1])
+        log_b = R.sum(M.lgamma(c), axis=-1) - M.lgamma(a0)
+        return (log_b + (a0 - k) * M.digamma(a0)
+                - R.sum((c - 1.0) * M.digamma(c), axis=-1))
+
+
+class Multinomial(Distribution):
+    """Multinomial(total_count, probs) (reference distribution/multinomial.py)."""
+
+    def __init__(self, total_count, probs):
+        if int(total_count) < 1:
+            raise ValueError("total_count must be >= 1")
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(batch_shape=tuple(self.probs.shape[:-1]),
+                         event_shape=tuple(self.probs.shape[-1:]))
+
+    @property
+    def mean(self):
+        return self.probs * float(self.total_count)
+
+    @property
+    def variance(self):
+        return float(self.total_count) * self.probs * (1.0 - self.probs)
+
+    def sample(self, shape=()):
+        shp = _shape(shape) + self._batch_shape
+        logits = jnp.log(jnp.clip(self.probs.data, 1e-37, None))
+        draws = jax.random.categorical(
+            random_mod.next_key(), logits, axis=-1,
+            shape=(self.total_count,) + shp)  # [n, *shape, *batch]
+        k = self.probs.shape[-1]
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(axis=0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        value = _t(value)
+        logits = M.log(M.clip(self.probs, 1e-37, None))
+        log_factorial_n = M.lgamma(_t(float(self.total_count + 1)))
+        log_factorial_xs = R.sum(M.lgamma(value + 1.0), axis=-1)
+        return (log_factorial_n - log_factorial_xs
+                + R.sum(value * logits, axis=-1))
+
+    def entropy(self):
+        """Monte-Carlo-free lower-order approximation is out of scope; use the
+        exact sum over a sampled support like the reference does via events."""
+        n = float(self.total_count)
+        # exact only for n=1 (categorical); otherwise use categorical bound * n
+        p = M.clip(self.probs, 1e-37, 1.0)
+        return -n * R.sum(p * M.log(p), axis=-1)
+
+
+# -- KL registry --------------------------------------------------------------
+
+_KL_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a KL(p||q) rule (reference distribution/kl.py:65)."""
+
+    def deco(fn):
+        _KL_REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+
+    return deco
+
+
+def _lookup(tp, tq):
+    best, best_fn = None, None
+    for (cp, cq), fn in _KL_REGISTRY.items():
+        if issubclass(tp, cp) and issubclass(tq, cq):
+            score = (len(tp.__mro__) - len(cp.__mro__)) + (len(tq.__mro__) - len(cq.__mro__))
+            if best is None or score < best:
+                best, best_fn = score, fn
+    return best_fn
+
+
+def kl_divergence(p, q):
+    """KL(p || q) via the (subclass-aware) registry (reference kl.py:33)."""
+    fn = _lookup(type(p), type(q))
+    if fn is None:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, {type(q).__name__})")
+    return fn(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = (p.scale / q.scale)
+    var_ratio = var_ratio * var_ratio
+    t1 = (p.loc - q.loc) / q.scale
+    t1 = t1 * t1
+    return 0.5 * (var_ratio + t1 - 1.0 - M.log(var_ratio))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    return M.log((q.high - q.low) / (p.high - p.low))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    from ..nn import functional as F
+
+    logp = F.log_softmax(p.logits, axis=-1)
+    logq = F.log_softmax(q.logits, axis=-1)
+    return R.sum(p._probs * (logp - logq), axis=-1)
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    eps = 1e-7
+    pp = Tensor(jnp.clip(p.probs_param.data, eps, 1 - eps))
+    qq = Tensor(jnp.clip(q.probs_param.data, eps, 1 - eps))
+    return (pp * (M.log(pp) - M.log(qq))
+            + (1.0 - pp) * (M.log(1.0 - pp) - M.log(1.0 - qq)))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    log_beta = lambda a, b: M.lgamma(a) + M.lgamma(b) - M.lgamma(a + b)
+    sp = p.alpha + p.beta
+    return (log_beta(q.alpha, q.beta) - log_beta(p.alpha, p.beta)
+            + (p.alpha - q.alpha) * M.digamma(p.alpha)
+            + (p.beta - q.beta) * M.digamma(p.beta)
+            + (q.alpha - p.alpha + q.beta - p.beta) * M.digamma(sp))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    cp, cq = p.concentration, q.concentration
+    a0 = R.sum(cp, axis=-1)
+    return (M.lgamma(a0) - R.sum(M.lgamma(cp), axis=-1)
+            - M.lgamma(R.sum(cq, axis=-1)) + R.sum(M.lgamma(cq), axis=-1)
+            + R.sum((cp - cq) * (M.digamma(cp)
+                                 - Man.unsqueeze(M.digamma(a0), [-1])), axis=-1))

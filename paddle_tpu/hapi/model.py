@@ -73,7 +73,7 @@ class Model:
         losses = to_list(self._loss(*(to_list(outputs) + labels)))
         return losses
 
-    def train_batch(self, inputs, labels=None, update=True):
+    def train_batch(self, inputs, labels=None, update=True, _loss_scale=1.0):
         self.network.train()
         self.mode = "train"
         inputs = [_as_tensor(x) for x in to_list(inputs)]
@@ -83,7 +83,10 @@ class Model:
         total = losses[0]
         for extra in losses[1:]:
             total = total + extra
-        total.backward()
+        if _loss_scale != 1.0:  # gradient accumulation averages micro-batches
+            (total * _loss_scale).backward()
+        else:
+            total.backward()
         if update and self._optimizer is not None:
             self._optimizer.step()
             self._optimizer.clear_grad()
@@ -160,7 +163,7 @@ class Model:
         return self
 
     # -- loops ---------------------------------------------------------------
-    def _make_loader(self, data, batch_size, shuffle, num_workers):
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last=False):
         from ..io import DataLoader, Dataset
 
         if data is None:
@@ -169,12 +172,12 @@ class Model:
             return data
         if isinstance(data, Dataset):
             return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
-                              num_workers=num_workers)
+                              num_workers=num_workers, drop_last=drop_last)
         return data  # any iterable of batches
 
     def _split_batch(self, batch):
         batch = batch if isinstance(batch, (list, tuple)) else [batch]
-        if self._loss is not None and len(batch) > 1:
+        if (self._loss is not None or self._metrics) and len(batch) > 1:
             # convention: last element(s) are labels (reference model.py:1986)
             n_labels = max(1, len(self._labels)) if self._labels else 1
             return list(batch[:-n_labels]), list(batch[-n_labels:])
@@ -185,7 +188,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
         assert train_data is not None, "train_data must be given"
-        loader = self._make_loader(train_data, batch_size, shuffle, num_workers)
+        loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
+                                   drop_last=drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
         steps = len(loader) if hasattr(loader, "__len__") else None
         metric_names = ["loss"] + [n for m in self._metrics for n in to_list(m.name())]
@@ -253,6 +257,7 @@ class Model:
             m.reset()
         logs = {}
         count = 0
+        pending = False
         for step, batch in enumerate(loader):
             if num_iters is not None and step >= num_iters:
                 break
@@ -262,7 +267,10 @@ class Model:
                 break
             if mode == "train":
                 update = (step + 1) % accumulate_grad_batches == 0
-                outs = self.train_batch(inputs, labels, update=update)
+                outs = self.train_batch(
+                    inputs, labels, update=update,
+                    _loss_scale=1.0 / accumulate_grad_batches)
+                pending = not update
             else:
                 outs = self.eval_batch(inputs, labels)
             if self._metrics and self._loss is not None:
@@ -282,6 +290,10 @@ class Model:
             count += bsz
             logs["batch_size"] = bsz
             cbks.on_batch_end(mode, step, logs)
+        if pending and self._optimizer is not None:
+            # flush the trailing partial accumulation group
+            self._optimizer.step()
+            self._optimizer.clear_grad()
         for m in self._metrics:
             res = m.accumulate()
             for n, v in zip(to_list(m.name()), to_list(res)):

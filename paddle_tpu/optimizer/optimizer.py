@@ -337,3 +337,44 @@ class Lamb(Optimizer):
         return (pf - lr * trust * r).astype(p.dtype), {
             "moment1": m.astype(state["moment1"].dtype),
             "moment2": v.astype(state["moment2"].dtype)}
+
+
+class LarsMomentum(Optimizer):
+    """LARS: layer-wise adaptive momentum (reference:
+    python/paddle/fluid/optimizer.py LarsMomentumOptimizer +
+    paddle/fluid/operators/optimizers/lars_momentum_op.cc).
+
+    local_lr = lr * lars_coeff * ||p|| / (||g|| + lars_weight_decay * ||p||);
+    velocity = mu * v + local_lr * (g + wd * p); p <- p - velocity.
+    """
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0.0, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._hyper_defaults = {"momentum": float(momentum),
+                                "lars_coeff": float(lars_coeff),
+                                "wd": float(lars_weight_decay),
+                                "eps": float(epsilon)}
+        if exclude_from_weight_decay:
+            # paddle contract: list of name fragments excluded from decay
+            fragments = list(exclude_from_weight_decay)
+            self._decay_param_fn = lambda p: not any(
+                f in (p.name or "") for f in fragments)
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        mu, coeff, wd, eps = (hyper["momentum"], hyper["lars_coeff"],
+                              hyper["wd"], hyper["eps"])
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        p_norm = jnp.linalg.norm(pf)
+        g_norm = jnp.linalg.norm(gf)
+        denom = g_norm + wd * p_norm + eps
+        local_lr = jnp.where((p_norm > 0) & (denom > 0),
+                             lr * coeff * p_norm / denom, lr)
+        v = mu * state["velocity"].astype(jnp.float32) + local_lr * (gf + wd * pf)
+        return (pf - v).astype(p.dtype), {"velocity": v.astype(state["velocity"].dtype)}

@@ -1,0 +1,498 @@
+"""Text datasets (reference: python/paddle/text/datasets/).
+
+Zero-egress environment: each dataset parses the reference's on-disk archive
+format from a local ``data_file`` and raises a clear error when absent
+(download=True cannot fetch). Formats match the reference loaders:
+UCIHousing (whitespace floats), Imdb (aclImdb tar), Imikolov (ptb tar),
+Movielens (ml-1m zip), Conll05st (tarred column files), WMT14/16 (parallel
+corpus tars).
+"""
+from __future__ import annotations
+
+import io
+import os
+import re
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io import Dataset
+
+
+def _require(data_file, name, hint):
+    if data_file is None or not os.path.exists(data_file):
+        raise RuntimeError(
+            f"{name}: automatic download is unavailable in this environment; "
+            f"pass data_file pointing at a local copy ({hint})")
+    return data_file
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression set (reference text/datasets/uci_housing.py:78).
+
+    data_file: whitespace-separated rows of 14 floats (13 features + price).
+    """
+
+    FEATURE_NUM = 14
+
+    def __init__(self, data_file=None, mode="train", download=False):
+        assert mode in ("train", "test")
+        _require(data_file, "UCIHousing", "housing.data, 14 columns per row")
+        self.mode = mode
+        self._load_data(data_file)
+
+    def _load_data(self, path, ratio=0.8):
+        data = np.fromfile(path, sep=" ", dtype=np.float32)
+        data = data.reshape(data.shape[0] // self.FEATURE_NUM, self.FEATURE_NUM)
+        maximums = data.max(axis=0)
+        minimums = data.min(axis=0)
+        avgs = data.sum(axis=0) / data.shape[0]
+        for i in range(self.FEATURE_NUM - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maximums[i] - minimums[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return np.asarray(row[:-1], "float32"), np.asarray(row[-1:], "float32")
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment (reference text/datasets/imdb.py): aclImdb tarball with
+    {mode}/pos/*.txt and {mode}/neg/*.txt members; builds a frequency-ranked
+    word index and returns (int64 ids, int64 label)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, download=False):
+        assert mode in ("train", "test")
+        _require(data_file, "Imdb", "aclImdb_v1.tar.gz")
+        self.mode = mode
+        self.docs, self.labels = [], []
+        self._load(data_file, cutoff)
+
+    def _tokenize(self, text):
+        return re.sub(r"[^a-z\s]", "", text.lower()).split()
+
+    def _load(self, data_file, cutoff):
+        """One pass over the archive: frequency counts over all four splits
+        (dict matches the reference's train+test vocabulary) while keeping the
+        requested split's token lists; ids assigned afterwards."""
+        freq = {}
+        kept = []  # (tokens, label) for self.mode
+        any_split = re.compile(r"aclImdb/((train)|(test))/((pos)|(neg))/.*\.txt$")
+        mine = re.compile(f"aclImdb/{self.mode}/((pos)|(neg))/.*\\.txt$")
+        with tarfile.open(data_file) as tf:
+            for member in tf:
+                if not any_split.match(member.name):
+                    continue
+                tokens = self._tokenize(
+                    tf.extractfile(member).read().decode("latin-1"))
+                for w in tokens:
+                    freq[w] = freq.get(w, 0) + 1
+                if mine.match(member.name):
+                    kept.append((tokens, 0 if "/pos/" in member.name else 1))
+        freq = {w: c for w, c in freq.items() if c >= cutoff}
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        self.word_idx = {w: i for i, (w, _) in enumerate(words)}
+        unk = self.word_idx["<unk>"] = len(words)
+        for tokens, label in kept:
+            self.docs.append(np.asarray(
+                [self.word_idx.get(w, unk) for w in tokens], "int64"))
+            self.labels.append(np.int64(label))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model set (reference text/datasets/imikolov.py): tarball
+    with simple-examples/data/ptb.{train,valid}.txt; data_type 'NGRAM' yields
+    fixed n-grams, 'SEQ' yields (input, target) shifted sequences."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=False):
+        assert data_type.upper() in ("NGRAM", "SEQ")
+        assert mode in ("train", "test")
+        _require(data_file, "Imikolov", "simple-examples.tgz (PTB)")
+        self.data_type = data_type.upper()
+        self.window_size = window_size
+        self.mode = mode
+        self.min_word_freq = min_word_freq
+        self.word_idx = self._build_dict(data_file)
+        self.data = self._load_anno(data_file)
+
+    def _member(self, tf, split):
+        name = f"./simple-examples/data/ptb.{split}.txt"
+        for cand in (name, name[2:]):
+            try:
+                return tf.extractfile(cand).read().decode("utf-8")
+            except KeyError:
+                continue
+        raise RuntimeError(f"Imikolov: member {name} missing from archive")
+
+    def _build_dict(self, data_file):
+        freq = {}
+        with tarfile.open(data_file) as tf:
+            for line in self._member(tf, "train").splitlines():
+                for w in line.strip().split():
+                    freq[w] = freq.get(w, 0) + 1
+        freq = {w: c for w, c in freq.items() if c >= self.min_word_freq}
+        freq.pop("<unk>", None)
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(words)}
+        word_idx["<unk>"] = len(words)
+        return word_idx
+
+    def _load_anno(self, data_file):
+        split = "train" if self.mode == "train" else "valid"
+        unk = self.word_idx["<unk>"]
+        out = []
+        with tarfile.open(data_file) as tf:
+            for line in self._member(tf, split).splitlines():
+                if self.data_type == "NGRAM":
+                    assert self.window_size > -1, "NGRAM needs window_size > 0"
+                    words = ["<s>"] + line.strip().split() + ["<e>"]
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    for i in range(self.window_size, len(ids)):
+                        out.append(np.asarray(ids[i - self.window_size:i + 1], "int64"))
+                else:
+                    words = ["<s>"] + line.strip().split() + ["<e>"]
+                    ids = [self.word_idx.get(w, unk) for w in words]
+                    out.append((np.asarray(ids[:-1], "int64"),
+                                np.asarray(ids[1:], "int64")))
+        return out
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings (reference text/datasets/movielens.py): ml-1m.zip
+    with users.dat / movies.dat / ratings.dat ('::'-separated). Yields
+    (user_id, gender, age, job, movie_id, title_ids, categories_onehot, rating).
+    """
+
+    AGES = [1, 18, 25, 35, 45, 50, 56]
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=False):
+        assert mode in ("train", "test")
+        _require(data_file, "Movielens", "ml-1m.zip")
+        self.mode = mode
+        self.test_ratio = test_ratio
+        self.rand_seed = rand_seed
+        self._load_meta(data_file)
+
+    def _read(self, zf, name):
+        for cand in (f"ml-1m/{name}", name):
+            try:
+                return zf.read(cand).decode("latin-1")
+            except KeyError:
+                continue
+        raise RuntimeError(f"Movielens: {name} missing from archive")
+
+    def _load_meta(self, data_file):
+        with zipfile.ZipFile(data_file) as zf:
+            users, movies, ratings = (self._read(zf, n) for n in
+                                      ("users.dat", "movies.dat", "ratings.dat"))
+        self.user_info = {}
+        for line in users.splitlines():
+            if not line.strip():
+                continue
+            uid, gender, age, job, _zip = line.split("::")
+            self.user_info[int(uid)] = (
+                int(uid), 0 if gender == "M" else 1,
+                self.AGES.index(int(age)) if int(age) in self.AGES else 0,
+                int(job))
+        # title word + category vocabularies
+        titles, cats = set(), set()
+        movie_rows = []
+        for line in movies.splitlines():
+            if not line.strip():
+                continue
+            mid, title, genres = line.split("::")
+            title = re.sub(r"\(\d{4}\)$", "", title).strip()
+            words = title.lower().split()
+            gs = genres.strip().split("|")
+            titles.update(words)
+            cats.update(gs)
+            movie_rows.append((int(mid), words, gs))
+        self.title_idx = {w: i for i, w in enumerate(sorted(titles))}
+        self.cat_idx = {c: i for i, c in enumerate(sorted(cats))}
+        self.movie_info = {}
+        for mid, words, gs in movie_rows:
+            tids = np.asarray([self.title_idx[w] for w in words], "int64")
+            onehot = np.zeros(len(self.cat_idx), "float32")
+            for g in gs:
+                onehot[self.cat_idx[g]] = 1.0
+            self.movie_info[mid] = (mid, tids, onehot)
+        rng = np.random.RandomState(self.rand_seed)
+        self.samples = []
+        for line in ratings.splitlines():
+            if not line.strip():
+                continue
+            uid, mid, rating, _ts = line.split("::")
+            uid, mid = int(uid), int(mid)
+            if uid not in self.user_info or mid not in self.movie_info:
+                continue
+            is_test = rng.rand() < self.test_ratio
+            if (self.mode == "test") == is_test:
+                self.samples.append((uid, mid, float(rating)))
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.samples[idx]
+        u = self.user_info[uid]
+        m = self.movie_info[mid]
+        return (np.int64(u[0]), np.int64(u[1]), np.int64(u[2]), np.int64(u[3]),
+                np.int64(m[0]), m[1], m[2], np.float32(rating))
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL (reference text/datasets/conll05.py): expects a tarball
+    with conll05st-release/test.wsj word/prop column files plus word/verb/target
+    dicts. Yields (word_ids, ctx_n2/n1/0/p1/p2, verb_id, mark, label_ids)."""
+
+    def __init__(self, data_file=None, word_dict_file=None, verb_dict_file=None,
+                 target_dict_file=None, emb_file=None, download=False):
+        _require(data_file, "Conll05st", "conll05st-tests.tar.gz")
+        _require(word_dict_file, "Conll05st", "wordDict.txt")
+        _require(verb_dict_file, "Conll05st", "verbDict.txt")
+        _require(target_dict_file, "Conll05st", "targetDict.txt")
+        self.word_dict = self._load_dict(word_dict_file)
+        self.verb_dict = self._load_dict(verb_dict_file)
+        self.label_dict = self._load_label_dict(target_dict_file)
+        self.samples = self._load_anno(data_file)
+
+    @staticmethod
+    def _load_dict(path):
+        d = {}
+        with open(path) as f:
+            for i, line in enumerate(f):
+                d[line.strip()] = i
+        return d
+
+    @staticmethod
+    def _load_label_dict(path):
+        """File order sets ids; each B-X reserves the next id for its I-X
+        (reference conll05.py load_label_dict)."""
+        d = {}
+        index = 0
+        with open(path) as f:
+            for line in f:
+                label = line.strip()
+                if not label:
+                    continue
+                if label.startswith("B-"):
+                    d[label] = index
+                    d[f"I-{label[2:]}"] = index + 1
+                    index += 2
+                else:
+                    d[label] = index
+                    index += 1
+        return d
+
+    def _load_anno(self, data_file):
+        import gzip as _gzip
+
+        sentences = []
+        with tarfile.open(data_file) as tf:
+            words_member = props_member = None
+            for m in tf.getmembers():
+                if m.name.endswith("words.gz"):
+                    words_member = m
+                elif m.name.endswith("props.gz"):
+                    props_member = m
+            if words_member is None or props_member is None:
+                raise RuntimeError("Conll05st: words.gz/props.gz missing")
+            words_txt = _gzip.decompress(tf.extractfile(words_member).read()).decode()
+            props_txt = _gzip.decompress(tf.extractfile(props_member).read()).decode()
+        sent, props = [], []
+        samples = []
+        prop_lines = iter(props_txt.splitlines())
+        for wline in words_txt.splitlines():
+            pline = next(prop_lines, "")
+            if wline.strip():
+                sent.append(wline.strip())
+                props.append(pline.strip().split())
+            else:
+                if sent and props and props[0]:
+                    samples.extend(self._make_samples(sent, props))
+                sent, props = [], []
+        if sent and props and props[0]:
+            samples.extend(self._make_samples(sent, props))
+        return samples
+
+    def _make_samples(self, sent, props):
+        unk = self.word_dict.get("<unk>", 0)
+        n = len(sent)
+        word_ids = np.asarray([self.word_dict.get(w.lower(), unk) for w in sent],
+                              "int64")
+        samples = []
+        n_props = len(props[0]) - 1 if props and props[0] else 0
+        for col in range(1, n_props + 1):
+            verb, verb_pos = None, -1
+            labels = []
+            for i, row in enumerate(props):
+                tag = row[col] if col < len(row) else "*"
+                labels.append(tag)
+                if "(V*" in tag:
+                    verb, verb_pos = props[i][0], i
+            if verb is None or verb == "-":
+                continue
+            ctx = [max(0, min(n - 1, verb_pos + d)) for d in (-2, -1, 0, 1, 2)]
+            ctx_ids = [word_ids[c] for c in ctx]
+            mark = np.zeros(n, "int64")
+            mark[verb_pos] = 1
+            label_ids = np.asarray(
+                [self.label_dict.get(self._iobes(l), 0) for l in labels], "int64")
+            samples.append((word_ids,
+                            *(np.full(n, c, "int64") for c in ctx_ids),
+                            np.full(n, self.verb_dict.get(verb, 0), "int64"),
+                            mark, label_ids))
+        return samples
+
+    @staticmethod
+    def _iobes(tag):
+        if tag == "*":
+            return "O"
+        m = re.match(r"\((\S+?)\*", tag)
+        return f"B-{m.group(1)}" if m else "O"
+
+    def get_dict(self):
+        return self.word_dict, self.verb_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class _WMTBase(Dataset):
+    START = "<s>"
+    END = "<e>"
+    UNK = "<unk>"
+
+    def _build_ids(self, pairs, src_dict, trg_dict):
+        unk_s = src_dict[self.UNK]
+        unk_t = trg_dict[self.UNK]
+        data = []
+        for src, trg in pairs:
+            s = [src_dict.get(w, unk_s) for w in src.split()]
+            t = ([trg_dict[self.START]]
+                 + [trg_dict.get(w, unk_t) for w in trg.split()]
+                 + [trg_dict[self.END]])
+            if not s:
+                continue
+            data.append((np.asarray(s, "int64"),
+                         np.asarray(t[:-1], "int64"),
+                         np.asarray(t[1:], "int64")))
+        return data
+
+    def _freq_dict(self, texts, dict_size):
+        freq = {}
+        for text in texts:
+            for w in text.split():
+                freq[w] = freq.get(w, 0) + 1
+        words = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        vocab = [self.START, self.END, self.UNK] + [w for w, _ in words]
+        vocab = vocab[:dict_size] if dict_size > 0 else vocab
+        return {w: i for i, w in enumerate(vocab)}
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en→fr (reference text/datasets/wmt14.py): tarball with
+    {mode}/*.src (en) and matching *.trg (fr) parallel line files."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1, download=False):
+        assert mode in ("train", "test", "gen")
+        _require(data_file, "WMT14", "wmt14 tarball with train/ test/ gen/ pairs")
+        self.mode = mode
+        pairs = self._read_pairs(data_file, mode)
+        self.src_dict = self._freq_dict([p[0] for p in pairs], dict_size)
+        self.trg_dict = self._freq_dict([p[1] for p in pairs], dict_size)
+        self.data = self._build_ids(pairs, self.src_dict, self.trg_dict)
+
+    def _read_pairs(self, data_file, mode):
+        srcs, trgs = {}, {}
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if f"/{mode}/" not in f"/{m.name}" and not m.name.startswith(mode):
+                    continue
+                if base.endswith(".src"):
+                    srcs[base[:-4]] = tf.extractfile(m).read().decode("utf-8")
+                elif base.endswith(".trg"):
+                    trgs[base[:-4]] = tf.extractfile(m).read().decode("utf-8")
+        pairs = []
+        for k in sorted(srcs):
+            if k in trgs:
+                for s, t in zip(srcs[k].splitlines(), trgs[k].splitlines()):
+                    if s.strip() and t.strip():
+                        pairs.append((s.strip().lower(), t.strip().lower()))
+        if not pairs:
+            raise RuntimeError(f"WMT14: no {self.mode} .src/.trg pairs in archive")
+        return pairs
+
+    def get_dict(self, reverse=False):
+        if reverse:
+            return ({v: k for k, v in self.src_dict.items()},
+                    {v: k for k, v in self.trg_dict.items()})
+        return self.src_dict, self.trg_dict
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en↔de (reference text/datasets/wmt16.py): tarball with
+    wmt16/{train,val,test} tab-separated 'src\\ttrg' lines."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=False):
+        assert mode in ("train", "val", "test")
+        _require(data_file, "WMT16", "wmt16.tar.gz with wmt16/{train,val,test}")
+        self.mode = mode
+        self.lang = lang
+        pairs = self._read_pairs(data_file, mode)
+        self.src_dict = self._freq_dict([p[0] for p in pairs], src_dict_size)
+        self.trg_dict = self._freq_dict([p[1] for p in pairs], trg_dict_size)
+        self.data = self._build_ids(pairs, self.src_dict, self.trg_dict)
+
+    def _read_pairs(self, data_file, mode):
+        text = None
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                if os.path.basename(m.name) == mode:
+                    text = tf.extractfile(m).read().decode("utf-8")
+                    break
+        if text is None:
+            raise RuntimeError(f"WMT16: member '{mode}' missing from archive")
+        pairs = []
+        for line in text.splitlines():
+            parts = line.split("\t")
+            if len(parts) != 2:
+                continue
+            src, trg = (parts if self.lang == "en" else parts[::-1])
+            if src.strip() and trg.strip():
+                pairs.append((src.strip().lower(), trg.strip().lower()))
+        return pairs
+
+    def get_dict(self, lang="en", reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else d

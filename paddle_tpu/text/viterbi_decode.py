@@ -1,0 +1,82 @@
+"""Viterbi decoding (reference: python/paddle/text/viterbi_decode.py +
+paddle/fluid/operators/viterbi_decode_op.h).
+
+TPU-native: the whole DP is one ``lax.scan`` over time inside a single
+primitive — scores/history stay on-device, backtrace is a second scan.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.dispatch import primitive
+from ..nn.layer.layers import Layer
+
+
+@primitive("viterbi_decode", nondiff=True)
+def _viterbi(potentials, transition, lengths, include_bos_eos_tag=True):
+    """potentials [B,T,N], transition [N,N], lengths [B] -> (scores[B], path[B,T])."""
+    B, T, N = potentials.shape
+    emis = jnp.swapaxes(potentials, 0, 1)  # [T,B,N]
+    if include_bos_eos_tag:
+        # reference semantics: tag N-2 is BOS, N-1 is EOS
+        alpha0 = emis[0] + transition[N - 2][None, :]
+    else:
+        alpha0 = emis[0]
+
+    steps = jnp.arange(1, T)
+
+    def step(alpha, inp):
+        e_t, t_idx = inp  # e_t [B,N]
+        # score[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + transition[None, :, :]
+        best_prev = jnp.argmax(scores, axis=1)  # [B,N]
+        best_score = jnp.max(scores, axis=1) + e_t
+        valid = (t_idx < lengths)[:, None]  # rows past length keep state
+        new_alpha = jnp.where(valid, best_score, alpha)
+        return new_alpha, best_prev
+
+    alpha_T, history = lax.scan(step, alpha0, (emis[1:], steps))  # history [T-1,B,N]
+    if include_bos_eos_tag:
+        last = alpha_T + transition[:, N - 1][None, :]
+    else:
+        last = alpha_T
+    scores = jnp.max(last, axis=-1)
+    last_tag = jnp.argmax(last, axis=-1)  # [B]
+
+    # backtrace: walk history from the back; entries at t >= length are no-ops
+    def back(tag, inp):
+        hist_t, t_idx = inp  # [B,N], scalar
+        prev = jnp.take_along_axis(hist_t, tag[:, None], axis=-1)[:, 0]
+        valid = t_idx < (lengths - 1)
+        new_tag = jnp.where(valid, prev, tag)
+        return new_tag, new_tag
+
+    tags_rev_init = last_tag
+    _, prev_tags = lax.scan(back, tags_rev_init, (history[::-1], steps[::-1] - 1))
+    # path = [prev_tags reversed..., last_tag] trimmed per row by length
+    path = jnp.concatenate([prev_tags[::-1], last_tag[None, :]], axis=0)  # [T,B]
+    path = jnp.swapaxes(path, 0, 1).astype(jnp.int64)  # [B,T]
+    return scores, path
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Returns (scores, paths). paths is [B, T] with entries beyond each row's
+    length repeating the row's last valid tag (callers trim by length,
+    matching the reference's LoD-trimmed output)."""
+    return _viterbi(potentials, transition_params, lengths,
+                    include_bos_eos_tag=bool(include_bos_eos_tag))
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (reference text/viterbi_decode.py ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

@@ -1,0 +1,293 @@
+"""paddle.distribution + paddle.text + LARS optimizer tests."""
+import gzip
+import io
+import os
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+from scipy import stats as sps
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+from paddle_tpu import text
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+# -- distributions ------------------------------------------------------------
+
+def test_normal_log_prob_entropy_kl():
+    n = D.Normal(1.0, 2.0)
+    x = np.asarray([0.5, 1.0, 3.0], "float32")
+    np.testing.assert_allclose(_np(n.log_prob(x)), sps.norm(1, 2).logpdf(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(float(_np(n.entropy())), sps.norm(1, 2).entropy(),
+                               rtol=1e-5)
+    m = D.Normal(0.0, 1.0)
+    kl = float(_np(D.kl_divergence(n, m)))
+    # closed form: log(1/2) + (4 + 1)/2 - 0.5
+    np.testing.assert_allclose(kl, np.log(0.5) + 2.5 - 0.5, rtol=1e-5)
+    s = n.sample([2000])
+    assert abs(float(_np(s).mean()) - 1.0) < 0.2
+
+
+def test_normal_log_prob_is_differentiable():
+    loc = paddle.to_tensor(np.asarray(0.5, "float32"), stop_gradient=False)
+    scale = paddle.to_tensor(np.asarray(1.5, "float32"), stop_gradient=False)
+    n = D.Normal(loc, scale)
+    lp = n.log_prob(paddle.to_tensor(np.asarray([1.0], "float32")))
+    lp.sum().backward()
+    assert loc.grad is not None and scale.grad is not None
+
+
+def test_uniform_and_categorical():
+    u = D.Uniform(0.0, 4.0)
+    np.testing.assert_allclose(float(_np(u.entropy())), np.log(4.0), rtol=1e-6)
+    lp = _np(u.log_prob(np.asarray([1.0, 5.0], "float32")))
+    np.testing.assert_allclose(lp[0], -np.log(4.0), rtol=1e-6)
+    assert np.isinf(lp[1]) and lp[1] < 0
+
+    logits = np.log(np.asarray([0.1, 0.2, 0.7], "float32"))
+    c = D.Categorical(logits)
+    np.testing.assert_allclose(_np(c.probs(np.asarray([2]))), [0.7], rtol=1e-5)
+    ent = float(_np(c.entropy()))
+    np.testing.assert_allclose(ent, sps.entropy([0.1, 0.2, 0.7]), rtol=1e-5)
+    c2 = D.Categorical(np.log(np.asarray([1 / 3, 1 / 3, 1 / 3], "float32")))
+    kl = float(_np(D.kl_divergence(c, c2)))
+    np.testing.assert_allclose(
+        kl, sps.entropy([0.1, 0.2, 0.7], [1 / 3, 1 / 3, 1 / 3]), rtol=1e-5)
+    s = _np(c.sample([500]))
+    assert s.shape == (500,) and (s == 2).mean() > 0.5
+
+
+def test_beta_dirichlet_multinomial():
+    b = D.Beta(2.0, 3.0)
+    x = np.asarray([0.3, 0.6], "float32")
+    np.testing.assert_allclose(_np(b.log_prob(x)), sps.beta(2, 3).logpdf(x),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(_np(b.entropy())), sps.beta(2, 3).entropy(),
+                               rtol=1e-4)
+    np.testing.assert_allclose(float(_np(b.mean)), 0.4, rtol=1e-6)
+
+    d = D.Dirichlet(np.asarray([1.0, 2.0, 3.0], "float32"))
+    v = np.asarray([0.2, 0.3, 0.5], "float32")
+    np.testing.assert_allclose(float(_np(d.log_prob(v))),
+                               sps.dirichlet([1, 2, 3]).logpdf(v), rtol=1e-4)
+    s = _np(d.sample([100]))
+    assert s.shape == (100, 3)
+    np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+
+    m = D.Multinomial(10, np.asarray([0.2, 0.3, 0.5], "float32"))
+    counts = _np(m.sample([50]))
+    assert counts.shape == (50, 3)
+    np.testing.assert_allclose(counts.sum(-1), 10.0)
+    lp = float(_np(m.log_prob(np.asarray([2.0, 3.0, 5.0], "float32"))))
+    np.testing.assert_allclose(lp, sps.multinomial(10, [0.2, 0.3, 0.5])
+                               .logpmf([2, 3, 5]), rtol=1e-4)
+
+
+def test_kl_beta_dirichlet_uniform():
+    kl = float(_np(D.kl_divergence(D.Beta(2.0, 3.0), D.Beta(3.0, 2.0))))
+    # numeric reference via quadrature
+    xs = np.linspace(1e-5, 1 - 1e-5, 20001)
+    p = sps.beta(2, 3).pdf(xs)
+    ref = np.trapezoid(p * (sps.beta(2, 3).logpdf(xs) - sps.beta(3, 2).logpdf(xs)), xs)
+    np.testing.assert_allclose(kl, ref, rtol=1e-3)
+    klu = float(_np(D.kl_divergence(D.Uniform(0.0, 1.0), D.Uniform(-1.0, 2.0))))
+    np.testing.assert_allclose(klu, np.log(3.0), rtol=1e-6)
+    d1 = D.Dirichlet(np.asarray([1.0, 2.0], "float32"))
+    d2 = D.Dirichlet(np.asarray([2.0, 1.0], "float32"))
+    assert float(_np(D.kl_divergence(d1, d2))) > 0
+
+
+# -- text datasets ------------------------------------------------------------
+
+def test_uci_housing(tmp_path):
+    rng = np.random.default_rng(0)
+    data = rng.random((50, 14)).astype("float32")
+    path = os.path.join(str(tmp_path), "housing.data")
+    np.savetxt(path, data, fmt="%.6f")
+    train = text.UCIHousing(data_file=path, mode="train")
+    test = text.UCIHousing(data_file=path, mode="test")
+    assert len(train) == 40 and len(test) == 10
+    x, y = train[0]
+    assert x.shape == (13,) and y.shape == (1,)
+
+
+def test_imdb_dataset(tmp_path):
+    path = os.path.join(str(tmp_path), "aclImdb.tar.gz")
+    docs = {
+        "aclImdb/train/pos/0.txt": b"a great great movie truly great",
+        "aclImdb/train/neg/0.txt": b"a terrible movie truly terrible",
+        "aclImdb/test/pos/0.txt": b"great movie",
+    }
+    with tarfile.open(path, "w:gz") as tf:
+        for name, content in docs.items():
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+    ds = text.Imdb(data_file=path, mode="train", cutoff=2)
+    assert len(ds) == 2
+    ids, label = ds[0]
+    assert ids.dtype == np.int64 and label in (0, 1)
+    # 'great'(3x), 'a'(2), 'movie'(2), 'terrible'(2), 'truly'(2) pass cutoff=2
+    assert ds.word_idx["great"] == 0
+
+
+def test_imikolov_dataset(tmp_path):
+    path = os.path.join(str(tmp_path), "simple-examples.tgz")
+    train = b"the cat sat\nthe dog sat\n"
+    valid = b"the cat ran\n"
+    with tarfile.open(path, "w:gz") as tf:
+        for name, content in (("./simple-examples/data/ptb.train.txt", train),
+                              ("./simple-examples/data/ptb.valid.txt", valid)):
+            info = tarfile.TarInfo(name)
+            info.size = len(content)
+            tf.addfile(info, io.BytesIO(content))
+    ds = text.Imikolov(data_file=path, data_type="NGRAM", window_size=2,
+                       mode="train", min_word_freq=1)
+    assert len(ds) > 0 and ds[0].shape == (3,)
+    seq = text.Imikolov(data_file=path, data_type="SEQ", mode="test",
+                        min_word_freq=1)
+    inp, tgt = seq[0]
+    assert len(inp) == len(tgt)
+
+
+def test_movielens_dataset(tmp_path):
+    path = os.path.join(str(tmp_path), "ml-1m.zip")
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("ml-1m/users.dat", "1::M::25::10::12345\n2::F::35::5::54321\n")
+        zf.writestr("ml-1m/movies.dat",
+                    "10::Toy Story (1995)::Animation|Comedy\n"
+                    "20::Heat (1995)::Action\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::10::5::1\n1::20::3::2\n2::10::4::3\n2::20::2::4\n")
+    ds = text.Movielens(data_file=path, mode="train", test_ratio=0.0)
+    assert len(ds) == 4
+    uid, gender, age, job, mid, title_ids, cats, rating = ds[0]
+    assert cats.shape == (3,)  # Animation, Comedy, Action
+    assert rating in (5.0, 3.0, 4.0, 2.0)
+
+
+def test_wmt16_dataset(tmp_path):
+    path = os.path.join(str(tmp_path), "wmt16.tar.gz")
+    train = b"hello world\thallo welt\ngood day\tguten tag\n"
+    with tarfile.open(path, "w:gz") as tf:
+        info = tarfile.TarInfo("wmt16/train")
+        info.size = len(train)
+        tf.addfile(info, io.BytesIO(train))
+    ds = text.WMT16(data_file=path, mode="train")
+    assert len(ds) == 2
+    src, trg_in, trg_out = ds[0]
+    assert trg_in[0] == ds.trg_dict["<s>"]
+    assert trg_out[-1] == ds.trg_dict["<e>"]
+
+
+def test_viterbi_decode_matches_brute_force():
+    rng = np.random.default_rng(0)
+    B, T, N = 2, 5, 4
+    emis = rng.standard_normal((B, T, N)).astype("float32")
+    trans = rng.standard_normal((N, N)).astype("float32")
+    lengths = np.asarray([5, 3], "int64")
+    scores, path = text.viterbi_decode(
+        paddle.to_tensor(emis), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=False)
+    scores, path = _np(scores), _np(path)
+
+    import itertools
+    for b in range(B):
+        L = int(lengths[b])
+        best, best_seq = -1e30, None
+        for seq in itertools.product(range(N), repeat=L):
+            s = emis[b, 0, seq[0]]
+            for t in range(1, L):
+                s += trans[seq[t - 1], seq[t]] + emis[b, t, seq[t]]
+            if s > best:
+                best, best_seq = s, seq
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        assert tuple(path[b, :L]) == best_seq
+
+
+def test_lars_momentum_trains():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    net = nn.Linear(8, 1)
+    opt = paddle.optimizer.LarsMomentum(learning_rate=0.5, momentum=0.9,
+                                        lars_coeff=0.05,
+                                        parameters=net.parameters())
+    x = paddle.randn([32, 8])
+    w = paddle.randn([8, 1])
+    y = x.matmul(w)
+    losses = []
+    for _ in range(30):
+        loss = F.mse_loss(net(x), y)
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_lars_rule_matches_numpy():
+    import jax.numpy as jnp
+
+    p = np.asarray([3.0, 4.0], "float32")          # ||p|| = 5
+    g = np.asarray([0.6, 0.8], "float32")          # ||g|| = 1
+    lr, mu, coeff, wd = 0.1, 0.9, 0.001, 0.0005
+    state = {"velocity": jnp.zeros(2)}
+    new_p, ns = paddle.optimizer.LarsMomentum._rule(
+        jnp.asarray(p), jnp.asarray(g), state, jnp.asarray(lr, jnp.float32),
+        jnp.asarray(1), {"momentum": mu, "lars_coeff": coeff, "wd": wd, "eps": 0.0})
+    local_lr = lr * coeff * 5.0 / (1.0 + wd * 5.0)
+    v = local_lr * (g + wd * p)
+    np.testing.assert_allclose(np.asarray(new_p), p - v, rtol=1e-6)
+
+
+def test_categorical_log_prob_differentiable():
+    logits = paddle.to_tensor(np.zeros(3, "float32"), stop_gradient=False)
+    c = D.Categorical(logits)
+    lp = c.log_prob(np.asarray([2]))
+    (-lp.sum()).backward()
+    assert logits.grad is not None
+    g = _np(logits.grad)
+    # d(-logp[2])/dlogits = softmax - onehot(2)
+    np.testing.assert_allclose(g, [1 / 3, 1 / 3, 1 / 3 - 1.0], rtol=1e-5)
+
+
+def test_bernoulli_log_prob_differentiable():
+    p = paddle.to_tensor(np.asarray([0.6], "float32"), stop_gradient=False)
+    b = D.Bernoulli(p)
+    lp = b.log_prob(np.asarray([1.0], "float32"))
+    lp.sum().backward()
+    np.testing.assert_allclose(_np(p.grad), [1 / 0.6], rtol=1e-4)
+
+
+def test_hapi_grad_accumulation_averages():
+    import paddle_tpu.nn as nn
+
+    paddle.seed(0)
+    x = np.random.default_rng(0).standard_normal((16, 4)).astype("float32")
+    y = np.zeros((16,), "int64")
+
+    def run(accum):
+        paddle.seed(42)
+        net = nn.Linear(4, 2)
+        model = paddle.Model(net)
+        model.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                      nn.CrossEntropyLoss())
+        ds = paddle.io.TensorDataset([paddle.to_tensor(x), paddle.to_tensor(y)])
+        model.fit(ds, epochs=1, batch_size=4, shuffle=False, verbose=0,
+                  accumulate_grad_batches=accum)
+        return _np(net.weight)
+
+    w1 = run(1)
+    w4 = run(4)  # one step over averaged grads ~= similar scale, not 4x
+    # averaged-accumulation step must differ from per-batch stepping but stay
+    # bounded: the update magnitude should be comparable (not 4x larger)
+    assert np.abs(w4).max() < np.abs(w1).max() * 2 + 1.0
