@@ -9,9 +9,12 @@ from .mesh import init_mesh, auto_mesh, get_mesh_env, MeshEnv, reset_mesh  # noq
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, is_initialized, init_parallel_env,
     get_rank, get_world_size, all_reduce, all_gather, broadcast, reduce,
-    reduce_scatter, alltoall, scatter, barrier, send, recv,
+    reduce_scatter, alltoall, scatter, barrier, send, recv, isend, irecv,
     psum, pmean, ppermute, axis_index, all_to_all_axis,
 )
+from . import checkpoint  # noqa: F401
+from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from .store import TCPStore, Store  # noqa: F401
 from .parallel import DataParallel, ShardedTrainStep, place_model  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .utils_recompute import recompute  # noqa: F401

@@ -17,6 +17,7 @@ ops with ring ids vs dygraph ProcessGroup objects) collapsed into one.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import List, Optional
 
 import jax
@@ -286,13 +287,83 @@ def barrier(group=None):
     return None
 
 
-def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv maps to pipeline ppermute; use "
-        "paddle_tpu.distributed.meta_parallel pipeline utilities")
+# -- point-to-point ----------------------------------------------------------
+# Reference contract: ProcessGroup.h:108-114 (send/recv + isend/irecv Tasks).
+# Under the single-controller SPMD runtime every "rank" lives in this process,
+# so p2p is a host-coordinated device-to-device handoff through a mailbox; the
+# in-trace path for compiled pipelines is ppermute (below), which is what the
+# 1F1B schedule uses. Multi-controller send/recv would ride the same mailbox
+# over the TCPStore control plane.
+
+_P2P_BOX: dict = {}
+_P2P_LOCK = threading.Lock()
+_P2P_CV = threading.Condition(_P2P_LOCK)
 
 
-recv = send
+class P2POp:
+    """Completed-op handle (the reference's ProcessGroup::Task role)."""
+
+    def __init__(self, done=True):
+        self._done = done
+
+    def is_completed(self):
+        return self._done
+
+    def wait(self, timeout=None):
+        return True
+
+
+def send(tensor, dst=0, group=None, sync_op=True, tag=0, src=None):
+    """Deposit `tensor`'s value for rank `dst` (device-resident copy).
+
+    `src` defaults to this process's rank; pass it explicitly when emulating
+    multiple ranks in one process (single-controller pipeline prototyping).
+    """
+    env = get_mesh_env()
+    data = tensor.data if hasattr(tensor, "data") else jnp.asarray(tensor)
+    if env is not None:
+        devices = env.mesh.devices.reshape(-1)
+        if dst < len(devices):
+            data = jax.device_put(data, devices[dst])
+    if src is None:
+        src = get_rank(group)
+    with _P2P_CV:
+        _P2P_BOX.setdefault((src, dst, tag), []).append(data)
+        _P2P_CV.notify_all()
+    return P2POp()
+
+
+def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None):
+    """Fill `tensor` in place with the next message from rank `src`.
+
+    `dst` defaults to this process's rank; pass the rank you are emulating to
+    retrieve a message addressed elsewhere (see send)."""
+    if dst is None:
+        dst = get_rank(group)
+    with _P2P_CV:
+        ok = _P2P_CV.wait_for(
+            lambda: _P2P_BOX.get((src, dst, tag)), timeout=60.0)
+        if not ok:
+            raise RuntimeError(
+                f"recv: no message from rank {src} to rank {dst} (tag {tag}); "
+                f"if the sender used dst!=your rank, pass recv(..., dst=...)")
+        data = _P2P_BOX[(src, dst, tag)].pop(0)
+    if hasattr(tensor, "data"):
+        if tuple(tensor.shape) != tuple(data.shape):
+            raise ValueError(
+                f"recv: shape mismatch {tuple(data.shape)} vs buffer "
+                f"{tuple(tensor.shape)}")
+        tensor.data = data.astype(tensor.data.dtype)
+        return P2POp()
+    return data
+
+
+def isend(tensor, dst=0, group=None, tag=0):
+    return send(tensor, dst, group, sync_op=False, tag=tag)
+
+
+def irecv(tensor, src=0, group=None, tag=0):
+    return recv(tensor, src, group, sync_op=False, tag=tag)
 
 
 # -- in-trace collectives (for shard_map bodies: TP/PP/EP internals) ---------

@@ -1,0 +1,180 @@
+"""Control-plane KV store (reference: paddle/fluid/distributed/store/
+tcp_store.h:91 TCPStore / store.h Store).
+
+The daemon + client are native C++ (store.cpp), compiled on first use with the
+system toolchain and bound via ctypes (SURVEY §7 stage 4 keeps this component
+off the XLA path: bootstrap/rendezvous before any mesh exists).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+__all__ = ["TCPStore", "Store"]
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+
+def _build_lib() -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        src_dir = os.path.dirname(os.path.abspath(__file__))
+        src = os.path.join(src_dir, "store.cpp")
+        out = os.path.join(src_dir, "_libtcpstore.so")
+        if (not os.path.exists(out)
+                or os.path.getmtime(out) < os.path.getmtime(src)):
+            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
+                   "-o", out + ".tmp", "-lpthread"]
+            subprocess.run(cmd, check=True, capture_output=True)
+            os.replace(out + ".tmp", out)
+        lib = ctypes.CDLL(out)
+        lib.tcpstore_server_start.restype = ctypes.c_void_p
+        lib.tcpstore_server_start.argtypes = [ctypes.c_int,
+                                              ctypes.POINTER(ctypes.c_int)]
+        lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+        lib.tcpstore_connect.restype = ctypes.c_int
+        lib.tcpstore_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_close.argtypes = [ctypes.c_int]
+        lib.tcpstore_set_timeout.restype = ctypes.c_int
+        lib.tcpstore_set_timeout.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.tcpstore_set.restype = ctypes.c_int
+        lib.tcpstore_set.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_get.restype = ctypes.c_int
+        lib.tcpstore_get.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_add.restype = ctypes.c_int64
+        lib.tcpstore_add.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                                     ctypes.c_int64]
+        lib.tcpstore_wait.restype = ctypes.c_int
+        lib.tcpstore_wait.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        lib.tcpstore_delete.restype = ctypes.c_int
+        lib.tcpstore_delete.argtypes = [ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
+        _LIB = lib
+        return lib
+
+
+class Store:
+    """Abstract store API (reference store.h)."""
+
+    def set(self, key: str, value):  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys):
+        raise NotImplementedError
+
+
+class TCPStore(Store):
+    """TCP-backed KV store (reference tcp_store.h:91).
+
+    The designated master (is_master=True) hosts the native daemon; every
+    process (master included) talks to it through the native client. barrier()
+    composes add+wait the way the reference's paddle.distributed.barrier
+    control plane does.
+    """
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=900):
+        self._lib = _build_lib()
+        self._server = None
+        self.host = host
+        self.world_size = int(world_size)
+        if is_master:
+            out_port = ctypes.c_int(0)
+            self._server = self._lib.tcpstore_server_start(int(port),
+                                                           ctypes.byref(out_port))
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = out_port.value
+        elif not port:
+            raise ValueError("non-master TCPStore needs the master's port")
+        self.port = int(port)
+        self._fd = self._lib.tcpstore_connect(host.encode(), self.port)
+        if self._fd < 0:
+            raise RuntimeError(f"TCPStore: cannot connect to {host}:{self.port}")
+        self.timeout = int(timeout)
+        if self.timeout > 0:
+            self._lib.tcpstore_set_timeout(self._fd, self.timeout)
+        self._lock = threading.Lock()
+
+    # -- Store API -----------------------------------------------------------
+    def set(self, key: str, value):
+        data = value if isinstance(value, (bytes, bytearray)) else str(value).encode()
+        with self._lock:
+            rc = self._lib.tcpstore_set(self._fd, key.encode(), len(key.encode()),
+                                        bytes(data), len(data))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key: str) -> bytes:
+        k = key.encode()
+        cap = 1 << 16
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            with self._lock:
+                n = self._lib.tcpstore_get(self._fd, k, len(k), buf, cap)
+            if n < 0:
+                raise TimeoutError(
+                    f"TCPStore.get({key}) failed or timed out after "
+                    f"{self.timeout}s")
+            if n <= cap:
+                return buf.raw[:n]
+            cap = n  # value larger than buffer: retry with exact size
+
+    def add(self, key: str, amount: int = 1) -> int:
+        k = key.encode()
+        with self._lock:
+            out = self._lib.tcpstore_add(self._fd, k, len(k), int(amount))
+        if out == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return int(out)
+
+    def wait(self, keys, timeout=None):
+        keys = keys if isinstance(keys, (list, tuple)) else [keys]
+        for key in keys:
+            k = key.encode()
+            with self._lock:
+                rc = self._lib.tcpstore_wait(self._fd, k, len(k))
+            if rc != 0:
+                raise TimeoutError(
+                    f"TCPStore.wait({key}) failed or timed out after "
+                    f"{self.timeout}s")
+
+    def delete_key(self, key: str):
+        k = key.encode()
+        with self._lock:
+            self._lib.tcpstore_delete(self._fd, k, len(k))
+
+    def barrier(self, tag="barrier"):
+        """All world_size processes rendezvous on the counter `tag`.
+        Generation-keyed so the same tag can barrier repeatedly."""
+        n = self.add(f"_{tag}/count", 1)
+        gen = (n - 1) // self.world_size
+        if n % self.world_size == 0:
+            self.set(f"_{tag}/done{gen}", b"1")
+        self.wait([f"_{tag}/done{gen}"])
+
+    def close(self):
+        if self._fd >= 0:
+            self._lib.tcpstore_close(self._fd)
+            self._fd = -1
+        if self._server:
+            self._lib.tcpstore_server_stop(self._server)
+            self._server = None
+
+    def __del__(self):  # pragma: no cover - GC path
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,286 @@
+// TCP key-value store: the control-plane rendezvous component.
+//
+// Reference: paddle/fluid/distributed/store/tcp_store.h:91 (TCPStore with a
+// MasterDaemon serving set/get/add/wait over a socket protocol). This is the
+// native (C++) piece of the runtime the survey (§7 stage 4) keeps off the XLA
+// path: worker bootstrap, barriers, and address exchange before any mesh
+// exists. Exposed through a C ABI consumed via ctypes (no pybind11 in image).
+//
+// Wire protocol (little-endian):
+//   request:  u8 cmd | u32 klen | key bytes | u32 vlen | value bytes
+//   response: u32 vlen | value bytes            (GET/WAIT/ADD)
+//             ADD's value is the new counter as 8-byte i64.
+// Commands: 1=SET 2=GET(blocking) 3=ADD 4=WAIT(blocking) 5=DELETE 6=PING
+#include <arpa/inet.h>
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string>
+#include <sys/socket.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+enum Cmd : uint8_t { SET = 1, GET = 2, ADD = 3, WAIT = 4, DEL = 5, PING = 6 };
+
+struct Daemon {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> kv;
+  bool stopping = false;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // open client sockets, for shutdown wakeup
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_value(int fd, const std::vector<uint8_t>& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  if (!write_exact(fd, &len, 4)) return false;
+  return v.empty() || write_exact(fd, v.data(), v.size());
+}
+
+void serve_conn(Daemon* d, int fd) {
+  for (;;) {
+    uint8_t cmd;
+    uint32_t klen = 0, vlen = 0;
+    if (!read_exact(fd, &cmd, 1) || !read_exact(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+    if (!read_exact(fd, &vlen, 4)) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !read_exact(fd, val.data(), vlen)) break;
+
+    if (cmd == SET) {
+      std::lock_guard<std::mutex> lk(d->mu);
+      d->kv[key] = std::move(val);
+      d->cv.notify_all();
+    } else if (cmd == GET || cmd == WAIT) {
+      std::unique_lock<std::mutex> lk(d->mu);
+      d->cv.wait(lk, [&] { return d->stopping || d->kv.count(key) > 0; });
+      if (d->stopping) break;
+      std::vector<uint8_t> out = (cmd == GET) ? d->kv[key]
+                                              : std::vector<uint8_t>{1};
+      lk.unlock();
+      if (!send_value(fd, out)) break;
+    } else if (cmd == ADD) {
+      int64_t delta = 0;
+      if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+      int64_t now;
+      {
+        std::lock_guard<std::mutex> lk(d->mu);
+        auto& cell = d->kv[key];
+        int64_t cur = 0;
+        if (cell.size() == 8) std::memcpy(&cur, cell.data(), 8);
+        now = cur + delta;
+        cell.resize(8);
+        std::memcpy(cell.data(), &now, 8);
+        d->cv.notify_all();
+      }
+      std::vector<uint8_t> out(8);
+      std::memcpy(out.data(), &now, 8);
+      if (!send_value(fd, out)) break;
+    } else if (cmd == DEL) {
+      std::lock_guard<std::mutex> lk(d->mu);
+      d->kv.erase(key);
+    } else if (cmd == PING) {
+      if (!send_value(fd, {1})) break;
+    } else {
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(d->mu);
+    for (auto it = d->conn_fds.begin(); it != d->conn_fds.end(); ++it) {
+      if (*it == fd) {
+        d->conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+}
+
+void accept_loop(Daemon* d) {
+  for (;;) {
+    int fd = ::accept(d->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (errno == EINTR) continue;
+      break;  // listen socket closed -> shut down
+    }
+    int one = 1;
+    ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    std::lock_guard<std::mutex> lk(d->mu);
+    if (d->stopping) {
+      ::close(fd);
+      break;
+    }
+    d->conn_fds.push_back(fd);
+    d->workers.emplace_back(serve_conn, d, fd);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Start the master daemon. port=0 picks a free port. Returns an opaque handle
+// (nullptr on failure); *out_port receives the bound port.
+void* tcpstore_server_start(int port, int* out_port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  auto* d = new Daemon();
+  d->listen_fd = fd;
+  d->port = ntohs(addr.sin_port);
+  if (out_port) *out_port = d->port;
+  d->accept_thread = std::thread(accept_loop, d);
+  return d;
+}
+
+void tcpstore_server_stop(void* handle) {
+  auto* d = static_cast<Daemon*>(handle);
+  if (!d) return;
+  {
+    std::lock_guard<std::mutex> lk(d->mu);
+    d->stopping = true;
+    d->cv.notify_all();
+    // wake workers blocked in recv() so they observe `stopping` and exit
+    for (int fd : d->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  ::shutdown(d->listen_fd, SHUT_RDWR);
+  ::close(d->listen_fd);
+  if (d->accept_thread.joinable()) d->accept_thread.join();
+  for (auto& t : d->workers)
+    if (t.joinable()) t.join();  // safe: every blocking site is unblocked above
+  delete d;
+}
+
+// ---- client ---------------------------------------------------------------
+
+int tcpstore_connect(const char* host, int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -1;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(fd);
+    return -1;
+  }
+  if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0) {
+    ::close(fd);
+    return -1;
+  }
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return fd;
+}
+
+void tcpstore_close(int fd) { ::close(fd); }
+
+// Bound how long blocking ops (GET/WAIT/ADD replies) may stall.
+int tcpstore_set_timeout(int fd, int seconds) {
+  timeval tv{};
+  tv.tv_sec = seconds;
+  return ::setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+}
+
+static bool send_req(int fd, uint8_t cmd, const char* key, int klen,
+                     const uint8_t* val, int vlen) {
+  uint32_t k = static_cast<uint32_t>(klen), v = static_cast<uint32_t>(vlen);
+  return write_exact(fd, &cmd, 1) && write_exact(fd, &k, 4) &&
+         (klen == 0 || write_exact(fd, key, klen)) && write_exact(fd, &v, 4) &&
+         (vlen == 0 || write_exact(fd, val, vlen));
+}
+
+int tcpstore_set(int fd, const char* key, int klen, const uint8_t* val,
+                 int vlen) {
+  return send_req(fd, SET, key, klen, val, vlen) ? 0 : -1;
+}
+
+// Blocking get. Returns value length (truncated to cap), -1 on error.
+int tcpstore_get(int fd, const char* key, int klen, uint8_t* out, int cap) {
+  if (!send_req(fd, GET, key, klen, nullptr, 0)) return -1;
+  uint32_t vlen = 0;
+  if (!read_exact(fd, &vlen, 4)) return -1;
+  std::vector<uint8_t> buf(vlen);
+  if (vlen && !read_exact(fd, buf.data(), vlen)) return -1;
+  int n = static_cast<int>(vlen) < cap ? static_cast<int>(vlen) : cap;
+  if (n > 0) std::memcpy(out, buf.data(), n);
+  return static_cast<int>(vlen);
+}
+
+int64_t tcpstore_add(int fd, const char* key, int klen, int64_t delta) {
+  uint8_t payload[8];
+  std::memcpy(payload, &delta, 8);
+  if (!send_req(fd, ADD, key, klen, payload, 8)) return INT64_MIN;
+  uint32_t vlen = 0;
+  if (!read_exact(fd, &vlen, 4) || vlen != 8) return INT64_MIN;
+  int64_t out;
+  if (!read_exact(fd, &out, 8)) return INT64_MIN;
+  return out;
+}
+
+int tcpstore_wait(int fd, const char* key, int klen) {
+  if (!send_req(fd, WAIT, key, klen, nullptr, 0)) return -1;
+  uint32_t vlen = 0;
+  if (!read_exact(fd, &vlen, 4)) return -1;
+  std::vector<uint8_t> buf(vlen);
+  if (vlen && !read_exact(fd, buf.data(), vlen)) return -1;
+  return 0;
+}
+
+int tcpstore_delete(int fd, const char* key, int klen) {
+  return send_req(fd, DEL, key, klen, nullptr, 0) ? 0 : -1;
+}
+
+}  // extern "C"

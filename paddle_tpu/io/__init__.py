@@ -295,6 +295,7 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
         self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -328,6 +329,9 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        if self.num_workers > 0 and not self._iterable_mode:
+            yield from _MultiprocessIterator(self)
+            return
         if self.prefetch == 0 and self.num_workers == 0:
             yield from self._batches()
             return
@@ -367,5 +371,130 @@ class _PrefetchIterator:
         return item
 
 
+class WorkerInfo:
+    def __init__(self, id, num_workers, seed, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.seed = seed
+        self.dataset = dataset
+
+
+_WORKER_INFO = None
+
+
 def get_worker_info():
-    return None
+    """Inside a worker process: (id, num_workers, seed, dataset); else None.
+    Reference: fluid/dataloader/worker.py get_worker_info."""
+    return _WORKER_INFO
+
+
+def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
+                 num_workers, base_seed, worker_init_fn):
+    """Worker process body (reference: fluid/dataloader/dataloader_iter.py
+    _worker_loop). Fetches sample indices, returns collated numpy batches —
+    jax stays untouched in workers (fork-safe); Tensor wrapping happens in the
+    parent so device transfer lives on the main thread."""
+    global _WORKER_INFO
+    _WORKER_INFO = WorkerInfo(worker_id, num_workers, base_seed + worker_id,
+                              dataset)
+    np.random.seed((base_seed + worker_id) % (2 ** 31))
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    while True:
+        task = index_queue.get()
+        if task is None:
+            break
+        batch_id, indices = task
+        try:
+            samples = [dataset[i] for i in indices]
+            result_queue.put((batch_id, samples, None))
+        except Exception as e:  # propagate to parent
+            result_queue.put((batch_id, None, e))
+
+
+class _MultiprocessIterator:
+    """Ordered multi-worker fetch (the reference's _DataLoaderIterMultiProcess,
+    fluid/dataloader/dataloader_iter.py). Index batches are dealt round-robin
+    to worker processes; results are reordered by batch id so output order
+    matches the sampler regardless of worker timing."""
+
+    def __init__(self, loader: "DataLoader"):
+        import multiprocessing as mp
+
+        self.loader = loader
+        ctx = mp.get_context("fork")
+        self.num_workers = loader.num_workers
+        self.index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        self.result_queue = ctx.Queue()
+        base_seed = int(np.random.randint(0, 2 ** 31 - 1))
+        self.workers = []
+        for wid in range(self.num_workers):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(loader.dataset, self.index_queues[wid], self.result_queue,
+                      loader.collate_fn, wid, self.num_workers, base_seed,
+                      getattr(loader, "worker_init_fn", None)),
+                daemon=True)
+            w.start()
+            self.workers.append(w)
+        self.batches = list(loader.batch_sampler)
+        self.depth = max(2, loader.prefetch or 2) * self.num_workers
+        self.next_dispatch = 0
+        self.next_yield = 0
+        self.cache = {}
+        for _ in range(min(self.depth, len(self.batches))):
+            self._dispatch()
+
+    def _dispatch(self):
+        bid = self.next_dispatch
+        if bid >= len(self.batches):
+            return
+        self.index_queues[bid % self.num_workers].put((bid, self.batches[bid]))
+        self.next_dispatch += 1
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self.next_yield >= len(self.batches):
+            self._shutdown()
+            raise StopIteration
+        while self.next_yield not in self.cache:
+            try:
+                bid, samples, err = self.result_queue.get(timeout=5.0)
+            except queue.Empty:
+                dead = [w.pid for w in self.workers if not w.is_alive()]
+                if dead:
+                    self._shutdown()
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} exited unexpectedly "
+                        f"(killed or crashed); check the dataset __getitem__ "
+                        f"or reduce num_workers")
+                continue
+            if err is not None:
+                self._shutdown()
+                raise err
+            self.cache[bid] = samples
+        samples = self.cache.pop(self.next_yield)
+        self.next_yield += 1
+        self._dispatch()
+        return self.loader.collate_fn(samples)
+
+    def _shutdown(self):
+        for q in self.index_queues:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for w in self.workers:
+            w.join(timeout=5)
+            if w.is_alive():
+                w.terminate()
+        self.workers = []
+
+    def __del__(self):  # pragma: no cover - GC path
+        try:
+            if self.workers:
+                self._shutdown()
+        except Exception:
+            pass

@@ -427,8 +427,15 @@ class WMT14(_WMTBase):
         _require(data_file, "WMT14", "wmt14 tarball with train/ test/ gen/ pairs")
         self.mode = mode
         pairs = self._read_pairs(data_file, mode)
-        self.src_dict = self._freq_dict([p[0] for p in pairs], dict_size)
-        self.trg_dict = self._freq_dict([p[1] for p in pairs], dict_size)
+        # vocabulary always comes from the training corpus so train/test ids
+        # agree (reference wmt14.py builds one dict from train)
+        try:
+            dict_pairs = pairs if mode == "train" else \
+                self._read_pairs(data_file, "train")
+        except RuntimeError:
+            dict_pairs = pairs
+        self.src_dict = self._freq_dict([p[0] for p in dict_pairs], dict_size)
+        self.trg_dict = self._freq_dict([p[1] for p in dict_pairs], dict_size)
         self.data = self._build_ids(pairs, self.src_dict, self.trg_dict)
 
     def _read_pairs(self, data_file, mode):
@@ -470,8 +477,14 @@ class WMT16(_WMTBase):
         self.mode = mode
         self.lang = lang
         pairs = self._read_pairs(data_file, mode)
-        self.src_dict = self._freq_dict([p[0] for p in pairs], src_dict_size)
-        self.trg_dict = self._freq_dict([p[1] for p in pairs], trg_dict_size)
+        # one vocabulary, built from the training split (reference wmt16.py)
+        try:
+            dict_pairs = pairs if mode == "train" else \
+                self._read_pairs(data_file, "train")
+        except RuntimeError:
+            dict_pairs = pairs
+        self.src_dict = self._freq_dict([p[0] for p in dict_pairs], src_dict_size)
+        self.trg_dict = self._freq_dict([p[1] for p in dict_pairs], trg_dict_size)
         self.data = self._build_ids(pairs, self.src_dict, self.trg_dict)
 
     def _read_pairs(self, data_file, mode):

@@ -1,0 +1,271 @@
+"""Distributed checkpoint resharding + TCPStore + p2p + multiprocess loader."""
+import multiprocessing
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from jax.sharding import PartitionSpec as P
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+# -- distributed checkpoint ---------------------------------------------------
+
+def test_checkpoint_roundtrip_replicated(tmp_path):
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    path = os.path.join(str(tmp_path), "ckpt")
+    dist.save_state_dict(net.state_dict(), path)
+
+    net2 = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    dist.load_state_dict(net2.state_dict(), path)
+    for (k1, p1), (k2, p2) in zip(net.state_dict().items(),
+                                  net2.state_dict().items()):
+        np.testing.assert_array_equal(_np(p1), _np(p2))
+
+
+def test_checkpoint_reshard_across_meshes(tmp_path):
+    """Save with params sharded one way, load onto a different mesh layout."""
+    import jax
+
+    paddle.seed(1)
+    path = os.path.join(str(tmp_path), "reshard")
+
+    # save under an sdp=8 mesh with weights sharded over rows
+    env1 = dist.init_mesh(sharding=8)
+    w = paddle.randn([16, 8])
+    w.data = jax.device_put(w.data, env1.sharding_for(P("sdp", None)))
+    sd = {"w": w}
+    dist.save_state_dict(sd, path)
+    assert len([f for f in os.listdir(path) if f.endswith(".npy")]) >= 8
+    w_ref = _np(w)
+    dist.reset_mesh()
+
+    # restore under mp2 x dp4, sharded over columns this time
+    env2 = dist.init_mesh(mp=2, dp=4)
+    w2 = paddle.zeros([16, 8])
+    w2.data = jax.device_put(w2.data, env2.sharding_for(P(None, "mp")))
+    dist.load_state_dict({"w": w2}, path)
+    np.testing.assert_array_equal(_np(w2), w_ref)
+    # target sharding preserved after load
+    assert w2.data.sharding.spec == P(None, "mp")
+    dist.reset_mesh()
+
+
+def test_checkpoint_missing_key_raises(tmp_path):
+    path = os.path.join(str(tmp_path), "ck")
+    dist.save_state_dict({"a": paddle.ones([2])}, path)
+    with pytest.raises(ValueError):
+        dist.load_state_dict({"a": paddle.zeros([2]), "b": paddle.zeros([3])}, path)
+
+
+def test_save_load_sharded_model_with_optimizer(tmp_path):
+    from paddle_tpu.distributed.checkpoint import (save_sharded_model,
+                                                   load_sharded_model)
+
+    paddle.seed(2)
+    net = nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    x = paddle.randn([8, 4])
+    net(x).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    path = os.path.join(str(tmp_path), "m")
+    save_sharded_model(net, opt, path)
+
+    net2 = nn.Linear(4, 4)
+    opt2 = paddle.optimizer.Adam(0.01, parameters=net2.parameters())
+    load_sharded_model(net2, opt2, path)
+    np.testing.assert_array_equal(_np(net.weight), _np(net2.weight))
+
+
+# -- TCPStore (native C++ daemon) --------------------------------------------
+
+def test_tcpstore_set_get_add():
+    master = dist.TCPStore(is_master=True, world_size=1)
+    try:
+        master.set("alpha", b"hello")
+        assert master.get("alpha") == b"hello"
+        assert master.add("ctr", 5) == 5
+        assert master.add("ctr", 2) == 7
+        master.set("large", b"x" * 100_000)
+        assert master.get("large") == b"x" * 100_000
+        master.delete_key("alpha")
+        master.set("alpha", b"new")
+        assert master.get("alpha") == b"new"
+    finally:
+        master.close()
+
+
+def _store_client(port, results):
+    import paddle_tpu.distributed as dist
+
+    client = dist.TCPStore(port=port, is_master=False, world_size=2)
+    client.wait(["ready"])
+    results.put(client.get("ready"))
+    client.add("joined", 1)
+    client.close()
+
+
+def test_tcpstore_cross_process_rendezvous():
+    master = dist.TCPStore(is_master=True, world_size=2)
+    try:
+        ctx = multiprocessing.get_context("fork")
+        results = ctx.Queue()
+        proc = ctx.Process(target=_store_client, args=(master.port, results))
+        proc.start()
+        time.sleep(0.2)
+        master.set("ready", b"go")  # releases the client's blocking wait
+        assert results.get(timeout=10) == b"go"
+        deadline = time.time() + 10
+        while master.add("joined", 0) < 1 and time.time() < deadline:
+            time.sleep(0.05)
+        assert master.add("joined", 0) == 1
+        proc.join(timeout=5)
+    finally:
+        master.close()
+
+
+def test_tcpstore_blocking_get_waits():
+    master = dist.TCPStore(is_master=True, world_size=1)
+    try:
+        import threading
+
+        got = {}
+
+        def getter():
+            c = dist.TCPStore(port=master.port, is_master=False, world_size=1)
+            got["v"] = c.get("later")
+            c.close()
+
+        t = threading.Thread(target=getter)
+        t.start()
+        time.sleep(0.2)
+        assert "v" not in got  # still blocked
+        master.set("later", b"done")
+        t.join(timeout=10)
+        assert got.get("v") == b"done"
+    finally:
+        master.close()
+
+
+# -- p2p send/recv ------------------------------------------------------------
+
+def test_send_recv_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    dist.send(x, dst=0)
+    out = paddle.zeros([2, 3])
+    dist.recv(out, src=0)
+    np.testing.assert_array_equal(_np(out), _np(x))
+
+
+def test_isend_irecv_tags():
+    a = paddle.ones([2]) * 3
+    b = paddle.ones([2]) * 7
+    dist.isend(a, dst=0, tag=1)
+    dist.isend(b, dst=0, tag=2)
+    out2 = paddle.zeros([2])
+    out1 = paddle.zeros([2])
+    dist.irecv(out2, src=0, tag=2)
+    dist.irecv(out1, src=0, tag=1)
+    np.testing.assert_array_equal(_np(out1), [3, 3])
+    np.testing.assert_array_equal(_np(out2), [7, 7])
+
+
+def test_recv_shape_mismatch_raises():
+    dist.send(paddle.ones([4]), dst=0, tag=9)
+    with pytest.raises(ValueError):
+        dist.recv(paddle.zeros([2, 2]), src=0, tag=9)
+
+
+# -- multiprocess DataLoader --------------------------------------------------
+
+class _SlowDataset(paddle.io.Dataset):
+    def __init__(self, n=32):
+        self.n = n
+
+    def __getitem__(self, i):
+        time.sleep(0.002)
+        return np.full((4,), i, "float32"), np.int64(i % 2)
+
+    def __len__(self):
+        return self.n
+
+
+def test_multiprocess_loader_order_and_values():
+    ds = _SlowDataset(32)
+    loader = paddle.io.DataLoader(ds, batch_size=4, num_workers=2,
+                                  shuffle=False)
+    seen = []
+    for xb, yb in loader:
+        assert xb.shape == [4, 4]
+        seen.extend(np.asarray(xb.data)[:, 0].astype(int).tolist())
+    assert seen == list(range(32)), "multiprocess loader must preserve order"
+
+
+def test_multiprocess_loader_matches_single_worker():
+    ds = _SlowDataset(16)
+    single = [np.asarray(x.data) for x, _ in
+              paddle.io.DataLoader(ds, batch_size=8, num_workers=0, shuffle=False)]
+    multi = [np.asarray(x.data) for x, _ in
+             paddle.io.DataLoader(ds, batch_size=8, num_workers=2, shuffle=False)]
+    for a, b in zip(single, multi):
+        np.testing.assert_array_equal(a, b)
+
+
+class _FailingDataset(paddle.io.Dataset):
+    def __getitem__(self, i):
+        if i == 5:
+            raise RuntimeError("boom at 5")
+        return np.zeros(2, "float32")
+
+    def __len__(self):
+        return 8
+
+
+def test_multiprocess_loader_propagates_worker_error():
+    loader = paddle.io.DataLoader(_FailingDataset(), batch_size=2, num_workers=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        for _ in loader:
+            pass
+
+
+def test_worker_init_fn_and_info():
+    calls = multiprocessing.get_context("fork").Queue()
+
+    def init_fn(worker_id):
+        from paddle_tpu.io import get_worker_info
+
+        info = get_worker_info()
+        calls.put((worker_id, info.num_workers))
+
+    ds = _SlowDataset(8)
+    loader = paddle.io.DataLoader(ds, batch_size=4, num_workers=2,
+                                  worker_init_fn=init_fn)
+    list(loader)
+    got = sorted(calls.get(timeout=5) for _ in range(2))
+    assert got == [(0, 2), (1, 2)]
+
+
+def test_tcpstore_barrier_reusable():
+    master = dist.TCPStore(is_master=True, world_size=1)
+    try:
+        for _ in range(3):  # same tag must re-arm each generation
+            master.barrier("loop")
+    finally:
+        master.close()
+
+
+def test_send_recv_emulated_ranks():
+    x = paddle.ones([3]) * 5
+    dist.send(x, dst=2, src=1)
+    out = paddle.zeros([3])
+    dist.recv(out, src=1, dst=2)
+    np.testing.assert_array_equal(_np(out), [5, 5, 5])
