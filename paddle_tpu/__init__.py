@@ -83,7 +83,8 @@ def _try_import(names):
 
 _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "distributed", "regularizer", "autograd", "profiler", "text",
-              "distribution", "static", "incubate", "device", "hapi"])
+              "distribution", "static", "incubate", "device", "hapi",
+              "inference", "utils"])
 try:
     from .hapi import Model, summary  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402

@@ -1,0 +1,168 @@
+"""paddle.device: device queries + the pluggable-device loader.
+
+Reference: python/paddle/device/__init__.py (set/get_device, device counts)
+and the PluggableDevice registration path (SURVEY Appendix A.1,
+paddle/phi/backends/device_ext.h). The XLA device set comes from PJRT via jax;
+custom hardware plugs in through the PT_DeviceInterface C ABI
+(ext/device_ext.h) loaded by CustomDeviceRuntime.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Dict, List
+
+from ..framework import get_device, set_device  # noqa: F401
+
+_CUSTOM: Dict[str, "CustomDeviceRuntime"] = {}
+
+
+def get_all_device_type() -> List[str]:
+    import jax
+
+    kinds = {d.platform for d in jax.devices()}
+    return sorted(kinds) + get_all_custom_device_type()
+
+
+def get_available_device() -> List[str]:
+    import jax
+
+    out = [f"{d.platform}:{d.id}" for d in jax.devices()]
+    for name, rt in _CUSTOM.items():
+        out.extend(f"{name}:{i}" for i in range(rt.device_count()))
+    return out
+
+
+def device_count() -> int:
+    import jax
+
+    return len(jax.devices())
+
+
+def cuda_device_count() -> int:
+    return 0  # TPU build
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in _CUSTOM
+
+
+def get_all_custom_device_type() -> List[str]:
+    return sorted(_CUSTOM)
+
+
+class _Iface(ctypes.Structure):
+    _fields_ = [
+        ("size", ctypes.c_size_t),
+        ("type_name", ctypes.c_char_p),
+        ("initialize", ctypes.c_void_p),
+        ("finalize", ctypes.c_void_p),
+        ("get_device_count", ctypes.c_void_p),
+        ("init_device", ctypes.c_void_p),
+        ("deinit_device", ctypes.c_void_p),
+        ("memory_allocate", ctypes.c_void_p),
+        ("memory_deallocate", ctypes.c_void_p),
+        ("memory_copy_h2d", ctypes.c_void_p),
+        ("memory_copy_d2h", ctypes.c_void_p),
+        ("device_memory_stats", ctypes.c_void_p),
+        ("synchronize_device", ctypes.c_void_p),
+    ]
+
+
+class _Device(ctypes.Structure):
+    _fields_ = [("id", ctypes.c_int)]
+
+
+_STATUS = ctypes.c_int
+_DEV_FN = ctypes.CFUNCTYPE(_STATUS, _Device)
+_COUNT_FN = ctypes.CFUNCTYPE(_STATUS, ctypes.POINTER(ctypes.c_int))
+_ALLOC_FN = ctypes.CFUNCTYPE(_STATUS, _Device, ctypes.POINTER(ctypes.c_void_p),
+                             ctypes.c_size_t)
+_FREE_FN = ctypes.CFUNCTYPE(_STATUS, _Device, ctypes.c_void_p, ctypes.c_size_t)
+_COPY_FN = ctypes.CFUNCTYPE(_STATUS, _Device, ctypes.c_void_p, ctypes.c_void_p,
+                            ctypes.c_size_t)
+_STATS_FN = ctypes.CFUNCTYPE(_STATUS, _Device, ctypes.POINTER(ctypes.c_size_t),
+                             ctypes.POINTER(ctypes.c_size_t))
+_VOID_FN = ctypes.CFUNCTYPE(_STATUS)
+
+
+class CustomDeviceRuntime:
+    """ctypes view over a PT_DeviceInterface plugin (the core-side
+    DeviceManager role, reference phi/backends/device_manager.cc)."""
+
+    def __init__(self, lib_path: str):
+        self._lib = ctypes.CDLL(lib_path)
+        self._iface = _Iface()
+        self._iface.size = ctypes.sizeof(_Iface)
+        init_fn = self._lib.PT_InitPlugin
+        init_fn.restype = ctypes.c_int
+        init_fn.argtypes = [ctypes.POINTER(_Iface)]
+        if init_fn(ctypes.byref(self._iface)) != 0:
+            raise RuntimeError(f"plugin {lib_path} rejected the ABI handshake")
+        self.type_name = self._iface.type_name.decode()
+        if _VOID_FN(self._iface.initialize)() != 0:
+            raise RuntimeError(f"plugin {self.type_name}: initialize failed")
+
+    def device_count(self) -> int:
+        n = ctypes.c_int(0)
+        if _COUNT_FN(self._iface.get_device_count)(ctypes.byref(n)) != 0:
+            raise RuntimeError("get_device_count failed")
+        return n.value
+
+    def memory_allocate(self, dev_id: int, size: int) -> int:
+        ptr = ctypes.c_void_p(None)
+        rc = _ALLOC_FN(self._iface.memory_allocate)(
+            _Device(dev_id), ctypes.byref(ptr), size)
+        if rc != 0 or not ptr.value:
+            raise MemoryError(f"{self.type_name}: allocate({size}) failed")
+        return ptr.value
+
+    def memory_deallocate(self, dev_id: int, ptr: int, size: int):
+        _FREE_FN(self._iface.memory_deallocate)(_Device(dev_id),
+                                                ctypes.c_void_p(ptr), size)
+
+    def copy_h2d(self, dev_id: int, dst: int, src: bytes):
+        buf = ctypes.create_string_buffer(src, len(src))
+        rc = _COPY_FN(self._iface.memory_copy_h2d)(
+            _Device(dev_id), ctypes.c_void_p(dst),
+            ctypes.cast(buf, ctypes.c_void_p), len(src))
+        if rc != 0:
+            raise RuntimeError("copy_h2d failed")
+
+    def copy_d2h(self, dev_id: int, src: int, size: int) -> bytes:
+        buf = ctypes.create_string_buffer(size)
+        rc = _COPY_FN(self._iface.memory_copy_d2h)(
+            _Device(dev_id), ctypes.cast(buf, ctypes.c_void_p),
+            ctypes.c_void_p(src), size)
+        if rc != 0:
+            raise RuntimeError("copy_d2h failed")
+        return buf.raw
+
+    def memory_stats(self, dev_id: int):
+        total = ctypes.c_size_t(0)
+        free = ctypes.c_size_t(0)
+        _STATS_FN(self._iface.device_memory_stats)(
+            _Device(dev_id), ctypes.byref(total), ctypes.byref(free))
+        return int(total.value), int(free.value)
+
+    def synchronize(self, dev_id: int):
+        _DEV_FN(self._iface.synchronize_device)(_Device(dev_id))
+
+
+def load_custom_device(lib_path: str) -> CustomDeviceRuntime:
+    """Register a PT_DeviceInterface plugin (reference: CUSTOM_DEVICE_ROOT
+    scan in phi/backends/custom/custom_device.cc)."""
+    rt = CustomDeviceRuntime(lib_path)
+    _CUSTOM[rt.type_name] = rt
+    return rt
+
+
+def build_fake_device() -> str:
+    """Compile the bundled sample plugin; returns the .so path (test helper)."""
+    from ..utils import cpp_extension
+
+    src_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ext")
+    lib = cpp_extension.load("fake_device",
+                             [os.path.join(src_dir, "fake_device.cpp")],
+                             extra_cxx_cflags=[f"-I{src_dir}"])
+    return lib._name

@@ -1,0 +1,62 @@
+/* Pluggable-device C ABI (the reference contract this mirrors:
+ * /root/reference/paddle/phi/backends/device_ext.h:48 C_DeviceInterface —
+ * a versioned struct of function pointers a hardware plugin fills in).
+ *
+ * TPU-native stance: the compute path talks to accelerators through PJRT, so
+ * this interface covers the *runtime* surface a plugin must provide to appear
+ * in paddle_tpu.device: lifecycle, device enumeration, raw memory, and a
+ * synchronous copy engine. A plugin exports:
+ *     int PT_InitPlugin(PT_DeviceInterface* iface);
+ * filling every pointer and setting `size` for ABI versioning.
+ */
+#ifndef PADDLE_TPU_DEVICE_EXT_H_
+#define PADDLE_TPU_DEVICE_EXT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef enum { PT_SUCCESS = 0, PT_FAILED = 1 } PT_Status;
+
+typedef struct {
+  int id; /* logical device index */
+} PT_Device;
+
+typedef struct PT_DeviceInterface {
+  size_t size;            /* sizeof(PT_DeviceInterface) the plugin built with */
+  const char* type_name;  /* e.g. "fake_cpu" */
+
+  /* lifecycle */
+  PT_Status (*initialize)(void);
+  PT_Status (*finalize)(void);
+
+  /* enumeration */
+  PT_Status (*get_device_count)(int* count);
+  PT_Status (*init_device)(PT_Device device);
+  PT_Status (*deinit_device)(PT_Device device);
+
+  /* memory */
+  PT_Status (*memory_allocate)(PT_Device device, void** ptr, size_t size);
+  PT_Status (*memory_deallocate)(PT_Device device, void* ptr, size_t size);
+  PT_Status (*memory_copy_h2d)(PT_Device device, void* dst, const void* src,
+                               size_t size);
+  PT_Status (*memory_copy_d2h)(PT_Device device, void* dst, const void* src,
+                               size_t size);
+  PT_Status (*device_memory_stats)(PT_Device device, size_t* total,
+                                   size_t* free_bytes);
+
+  /* execution */
+  PT_Status (*synchronize_device)(PT_Device device);
+} PT_DeviceInterface;
+
+/* Every plugin exports exactly this symbol. */
+typedef int (*PT_InitPluginFn)(PT_DeviceInterface* iface);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* PADDLE_TPU_DEVICE_EXT_H_ */

@@ -1,0 +1,81 @@
+// Sample pluggable device: a host-memory "fake_cpu" backend.
+//
+// Reference role: /root/reference/paddle/fluid/platform/device/custom/
+// fake_cpu_device.h (the test plugin validating the device_ext contract).
+// Demonstrates the PT_DeviceInterface ABI end to end: enumeration, raw
+// allocation with stats accounting, and the two copy directions.
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+
+#include "device_ext.h"
+
+namespace {
+constexpr int kDeviceCount = 2;
+constexpr size_t kTotalBytes = 1ull << 30;
+std::mutex g_mu;
+size_t g_used = 0;
+
+PT_Status init() { return PT_SUCCESS; }
+PT_Status fini() { return PT_SUCCESS; }
+
+PT_Status device_count(int* count) {
+  *count = kDeviceCount;
+  return PT_SUCCESS;
+}
+
+PT_Status init_device(PT_Device) { return PT_SUCCESS; }
+PT_Status deinit_device(PT_Device) { return PT_SUCCESS; }
+
+PT_Status mem_alloc(PT_Device, void** ptr, size_t size) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  if (g_used + size > kTotalBytes) return PT_FAILED;
+  *ptr = std::malloc(size);
+  if (!*ptr) return PT_FAILED;
+  g_used += size;
+  return PT_SUCCESS;
+}
+
+PT_Status mem_free(PT_Device, void* ptr, size_t size) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  std::free(ptr);
+  g_used -= size > g_used ? g_used : size;
+  return PT_SUCCESS;
+}
+
+PT_Status copy_h2d(PT_Device, void* dst, const void* src, size_t size) {
+  std::memcpy(dst, src, size);
+  return PT_SUCCESS;
+}
+
+PT_Status copy_d2h(PT_Device, void* dst, const void* src, size_t size) {
+  std::memcpy(dst, src, size);
+  return PT_SUCCESS;
+}
+
+PT_Status mem_stats(PT_Device, size_t* total, size_t* free_bytes) {
+  std::lock_guard<std::mutex> lk(g_mu);
+  *total = kTotalBytes;
+  *free_bytes = kTotalBytes - g_used;
+  return PT_SUCCESS;
+}
+
+PT_Status sync_device(PT_Device) { return PT_SUCCESS; }
+}  // namespace
+
+extern "C" int PT_InitPlugin(PT_DeviceInterface* iface) {
+  if (!iface || iface->size < sizeof(PT_DeviceInterface)) return 1;
+  iface->type_name = "fake_cpu";
+  iface->initialize = init;
+  iface->finalize = fini;
+  iface->get_device_count = device_count;
+  iface->init_device = init_device;
+  iface->deinit_device = deinit_device;
+  iface->memory_allocate = mem_alloc;
+  iface->memory_deallocate = mem_free;
+  iface->memory_copy_h2d = copy_h2d;
+  iface->memory_copy_d2h = copy_d2h;
+  iface->device_memory_stats = mem_stats;
+  iface->synchronize_device = sync_device;
+  return 0;
+}

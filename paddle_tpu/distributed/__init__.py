@@ -41,3 +41,6 @@ class ParallelEnv:
         self.nranks = self.world_size
         self.current_endpoint = ""
         self.trainer_endpoints = []
+
+from . import auto_parallel  # noqa: F401,E402
+from .auto_parallel import shard_tensor, shard_op, ProcessMesh  # noqa: F401,E402

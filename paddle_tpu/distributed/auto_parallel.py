@@ -1,0 +1,120 @@
+"""Auto-parallel annotation API (reference: python/paddle/distributed/
+auto_parallel/interface.py shard_tensor/shard_op + ProcessMesh).
+
+TPU-native: annotations ARE the implementation. The reference runs a
+Completer/Partitioner pass to propagate dist_attrs and rewrite the program;
+here a dims_mapping becomes a jax PartitionSpec and GSPMD does the completion
+— XLA's sharding propagation is the Completer, SPMD partitioning the
+Partitioner (SURVEY §2.3 auto-parallel row).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..core.tensor import Tensor
+from .mesh import get_mesh_env, require_mesh_env
+
+
+class ProcessMesh:
+    """Logical device mesh view (reference auto_parallel/process_mesh.py).
+
+    topology: per-axis degrees; dim_names: axis names. On this framework it
+    must agree with (a sub-grid of) the live MeshEnv axes."""
+
+    def __init__(self, mesh: Optional[Sequence] = None,
+                 topology: Optional[List[int]] = None,
+                 dim_names: Optional[List[str]] = None):
+        env = get_mesh_env()
+        if dim_names is None and env is not None:
+            dim_names = [ax for ax in env.axis_names if env.degrees[ax] > 1]
+        self.dim_names = list(dim_names or [])
+        if topology is None and env is not None:
+            topology = [env.degrees[ax] for ax in self.dim_names]
+        self.topology = list(topology or [])
+
+    @property
+    def shape(self):
+        return list(self.topology)
+
+    def __repr__(self):
+        return f"ProcessMesh(topology={self.topology}, dim_names={self.dim_names})"
+
+
+def _dims_mapping_to_spec(dims_mapping: Sequence[int],
+                          mesh: Optional[ProcessMesh]) -> PartitionSpec:
+    """dims_mapping[i] = mesh-axis index sharding tensor dim i, or -1."""
+    env = require_mesh_env()
+    names = (mesh.dim_names if mesh is not None and mesh.dim_names
+             else [ax for ax in env.axis_names if env.degrees[ax] > 1])
+    parts = []
+    for m in dims_mapping:
+        if m is None or m < 0:
+            parts.append(None)
+        else:
+            if m >= len(names):
+                raise ValueError(
+                    f"dims_mapping entry {m} out of range for mesh axes {names}")
+            parts.append(names[m])
+    return PartitionSpec(*parts)
+
+
+def shard_tensor(x, dist_attr=None, process_mesh=None, shard_spec=None):
+    """Place a tensor according to a dist_attr (reference interface.py:36).
+
+    Accepts either the reference dict form
+    ``{"process_mesh": pm, "dims_mapping": [0, -1]}`` or a direct
+    ``shard_spec`` of mesh-axis names (["dp", None] style)."""
+    env = require_mesh_env()
+    if shard_spec is not None:
+        spec = PartitionSpec(*[s if s else None for s in shard_spec])
+    elif dist_attr is not None:
+        spec = _dims_mapping_to_spec(dist_attr.get("dims_mapping", []),
+                                     dist_attr.get("process_mesh", process_mesh))
+    else:
+        spec = PartitionSpec()
+    sharding = NamedSharding(env.mesh, spec)
+    if isinstance(x, Tensor):
+        x.data = jax.device_put(x.data, sharding)
+        if hasattr(x, "dist_spec"):
+            x.dist_spec = spec
+        return x
+    return jax.device_put(x, sharding)
+
+
+def shard_op(op_fn, dist_attr=None, out_shard_specs=None):
+    """Wrap a callable so its outputs carry sharding constraints
+    (reference interface.py shard_op). Use inside jit-traced code; GSPMD
+    propagates the annotation through the surrounding computation."""
+    env = require_mesh_env()
+
+    def specs_for(outs):
+        n = len(outs)
+        if out_shard_specs is not None:
+            return [PartitionSpec(*[s if s else None for s in sp]) if sp else
+                    PartitionSpec() for sp in out_shard_specs]
+        if dist_attr is not None:
+            sp = _dims_mapping_to_spec(dist_attr.get("dims_mapping", []),
+                                       dist_attr.get("process_mesh"))
+            return [sp] * n
+        return [PartitionSpec()] * n
+
+    def wrapped(*args, **kwargs):
+        out = op_fn(*args, **kwargs)
+        multi = isinstance(out, (list, tuple))
+        outs = list(out) if multi else [out]
+        specs = specs_for(outs)
+        constrained = []
+        for o, sp in zip(outs, specs):
+            if isinstance(o, Tensor):
+                o.data = jax.lax.with_sharding_constraint(
+                    o.data, NamedSharding(env.mesh, sp))
+                constrained.append(o)
+            else:
+                constrained.append(jax.lax.with_sharding_constraint(
+                    o, NamedSharding(env.mesh, sp)))
+        return type(out)(constrained) if multi else constrained[0]
+
+    return wrapped

@@ -19,7 +19,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import NamedSharding
 
 from ..core.tensor import Tensor
 
@@ -35,18 +35,6 @@ def _spec_to_json(spec) -> list:
         else:
             out.append(str(e))
     return out
-
-
-def _spec_from_json(entries) -> PartitionSpec:
-    parts = []
-    for e in entries:
-        if e is None:
-            parts.append(None)
-        elif isinstance(e, list):
-            parts.append(tuple(e))
-        else:
-            parts.append(e)
-    return PartitionSpec(*parts)
 
 
 def _sanitize(key: str) -> str:

@@ -301,15 +301,23 @@ _P2P_CV = threading.Condition(_P2P_LOCK)
 
 
 class P2POp:
-    """Completed-op handle (the reference's ProcessGroup::Task role)."""
+    """Op handle (the reference's ProcessGroup::Task role). For async ops the
+    result is produced on a background thread; wait() joins it."""
 
-    def __init__(self, done=True):
-        self._done = done
+    def __init__(self, thread=None):
+        self._thread = thread
+        self._exc = None
 
     def is_completed(self):
-        return self._done
+        return self._thread is None or not self._thread.is_alive()
 
     def wait(self, timeout=None):
+        if self._thread is not None:
+            self._thread.join(timeout)
+            if self._thread.is_alive():
+                return False
+            if self._exc is not None:
+                raise self._exc
         return True
 
 
@@ -359,11 +367,25 @@ def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None):
 
 
 def isend(tensor, dst=0, group=None, tag=0):
+    # deposit is already non-blocking; reuse the sync path
     return send(tensor, dst, group, sync_op=False, tag=tag)
 
 
 def irecv(tensor, src=0, group=None, tag=0):
-    return recv(tensor, src, group, sync_op=False, tag=tag)
+    """Asynchronous receive: returns immediately; wait() joins the background
+    receive so 'task = irecv(...); send(...); task.wait()' exchanges work."""
+    op = P2POp(thread=None)
+
+    def run():
+        try:
+            recv(tensor, src, group, sync_op=True, tag=tag)
+        except BaseException as e:
+            op._exc = e
+
+    t = threading.Thread(target=run, daemon=True)
+    op._thread = t
+    t.start()
+    return op
 
 
 # -- in-trace collectives (for shard_map bodies: TP/PP/EP internals) ---------

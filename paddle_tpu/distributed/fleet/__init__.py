@@ -13,3 +13,5 @@ from ..meta_parallel import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from ..utils_recompute import recompute  # noqa: F401
+from . import elastic  # noqa: F401,E402
+from .elastic import ElasticManager, ElasticStatus  # noqa: F401,E402

@@ -1,0 +1,113 @@
+"""Elastic training manager (reference: python/paddle/distributed/fleet/
+elastic/manager.py:130 ElasticManager).
+
+The reference watches etcd for node join/leave and restarts training at the
+new world size. Here the control plane is the native TCPStore (store/): each
+worker heartbeats `host/<rank>` keys; the manager watches liveness and reports
+scale events. Under TPU SPMD, "rescale" means rebuilding the jax.distributed
+world + mesh, so this layer's job is detection + rendezvous, not process
+surgery: the launcher re-execs workers at the new world size.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+from ..store import TCPStore
+
+
+class ElasticStatus(Enum):
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, rank: int, world_size: int,
+                 min_np: Optional[int] = None, max_np: Optional[int] = None,
+                 heartbeat_interval: float = 1.0, timeout: float = 5.0):
+        self.store = store
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self.min_np = min_np if min_np is not None else world_size
+        self.max_np = max_np if max_np is not None else world_size
+        self.interval = heartbeat_interval
+        self.timeout = timeout
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._on_scale: Optional[Callable[[List[int]], None]] = None
+
+    # -- membership ---------------------------------------------------------
+    def register(self):
+        """Announce this worker and start heartbeating."""
+        self.store.set("elastic/np", str(self.world_size))
+        self._beat()
+        # one-time publish marker so liveness probes never block (see
+        # store_get_nowait: TCPStore.get blocks on absent keys by design)
+        self.store.add(f"elastic/worker/{self.rank}/published", 1)
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _beat(self):
+        self.store.set(f"elastic/worker/{self.rank}",
+                       json.dumps({"ts": time.time()}))
+
+    def _loop(self):
+        while not self._stop.is_set():
+            self._beat()
+            self._stop.wait(self.interval)
+
+    def alive_workers(self) -> List[int]:
+        """Ranks whose heartbeat is fresher than `timeout` seconds."""
+        now = time.time()
+        alive = []
+        for r in range(self.max_np):
+            try:
+                raw = self.store_get_nowait(f"elastic/worker/{r}")
+            except KeyError:
+                continue
+            try:
+                ts = json.loads(raw)["ts"]
+            except Exception:
+                continue
+            if now - ts <= self.timeout:
+                alive.append(r)
+        return alive
+
+    def store_get_nowait(self, key: str) -> bytes:
+        """Non-blocking existence probe: TCPStore.get blocks on absent keys,
+        so liveness checks consult the atomic `<key>/published` counter first
+        (add(0) reads without blocking) and only then fetch the value."""
+        if self.store.add(f"{key}/published", 0) < 1:
+            raise KeyError(key)
+        return self.store.get(key)
+
+    # -- scale watching ------------------------------------------------------
+    def on_scale(self, fn: Callable[[List[int]], None]):
+        self._on_scale = fn
+        return fn
+
+    def watch(self) -> ElasticStatus:
+        """One scale-check round (reference manager.py watch loop body)."""
+        alive = self.alive_workers()
+        n = len(alive)
+        if n == self.world_size:
+            return ElasticStatus.COMPLETED if self._stop.is_set() \
+                else ElasticStatus.HOLD
+        if n < self.min_np:
+            return ElasticStatus.ERROR
+        if self._on_scale is not None:
+            self._on_scale(alive)
+        return ElasticStatus.RESTART
+
+    def exit(self, completed=True):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+        return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT

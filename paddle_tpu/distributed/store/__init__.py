@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 
 __all__ = ["TCPStore", "Store"]
@@ -23,16 +22,12 @@ def _build_lib() -> ctypes.CDLL:
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
+        from ...utils import cpp_extension
+
         src_dir = os.path.dirname(os.path.abspath(__file__))
-        src = os.path.join(src_dir, "store.cpp")
-        out = os.path.join(src_dir, "_libtcpstore.so")
-        if (not os.path.exists(out)
-                or os.path.getmtime(out) < os.path.getmtime(src)):
-            cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", src,
-                   "-o", out + ".tmp", "-lpthread"]
-            subprocess.run(cmd, check=True, capture_output=True)
-            os.replace(out + ".tmp", out)
-        lib = ctypes.CDLL(out)
+        lib = cpp_extension.load("tcpstore",
+                                 [os.path.join(src_dir, "store.cpp")],
+                                 build_directory=src_dir)
         lib.tcpstore_server_start.restype = ctypes.c_void_p
         lib.tcpstore_server_start.argtypes = [ctypes.c_int,
                                               ctypes.POINTER(ctypes.c_int)]

@@ -1,0 +1,134 @@
+"""paddle.inference: the deployment predictor API.
+
+Reference: paddle/fluid/inference/api/analysis_predictor.h:91 (AnalysisPredictor
+over an optimized program) + python/paddle/inference/__init__.py (Config /
+create_predictor / Tensor handles). TPU-native: the "optimized program" is a
+jax.export StableHLO artifact produced by paddle_tpu.jit.save — XLA is the
+analysis/optimization pass stack, so Config's IR-pass switches are no-ops kept
+for API compatibility.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["Config", "Predictor", "create_predictor", "PredictorTensor"]
+
+
+class Config:
+    """Reference inference/api/paddle_analysis_config.h role."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[: -len(".pdmodel")]
+        self.prefix = prog_file
+        self._ir_optim = True
+        self._memory_optim = True
+        self._device = "tpu"
+
+    def set_prog_file(self, path):
+        self.prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") else path
+
+    def prog_file(self):
+        return (self.prefix or "") + ".pdmodel"
+
+    def params_file(self):
+        return (self.prefix or "") + ".pdiparams"
+
+    # API-compat switches; XLA always optimizes (no discrete IR passes here)
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def enable_memory_optim(self, flag=True):
+        self._memory_optim = flag
+
+    def disable_glog_info(self):
+        pass
+
+    def enable_use_gpu(self, *a, **k):  # GPU configs are inert on TPU builds
+        pass
+
+    def disable_gpu(self):
+        pass
+
+
+class PredictorTensor:
+    """Input/output handle (reference api/paddle_tensor.h ZeroCopyTensor)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def copy_from_cpu(self, array):
+        self._value = np.ascontiguousarray(array)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else []
+
+
+class Predictor:
+    """Runs the exported program (AnalysisPredictor role)."""
+
+    def __init__(self, config: Config):
+        from .. import jit
+
+        if not config.prefix:
+            raise ValueError("Config needs the model path prefix")
+        self._layer = jit.load(config.prefix)
+        n = self._n_inputs = self._layer_num_inputs(config.prefix)
+        self._inputs: Dict[str, PredictorTensor] = {
+            f"x{i}": PredictorTensor(f"x{i}") for i in range(n)}
+        self._outputs: Dict[str, PredictorTensor] = {}
+
+    @staticmethod
+    def _layer_num_inputs(prefix):
+        import json
+
+        with open(prefix + ".pdmeta") as f:
+            return int(json.load(f)["num_inputs"])
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_input_handle(self, name) -> PredictorTensor:
+        return self._inputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional arrays, or handles filled via copy_from_cpu."""
+        if inputs is not None:
+            arrays = [np.asarray(a) for a in inputs]
+        else:
+            arrays = [self._inputs[n]._value for n in self.get_input_names()]
+            if any(a is None for a in arrays):
+                missing = [n for n in self._inputs if self._inputs[n]._value is None]
+                raise RuntimeError(f"inputs not set: {missing}")
+        outs = self._layer(*[jnp.asarray(a) for a in arrays])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        self._outputs = {}
+        results = []
+        for i, o in enumerate(outs):
+            t = PredictorTensor(f"out{i}")
+            t.copy_from_cpu(np.asarray(o.data))
+            self._outputs[t.name] = t
+            results.append(t.copy_to_cpu())
+        return results
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_output_handle(self, name) -> PredictorTensor:
+        return self._outputs[name]
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -388,12 +388,12 @@ def get_worker_info():
     return _WORKER_INFO
 
 
-def _worker_loop(dataset, index_queue, result_queue, collate_fn, worker_id,
+def _worker_loop(dataset, index_queue, result_queue, worker_id,
                  num_workers, base_seed, worker_init_fn):
     """Worker process body (reference: fluid/dataloader/dataloader_iter.py
-    _worker_loop). Fetches sample indices, returns collated numpy batches —
-    jax stays untouched in workers (fork-safe); Tensor wrapping happens in the
-    parent so device transfer lives on the main thread."""
+    _worker_loop). Fetches samples by index and returns the raw sample lists —
+    collation into Tensors happens in the parent so jax (and device transfer)
+    stays off the forked workers entirely."""
     global _WORKER_INFO
     _WORKER_INFO = WorkerInfo(worker_id, num_workers, base_seed + worker_id,
                               dataset)
@@ -432,7 +432,7 @@ class _MultiprocessIterator:
             w = ctx.Process(
                 target=_worker_loop,
                 args=(loader.dataset, self.index_queues[wid], self.result_queue,
-                      loader.collate_fn, wid, self.num_workers, base_seed,
+                      wid, self.num_workers, base_seed,
                       getattr(loader, "worker_init_fn", None)),
                 daemon=True)
             w.start()

@@ -213,19 +213,132 @@ class TrainStep:
         return Tensor(loss)
 
 
+def _as_shape_struct(spec, poly_suffix=""):
+    """InputSpec/Tensor/array -> jax.ShapeDtypeStruct; None dims become
+    symbolic so the exported program accepts any batch size."""
+    from jax import export as jexport
+
+    if isinstance(spec, Tensor):
+        return jax.ShapeDtypeStruct(tuple(spec.shape), spec.data.dtype)
+    if hasattr(spec, "shape") and hasattr(spec, "dtype"):
+        shape = tuple(spec.shape)
+        dtype = jnp.dtype(str(spec.dtype).replace("paddle.", ""))
+        if any(d is None or (isinstance(d, int) and d < 0) for d in shape):
+            dims = [f"b{poly_suffix}_{i}"
+                    if d is None or (isinstance(d, int) and d < 0) else str(d)
+                    for i, d in enumerate(shape)]
+            shape = jexport.symbolic_shape(",".join(dims))
+        return jax.ShapeDtypeStruct(shape, dtype)
+    raise TypeError(f"cannot build a trace signature from {spec!r}")
+
+
 def save(layer, path, input_spec=None, **configs):
-    """jit.save: persist weights + a marker (AOT export comes with the
-    inference subsystem; reference: fluid/dygraph/jit.py jit.save)."""
-    from ..framework import io as fio
+    """jit.save: AOT-export the traced forward (reference: fluid/dygraph/jit.py
+    jit.save -> TranslatedLayer artifacts). Artifacts:
+
+    - `<path>.pdmodel`   serialized StableHLO program (jax.export bytes),
+      traced as fn(param_arrays, *inputs) for CPU+TPU platforms
+    - `<path>.pdiparams` weights as npz (positional, matching the trace)
+    - `<path>.pdmeta`    json: state-dict keys + input/output structure
+    """
+    import json
+
+    from jax import export as jexport
+
+    import numpy as np
 
     target = layer._target if isinstance(layer, StaticLayer) else layer
-    fio.save(target.state_dict(), path + ".pdparams")
+    if not isinstance(target, Layer):
+        raise TypeError("jit.save expects an nn.Layer (or to_static of one)")
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(...)|example Tensor, ...] "
+            "to trace the forward")
+    named, buffers = _collect_params(target)
+    tensors = [p for _, p in named] + [b for _, b in buffers]
+    keys = [k for k, _ in named] + [k for k, _ in buffers]
+    arg_structs = [_as_shape_struct(s, poly_suffix=str(i))
+                   for i, s in enumerate(input_spec)]
+    param_structs = [jax.ShapeDtypeStruct(tuple(t.data.shape), t.data.dtype)
+                     for t in tensors]
+
+    def run(param_arrays, *input_arrays):
+        ts = tensors
+        with _Binder(ts) as b:
+            b.bind(list(param_arrays))
+            with autograd.no_grad():
+                out = target(*[Tensor(a) for a in input_arrays])
+        return jax.tree_util.tree_map(
+            lambda t: t.data if isinstance(t, Tensor) else t, out,
+            is_leaf=lambda t: isinstance(t, Tensor))
+
+    was_training = target.training
+    target.eval()  # export inference semantics (dropout off, BN running stats)
+    try:
+        exp = jexport.export(jax.jit(run), platforms=("cpu", "tpu"))(
+            param_structs, *arg_structs)
+    finally:
+        if was_training:
+            target.train()
+    with open(path + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path + ".pdiparams", "wb") as f:
+        np.savez(f, **{f"p{i}": np.asarray(t.data) for i, t in enumerate(tensors)})
+    with open(path + ".pdmeta", "w") as f:
+        json.dump({"param_keys": keys,
+                   "num_inputs": len(arg_structs),
+                   "input_specs": [
+                       {"shape": [None if not isinstance(d, int) else d
+                                  for d in s.shape],
+                        "dtype": str(s.dtype)} for s in arg_structs]}, f)
+
+
+class TranslatedLayer(Layer):
+    """Loaded AOT program (reference: fluid/dygraph/io.py TranslatedLayer).
+
+    Parameters are live: set_state_dict updates them and the next call feeds
+    the new arrays into the exported executable."""
+
+    def forward(self, *inputs):  # pragma: no cover - bound per-instance in load()
+        raise RuntimeError("TranslatedLayer not initialized; use jit.load")
 
 
 def load(path, **configs):
-    raise NotImplementedError(
-        "jit.load requires the inference subsystem (planned); "
-        "use paddle.load + set_state_dict")
+    """jit.load: rehydrate a jit.save artifact as a callable Layer."""
+    import json
+
+    import numpy as np
+    from jax import export as jexport
+
+    with open(path + ".pdmodel", "rb") as f:
+        exp = jexport.deserialize(f.read())
+    with open(path + ".pdmeta") as f:
+        meta = json.load(f)
+    data = np.load(path + ".pdiparams")
+    arrays = [data[f"p{i}"] for i in range(len(meta["param_keys"]))]
+
+    from ..nn.layer.layers import Parameter
+
+    layer = TranslatedLayer()
+    params = []
+    for key, arr in zip(meta["param_keys"], arrays):
+        p = Parameter(jnp.asarray(arr), name=key.replace(".", "_"))
+        # register under the ORIGINAL dotted key: named_parameters/state_dict
+        # then expose the same names the source model used, so
+        # set_state_dict(trained_net.state_dict()) round-trips
+        layer.add_parameter(key, p)
+        params.append(p)
+
+    def forward(*inputs):
+        arrs = [x.data if isinstance(x, Tensor) else jnp.asarray(x)
+                for x in inputs]
+        out = exp.call([p.data for p in params], *arrs)
+        return jax.tree_util.tree_map(Tensor, out)
+
+    layer.forward = forward
+    layer._param_keys = meta["param_keys"]
+    layer.eval()
+    return layer
 
 
 def not_to_static(fn=None):

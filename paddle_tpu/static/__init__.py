@@ -1,0 +1,50 @@
+"""paddle.static compat surface (reference: python/paddle/static/).
+
+paddle_tpu is dygraph-first: graph capture is `paddle_tpu.jit.to_static`
+(tracing), not a ProgramDesc build. This module provides the pieces of the
+static API that carry over meaningfully: InputSpec (trace signatures),
+control-flow ops (lax.cond/while_loop backed), and save/load_inference_model
+(jax.export AOT artifacts). Program/Executor raise with pointers to the
+dygraph equivalents rather than emulating a second IR.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import nn  # noqa: F401
+from .input_spec import InputSpec  # noqa: F401
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Maps to jit.save of the traced layer (reference static/io.py)."""
+    raise NotImplementedError(
+        "use paddle_tpu.jit.save(layer, path, input_spec=[...]) — tracing "
+        "replaces Program capture on this framework")
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    raise NotImplementedError(
+        "use paddle_tpu.jit.load(path) or paddle_tpu.inference.create_predictor")
+
+
+class Program:  # pragma: no cover - compat stub
+    def __init__(self):
+        raise NotImplementedError(
+            "paddle_tpu has no ProgramDesc IR; capture graphs with "
+            "paddle_tpu.jit.to_static (jaxpr/StableHLO is the program)")
+
+
+class Executor:  # pragma: no cover - compat stub
+    def __init__(self, place=None):
+        raise NotImplementedError(
+            "paddle_tpu has no static Executor; compiled execution is "
+            "paddle_tpu.jit.to_static / jit.TrainStep (XLA executables)")
+
+
+def default_main_program():  # pragma: no cover - compat stub
+    raise NotImplementedError("no ProgramDesc IR; see paddle_tpu.jit")
+
+
+def default_startup_program():  # pragma: no cover - compat stub
+    raise NotImplementedError("no ProgramDesc IR; see paddle_tpu.jit")

@@ -1,0 +1,152 @@
+"""Control-flow ops (reference: python/paddle/fluid/layers/control_flow.py
+cond:2297, while_loop:1064, case, switch_case; exported via static/nn).
+
+TPU-native dual path: with a *concrete* predicate (eager mode) the Python
+branch runs directly — the autograd tape records through it like any other
+ops. With a *traced* predicate (inside jit.to_static / TrainStep) the op
+lowers to lax.cond / lax.while_loop / lax.switch so both branches compile into
+the one XLA executable (the role of the reference's ConditionalBlockOp /
+WhileOp sub-block execution).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["cond", "while_loop", "case", "switch_case"]
+
+
+def _is_tracer(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def _to_data(tree):
+    return jax.tree_util.tree_map(
+        lambda t: t.data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _to_tensor(tree):
+    return jax.tree_util.tree_map(
+        lambda a: Tensor(a) if isinstance(a, (jax.Array, jnp.ndarray)) else a,
+        tree)
+
+
+def _pred_value(pred):
+    if isinstance(pred, Tensor):
+        return pred.data
+    return pred
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """Run true_fn() or false_fn() (both callables of no arguments).
+
+    Both branches must return the same structure of Tensors (reference
+    control_flow.py:2297 contract)."""
+    pd = _pred_value(pred)
+    if not _is_tracer(pd):
+        chosen = true_fn if bool(np_bool(pd)) else false_fn
+        return chosen() if chosen is not None else None
+    if true_fn is None or false_fn is None:
+        raise ValueError("traced cond requires both true_fn and false_fn")
+    out = jax.lax.cond(jnp.asarray(pd).astype(bool).reshape(()),
+                       lambda _: _to_data(true_fn()),
+                       lambda _: _to_data(false_fn()),
+                       operand=None)
+    return _to_tensor(out)
+
+
+def np_bool(x):
+    import numpy as np
+
+    return bool(np.asarray(x))
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """paddle.static.nn.while_loop (reference control_flow.py:1064).
+
+    loop_vars is a list; body returns the same-length list. Shapes must be
+    loop-invariant under trace (XLA requirement; the reference's WhileOp allows
+    LoD growth, which has no TPU-legal equivalent — use padded buffers)."""
+    if not isinstance(loop_vars, (list, tuple)) or not loop_vars:
+        raise ValueError("loop_vars must be a non-empty list")
+    probe = cond_fn(*loop_vars)
+    pd = _pred_value(probe)
+    if not _is_tracer(pd):
+        vars_ = list(loop_vars)
+        while np_bool(_pred_value(cond_fn(*vars_))):
+            out = body_fn(*vars_)
+            vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+        return vars_
+    flat = _to_data(list(loop_vars))
+
+    def c(vs):
+        return jnp.asarray(_pred_value(cond_fn(*_to_tensor(vs)))).reshape(())
+
+    def b(vs):
+        out = body_fn(*_to_tensor(vs))
+        out = list(out) if isinstance(out, (list, tuple)) else [out]
+        return _to_data(out)
+
+    out = jax.lax.while_loop(c, b, flat)
+    return _to_tensor(out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First true predicate wins (reference control_flow.py case)."""
+    if not pred_fn_pairs:
+        raise ValueError("pred_fn_pairs must be non-empty")
+    preds = [_pred_value(p) for p, _ in pred_fn_pairs]
+    if not any(_is_tracer(p) for p in preds):
+        for p, fn in pred_fn_pairs:
+            if np_bool(_pred_value(p)):
+                return fn()
+        if default is None:
+            return pred_fn_pairs[-1][1]()
+        return default()
+    # traced: right-fold into nested lax.cond
+    tail = default if default is not None else pred_fn_pairs[-1][1]
+
+    def build(i):
+        if i == len(pred_fn_pairs):
+            return tail
+        p, fn = pred_fn_pairs[i]
+        return lambda: cond(p, fn, build(i + 1))
+
+    return build(0)()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index (reference control_flow.py switch_case).
+
+    branch_fns: dict {index: fn} or list of (index, fn) or list of fns."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    idx = _pred_value(branch_index)
+    if not _is_tracer(idx):
+        import numpy as np
+
+        i = int(np.asarray(idx))
+        for k, fn in items:
+            if k == i:
+                return fn()
+        if default is not None:
+            return default()
+        return items[-1][1]()
+    fallback = default if default is not None else items[-1][1]
+    keys = jnp.asarray([k for k, _ in items])
+    # map arbitrary branch keys to dense positions; miss -> fallback slot
+    dense = jnp.sum(jnp.where(keys == jnp.asarray(idx).reshape(()),
+                              jnp.arange(len(items)), 0))
+    hit = jnp.any(keys == jnp.asarray(idx).reshape(()))
+    branches = [lambda _, f=fn: _to_data(f()) for _, fn in items]
+    branches.append(lambda _: _to_data(fallback()))
+    sel = jnp.where(hit, dense, len(items))
+    out = jax.lax.switch(sel, branches, None)
+    return _to_tensor(out)

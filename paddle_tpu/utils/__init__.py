@@ -1,0 +1,34 @@
+"""paddle.utils (reference: python/paddle/utils/)."""
+from . import cpp_extension  # noqa: F401
+from .custom_op import register_op, get_custom_op  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    """reference utils/lazy_import.py try_import."""
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(err_msg or f"{module_name} is not installed")
+
+
+def run_check():
+    """reference utils/install_check.py: sanity-check the device path."""
+    import jax
+    import numpy as np
+
+    from ..core.tensor import Tensor
+
+    devs = jax.devices()
+    x = Tensor(np.ones((2, 2), "float32"))
+    y = (x @ x).numpy()
+    assert y.shape == (2, 2)
+    print(f"paddle_tpu is installed successfully! devices: {devs}")
+
+
+def deprecated(update_to="", since="", reason=""):  # decorator passthrough
+    def deco(fn):
+        return fn
+
+    return deco

@@ -1,0 +1,53 @@
+"""paddle.utils.cpp_extension (reference: python/paddle/utils/cpp_extension/).
+
+The reference JIT-compiles C++/CUDA custom kernels into a loadable module.
+Here host-side native extensions still compile (g++ via ctypes, e.g. the
+TCPStore daemon follows this path), but *device* kernels target TPU through
+pallas/jax functions registered with paddle_tpu.utils.custom_op.register_op —
+a C++ CUDA kernel has no TPU lowering, so `load` builds host libraries only.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional
+
+
+class CppExtension:
+    def __init__(self, sources: List[str], extra_compile_args=None, **kw):
+        self.sources = sources
+        self.extra_compile_args = extra_compile_args or []
+
+
+def CUDAExtension(*args, **kwargs):
+    raise NotImplementedError(
+        "CUDA kernels have no TPU lowering; write the kernel as jax/pallas "
+        "and register it with paddle_tpu.utils.register_op")
+
+
+def load(name: str, sources: List[str], extra_cxx_cflags: Optional[List[str]] = None,
+         build_directory: Optional[str] = None, verbose: bool = False, **kwargs):
+    """Compile host C++ sources into a shared library and return the ctypes
+    handle (the reference returns an imported python module of generated stubs;
+    callers here bind the C ABI directly)."""
+    build_dir = build_directory or os.path.join(
+        os.path.dirname(os.path.abspath(sources[0])), "_build")
+    os.makedirs(build_dir, exist_ok=True)
+    out = os.path.join(build_dir, f"lib{name}.so")
+    newest_src = max(os.path.getmtime(s) for s in sources)
+    if not os.path.exists(out) or os.path.getmtime(out) < newest_src:
+        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+               + (extra_cxx_cflags or []) + list(sources)
+               + ["-o", out + ".tmp", "-lpthread"])
+        if verbose:
+            print(" ".join(cmd))
+        proc = subprocess.run(cmd, capture_output=True, text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"cpp_extension build failed:\n{proc.stderr}")
+        os.replace(out + ".tmp", out)
+    return ctypes.CDLL(out)
+
+
+def get_build_directory():
+    return os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_ext")

@@ -1,0 +1,41 @@
+"""Custom-op registration: the PD_BUILD_OP role, TPU-native.
+
+Reference: paddle/phi/api/ext/op_meta_info.h:539 (OpMetaInfoBuilder
+Inputs/Outputs/SetKernelFn) + python/paddle/utils/cpp_extension (building and
+loading the compiled op). On this framework a "kernel" is a pure jax (or
+pallas_call) function, so registration inserts it straight into the Primitive
+dispatch registry: the op gets the same per-attrs jit cache, AMP hook, profiler
+span, and tape integration as every built-in op, and a custom vjp replaces the
+generated GradNode.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..core.dispatch import Primitive, get_primitive, registry
+
+
+def register_op(name: str, forward: Callable, backward: Optional[Callable] = None,
+                nondiff: bool = False):
+    """Register `forward` (pure jax: arrays in, array/tuple out) as op `name`.
+
+    backward, if given, is a vjp rule ``rule(ct, out, primals, **attrs) ->
+    tuple of input cotangents (None for non-diff inputs)``; without it the op
+    falls back to recompute-vjp through jax.vjp (dispatch.py Primitive.bwd).
+    NOTE: compiled ``pallas_call`` kernels do not support automatic reverse
+    differentiation — pass an explicit ``backward`` (usually a second pallas
+    kernel, see kernels/flash_attention.py) or mark the op ``nondiff=True``.
+
+    Returns the callable op: ``op(*tensors, **attrs) -> Tensor(s)``, the
+    analogue of the python API stub cpp_extension generates for PD_BUILD_OP.
+    """
+    if name in registry():
+        raise ValueError(f"op '{name}' is already registered")
+    prim = Primitive(name, forward, nondiff=nondiff)
+    if backward is not None:
+        prim.defvjp(backward)
+    return prim
+
+
+def get_custom_op(name: str):
+    return get_primitive(name)

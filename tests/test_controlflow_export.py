@@ -1,0 +1,207 @@
+"""Control flow ops + jit.save/load (AOT export) + inference predictor."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.static import nn as static_nn
+from paddle_tpu.static import InputSpec
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+# -- control flow: eager ------------------------------------------------------
+
+def test_cond_eager_and_grad():
+    x = paddle.to_tensor(np.asarray([2.0], "float32"), stop_gradient=False)
+    out = static_nn.cond(paddle.to_tensor(True),
+                         lambda: x * 3.0, lambda: x * 5.0)
+    out.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), [3.0])
+    out2 = static_nn.cond(paddle.to_tensor(False),
+                          lambda: x * 3.0, lambda: x * 5.0)
+    np.testing.assert_allclose(_np(out2), [10.0])
+
+
+def test_while_loop_eager():
+    i = paddle.to_tensor(np.asarray(0, "int32"))
+    s = paddle.to_tensor(np.asarray(0.0, "float32"))
+    i2, s2 = static_nn.while_loop(
+        lambda i, s: i < 5,
+        lambda i, s: [i + 1, s + 2.0],
+        [i, s])
+    assert int(_np(i2)) == 5 and float(_np(s2)) == 10.0
+
+
+def test_case_and_switch_case_eager():
+    x = paddle.ones([2])
+    out = static_nn.case([
+        (paddle.to_tensor(False), lambda: x * 1.0),
+        (paddle.to_tensor(True), lambda: x * 2.0),
+    ], default=lambda: x * 9.0)
+    np.testing.assert_allclose(_np(out), [2, 2])
+    out = static_nn.switch_case(paddle.to_tensor(np.asarray(1, "int32")),
+                                {0: lambda: x * 10.0, 1: lambda: x * 20.0},
+                                default=lambda: x * 0.0)
+    np.testing.assert_allclose(_np(out), [20, 20])
+    out = static_nn.switch_case(paddle.to_tensor(np.asarray(7, "int32")),
+                                {0: lambda: x * 10.0, 1: lambda: x * 20.0},
+                                default=lambda: x * 0.0)
+    np.testing.assert_allclose(_np(out), [0, 0])
+
+
+# -- control flow: traced (inside to_static) ----------------------------------
+
+def test_cond_traced_inside_to_static():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = nn.Linear(4, 4)
+
+        def forward(self, x):
+            # traced predicate: data-dependent branch -> lax.cond
+            return static_nn.cond(x.sum() > 0,
+                                  lambda: self.lin(x),
+                                  lambda: self.lin(x) * 0.0)
+
+    paddle.seed(0)
+    net = Net()
+    st = paddle.jit.to_static(net)
+    xp = paddle.ones([2, 4])
+    xn = paddle.ones([2, 4]) * -1.0
+    np.testing.assert_allclose(_np(st(xp)), _np(net.lin(xp)), rtol=1e-5)
+    np.testing.assert_allclose(_np(st(xn)), 0.0, atol=1e-7)
+
+
+def test_while_loop_traced():
+    @paddle.jit.to_static
+    def f(x):
+        i = paddle.zeros([], dtype="int32")
+        out = static_nn.while_loop(
+            lambda i, acc: i < 3,
+            lambda i, acc: [i + 1, acc * 2.0],
+            [i, x])
+        return out[1]
+
+    x = paddle.ones([3])
+    np.testing.assert_allclose(_np(f(x)), [8, 8, 8], rtol=1e-6)
+
+
+def test_switch_case_traced():
+    @paddle.jit.to_static
+    def f(idx, x):
+        return static_nn.switch_case(idx, {0: lambda: x + 1.0,
+                                           1: lambda: x + 10.0},
+                                     default=lambda: x)
+
+    x = paddle.zeros([2])
+    np.testing.assert_allclose(_np(f(paddle.to_tensor(np.asarray(1, "int32")), x)),
+                               [10, 10])
+    np.testing.assert_allclose(_np(f(paddle.to_tensor(np.asarray(0, "int32")), x)),
+                               [1, 1])
+
+
+# -- jit.save / jit.load ------------------------------------------------------
+
+def _make_net():
+    paddle.seed(7)
+    return nn.Sequential(nn.Linear(8, 16), nn.GELU(), nn.Linear(16, 3))
+
+
+def test_jit_save_load_roundtrip(tmp_path):
+    net = _make_net()
+    net.eval()
+    path = os.path.join(str(tmp_path), "model")
+    paddle.jit.save(net, path, input_spec=[InputSpec([4, 8], "float32")])
+    assert os.path.exists(path + ".pdmodel")
+    assert os.path.exists(path + ".pdiparams")
+
+    loaded = paddle.jit.load(path)
+    x = paddle.randn([4, 8])
+    np.testing.assert_allclose(_np(loaded(x)), _np(net(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_jit_load_dynamic_batch(tmp_path):
+    net = _make_net()
+    path = os.path.join(str(tmp_path), "dyn")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    for bsz in (1, 5, 32):
+        x = paddle.randn([bsz, 8])
+        out = loaded(x)
+        assert out.shape == [bsz, 3]
+        np.testing.assert_allclose(_np(out), _np(net(x)), rtol=1e-5, atol=1e-6)
+
+
+def test_translated_layer_set_state_dict(tmp_path):
+    net = _make_net()
+    path = os.path.join(str(tmp_path), "sd")
+    paddle.jit.save(net, path, input_spec=[InputSpec([2, 8], "float32")])
+    loaded = paddle.jit.load(path)
+    # zero all weights through the state-dict surface; output becomes bias-only
+    sd = loaded.state_dict()
+    zeroed = {k: paddle.zeros(list(v.shape)) for k, v in sd.items()}
+    loaded.set_state_dict(zeroed)
+    x = paddle.randn([2, 8])
+    np.testing.assert_allclose(_np(loaded(x)), 0.0, atol=1e-7)
+
+
+def test_jit_save_requires_spec(tmp_path):
+    with pytest.raises(ValueError):
+        paddle.jit.save(_make_net(), os.path.join(str(tmp_path), "x"))
+
+
+# -- inference predictor ------------------------------------------------------
+
+def test_inference_predictor(tmp_path):
+    from paddle_tpu import inference
+
+    net = _make_net()
+    net.eval()
+    path = os.path.join(str(tmp_path), "deploy")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 8], "float32")])
+
+    config = inference.Config(path + ".pdmodel")
+    predictor = inference.create_predictor(config)
+    names = predictor.get_input_names()
+    assert names == ["x0"]
+    x = np.random.default_rng(0).standard_normal((6, 8)).astype("float32")
+    handle = predictor.get_input_handle("x0")
+    handle.copy_from_cpu(x)
+    outs = predictor.run()
+    assert outs[0].shape == (6, 3)
+    ref = _np(net(paddle.to_tensor(x)))
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+    # positional style
+    outs2 = predictor.run([x])
+    np.testing.assert_allclose(outs2[0], outs[0], rtol=1e-6)
+
+
+def test_translated_layer_accepts_original_keys(tmp_path):
+    """Nested-model state dicts round-trip through jit.load (dotted keys)."""
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    paddle.seed(1)
+    net = Net()
+    path = os.path.join(str(tmp_path), "nested")
+    paddle.jit.save(net, path, input_spec=[InputSpec([None, 4], "float32")])
+    loaded = paddle.jit.load(path)
+    assert set(loaded.state_dict()) == set(net.state_dict())
+    # retrain source, push new weights into the loaded artifact
+    net.fc.weight.set_value(np.asarray(net.fc.weight.data) * 3.0)
+    missing, unexpected = loaded.set_state_dict(net.state_dict())
+    assert not missing and not unexpected
+    x = paddle.randn([2, 4])
+    np.testing.assert_allclose(np.asarray(loaded(x).data),
+                               np.asarray(net(x).data), rtol=1e-5, atol=1e-6)

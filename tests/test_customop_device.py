@@ -1,0 +1,152 @@
+"""Custom op registration (PD_BUILD_OP role) + pluggable device C-ABI."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_register_op_forward_and_recompute_vjp():
+    import jax.numpy as jnp
+
+    op = paddle.utils.register_op("custom_square_plus",
+                                  lambda x, y: x * x + y)
+    a = paddle.to_tensor(np.asarray([2.0, 3.0], "float32"), stop_gradient=False)
+    b = paddle.to_tensor(np.asarray([1.0, 1.0], "float32"), stop_gradient=False)
+    out = op(a, b)
+    np.testing.assert_allclose(_np(out), [5.0, 10.0])
+    out.sum().backward()
+    np.testing.assert_allclose(_np(a.grad), [4.0, 6.0])  # d/dx x^2 = 2x
+    np.testing.assert_allclose(_np(b.grad), [1.0, 1.0])
+
+
+def test_register_op_custom_backward():
+    import jax.numpy as jnp
+
+    calls = {"bwd": 0}
+
+    def fwd(x):
+        return jnp.exp(x)
+
+    def bwd(ct, out, primals):
+        calls["bwd"] += 1
+        return (ct * out * 2.0,)  # deliberately 2x the true grad
+
+    op = paddle.utils.register_op("custom_exp2grad", fwd, backward=bwd)
+    x = paddle.to_tensor(np.asarray([0.0, 1.0], "float32"), stop_gradient=False)
+    y = op(x)
+    y.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), 2.0 * np.exp([0.0, 1.0]), rtol=1e-5)
+
+
+def test_register_op_duplicate_raises():
+    paddle.utils.register_op("custom_once", lambda x: x)
+    with pytest.raises(ValueError):
+        paddle.utils.register_op("custom_once", lambda x: x)
+
+
+def test_register_pallas_kernel_as_op():
+    """A pallas_call kernel goes through the same registration path."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        from jax.experimental import pallas as pl
+    except ImportError:
+        pytest.skip("pallas unavailable")
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    def fwd(x):
+        return pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            interpret=jax.default_backend() == "cpu")(x)
+
+    op = paddle.utils.register_op("custom_pallas_double", fwd)
+    x = paddle.to_tensor(np.asarray([1.0, 2.5], "float32"))
+    np.testing.assert_allclose(_np(op(x)), [2.0, 5.0])
+
+
+def test_fake_device_plugin_roundtrip():
+    from paddle_tpu import device
+
+    path = device.build_fake_device()
+    rt = device.load_custom_device(path)
+    assert rt.type_name == "fake_cpu"
+    assert device.is_compiled_with_custom_device("fake_cpu")
+    assert "fake_cpu" in device.get_all_custom_device_type()
+    assert rt.device_count() == 2
+
+    total0, free0 = rt.memory_stats(0)
+    ptr = rt.memory_allocate(0, 4096)
+    total1, free1 = rt.memory_stats(0)
+    assert total1 == total0 and free1 == free0 - 4096
+
+    payload = bytes(range(256)) * 16
+    rt.copy_h2d(0, ptr, payload)
+    back = rt.copy_d2h(0, ptr, len(payload))
+    assert back == payload
+    rt.synchronize(0)
+    rt.memory_deallocate(0, ptr, 4096)
+    _, free2 = rt.memory_stats(0)
+    assert free2 == free0
+
+
+def test_run_check():
+    paddle.utils.run_check()
+
+
+def test_auto_parallel_shard_tensor_and_op():
+    import jax
+    import paddle_tpu.distributed as dist
+    from jax.sharding import PartitionSpec as P
+
+    env = dist.init_mesh(dp=2, mp=4)
+    try:
+        x = paddle.randn([8, 16])
+        dist.shard_tensor(x, dist_attr={"dims_mapping": [0, 1]})  # dp, mp
+        assert x.data.sharding.spec == P("dp", "mp")
+        y = paddle.randn([8, 16])
+        dist.shard_tensor(y, shard_spec=["dp", None])
+        assert y.data.sharding.spec == P("dp", None)
+
+        pm = dist.ProcessMesh()
+        assert pm.topology and pm.dim_names == ["dp", "mp"]
+
+        @paddle.jit.to_static
+        def f(a):
+            mm = a.matmul(a.transpose([1, 0]))
+            return dist.shard_op(lambda t: t * 2.0,
+                                 out_shard_specs=[["dp", None]])(mm)
+
+        out = f(x)
+        assert out.shape == [8, 8]
+    finally:
+        dist.reset_mesh()
+
+
+def test_elastic_manager_heartbeat_and_watch():
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.fleet import ElasticManager, ElasticStatus
+
+    store = dist.TCPStore(is_master=True, world_size=2)
+    try:
+        m0 = ElasticManager(store, rank=0, world_size=2, min_np=1,
+                            heartbeat_interval=0.1, timeout=2.0).register()
+        # only one of two workers alive -> RESTART with the scale callback
+        events = []
+        m0.on_scale(lambda alive: events.append(alive))
+        assert m0.watch() == ElasticStatus.RESTART
+        assert events == [[0]]
+        # second worker joins -> HOLD (steady state)
+        m1 = ElasticManager(store, rank=1, world_size=2, min_np=1,
+                            heartbeat_interval=0.1, timeout=2.0).register()
+        assert m0.watch() == ElasticStatus.HOLD
+        assert sorted(m0.alive_workers()) == [0, 1]
+        m0.exit(); m1.exit()
+    finally:
+        store.close()

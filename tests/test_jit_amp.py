@@ -134,7 +134,12 @@ def test_grad_scaler_skips_on_inf():
 
 
 def test_jit_save(tmp_path):
+    from paddle_tpu.static import InputSpec
+
     net = nn.Linear(2, 2)
-    jit.save(net, str(tmp_path / "model"))
-    sd = paddle.load(str(tmp_path / "model.pdparams"))
-    assert "weight" in sd
+    jit.save(net, str(tmp_path / "model"),
+             input_spec=[InputSpec([None, 2], "float32")])
+    loaded = jit.load(str(tmp_path / "model"))
+    x = paddle.randn([3, 2])
+    np.testing.assert_allclose(np.asarray(loaded(x).data),
+                               np.asarray(net(x).data), rtol=1e-5, atol=1e-6)
