@@ -1,0 +1,11 @@
+"""paddle.incubate (reference: python/paddle/incubate/)."""
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """reference incubate/operators/softmax_mask_fuse.py — XLA fuses these."""
+    from ..nn import functional as F
+
+    return F.softmax(x + mask, axis=-1)

@@ -1,0 +1,124 @@
+"""Fused transformer layers (reference: python/paddle/incubate/nn/
+layer/fused_transformer.py — FusedMultiHeadAttention wrapping
+fused_attention_op.cu, FusedFeedForward wrapping fused_feedforward_op.cu).
+
+TPU-native: "fusion" is the flash-attention pallas kernel plus XLA's automatic
+elementwise fusion; these layers are the single-dispatch equivalents of the
+reference's monolithic CUDA ops (pre/post layernorm + residual + dropout in
+one compiled region).
+"""
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...nn import functional as F
+from ...nn.layer.layers import Layer
+from ...ops import linalg, manipulation as M
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """reference incubate/nn/layer/fused_transformer.py FusedMultiHeadAttention:
+    layernorm (pre or post) + QKV projection + flash attention + out
+    projection + residual + dropout, one compiled region."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False, qkv_weight_attr=None,
+                 qkv_bias_attr=None, linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None, ln_scale_attr=None,
+                 ln_bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.qkv = nn.Linear(embed_dim, 3 * embed_dim,
+                             weight_attr=qkv_weight_attr, bias_attr=qkv_bias_attr)
+        self.out_proj = nn.Linear(embed_dim, embed_dim,
+                                  weight_attr=linear_weight_attr,
+                                  bias_attr=linear_bias_attr)
+        self.norm = nn.LayerNorm(embed_dim, epsilon=epsilon)
+        self.dropout = nn.Dropout(dropout_rate)
+        self.attn_dropout_rate = attn_dropout_rate
+
+    def forward(self, query, attn_mask=None, cache=None):
+        b, s, _ = query.shape
+        residual = query
+        x = self.norm(query) if self.normalize_before else query
+        qkv = self.qkv(x)  # [b, s, 3e]
+        qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
+        q = M.squeeze(M.slice(qkv, [2], [0], [1]), [2])
+        k = M.squeeze(M.slice(qkv, [2], [1], [2]), [2])
+        v = M.squeeze(M.slice(qkv, [2], [2], [3]), [2])
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask,
+            dropout_p=self.attn_dropout_rate if self.training else 0.0,
+            is_causal=False)
+        out = M.reshape(out, [b, s, self.embed_dim])
+        out = self.dropout(self.out_proj(out))
+        out = residual + out
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    """reference FusedFeedForward: ln + linear + act + dropout + linear +
+    residual (+ ln) — XLA fuses the elementwise chain into the matmuls."""
+
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None, ln1_bias_attr=None,
+                 ln2_scale_attr=None, ln2_bias_attr=None, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = nn.Linear(d_model, dim_feedforward,
+                                 weight_attr=linear1_weight_attr,
+                                 bias_attr=linear1_bias_attr)
+        self.linear2 = nn.Linear(dim_feedforward, d_model,
+                                 weight_attr=linear2_weight_attr,
+                                 bias_attr=linear2_bias_attr)
+        self.norm = nn.LayerNorm(d_model, epsilon=epsilon)
+        self.dropout1 = nn.Dropout(act_dropout_rate if act_dropout_rate
+                                   is not None else dropout_rate)
+        self.dropout2 = nn.Dropout(dropout_rate)
+        self.activation = getattr(F, activation)
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.norm(src) if self.normalize_before else src
+        x = self.dropout1(self.activation(self.linear1(x)))
+        x = self.dropout2(self.linear2(x))
+        x = residual + x
+        if not self.normalize_before:
+            x = self.norm(x)
+        return x
+
+
+class FusedTransformerEncoderLayer(Layer):
+    """reference FusedTransformerEncoderLayer = fused MHA + fused FFN."""
+
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead,
+            dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate if attn_dropout_rate is not None
+            else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        return self.ffn(self.fused_attn(src, attn_mask=src_mask))

@@ -1,0 +1,139 @@
+"""Incubate optimizers (reference: python/paddle/incubate/optimizer/):
+LookAhead (lookahead.py), ModelAverage (modelaverage.py),
+DistributedFusedLamb (distributed_fused_lamb.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Lamb, Optimizer
+
+
+class LookAhead(Optimizer):
+    """k-step lookahead wrapper: slow weights interpolate toward the inner
+    optimizer's fast weights every k steps (reference lookahead.py)."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._parameter_list = inner_optimizer._parameter_list
+        self._slow = None
+        self._step_count = 0
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        if self._slow is None:
+            self._slow = [p.data for p in self._parameter_list
+                          if not p.stop_gradient]
+        self.inner_optimizer.step()
+        self._step_count += 1
+        if self._step_count % self.k == 0:
+            fast_params = [p for p in self._parameter_list if not p.stop_gradient]
+            new_slow = []
+            for p, slow in zip(fast_params, self._slow):
+                merged = slow + self.alpha * (p.data - slow)
+                p.data = merged
+                new_slow.append(merged)
+            self._slow = new_slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kwargs):
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["lookahead_step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.pop("lookahead_step", 0))
+        self.inner_optimizer.set_state_dict(state_dict)
+
+
+class ModelAverage(Optimizer):
+    """Maintains a running average of parameters for evaluation
+    (reference modelaverage.py: apply()/restore() context)."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000, name=None):
+        if parameters is None:
+            raise ValueError("parameters must be provided")
+        self._parameter_list = list(parameters)
+        self.rate = float(average_window_rate)
+        self.min_w = min_average_window
+        self.max_w = max_average_window
+        self._sums = [jnp.zeros_like(p.data) for p in self._parameter_list]
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        """Accumulate the current weights (call after the inner optimizer)."""
+        self._sums = [s + p.data for s, p in zip(self._sums, self._parameter_list)]
+        self._count += 1
+        window = max(self.min_w, min(self.max_w,
+                                     int(self._count * self.rate) or 1))
+        if self._count > window:  # slide: decay old contributions
+            scale = window / self._count
+            self._sums = [s * scale for s in self._sums]
+            self._count = window
+
+    def apply(self, executor=None, need_restore=True):
+        """Swap in averaged weights (context-manager style like the ref)."""
+        if self._count == 0:
+            return _Restore(self, None)
+        self._backup = [p.data for p in self._parameter_list]
+        for p, s in zip(self._parameter_list, self._sums):
+            p.data = (s / self._count).astype(p.data.dtype)
+        return _Restore(self, self._backup if need_restore else None)
+
+    def restore(self, executor=None):
+        if self._backup is not None:
+            for p, b in zip(self._parameter_list, self._backup):
+                p.data = b
+            self._backup = None
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+
+class _Restore:
+    def __init__(self, avg, backup):
+        self.avg = avg
+        self.backup = backup
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self.backup is not None:
+            self.avg.restore()
+        return False
+
+
+class DistributedFusedLamb(Lamb):
+    """reference incubate/optimizer/distributed_fused_lamb.py: Lamb whose
+    per-param moments/trust-ratio math runs fused. Here every optimizer already
+    compiles all param updates into one XLA executable (optimizer.py
+    _get_fused), so this is Lamb with the distributed flags accepted."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, clip_after_allreduce=True,
+                 is_grad_scaled_by_nranks=True, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 name=None):
+        super().__init__(learning_rate, lamb_weight_decay, beta1, beta2,
+                         epsilon, parameters, grad_clip,
+                         exclude_from_weight_decay_fn, name)

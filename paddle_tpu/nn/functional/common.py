@@ -378,37 +378,45 @@ def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     )
 
 
-@primitive("adaptive_avg_pool2d_op")
-def _adaptive_avg_pool2d(x, *, out_hw):
+def _adaptive_bins(size, out):
+    """torch/paddle adaptive pooling bin edges: start=floor(i*s/o),
+    end=ceil((i+1)*s/o). Static python ints — fine under jit."""
+    return [(i * size // out, -(-(i + 1) * size // out)) for i in range(out)]
+
+
+def _adaptive_pool2d_body(x, out_hw, reduce_fn):
+    """Shared divisible-fast-path + general bin loop (NCHW)."""
     n, c, h, w = x.shape
     oh, ow = out_hw
-    # restrict to the divisible case (covers the model zoo); general case later
-    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
-    return jnp.mean(x, axis=(3, 5))
+    if h % oh == 0 and w % ow == 0:  # fast path: one reshape-reduce
+        return reduce_fn(x.reshape(n, c, oh, h // oh, ow, w // ow), (3, 5))
+    rows = []
+    for hs, he in _adaptive_bins(h, oh):
+        cols = [reduce_fn(x[:, :, hs:he, ws:we], (2, 3))
+                for ws, we in _adaptive_bins(w, ow)]
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+@primitive("adaptive_avg_pool2d_op")
+def _adaptive_avg_pool2d(x, *, out_hw):
+    return _adaptive_pool2d_body(x, out_hw, lambda v, ax: jnp.mean(v, axis=ax))
 
 
 def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
-    out_hw = _pair(output_size)
-    h, w = x.shape[2], x.shape[3]
-    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
-        return _adaptive_avg_pool2d(x, out_hw=out_hw)
-    raise NotImplementedError("adaptive_avg_pool2d with non-divisible sizes")
-
-
-def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
-    out_hw = _pair(output_size)
-    h, w = x.shape[2], x.shape[3]
-    if h % out_hw[0] == 0 and w % out_hw[1] == 0:
-        return _adaptive_max_pool2d(x, out_hw=out_hw)
-    raise NotImplementedError("adaptive_max_pool2d with non-divisible sizes")
+    return _adaptive_avg_pool2d(x, out_hw=_pair(output_size))
 
 
 @primitive("adaptive_max_pool2d_op")
-def _adaptive_max_pool2d(x, *, out_hw):
-    n, c, h, w = x.shape
-    oh, ow = out_hw
-    x = x.reshape(n, c, oh, h // oh, ow, w // ow)
-    return jnp.max(x, axis=(3, 5))
+def _adaptive_max_pool2d_any(x, *, out_hw):
+    return _adaptive_pool2d_body(x, out_hw, lambda v, ax: jnp.max(v, axis=ax))
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    if return_mask:
+        raise NotImplementedError(
+            "adaptive_max_pool2d return_mask=True is not supported yet")
+    return _adaptive_max_pool2d_any(x, out_hw=_pair(output_size))
 
 
 @primitive("interpolate_nearest_op")

@@ -131,3 +131,124 @@ class Cifar100(Cifar10):
                     data = d[b"data"].reshape(-1, 3, 32, 32)
                     return data, np.asarray(d[b"fine_labels"], dtype=np.int64)
         raise FileNotFoundError(name)
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (reference: python/paddle/vision/datasets/flowers.py).
+
+    data_file: 102flowers.tgz of jpg images; label_file: imagelabels.mat;
+    setid_file: setid.mat (train 'trnid' / valid 'valid' / test 'tstid').
+    """
+
+    MODE_KEY = {"train": "trnid", "valid": "valid", "test": "tstid"}
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=False, backend="pil"):
+        assert mode in self.MODE_KEY, f"mode must be one of {list(self.MODE_KEY)}"
+        for path, name in ((data_file, "data_file (102flowers.tgz)"),
+                           (label_file, "label_file (imagelabels.mat)"),
+                           (setid_file, "setid_file (setid.mat)")):
+            if path is None or not os.path.exists(path):
+                raise RuntimeError(
+                    f"Flowers: download is unavailable in this environment; "
+                    f"provide {name}")
+        import scipy.io
+
+        self.transform = transform
+        labels = scipy.io.loadmat(label_file)["labels"].ravel()
+        ids = scipy.io.loadmat(setid_file)[self.MODE_KEY[mode]].ravel()
+        self.indexes = [int(i) for i in ids]
+        self.labels = {int(i): int(labels[int(i) - 1]) - 1 for i in ids}
+        self._tar_path = data_file
+        self._tar = None  # opened lazily per process (picklable for workers)
+        self._members = {}
+        with tarfile.open(data_file) as tf:
+            for m in tf.getmembers():
+                base = os.path.basename(m.name)
+                if base.startswith("image_") and base.endswith(".jpg"):
+                    self._members[int(base[6:-4])] = m.name
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar"] = None  # TarFile handles don't pickle across fork/spawn
+        return state
+
+    def _archive(self):
+        if self._tar is None:
+            self._tar = tarfile.open(self._tar_path)
+        return self._tar
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+
+        img_id = self.indexes[idx]
+        raw = self._archive().extractfile(self._members[img_id]).read()
+        img = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(self.labels[img_id])
+
+    def __len__(self):
+        return len(self.indexes)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC2012 segmentation (reference: python/paddle/text? no —
+    python/paddle/vision/datasets/voc2012.py). data_file: the VOCtrainval
+    tarball; yields (image, segmentation label) arrays."""
+
+    SPLIT_DIR = "VOCdevkit/VOC2012/ImageSets/Segmentation"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="pil"):
+        assert mode in ("train", "valid", "test")
+        if data_file is None or not os.path.exists(data_file):
+            raise RuntimeError(
+                "VOC2012: download is unavailable in this environment; provide "
+                "data_file (VOCtrainval_11-May-2012.tar)")
+        self.transform = transform
+        split = {"train": "train.txt", "valid": "val.txt",
+                 "test": "trainval.txt"}[mode]
+        self._tar_path = data_file
+        with tarfile.open(data_file) as tf:
+            names = tf.getnames()
+            prefix = ""
+            for n in names:
+                if n.endswith(f"{self.SPLIT_DIR}/{split}"):
+                    prefix = n[: -len(f"{self.SPLIT_DIR}/{split}")]
+                    ids = tf.extractfile(n).read().decode().split()
+                    break
+            else:
+                raise RuntimeError(f"VOC2012: split list {split} not in archive")
+        self._prefix = prefix
+        self._tar = None  # opened lazily per process (picklable for workers)
+        self.ids = ids
+
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_tar"] = None
+        return state
+
+    def _archive(self):
+        if self._tar is None:
+            self._tar = tarfile.open(self._tar_path)
+        return self._tar
+
+    def __getitem__(self, idx):
+        from PIL import Image
+        import io as _io
+
+        name = self.ids[idx]
+        base = f"{self._prefix}VOCdevkit/VOC2012"
+        tf = self._archive()
+        img_raw = tf.extractfile(f"{base}/JPEGImages/{name}.jpg").read()
+        lbl_raw = tf.extractfile(f"{base}/SegmentationClass/{name}.png").read()
+        img = np.asarray(Image.open(_io.BytesIO(img_raw)).convert("RGB"))
+        label = np.asarray(Image.open(_io.BytesIO(lbl_raw)))
+        if self.transform:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.ids)

@@ -6,3 +6,10 @@ from .small import (  # noqa: F401
     LeNet, AlexNet, alexnet, VGG, vgg11, vgg13, vgg16, vgg19,
     MobileNetV2, mobilenet_v2,
 )
+from .extra import (  # noqa: F401
+    DenseNet, densenet121, densenet161, densenet169, densenet201, densenet264,
+    GoogLeNet, googlenet, InceptionV3, inception_v3,
+    ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33, shufflenet_v2_x0_5,
+    shufflenet_v2_x1_0, shufflenet_v2_x1_5, shufflenet_v2_x2_0,
+    shufflenet_v2_swish, SqueezeNet, squeezenet1_0, squeezenet1_1,
+)

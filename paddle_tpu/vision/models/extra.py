@@ -1,0 +1,529 @@
+"""DenseNet / GoogLeNet / InceptionV3 / ShuffleNetV2 / SqueezeNet
+(reference: python/paddle/vision/models/{densenet,googlenet,inceptionv3,
+shufflenetv2,squeezenet}.py — same published architectures, condensed
+jax-native re-expressions; channel recipes are the papers' standards).
+"""
+from __future__ import annotations
+
+import math
+
+from ... import nn
+from ...ops import manipulation as M
+
+
+def _flatten(x):
+    return M.flatten(x, 1)
+
+
+# -- DenseNet ------------------------------------------------------------------
+
+class _DenseLayer(nn.Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv1 = nn.Conv2D(in_c, bn_size * growth_rate, 1, bias_attr=False)
+        self.norm2 = nn.BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = nn.Conv2D(bn_size * growth_rate, growth_rate, 3, padding=1,
+                               bias_attr=False)
+        self.dropout = nn.Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return M.concat([x, out], axis=1)
+
+
+class _DenseBlock(nn.Layer):
+    def __init__(self, num_layers, in_c, bn_size, growth_rate, dropout):
+        super().__init__()
+        self.layers = nn.LayerList([
+            _DenseLayer(in_c + i * growth_rate, growth_rate, bn_size, dropout)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for l in self.layers:
+            x = l(x)
+        return x
+
+
+class _Transition(nn.Layer):
+    def __init__(self, in_c, out_c):
+        super().__init__()
+        self.norm = nn.BatchNorm2D(in_c)
+        self.relu = nn.ReLU()
+        self.conv = nn.Conv2D(in_c, out_c, 1, bias_attr=False)
+        self.pool = nn.AvgPool2D(2, 2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(nn.Layer):
+    """reference vision/models/densenet.py DenseNet."""
+
+    CFG = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+           169: (6, 12, 32, 32), 201: (6, 12, 48, 32), 264: (6, 12, 64, 48)}
+
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        block_cfg = self.CFG[layers]
+        growth = 48 if layers == 161 else 32
+        init_c = 96 if layers == 161 else 64
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, init_c, 7, stride=2, padding=3, bias_attr=False),
+            nn.BatchNorm2D(init_c), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))
+        blocks = []
+        c = init_c
+        for i, n in enumerate(block_cfg):
+            blocks.append(_DenseBlock(n, c, bn_size, growth, dropout))
+            c += n * growth
+            if i != len(block_cfg) - 1:
+                blocks.append(_Transition(c, c // 2))
+                c //= 2
+        self.features = nn.Sequential(*blocks)
+        self.norm = nn.BatchNorm2D(c)
+        self.relu = nn.ReLU()
+        self.with_pool = with_pool
+        self.num_classes = num_classes
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Linear(c, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm(self.features(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(_flatten(x))
+        return x
+
+
+def _densenet(layers, pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return DenseNet(layers=layers, **kw)
+
+
+def densenet121(pretrained=False, **kw):
+    return _densenet(121, pretrained, **kw)
+
+
+def densenet161(pretrained=False, **kw):
+    return _densenet(161, pretrained, **kw)
+
+
+def densenet169(pretrained=False, **kw):
+    return _densenet(169, pretrained, **kw)
+
+
+def densenet201(pretrained=False, **kw):
+    return _densenet(201, pretrained, **kw)
+
+
+def densenet264(pretrained=False, **kw):
+    return _densenet(264, pretrained, **kw)
+
+
+# -- GoogLeNet -----------------------------------------------------------------
+
+class _Inception(nn.Layer):
+    def __init__(self, in_c, c1, c2, c3, c4):
+        super().__init__()
+        self.b1 = nn.Sequential(nn.Conv2D(in_c, c1, 1), nn.ReLU())
+        self.b2 = nn.Sequential(nn.Conv2D(in_c, c2[0], 1), nn.ReLU(),
+                                nn.Conv2D(c2[0], c2[1], 3, padding=1), nn.ReLU())
+        self.b3 = nn.Sequential(nn.Conv2D(in_c, c3[0], 1), nn.ReLU(),
+                                nn.Conv2D(c3[0], c3[1], 5, padding=2), nn.ReLU())
+        self.b4 = nn.Sequential(nn.MaxPool2D(3, 1, padding=1),
+                                nn.Conv2D(in_c, c4, 1), nn.ReLU())
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)], axis=1)
+
+
+class GoogLeNet(nn.Layer):
+    """reference vision/models/googlenet.py (returns main + 2 aux logits in
+    train mode like the reference)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, 64, 7, stride=2, padding=3), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1),
+            nn.Conv2D(64, 64, 1), nn.ReLU(),
+            nn.Conv2D(64, 192, 3, padding=1), nn.ReLU(),
+            nn.MaxPool2D(3, 2, padding=1))
+        self.i3a = _Inception(192, 64, (96, 128), (16, 32), 32)
+        self.i3b = _Inception(256, 128, (128, 192), (32, 96), 64)
+        self.pool3 = nn.MaxPool2D(3, 2, padding=1)
+        self.i4a = _Inception(480, 192, (96, 208), (16, 48), 64)
+        self.i4b = _Inception(512, 160, (112, 224), (24, 64), 64)
+        self.i4c = _Inception(512, 128, (128, 256), (24, 64), 64)
+        self.i4d = _Inception(512, 112, (144, 288), (32, 64), 64)
+        self.i4e = _Inception(528, 256, (160, 320), (32, 128), 128)
+        self.pool4 = nn.MaxPool2D(3, 2, padding=1)
+        self.i5a = _Inception(832, 256, (160, 320), (32, 128), 128)
+        self.i5b = _Inception(832, 384, (192, 384), (48, 128), 128)
+        if with_pool:
+            self.pool5 = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.2)
+            self.fc = nn.Linear(1024, num_classes)
+            # aux heads (train-mode deep supervision)
+            self.aux1 = self._aux(512, num_classes)
+            self.aux2 = self._aux(528, num_classes)
+
+    @staticmethod
+    def _aux(in_c, num_classes):
+        return nn.Sequential(
+            nn.AdaptiveAvgPool2D(4),
+            nn.Conv2D(in_c, 128, 1), nn.ReLU(),
+            nn.Flatten(), nn.Linear(128 * 16, 1024), nn.ReLU(),
+            nn.Dropout(0.7), nn.Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.i3b(self.i3a(self.stem(x)))
+        x = self.i4a(self.pool3(x))
+        aux1 = self.aux1(x) if self.num_classes > 0 and self.training else None
+        x = self.i4d(self.i4c(self.i4b(x)))
+        aux2 = self.aux2(x) if self.num_classes > 0 and self.training else None
+        x = self.i5b(self.i5a(self.pool4(self.i4e(x))))
+        if self.with_pool:
+            x = self.pool5(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(_flatten(x)))
+        if self.training and self.num_classes > 0:
+            return x, aux1, aux2
+        return x
+
+
+def googlenet(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return GoogLeNet(**kw)
+
+
+# -- InceptionV3 ---------------------------------------------------------------
+
+class _BNConv(nn.Layer):
+    def __init__(self, in_c, out_c, kernel, **kw):
+        super().__init__()
+        self.conv = nn.Conv2D(in_c, out_c, kernel, bias_attr=False, **kw)
+        self.bn = nn.BatchNorm2D(out_c)
+        self.relu = nn.ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _InceptionA(nn.Layer):
+    def __init__(self, in_c, pool_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 64, 1)
+        self.b5 = nn.Sequential(_BNConv(in_c, 48, 1), _BNConv(48, 64, 5, padding=2))
+        self.b3 = nn.Sequential(_BNConv(in_c, 64, 1), _BNConv(64, 96, 3, padding=1),
+                                _BNConv(96, 96, 3, padding=1))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, pool_c, 1))
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], axis=1)
+
+
+class _InceptionB(nn.Layer):  # grid reduction 35->17
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = _BNConv(in_c, 384, 3, stride=2)
+        self.b33 = nn.Sequential(_BNConv(in_c, 64, 1), _BNConv(64, 96, 3, padding=1),
+                                 _BNConv(96, 96, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return M.concat([self.b3(x), self.b33(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(nn.Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 192, 1)
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, c7, 1), _BNConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BNConv(c7, 192, (7, 1), padding=(3, 0)))
+        self.b77 = nn.Sequential(
+            _BNConv(in_c, c7, 1), _BNConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BNConv(c7, c7, (1, 7), padding=(0, 3)),
+            _BNConv(c7, c7, (7, 1), padding=(3, 0)),
+            _BNConv(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        return M.concat([self.b1(x), self.b7(x), self.b77(x), self.bp(x)], axis=1)
+
+
+class _InceptionD(nn.Layer):  # grid reduction 17->8
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = nn.Sequential(_BNConv(in_c, 192, 1), _BNConv(192, 320, 3, stride=2))
+        self.b7 = nn.Sequential(
+            _BNConv(in_c, 192, 1), _BNConv(192, 192, (1, 7), padding=(0, 3)),
+            _BNConv(192, 192, (7, 1), padding=(3, 0)), _BNConv(192, 192, 3, stride=2))
+        self.pool = nn.MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return M.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionE(nn.Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = _BNConv(in_c, 320, 1)
+        self.b3_stem = _BNConv(in_c, 384, 1)
+        self.b3_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.b33_stem = nn.Sequential(_BNConv(in_c, 448, 1),
+                                      _BNConv(448, 384, 3, padding=1))
+        self.b33_a = _BNConv(384, 384, (1, 3), padding=(0, 1))
+        self.b33_b = _BNConv(384, 384, (3, 1), padding=(1, 0))
+        self.bp = nn.Sequential(nn.AvgPool2D(3, 1, padding=1), _BNConv(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        t = self.b33_stem(x)
+        return M.concat([self.b1(x),
+                         M.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+                         M.concat([self.b33_a(t), self.b33_b(t)], axis=1),
+                         self.bp(x)], axis=1)
+
+
+class InceptionV3(nn.Layer):
+    """reference vision/models/inceptionv3.py (299x299 input)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            _BNConv(3, 32, 3, stride=2), _BNConv(32, 32, 3),
+            _BNConv(32, 64, 3, padding=1), nn.MaxPool2D(3, 2),
+            _BNConv(64, 80, 1), _BNConv(80, 192, 3), nn.MaxPool2D(3, 2))
+        self.blocks = nn.Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160), _InceptionC(768, 160),
+            _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = nn.Dropout(0.5)
+            self.fc = nn.Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(_flatten(x)))
+        return x
+
+
+def inception_v3(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return InceptionV3(**kw)
+
+
+# -- ShuffleNetV2 --------------------------------------------------------------
+
+def _channel_shuffle(x, groups):
+    b, c, h, w = x.shape
+    x = M.reshape(x, [b, groups, c // groups, h, w])
+    x = M.transpose(x, [0, 2, 1, 3, 4])
+    return M.reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(nn.Layer):
+    def __init__(self, in_c, out_c, stride, act="relu"):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        act_layer = nn.Swish if act == "swish" else nn.ReLU
+        if stride > 1:
+            self.branch1 = nn.Sequential(
+                nn.Conv2D(in_c, in_c, 3, stride=stride, padding=1, groups=in_c,
+                          bias_attr=False),
+                nn.BatchNorm2D(in_c),
+                nn.Conv2D(in_c, branch_c, 1, bias_attr=False),
+                nn.BatchNorm2D(branch_c), act_layer())
+            b2_in = in_c
+        else:
+            self.branch1 = None
+            b2_in = in_c // 2
+        self.branch2 = nn.Sequential(
+            nn.Conv2D(b2_in, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer(),
+            nn.Conv2D(branch_c, branch_c, 3, stride=stride, padding=1,
+                      groups=branch_c, bias_attr=False),
+            nn.BatchNorm2D(branch_c),
+            nn.Conv2D(branch_c, branch_c, 1, bias_attr=False),
+            nn.BatchNorm2D(branch_c), act_layer())
+
+    def forward(self, x):
+        if self.stride == 1:
+            c = x.shape[1] // 2
+            x1 = x[:, :c]
+            x2 = x[:, c:]
+            out = M.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = M.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    """reference vision/models/shufflenetv2.py."""
+
+    CFG = {0.25: (24, 24, 48, 96, 512), 0.33: (24, 32, 64, 128, 512),
+           0.5: (24, 48, 96, 192, 1024), 1.0: (24, 116, 232, 464, 1024),
+           1.5: (24, 176, 352, 704, 1024), 2.0: (24, 244, 488, 976, 2048)}
+    REPEATS = (4, 8, 4)
+
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        c = self.CFG[scale]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = nn.Sequential(
+            nn.Conv2D(3, c[0], 3, stride=2, padding=1, bias_attr=False),
+            nn.BatchNorm2D(c[0]), nn.ReLU(), nn.MaxPool2D(3, 2, padding=1))
+        stages = []
+        in_c = c[0]
+        for stage_i, reps in enumerate(self.REPEATS):
+            out_c = c[stage_i + 1]
+            stages.append(_ShuffleUnit(in_c, out_c, 2, act))
+            stages.extend(_ShuffleUnit(out_c, out_c, 1, act) for _ in range(reps - 1))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.tail = nn.Sequential(
+            nn.Conv2D(in_c, c[4], 1, bias_attr=False), nn.BatchNorm2D(c[4]),
+            nn.ReLU())
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(c[4], num_classes)
+
+    def forward(self, x):
+        x = self.tail(self.stages(self.stem(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_flatten(x))
+        return x
+
+
+def _shufflenet(scale, act="relu", pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return ShuffleNetV2(scale=scale, act=act, **kw)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kw):
+    return _shufflenet(0.25, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kw):
+    return _shufflenet(0.33, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kw):
+    return _shufflenet(0.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kw):
+    return _shufflenet(1.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kw):
+    return _shufflenet(1.5, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kw):
+    return _shufflenet(2.0, pretrained=pretrained, **kw)
+
+
+def shufflenet_v2_swish(pretrained=False, **kw):
+    return _shufflenet(1.0, act="swish", pretrained=pretrained, **kw)
+
+
+# -- SqueezeNet ----------------------------------------------------------------
+
+class _Fire(nn.Layer):
+    def __init__(self, in_c, squeeze_c, e1_c, e3_c):
+        super().__init__()
+        self.squeeze = nn.Conv2D(in_c, squeeze_c, 1)
+        self.relu = nn.ReLU()
+        self.e1 = nn.Conv2D(squeeze_c, e1_c, 1)
+        self.e3 = nn.Conv2D(squeeze_c, e3_c, 3, padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return M.concat([self.relu(self.e1(x)), self.relu(self.e3(x))], axis=1)
+
+
+class SqueezeNet(nn.Layer):
+    """reference vision/models/squeezenet.py (versions '1.0'/'1.1')."""
+
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 96, 7, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), nn.MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                nn.MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        elif version == "1.1":
+            self.features = nn.Sequential(
+                nn.Conv2D(3, 64, 3, stride=2), nn.ReLU(), nn.MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64), nn.MaxPool2D(3, 2),
+                _Fire(128, 32, 128, 128), _Fire(256, 32, 128, 128),
+                nn.MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        else:
+            raise ValueError(f"unsupported SqueezeNet version {version}")
+        if num_classes > 0:
+            self.classifier_conv = nn.Conv2D(512, num_classes, 1)
+            self.dropout = nn.Dropout(0.5)
+            self.relu = nn.ReLU()
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.relu(self.classifier_conv(self.dropout(x)))
+        if self.with_pool:
+            x = self.pool(x)
+        return _flatten(x)
+
+
+def squeezenet1_0(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return SqueezeNet("1.0", **kw)
+
+
+def squeezenet1_1(pretrained=False, **kw):
+    if pretrained:
+        raise RuntimeError("pretrained weights unavailable in this environment")
+    return SqueezeNet("1.1", **kw)

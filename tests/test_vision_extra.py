@@ -1,0 +1,171 @@
+"""Vision model long tail + Flowers dataset."""
+import io
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+@pytest.mark.parametrize("ctor,size", [
+    (models.densenet121, 64),
+    (models.squeezenet1_0, 64),
+    (models.squeezenet1_1, 64),
+    (models.shufflenet_v2_x0_5, 64),
+    (models.shufflenet_v2_swish, 64),
+])
+def test_extra_models_forward(ctor, size):
+    net = ctor(num_classes=10)
+    net.eval()
+    out = net(paddle.randn([2, 3, size, size]))
+    assert out.shape == [2, 10]
+
+
+def test_googlenet_aux_heads_and_grad():
+    net = models.googlenet(num_classes=5)
+    net.train()
+    x = paddle.randn([2, 3, 96, 96])
+    main, aux1, aux2 = net(x)
+    assert main.shape == aux1.shape == aux2.shape == [2, 5]
+    loss = main.sum() + 0.3 * (aux1.sum() + aux2.sum())
+    loss.backward()
+    assert net.fc.weight.grad is not None
+    net.eval()
+    out = net(x)
+    assert out.shape == [2, 5]
+
+
+def test_inception_v3_forward():
+    net = models.inception_v3(num_classes=7)
+    net.eval()
+    out = net(paddle.randn([1, 3, 299, 299]))
+    assert out.shape == [1, 7]
+
+
+def test_densenet_variants_param_counts_increase():
+    import numpy as _n
+
+    def nparams(net):
+        return sum(int(_n.prod(p.shape)) for p in net.parameters())
+
+    n121 = nparams(models.densenet121(num_classes=0, with_pool=False))
+    n169 = nparams(models.densenet169(num_classes=0, with_pool=False))
+    assert n169 > n121
+
+
+def test_adaptive_pool_non_divisible():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([1, 2, 7, 5])
+    out = F.adaptive_avg_pool2d(x, 3)
+    assert out.shape == [1, 2, 3, 3]
+    # parity with torch-style bin edges on a known input
+    v = np.arange(7, dtype="float32").reshape(1, 1, 7, 1)
+    got = _np(F.adaptive_avg_pool2d(paddle.to_tensor(np.broadcast_to(v, (1, 1, 7, 1)).copy()), (3, 1)))
+    # bins: [0,3) [2,5) [4,7)  -> means 1, 3, 5
+    np.testing.assert_allclose(got.ravel(), [1.0, 3.0, 5.0])
+
+
+def test_flowers_dataset(tmp_path):
+    from PIL import Image
+    import scipy.io
+
+    tar_path = os.path.join(str(tmp_path), "102flowers.tgz")
+    with tarfile.open(tar_path, "w:gz") as tf:
+        for i in range(1, 5):
+            buf = io.BytesIO()
+            Image.fromarray(
+                np.full((8, 8, 3), i * 10, "uint8")).save(buf, format="JPEG")
+            data = buf.getvalue()
+            info = tarfile.TarInfo(f"jpg/image_{i:05d}.jpg")
+            info.size = len(data)
+            tf.addfile(info, io.BytesIO(data))
+    labels = os.path.join(str(tmp_path), "imagelabels.mat")
+    scipy.io.savemat(labels, {"labels": np.asarray([[1, 2, 1, 2]])})
+    setid = os.path.join(str(tmp_path), "setid.mat")
+    scipy.io.savemat(setid, {"trnid": np.asarray([[1, 2, 3]]),
+                             "valid": np.asarray([[4]]),
+                             "tstid": np.asarray([[4]])})
+    ds = datasets.Flowers(data_file=tar_path, label_file=labels,
+                          setid_file=setid, mode="train")
+    assert len(ds) == 3
+    img, label = ds[0]
+    assert img.shape == (8, 8, 3) and label in (0, 1)
+    val = datasets.Flowers(data_file=tar_path, label_file=labels,
+                           setid_file=setid, mode="valid")
+    assert len(val) == 1
+
+
+# -- incubate fused layers + optimizers ---------------------------------------
+
+def test_fused_transformer_encoder_layer():
+    import paddle_tpu.incubate as incubate
+
+    paddle.seed(0)
+    layer = incubate.nn.FusedTransformerEncoderLayer(
+        d_model=32, nhead=4, dim_feedforward=64, dropout_rate=0.0)
+    layer.eval()
+    x = paddle.randn([2, 8, 32])
+    out = layer(x)
+    assert out.shape == [2, 8, 32]
+    out.sum().backward()
+    assert layer.fused_attn.qkv.weight.grad is not None
+
+
+def test_fused_mha_pre_and_post_norm_differ():
+    import paddle_tpu.incubate as incubate
+
+    paddle.seed(1)
+    x = paddle.randn([1, 4, 16])
+    pre = incubate.nn.FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                              attn_dropout_rate=0.0,
+                                              normalize_before=True)
+    post = incubate.nn.FusedMultiHeadAttention(16, 2, dropout_rate=0.0,
+                                               attn_dropout_rate=0.0,
+                                               normalize_before=False)
+    post.set_state_dict(dict(pre.state_dict()))
+    pre.eval(); post.eval()
+    assert not np.allclose(_np(pre(x)), _np(post(x)))
+
+
+def test_lookahead_optimizer():
+    import paddle_tpu.nn as nn
+    import paddle_tpu.nn.functional as F
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    paddle.seed(0)
+    net = nn.Linear(4, 1)
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.randn([16, 4]); y = paddle.randn([16, 1])
+    l0 = None
+    for _ in range(10):
+        loss = F.mse_loss(net(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward(); opt.step(); opt.clear_grad()
+    assert float(loss) < l0
+
+
+def test_model_average_apply_restore():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    net = nn.Linear(2, 1)
+    avg = ModelAverage(parameters=net.parameters(), min_average_window=1,
+                       max_average_window=100)
+    w0 = _np(net.weight).copy()
+    avg.step()
+    net.weight.set_value(w0 + 1.0)
+    avg.step()
+    cur = _np(net.weight).copy()
+    with avg.apply():
+        np.testing.assert_allclose(_np(net.weight), w0 + 0.5, rtol=1e-6)
+    np.testing.assert_allclose(_np(net.weight), cur, rtol=1e-6)
