@@ -84,9 +84,9 @@ def _try_import(names):
 _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "distributed", "regularizer", "autograd", "profiler", "text",
               "distribution", "static", "incubate", "device", "hapi",
-              "inference", "utils"])
+              "inference", "utils", "fft", "signal", "sparse", "onnx"])
 try:
-    from .hapi import Model, summary  # noqa: F401,E402
+    from .hapi import Model, summary, flops  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402
 except ImportError:
     pass

@@ -13,6 +13,11 @@ import os
 from typing import Dict, List
 
 from ..framework import get_device, set_device  # noqa: F401
+from . import memory  # noqa: F401
+from .memory import (  # noqa: F401
+    memory_allocated, max_memory_allocated, memory_reserved, memory_stats,
+    empty_cache,
+)
 
 _CUSTOM: Dict[str, "CustomDeviceRuntime"] = {}
 

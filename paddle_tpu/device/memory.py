@@ -1,0 +1,75 @@
+"""Device memory stats (reference roles: paddle/fluid/memory/stats.h
+StatRegistry + python/paddle/device/cuda/__init__.py memory_allocated /
+max_memory_allocated). TPU-native: PJRT owns the allocator, so stats come from
+`Device.memory_stats()` (live HBM) plus a host-side registry of live
+jax.Arrays for per-process accounting on backends without PJRT stats (CPU).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+__all__ = ["memory_allocated", "max_memory_allocated", "memory_reserved",
+           "memory_stats", "empty_cache"]
+
+_PEAK: Dict[int, int] = {}
+
+
+def _device(device=None):
+    if device is None:
+        return jax.devices()[0]
+    if isinstance(device, int):
+        return jax.devices()[device]
+    if isinstance(device, str):  # paddle-style ids: "gpu:0", "tpu:1", "cpu"
+        idx = int(device.split(":")[1]) if ":" in device else 0
+        return jax.devices()[idx]
+    return device
+
+
+def memory_stats(device=None) -> dict:
+    """Raw PJRT stats dict (bytes_in_use, peak_bytes_in_use, ...) or a
+    live-array fallback on backends that expose none."""
+    dev = _device(device)
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    if stats:
+        return dict(stats)
+    total = sum(
+        arr.nbytes for arr in jax.live_arrays()
+        if dev in getattr(arr, "devices", lambda: set())())
+    return {"bytes_in_use": total,
+            "peak_bytes_in_use": max(total, _PEAK.get(dev.id, 0))}
+
+
+def memory_allocated(device=None) -> int:
+    """Bytes currently allocated on the device (reference
+    device/cuda memory_allocated)."""
+    stats = memory_stats(device)
+    used = int(stats.get("bytes_in_use", 0))
+    dev = _device(device)
+    _PEAK[dev.id] = max(_PEAK.get(dev.id, 0), used)
+    return used
+
+
+def max_memory_allocated(device=None) -> int:
+    stats = memory_stats(device)
+    dev = _device(device)
+    peak = int(stats.get("peak_bytes_in_use", 0))
+    return max(peak, _PEAK.get(dev.id, 0))
+
+
+def memory_reserved(device=None) -> int:
+    """Total reservable pool (bytes_limit) when PJRT reports one."""
+    stats = memory_stats(device)
+    return int(stats.get("bytes_limit", stats.get("bytes_in_use", 0)))
+
+
+def empty_cache():
+    """The reference releases cached allocator blocks; PJRT manages its own
+    pool — provided for API compatibility (garbage-collects dropped arrays)."""
+    import gc
+
+    gc.collect()

@@ -7,4 +7,5 @@ reference's callback zoo (callbacks.py) with the same hook points.
 """
 from .model import Model  # noqa: F401
 from .model_summary import summary  # noqa: F401
+from .dynamic_flops import flops  # noqa: F401
 from . import callbacks  # noqa: F401

@@ -1,0 +1,94 @@
+"""paddle.flops (reference: python/paddle/hapi/dynamic_flops.py).
+
+Hook-based FLOP accounting over one traced forward — the same per-layer-type
+count table as the reference (conv: 2*k*k*cin/g*cout*oh*ow, linear: 2*in*out,
+norm/act/pool: elementwise)."""
+from __future__ import annotations
+
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+
+def _numel(shape):
+    return int(np.prod(shape)) if shape else 1
+
+
+def _count(layer, inputs, output):
+    x = inputs[0] if isinstance(inputs, (list, tuple)) else inputs
+    out = output[0] if isinstance(output, (list, tuple)) else output
+    name = type(layer).__name__
+    if isinstance(layer, (nn.Conv2D, nn.Conv1D)):
+        kernel = _numel(layer._kernel_size)
+        cin = layer._in_channels // layer._groups
+        out_elems = _numel(out.shape)
+        flops = 2 * kernel * cin * out_elems
+        if layer.bias is None:
+            flops -= out_elems
+        return flops
+    if isinstance(layer, nn.Linear):
+        out_elems = _numel(out.shape)
+        flops = 2 * layer._in_features * out_elems
+        if layer.bias is None:
+            flops -= out_elems
+        return flops
+    if "Norm" in name:
+        return 2 * _numel(x.shape)
+    if "Pool" in name:
+        return _numel(x.shape)
+    if name in ("ReLU", "ReLU6", "GELU", "Sigmoid", "Tanh", "Silu", "Swish",
+                "LeakyReLU", "Hardswish", "Hardsigmoid", "Mish", "ELU"):
+        return _numel(x.shape)
+    return 0
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Count multiply-accumulate FLOPs of one forward (reference
+    dynamic_flops.py flops). custom_ops: {LayerType: fn(layer, in, out)->int}.
+    """
+    custom_ops = custom_ops or {}
+    records = []
+    hooks = []
+
+    def make_hook(layer):
+        def hook(l, ins, outs):
+            fn = None
+            for cls, f in custom_ops.items():
+                if isinstance(l, cls):
+                    fn = f
+                    break
+            n = fn(l, ins, outs) if fn else _count(l, ins, outs)
+            params = sum(_numel(p.shape) for p in l._parameters.values()
+                         if p is not None)
+            records.append((type(l).__name__, n, params))
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for _, sub in net.named_sublayers():
+        if not sub._sub_layers:
+            make_hook(sub)
+    if not hooks:
+        make_hook(net)
+
+    was_training = net.training
+    net.eval()
+    try:
+        from ..core import no_grad
+
+        shape = [1 if (d is None or d < 0) else d for d in input_size]
+        x = Tensor(np.zeros(shape, "float32"))
+        with no_grad():
+            net(x)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(r[1] for r in records)
+    if print_detail:
+        print(f"{'Layer':<24}{'FLOPs':>16}{'Params':>12}")
+        for name, n, p in records:
+            print(f"{name:<24}{n:>16,}{p:>12,}")
+        print(f"Total FLOPs: {total:,}")
+    return total

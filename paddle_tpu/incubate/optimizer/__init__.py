@@ -15,10 +15,11 @@ class LookAhead(Optimizer):
     optimizer's fast weights every k steps (reference lookahead.py)."""
 
     def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        super().__init__(learning_rate=0.0,
+                         parameters=inner_optimizer._parameter_list)
         self.inner_optimizer = inner_optimizer
         self.alpha = float(alpha)
         self.k = int(k)
-        self._parameter_list = inner_optimizer._parameter_list
         self._slow = None
         self._step_count = 0
 
@@ -52,10 +53,21 @@ class LookAhead(Optimizer):
     def state_dict(self):
         sd = self.inner_optimizer.state_dict()
         sd["lookahead_step"] = self._step_count
+        if self._slow is not None:  # anchor weights shape the k-step pullback
+            for i, s in enumerate(self._slow):
+                sd[f"lookahead_slow_{i}"] = Tensor(s)
         return sd
 
     def set_state_dict(self, state_dict):
+        state_dict = dict(state_dict)
         self._step_count = int(state_dict.pop("lookahead_step", 0))
+        slow = []
+        i = 0
+        while f"lookahead_slow_{i}" in state_dict:
+            v = state_dict.pop(f"lookahead_slow_{i}")
+            slow.append(v.data if isinstance(v, Tensor) else jnp.asarray(v))
+            i += 1
+        self._slow = slow or None
         self.inner_optimizer.set_state_dict(state_dict)
 
 
@@ -67,7 +79,7 @@ class ModelAverage(Optimizer):
                  min_average_window=10000, max_average_window=10000, name=None):
         if parameters is None:
             raise ValueError("parameters must be provided")
-        self._parameter_list = list(parameters)
+        super().__init__(learning_rate=0.0, parameters=parameters)
         self.rate = float(average_window_rate)
         self.min_w = min_average_window
         self.max_w = max_average_window

@@ -1,0 +1,13 @@
+"""paddle.onnx (reference: python/paddle/onnx/export.py wraps paddle2onnx).
+
+paddle2onnx is CUDA/ProgramDesc-specific and has no TPU meaning; the portable
+deployment artifact on this framework is the StableHLO export, which any ONNX
+toolchain consuming MLIR can ingest.
+"""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export is not provided on the TPU framework; use "
+        "paddle_tpu.jit.save(layer, path, input_spec=[...]) to produce a "
+        "portable StableHLO program (.pdmodel) instead")

@@ -1,0 +1,127 @@
+"""paddle.signal (reference: python/paddle/signal.py — frame/overlap_add native
+ops + stft/istft composed in python). TPU-native: framing is one strided
+gather; the FFT rides paddle_tpu.fft.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from . import fft as _fft
+from .core.dispatch import primitive
+from .core.tensor import Tensor
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+@primitive("signal_frame")
+def _frame(x, *, frame_length, hop_length, axis):
+    if axis not in (-1, x.ndim - 1, 0):
+        raise ValueError("frame: axis must be 0 or -1")
+    time_last = axis in (-1, x.ndim - 1)
+    if not time_last:
+        x = jnp.moveaxis(x, 0, -1)
+    n = x.shape[-1]
+    num_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(num_frames)[:, None])  # [F, L]
+    out = x[..., idx]  # [..., F, L]
+    out = jnp.swapaxes(out, -1, -2)  # [..., L, F] (paddle layout)
+    if not time_last:
+        out = jnp.moveaxis(out, (-2, -1), (0, 1))
+    return out
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames (reference signal.py frame)."""
+    return _frame(x, frame_length=int(frame_length), hop_length=int(hop_length),
+                  axis=int(axis))
+
+
+@primitive("signal_overlap_add")
+def _overlap_add(x, *, hop_length, axis):
+    time_last = axis in (-1, x.ndim - 1)
+    if not time_last:
+        x = jnp.moveaxis(x, (0, 1), (-2, -1))
+    # x: [..., frame_length, num_frames]
+    frame_length, num_frames = x.shape[-2], x.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    batch = x.shape[:-2]
+    out = jnp.zeros(batch + (out_len,), x.dtype)
+    for f in range(num_frames):  # static unroll; num_frames is trace-constant
+        out = out.at[..., f * hop_length: f * hop_length + frame_length].add(
+            x[..., f])
+    if not time_last:
+        out = jnp.moveaxis(out, -1, 0)
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    return _overlap_add(x, hop_length=int(hop_length), axis=int(axis))
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Short-time Fourier transform (reference signal.py stft). Returns
+    [..., n_fft//2+1 (or n_fft), num_frames] complex."""
+    from .ops import creation, manipulation as M
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = creation.ones([win_length])
+    if win_length < n_fft:  # center-pad window to n_fft (reference behavior)
+        pad = (n_fft - win_length) // 2
+        window = M.concat([creation.zeros([pad]), window,
+                           creation.zeros([n_fft - win_length - pad])])
+    if center:
+        p = n_fft // 2
+        x = Tensor(jnp.pad(x.data, [(0, 0)] * (x.ndim - 1) + [(p, p)],
+                           mode=pad_mode))
+    frames = frame(x, n_fft, hop_length, axis=-1)  # [..., n_fft, F]
+    frames = frames * M.unsqueeze(window, [-1])
+    spec_fn = _fft.rfft if onesided else _fft.fft
+    spec = spec_fn(frames, n=n_fft, axis=-2)
+    if normalized:
+        spec = spec * (1.0 / float(n_fft) ** 0.5)
+    return spec
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Inverse STFT with window-envelope normalization (reference signal.py)."""
+    from .ops import creation, manipulation as M
+
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    if window is None:
+        window = creation.ones([win_length])
+    if win_length < n_fft:
+        pad = (n_fft - win_length) // 2
+        window = M.concat([creation.zeros([pad]), window,
+                           creation.zeros([n_fft - win_length - pad])])
+    if return_complex and onesided:
+        raise ValueError("istft: return_complex=True requires onesided=False")
+    if normalized:
+        x = x * float(n_fft) ** 0.5
+    inv_fn = _fft.irfft if onesided else _fft.ifft
+    frames = inv_fn(x, n=n_fft, axis=-2)  # [..., n_fft, F]
+    if not onesided and not return_complex:
+        frames = Tensor(frames.data.real)
+    frames = frames * M.unsqueeze(window, [-1])
+    out = overlap_add(frames, hop_length, axis=-1)
+    # divide by the summed squared-window envelope
+    wsq = M.unsqueeze(window * window, [-1])
+    num_frames = x.shape[-1]
+    env = _overlap_add(jnp.broadcast_to(
+        wsq.data, (n_fft, num_frames)), hop_length=hop_length, axis=-1)
+    env = Tensor(jnp.where(env.data > 1e-11, env.data, 1.0)) \
+        if isinstance(env, Tensor) else Tensor(jnp.where(env > 1e-11, env, 1.0))
+    out = out / env
+    if center:
+        p = n_fft // 2
+        end = out.shape[-1] - p
+        out = Tensor(out.data[..., p:end])
+    if length is not None:
+        out = Tensor(out.data[..., :length])
+    return out

@@ -1,0 +1,122 @@
+"""paddle.sparse (reference: python/paddle/sparse/ + paddle/phi/kernels/sparse/).
+
+TPU-native: SparseCooTensor wraps jax.experimental.sparse.BCOO — XLA lowers
+BCOO matmul to gather/segment-sum HLO (TPUs have no sparse MXU path, matching
+the reference's CPU/GPU sparse kernels in spirit: a distinct storage format
+whose ops produce dense results where needed).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "matmul", "add", "relu", "is_sparse_coo"]
+
+
+class SparseCooTensor:
+    """COO sparse tensor (reference phi/core/sparse_coo_tensor.h)."""
+
+    def __init__(self, bcoo: jsparse.BCOO):
+        self._bcoo = bcoo
+
+    # -- paddle surface ------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def indices(self) -> Tensor:
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))  # [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def is_sparse_coo(self):
+        return True
+
+    def coalesce(self):
+        return SparseCooTensor(self._bcoo.sum_duplicates())
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a COO tensor (reference sparse/creation.py sparse_coo_tensor).
+    indices: [ndim, nnz]; values: [nnz]."""
+    idx = indices.data if isinstance(indices, Tensor) else jnp.asarray(
+        np.asarray(indices))
+    val = values.data if isinstance(values, Tensor) else jnp.asarray(
+        np.asarray(values, dtype or "float32"))
+    idx = jnp.swapaxes(idx.astype(jnp.int32), 0, 1)  # BCOO wants [nnz, ndim]
+    if shape is None:
+        shape = tuple(int(m) + 1 for m in np.asarray(idx).max(axis=0))
+    bcoo = jsparse.BCOO((val, idx), shape=tuple(int(s) for s in shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """CSR input surface; stored as (row-sorted) COO internally — BCOO is the
+    only XLA-lowered sparse format (reference sparse_csr_tensor.h role)."""
+    crows = np.asarray(crows.numpy() if isinstance(crows, Tensor) else crows)
+    cols = np.asarray(cols.numpy() if isinstance(cols, Tensor) else cols)
+    rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+    return sparse_coo_tensor(np.stack([rows, cols]), values, shape, dtype)
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def _unwrap(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, Tensor):
+        return x.data
+    return jnp.asarray(x)
+
+
+def matmul(x, y, name=None):
+    """sparse @ dense -> dense (reference sparse/functional matmul)."""
+    a, b = _unwrap(x), _unwrap(y)
+    out = a @ b
+    if isinstance(out, jsparse.BCOO):
+        return SparseCooTensor(out)
+    return Tensor(out)
+
+
+def add(x, y, name=None):
+    a, b = _unwrap(x), _unwrap(y)
+    if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
+        return SparseCooTensor(jsparse.bcoo_add_indices_dedupe(
+            a, b)) if hasattr(jsparse, "bcoo_add_indices_dedupe") else \
+            SparseCooTensor((a + b).sum_duplicates())
+    out = (a.todense() if isinstance(a, jsparse.BCOO) else a) + \
+        (b.todense() if isinstance(b, jsparse.BCOO) else b)
+    return Tensor(out)
+
+
+def relu(x, name=None):
+    """Elementwise on stored values only (reference sparse/nn relu)."""
+    if isinstance(x, SparseCooTensor):
+        b = x._bcoo
+        return SparseCooTensor(jsparse.BCOO((jax.nn.relu(b.data), b.indices),
+                                            shape=b.shape))
+    return Tensor(jax.nn.relu(_unwrap(x)))

@@ -1,0 +1,420 @@
+"""Detection ops (reference: python/paddle/vision/ops.py + the CUDA kernels
+under paddle/fluid/operators/detection/).
+
+TPU-native designs:
+- IoU/suppression math is fixed-shape jax (an [N,N] IoU matrix + a sequential
+  keep scan); only the final variable-length index extraction happens on host,
+  because XLA requires static shapes (nms is an eager postprocess op).
+- roi_align/roi_pool are vmapped bilinear/max gathers (one fused executable),
+  the role of roi_align_op.cu's per-box CUDA kernel.
+- deform_conv2d samples with bilinear gathers then runs a dense matmul —
+  gather + MXU instead of the reference's fused CUDA im2col.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["nms", "roi_align", "roi_pool", "psroi_pool", "deform_conv2d",
+           "yolo_box", "box_iou", "RoIAlign", "RoIPool", "DeformConv2D",
+           "ConvNormActivation"]
+
+
+@primitive("box_iou", nondiff=True)
+def _box_iou(a, b):
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0.0, None)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area_a[:, None] + area_b[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    """Pairwise IoU [N,M] for xyxy boxes."""
+    return _box_iou(boxes1, boxes2)
+
+
+@primitive("nms_keep_mask", nondiff=True)
+def _nms_keep_mask(boxes, scores, iou_threshold):
+    order = jnp.argsort(-scores)
+    sorted_boxes = boxes[order]
+    iou = _box_iou.fn(sorted_boxes, sorted_boxes)
+    n = boxes.shape[0]
+
+    def body(i, keep):
+        # suppress every j > i overlapping a kept i
+        row = (iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~row
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    return keep_sorted, order
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None, name=None):
+    """Greedy NMS returning kept indices in score order (reference
+    vision/ops.py nms — same positional order: boxes, iou_threshold, scores).
+    Eager host op: output length is data-dependent."""
+    if scores is None:
+        scores = Tensor(jnp.zeros((boxes.shape[0],), jnp.float32))
+    if category_idxs is not None:
+        # batched-nms trick: offset boxes per category so they never overlap
+        data = boxes.data if isinstance(boxes, Tensor) else boxes
+        cat = category_idxs.data if isinstance(category_idxs, Tensor) \
+            else jnp.asarray(category_idxs)
+        offset = (data.max() + 1.0) * cat.astype(data.dtype)
+        boxes = Tensor(data + offset[:, None])
+    keep_sorted, order = _nms_keep_mask(boxes, scores,
+                                        iou_threshold=float(iou_threshold))
+    keep_np = np.asarray(keep_sorted.data)
+    order_np = np.asarray(order.data)
+    kept = order_np[keep_np]
+    if top_k is not None:
+        kept = kept[: int(top_k)]
+    return Tensor(jnp.asarray(kept.astype(np.int64)))
+
+
+def _bilinear(feat, y, x):
+    """feat [C,H,W]; y/x sample grids of identical shape -> [C, *grid].
+    Samples strictly outside the map contribute zero (reference
+    roi_align_op.cu / deformable_conv bilinear with the -1..H tolerance band).
+    """
+    H, W = feat.shape[1], feat.shape[2]
+    valid = (y > -1.0) & (y < H) & (x > -1.0) & (x < W)
+    y = jnp.clip(y, 0.0, H - 1.0)
+    x = jnp.clip(x, 0.0, W - 1.0)
+    y0 = jnp.floor(y).astype(jnp.int32)
+    x0 = jnp.floor(x).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, H - 1)
+    x1 = jnp.minimum(x0 + 1, W - 1)
+    wy = y - y0
+    wx = x - x0
+    v00 = feat[:, y0, x0]
+    v01 = feat[:, y0, x1]
+    v10 = feat[:, y1, x0]
+    v11 = feat[:, y1, x1]
+    out = (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+           + v10 * wy * (1 - wx) + v11 * wy * wx)
+    return out * valid.astype(feat.dtype)
+
+
+@primitive("roi_align_op")
+def _roi_align(x, boxes, boxes_num, *, output_size, spatial_scale,
+               sampling_ratio, aligned):
+    oh, ow = output_size
+    sr = max(int(sampling_ratio), 1)
+    # batch index per roi from boxes_num (static cumsum over python ints is
+    # not possible for traced boxes_num; use repeat via searchsorted)
+    n_rois = boxes.shape[0]
+    batch_of = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                jnp.arange(n_rois), side="right")
+
+    half = 0.5 if aligned else 0.0
+
+    def one_roi(box, b_idx):
+        feat = x[b_idx]  # [C,H,W]
+        x1, y1, x2, y2 = box * spatial_scale - half
+        rw = jnp.maximum(x2 - x1, 1e-6 if aligned else 1.0)
+        rh = jnp.maximum(y2 - y1, 1e-6 if aligned else 1.0)
+        bin_h = rh / oh
+        bin_w = rw / ow
+        # sr x sr sample points per bin
+        gy = (y1 + bin_h * (jnp.arange(oh)[:, None] +
+                            (jnp.arange(sr)[None, :] + 0.5) / sr)).reshape(-1)
+        gx = (x1 + bin_w * (jnp.arange(ow)[:, None] +
+                            (jnp.arange(sr)[None, :] + 0.5) / sr)).reshape(-1)
+        yy = jnp.repeat(gy, gx.shape[0]).reshape(gy.shape[0], gx.shape[0])
+        xx = jnp.tile(gx, (gy.shape[0], 1))
+        sampled = _bilinear(feat, yy, xx)  # [C, oh*sr, ow*sr]
+        C = sampled.shape[0]
+        sampled = sampled.reshape(C, oh, sr, ow, sr)
+        return sampled.mean(axis=(2, 4))
+
+    return jax.vmap(one_roi)(boxes, batch_of)
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """reference vision/ops.py roi_align / operators/roi_align_op.cu.
+
+    Deviation: the reference's sampling_ratio<=0 means *adaptive*
+    ceil(roi_size/output_size) samples per bin — a data-dependent count XLA
+    cannot compile (static shapes). Here sampling_ratio<=0 uses 2 samples per
+    bin; pass an explicit sampling_ratio to match reference numerics on large
+    RoIs."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_align(x, boxes, boxes_num,
+                      output_size=tuple(int(v) for v in output_size),
+                      spatial_scale=float(spatial_scale),
+                      sampling_ratio=int(sampling_ratio if sampling_ratio > 0
+                                         else 2),
+                      aligned=bool(aligned))
+
+
+@primitive("roi_pool_op")
+def _roi_pool(x, boxes, boxes_num, *, output_size, spatial_scale):
+    oh, ow = output_size
+    n_rois = boxes.shape[0]
+    batch_of = jnp.searchsorted(jnp.cumsum(boxes_num),
+                                jnp.arange(n_rois), side="right")
+    H, W = x.shape[2], x.shape[3]
+
+    def one_roi(box, b_idx):
+        feat = x[b_idx]
+        x1 = jnp.round(box[0] * spatial_scale).astype(jnp.int32)
+        y1 = jnp.round(box[1] * spatial_scale).astype(jnp.int32)
+        x2 = jnp.round(box[2] * spatial_scale).astype(jnp.int32)
+        y2 = jnp.round(box[3] * spatial_scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+        neg = jnp.finfo(feat.dtype).min
+        # static oh*ow loop of masked max reductions; bin edges are the
+        # reference's floor/ceil splits (roi_pool_op.cu bin arithmetic)
+        bins = []
+        for i in range(oh):
+            hs = y1 + (i * rh) // oh
+            he = y1 + -((-(i + 1) * rh) // oh)
+            for j in range(ow):
+                ws = x1 + (j * rw) // ow
+                we = x1 + -((-(j + 1) * rw) // ow)
+                m = (((ys >= hs) & (ys < he))[None, :, None]
+                     & ((xs >= ws) & (xs < we))[None, None, :])
+                val = jnp.max(jnp.where(m, feat, neg), axis=(1, 2))
+                bins.append(jnp.where(jnp.any(m), val, 0.0))
+        return jnp.stack(bins, axis=-1).reshape(feat.shape[0], oh, ow)
+
+    return jax.vmap(one_roi)(boxes, batch_of)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """reference vision/ops.py roi_pool / operators/roi_pool_op.cu."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    return _roi_pool(x, boxes, boxes_num,
+                     output_size=tuple(int(v) for v in output_size),
+                     spatial_scale=float(spatial_scale))
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool_op.cu): input
+    channels C = out_c * oh * ow; each output bin averages its own channel
+    group within the bin region."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    C = x.shape[1]
+    assert C % (oh * ow) == 0, "channels must be divisible by oh*ow"
+    aligned = roi_align(x, boxes, boxes_num, output_size, spatial_scale,
+                        sampling_ratio=2, aligned=False)  # [K, C, oh, ow]
+    out_c = C // (oh * ow)
+    K = aligned.shape[0]
+    from ..ops import manipulation as M
+
+    g = M.reshape(aligned, [K, out_c, oh, ow, oh, ow])
+    # pick the bin's own channel group: out[k,c,i,j] = g[k,c,i,j,i,j]
+    data = g.data
+    ii = jnp.arange(oh)
+    jj = jnp.arange(ow)
+    picked = data[:, :, ii[:, None], jj[None, :], ii[:, None], jj[None, :]]
+    return Tensor(picked)
+
+
+@primitive("deform_conv2d_op")
+def _deform_conv2d(x, offset, mask, weight, *, stride, padding, dilation,
+                   deformable_groups, groups):
+    """Bilinear-gather im2col + grouped matmul in one primitive.
+    offset: [N, dg*2*kh*kw, oh, ow]; mask: [N, dg*kh*kw, oh, ow]."""
+    N, C, H, W = x.shape
+    out_c, _, kh, kw = weight.shape
+    dg = deformable_groups
+    sh, sw = stride
+    ph, pw = padding
+    dh, dw = dilation
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    base_y = (jnp.arange(oh) * sh - ph).reshape(oh, 1, 1, 1)
+    base_x = (jnp.arange(ow) * sw - pw).reshape(1, ow, 1, 1)
+    ker_y = (jnp.arange(kh) * dh).reshape(1, 1, kh, 1)
+    ker_x = (jnp.arange(kw) * dw).reshape(1, 1, 1, kw)
+    # per-deformable-group offsets (y then x per kernel point, ref layout)
+    off = offset.reshape(N, dg, kh * kw, 2, oh, ow)
+    off_y = off[:, :, :, 0].reshape(N, dg, kh, kw, oh, ow) \
+        .transpose(0, 1, 4, 5, 2, 3)  # [N, dg, oh, ow, kh, kw]
+    off_x = off[:, :, :, 1].reshape(N, dg, kh, kw, oh, ow) \
+        .transpose(0, 1, 4, 5, 2, 3)
+    sy = base_y[None, None] + ker_y[None, None] + off_y
+    sx = base_x[None, None] + ker_x[None, None] + off_x
+    mm = mask.reshape(N, dg, kh, kw, oh, ow).transpose(0, 1, 4, 5, 2, 3)
+
+    cpg = C // dg  # channels per deformable group
+
+    def per_image(feat, yy, xx, m):
+        # feat [C,H,W] viewed as dg groups of cpg channels, each sampled
+        # with its own grid
+        cols = []
+        for g in range(dg):
+            s = _bilinear(feat[g * cpg:(g + 1) * cpg], yy[g], xx[g])
+            cols.append(s * m[g][None])  # [cpg, oh, ow, kh, kw]
+        s = jnp.concatenate(cols, axis=0)  # [C, oh, ow, kh, kw]
+        return s.transpose(0, 3, 4, 1, 2).reshape(C * kh * kw, oh, ow)
+
+    cols = jax.vmap(per_image)(x, sy, sx, mm)  # [N, C*kh*kw, oh, ow]
+    # grouped matmul: [g, O/g, (C/g)*kh*kw] @ [N, g, (C/g)*kh*kw, oh*ow]
+    gsz = C // groups
+    w_g = weight.reshape(groups, out_c // groups, gsz * kh * kw)
+    cols_g = cols.reshape(N, groups, gsz, kh * kw, oh * ow) \
+        .reshape(N, groups, gsz * kh * kw, oh * ow)
+    out = jnp.einsum("gok,ngks->ngos", w_g, cols_g)
+    return out.reshape(N, out_c, oh, ow)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (reference deformable_conv_op.cu): bilinear
+    gather into im2col columns, then one grouped MXU matmul."""
+    from ..ops import creation, manipulation as M
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+
+    kh, kw = int(weight.shape[2]), int(weight.shape[3])
+    if mask is None:  # v1: unmodulated
+        n = x.shape[0]
+        oh_ow = offset.shape[2], offset.shape[3]
+        mask = creation.ones([n, deformable_groups * kh * kw, *oh_ow],
+                             dtype=str(x.dtype))
+    out = _deform_conv2d(x, offset, mask, weight,
+                         stride=_pair(stride), padding=_pair(padding),
+                         dilation=_pair(dilation),
+                         deformable_groups=int(deformable_groups),
+                         groups=int(groups))
+    if bias is not None:
+        out = out + M.reshape(bias, [1, -1, 1, 1])
+    return out
+
+
+@primitive("yolo_box_decode", nondiff=True)
+def _yolo_box(x, img_size, *, anchors, class_num, conf_thresh, downsample_ratio,
+              clip_bbox, scale_x_y):
+    N, _, H, W = x.shape
+    na = len(anchors) // 2
+    x = x.reshape(N, na, 5 + class_num, H, W)
+    grid_x = jnp.arange(W)[None, None, None, :]
+    grid_y = jnp.arange(H)[None, None, :, None]
+    anc = jnp.asarray(anchors, jnp.float32).reshape(na, 2)
+    sig = jax.nn.sigmoid
+    bx = (sig(x[:, :, 0]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + grid_x) / W
+    by = (sig(x[:, :, 1]) * scale_x_y - 0.5 * (scale_x_y - 1.0) + grid_y) / H
+    bw = jnp.exp(x[:, :, 2]) * anc[None, :, 0, None, None] / (W * downsample_ratio)
+    bh = jnp.exp(x[:, :, 3]) * anc[None, :, 1, None, None] / (H * downsample_ratio)
+    conf = sig(x[:, :, 4])
+    probs = sig(x[:, :, 5:]) * conf[:, :, None]
+    img_h = img_size[:, 0].astype(jnp.float32)[:, None, None, None]
+    img_w = img_size[:, 1].astype(jnp.float32)[:, None, None, None]
+    x1 = (bx - bw / 2) * img_w
+    y1 = (by - bh / 2) * img_h
+    x2 = (bx + bw / 2) * img_w
+    y2 = (by + bh / 2) * img_h
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0.0, img_w - 1)
+        y1 = jnp.clip(y1, 0.0, img_h - 1)
+        x2 = jnp.clip(x2, 0.0, img_w - 1)
+        y2 = jnp.clip(y2, 0.0, img_h - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+    mask = (conf > conf_thresh).reshape(N, -1, 1)
+    boxes = boxes * mask
+    scores = (probs.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+              * mask)
+    return boxes, scores
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0, name=None):
+    """reference vision/ops.py yolo_box / yolo_box_op.cu."""
+    return _yolo_box(x, img_size, anchors=tuple(int(a) for a in anchors),
+                     class_num=int(class_num), conf_thresh=float(conf_thresh),
+                     downsample_ratio=int(downsample_ratio),
+                     clip_bbox=bool(clip_bbox), scale_x_y=float(scale_x_y))
+
+
+class RoIAlign(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool(nn.Layer):
+    def __init__(self, output_size, spatial_scale=1.0):
+        super().__init__()
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def forward(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class DeformConv2D(nn.Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        from .. import nn as _nn
+        from ..nn import initializer as I
+
+        self.weight = self.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            self.bias = self.create_parameter([out_channels], attr=bias_attr,
+                                              is_bias=True)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias, self.stride,
+                             self.padding, self.dilation,
+                             self.deformable_groups, self.groups, mask)
+
+
+class ConvNormActivation(nn.Sequential):
+    """reference vision/ops.py ConvNormActivation building block."""
+
+    def __init__(self, in_channels, out_channels, kernel_size=3, stride=1,
+                 padding=None, groups=1, norm_layer=nn.BatchNorm2D,
+                 activation_layer=nn.ReLU, dilation=1, bias=None):
+        if padding is None:
+            padding = (kernel_size - 1) // 2 * dilation
+        if bias is None:
+            bias = norm_layer is None
+        layers = [nn.Conv2D(in_channels, out_channels, kernel_size, stride,
+                            padding, dilation=dilation, groups=groups,
+                            bias_attr=None if bias else False)]
+        if norm_layer is not None:
+            layers.append(norm_layer(out_channels))
+        if activation_layer is not None:
+            layers.append(activation_layer())
+        super().__init__(*layers)

@@ -1,0 +1,249 @@
+"""paddle.fft / paddle.signal / vision detection ops / sparse / flops / memory."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import fft, signal, sparse
+from paddle_tpu.vision import ops as vops
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+# -- fft ----------------------------------------------------------------------
+
+def test_fft_matches_numpy():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(16).astype("float32")
+    np.testing.assert_allclose(_np(fft.fft(paddle.to_tensor(x))),
+                               np.fft.fft(x), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(_np(fft.rfft(paddle.to_tensor(x))),
+                               np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+    x2 = rng.standard_normal((4, 8)).astype("float32")
+    np.testing.assert_allclose(_np(fft.fft2(paddle.to_tensor(x2))),
+                               np.fft.fft2(x2), rtol=1e-4, atol=1e-4)
+    # roundtrip + ortho norm
+    y = fft.ifft(fft.fft(paddle.to_tensor(x), norm="ortho"), norm="ortho")
+    np.testing.assert_allclose(_np(y).real, x, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(_np(fft.fftfreq(8, 0.5)), np.fft.fftfreq(8, 0.5),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_np(fft.fftshift(paddle.to_tensor(x))),
+                               np.fft.fftshift(x), rtol=1e-6)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(np.random.default_rng(1).standard_normal(8)
+                         .astype("float32"), stop_gradient=False)
+    out = fft.rfft(x)
+    # |X|^2 loss
+    mag = out.abs() if hasattr(out, "abs") else None
+    from paddle_tpu.ops import math as M
+
+    loss = (M.real(out) * M.real(out) + M.imag(out) * M.imag(out)).sum()
+    loss.backward()
+    assert x.grad is not None and np.isfinite(_np(x.grad)).all()
+
+
+# -- signal -------------------------------------------------------------------
+
+def test_frame_and_overlap_add_roundtrip():
+    x = paddle.to_tensor(np.arange(16, dtype="float32"))
+    f = signal.frame(x, frame_length=4, hop_length=4)  # non-overlapping
+    assert f.shape == [4, 4]
+    back = signal.overlap_add(f, hop_length=4)
+    np.testing.assert_allclose(_np(back), np.arange(16), rtol=1e-6)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((2, 256)).astype("float32"))
+    n_fft = 64
+    window = paddle.to_tensor(np.hanning(n_fft).astype("float32"))
+    spec = signal.stft(x, n_fft=n_fft, hop_length=16, window=window)
+    assert spec.shape[:2] == [2, n_fft // 2 + 1]
+    rec = signal.istft(spec, n_fft=n_fft, hop_length=16, window=window,
+                       length=256)
+    np.testing.assert_allclose(_np(rec), _np(x), rtol=1e-3, atol=1e-4)
+
+
+# -- detection ops ------------------------------------------------------------
+
+def test_nms_matches_reference_greedy():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60],
+                        [0, 0, 9, 9]], "float32")
+    scores = np.asarray([0.9, 0.8, 0.7, 0.6], "float32")
+    kept = _np(vops.nms(paddle.to_tensor(boxes), 0.5,
+                        paddle.to_tensor(scores)))
+    # boxes 1 (IoU .68) and 3 (IoU .81) are suppressed by box 0
+    assert kept.tolist() == [0, 2]
+    kept_loose = _np(vops.nms(paddle.to_tensor(boxes), 0.9,
+                              paddle.to_tensor(scores)))
+    assert kept_loose.tolist() == [0, 1, 2, 3]
+
+
+def test_nms_categories_and_topk():
+    boxes = np.asarray([[0, 0, 10, 10], [1, 1, 11, 11]], "float32")
+    scores = np.asarray([0.9, 0.8], "float32")
+    cats = np.asarray([0, 1], "int32")
+    kept = _np(vops.nms(paddle.to_tensor(boxes), 0.5, paddle.to_tensor(scores),
+                        category_idxs=paddle.to_tensor(cats),
+                        categories=[0, 1]))
+    assert sorted(kept.tolist()) == [0, 1]  # different classes: both survive
+
+
+def test_roi_align_uniform_feature():
+    # constant feature map -> every pooled value equals the constant
+    x = paddle.ones([1, 3, 16, 16]) * 5.0
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 8, 8], [4, 4, 12, 12]],
+                                        "float32"))
+    num = paddle.to_tensor(np.asarray([2], "int32"))
+    out = vops.roi_align(x, boxes, num, output_size=4)
+    assert out.shape == [2, 3, 4, 4]
+    np.testing.assert_allclose(_np(out), 5.0, rtol=1e-5)
+
+
+def test_roi_align_gradient():
+    x = paddle.ones([1, 1, 8, 8])
+    x.stop_gradient = False
+    boxes = paddle.to_tensor(np.asarray([[1, 1, 5, 5]], "float32"))
+    num = paddle.to_tensor(np.asarray([1], "int32"))
+    out = vops.roi_align(x, boxes, num, output_size=2)
+    out.sum().backward()
+    assert x.grad is not None and float(_np(x.grad).sum()) > 0
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 8, 8), "float32")
+    feat[0, 0, 2, 2] = 7.0
+    x = paddle.to_tensor(feat)
+    boxes = paddle.to_tensor(np.asarray([[0, 0, 7, 7]], "float32"))
+    num = paddle.to_tensor(np.asarray([1], "int32"))
+    out = _np(vops.roi_pool(x, boxes, num, output_size=2))
+    assert out.max() == 7.0
+
+
+def test_deform_conv2d_zero_offset_equals_conv():
+    paddle.seed(0)
+    x = paddle.randn([1, 2, 8, 8])
+    w = paddle.randn([4, 2, 3, 3])
+    offset = paddle.zeros([1, 2 * 9, 8, 8])
+    out = vops.deform_conv2d(x, offset, w, stride=1, padding=1)
+    import paddle_tpu.nn.functional as F
+
+    ref = F.conv2d(x, w, stride=1, padding=1)
+    np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_yolo_box_shapes():
+    N, na, C = 1, 3, 4
+    H = W = 5
+    x = paddle.randn([N, na * (5 + C), H, W])
+    img = paddle.to_tensor(np.asarray([[320, 320]], "int32"))
+    boxes, scores = vops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                  class_num=C, conf_thresh=0.0)
+    assert boxes.shape == [N, na * H * W, 4]
+    assert scores.shape == [N, na * H * W, C]
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.asarray([[0, 0, 10, 10]], "float32"))
+    b = paddle.to_tensor(np.asarray([[0, 0, 10, 10], [5, 5, 15, 15],
+                                     [20, 20, 30, 30]], "float32"))
+    iou = _np(vops.box_iou(a, b))
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[0, 1], 25.0 / 175.0, rtol=1e-5)
+    np.testing.assert_allclose(iou[0, 2], 0.0, atol=1e-7)
+
+
+# -- sparse -------------------------------------------------------------------
+
+def test_sparse_coo_roundtrip_and_matmul():
+    s = sparse.sparse_coo_tensor([[0, 1, 2], [1, 2, 0]], [1.0, 2.0, 3.0],
+                                 shape=[3, 3])
+    assert s.nnz() == 3 and sparse.is_sparse_coo(s)
+    dense = _np(s.to_dense())
+    expect = np.zeros((3, 3), "float32")
+    expect[0, 1], expect[1, 2], expect[2, 0] = 1, 2, 3
+    np.testing.assert_array_equal(dense, expect)
+    out = sparse.matmul(s, paddle.to_tensor(np.eye(3, dtype="float32")))
+    np.testing.assert_allclose(_np(out), expect, rtol=1e-6)
+    r = sparse.relu(sparse.sparse_coo_tensor([[0], [0]], [-5.0], shape=[2, 2]))
+    assert _np(r.to_dense()).max() == 0.0
+
+
+def test_sparse_csr_surface():
+    s = sparse.sparse_csr_tensor([0, 1, 2, 3], [1, 2, 0], [1.0, 2.0, 3.0],
+                                 shape=[3, 3])
+    dense = _np(s.to_dense())
+    assert dense[0, 1] == 1.0 and dense[1, 2] == 2.0 and dense[2, 0] == 3.0
+
+
+# -- flops + memory -----------------------------------------------------------
+
+def test_flops_counts_conv_linear():
+    net = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+    total = paddle.flops(net, [1, 3, 8, 8])
+    conv = 2 * 9 * 3 * (8 * 8 * 8)
+    lin = 2 * 512 * 10
+    act = 8 * 8 * 8
+    assert total == conv + lin + act
+
+
+def test_memory_stats_surface():
+    import paddle_tpu.device as device
+
+    x = paddle.ones([256, 256])
+    allocated = device.memory_allocated()
+    assert allocated >= 0
+    assert device.max_memory_allocated() >= allocated
+    stats = device.memory_stats()
+    assert "bytes_in_use" in stats
+    device.empty_cache()
+
+
+def test_deform_conv2d_groups():
+    paddle.seed(1)
+    x = paddle.randn([1, 4, 8, 8])
+    w = paddle.randn([4, 2, 3, 3])  # groups=2: each group sees 2 in-channels
+    offset = paddle.zeros([1, 2 * 9, 8, 8])
+    out = vops.deform_conv2d(x, offset, w, stride=1, padding=1, groups=2)
+    import paddle_tpu.nn.functional as F
+
+    ref = F.conv2d(x, w, stride=1, padding=1, groups=2)
+    np.testing.assert_allclose(_np(out), _np(ref), rtol=1e-4, atol=1e-4)
+    # deformable_groups=2 with zero offsets also matches
+    offset2 = paddle.zeros([1, 2 * 2 * 9, 8, 8])
+    out2 = vops.deform_conv2d(x, offset2, w, stride=1, padding=1, groups=2,
+                              deformable_groups=2)
+    np.testing.assert_allclose(_np(out2), _np(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_lookahead_state_dict_roundtrip():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.optimizer import LookAhead
+
+    net = nn.Linear(2, 1)
+    inner = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+    opt = LookAhead(inner, alpha=0.5, k=5)
+    (net(paddle.ones([2, 2]))).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert "lookahead_step" in sd and "lookahead_slow_0" in sd
+    net2 = nn.Linear(2, 1)
+    opt2 = LookAhead(paddle.optimizer.SGD(0.1, parameters=net2.parameters()),
+                     alpha=0.5, k=5)
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1 and opt2._slow is not None
+
+
+def test_model_average_state_dict_does_not_crash():
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.optimizer import ModelAverage
+
+    net = nn.Linear(2, 1)
+    avg = ModelAverage(parameters=net.parameters())
+    sd = avg.state_dict()
+    assert "global_step" in sd
