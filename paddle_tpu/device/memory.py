@@ -22,8 +22,12 @@ def _device(device=None):
     if isinstance(device, int):
         return jax.devices()[device]
     if isinstance(device, str):  # paddle-style ids: "gpu:0", "tpu:1", "cpu"
-        idx = int(device.split(":")[1]) if ":" in device else 0
-        return jax.devices()[idx]
+        platform, _, idx = device.partition(":")
+        idx = int(idx) if idx else 0
+        try:
+            return jax.devices(platform)[idx]
+        except RuntimeError:  # platform not present: fall back to default set
+            return jax.devices()[idx]
     return device
 
 

@@ -1,0 +1,170 @@
+"""Parameter-server mode (reference: paddle/fluid/distributed/ps/ +
+python/paddle/distributed/fleet PS strategies — pserver processes hold dense/
+sparse tables; trainers pull params and push grads).
+
+TPU-native scope: dense training belongs to SPMD/GSPMD, so the PS here covers
+the role SPMD cannot: giant sparse embedding tables that never fit a chip and
+update sparsely. Tables live server-side; the wire is the native TCPStore
+(store/store.cpp), values as raw ndarray bytes — trainers pull rows for the
+batch, compute on-device, and push row gradients back for a server-side SGD
+update (async, like the reference's async PS mode).
+"""
+from __future__ import annotations
+
+import io
+import threading
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..store import TCPStore
+
+__all__ = ["ParameterServer", "PsTrainer", "SparseEmbedding"]
+
+
+def _dumps(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
+
+
+def _loads(raw: bytes) -> np.ndarray:
+    return np.load(io.BytesIO(raw))
+
+
+def _own_client(store: TCPStore) -> TCPStore:
+    """Blocking gets hold a per-connection lock, so the serving loop and each
+    trainer need their own client socket to the same daemon."""
+    return TCPStore(host=store.host, port=store.port, is_master=False,
+                    world_size=store.world_size, timeout=store.timeout)
+
+
+class ParameterServer:
+    """Holds sparse tables; applies pushed row-gradients (table_manager role,
+    reference ps/table/memory_sparse_table.cc)."""
+
+    def __init__(self, store: TCPStore, server_id: int = 0):
+        self.store = _own_client(store)
+        self.server_id = server_id
+        self.tables: Dict[str, np.ndarray] = {}
+        self.lr: Dict[str, float] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def create_table(self, name: str, shape, lr: float = 0.1, init_std=0.01,
+                     seed: int = 0):
+        rng = np.random.RandomState(seed)
+        self.tables[name] = (rng.randn(*shape) * init_std).astype("float32")
+        self.lr[name] = float(lr)
+        self.store.set(f"ps/{name}/meta", _dumps(np.asarray(shape, "int64")))
+        return self
+
+    # -- serving loop --------------------------------------------------------
+    def run(self, poll_interval=0.01):
+        """Serve pull/push requests until stop() (reference brpc service loop;
+        here requests rendezvous through store counters)."""
+        self._thread = threading.Thread(target=self._loop,
+                                        args=(poll_interval,), daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self, poll_interval):
+        served_pull: Dict[str, int] = {}
+        served_push: Dict[str, int] = {}
+        while not self._stop.is_set():
+            for name, table in self.tables.items():
+                # pulls: trainer writes ids, bumps request counter
+                n_req = self.store.add(f"ps/{name}/pull_req", 0)
+                k = served_pull.get(name, 0)
+                while k < n_req:
+                    k += 1
+                    ids = _loads(self.store.get(f"ps/{name}/pull/{k}/ids"))
+                    rows = table[ids]
+                    self.store.set(f"ps/{name}/pull/{k}/rows", _dumps(rows))
+                    self.store.delete_key(f"ps/{name}/pull/{k}/ids")
+                served_pull[name] = k
+                # pushes: trainer writes (ids, grads), bumps counter
+                n_push = self.store.add(f"ps/{name}/push_req", 0)
+                k = served_push.get(name, 0)
+                while k < n_push:
+                    k += 1
+                    ids = _loads(self.store.get(f"ps/{name}/push/{k}/ids"))
+                    grads = _loads(self.store.get(f"ps/{name}/push/{k}/grads"))
+                    np.subtract.at(table, ids, self.lr[name] * grads)
+                    # per-request ack, then free the payload keys
+                    self.store.set(f"ps/{name}/push/{k}/done", b"1")
+                    self.store.delete_key(f"ps/{name}/push/{k}/ids")
+                    self.store.delete_key(f"ps/{name}/push/{k}/grads")
+                served_push[name] = k
+            self._stop.wait(poll_interval)
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.store.close()
+
+
+class PsTrainer:
+    """Trainer-side pull/push client (reference fleet communicator role)."""
+
+    def __init__(self, store: TCPStore):
+        self.store = _own_client(store)
+
+    def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
+        req = self.store.add(f"ps/{table}/pull_req", 1)
+        self.store.set(f"ps/{table}/pull/{req}/ids",
+                       _dumps(np.asarray(ids, "int64")))
+        # get() blocks until the server answers this request id
+        rows = _loads(self.store.get(f"ps/{table}/pull/{req}/rows"))
+        self.store.delete_key(f"ps/{table}/pull/{req}/rows")
+        return rows
+
+    def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
+             wait: bool = False):
+        req = self.store.add(f"ps/{table}/push_req", 1)
+        self.store.set(f"ps/{table}/push/{req}/grads",
+                       _dumps(np.asarray(grads, "float32")))
+        self.store.set(f"ps/{table}/push/{req}/ids",
+                       _dumps(np.asarray(ids, "int64")))
+        if wait:  # per-request ack: immune to other trainers' pushes
+            self.store.wait([f"ps/{table}/push/{req}/done"])
+            self.store.delete_key(f"ps/{table}/push/{req}/done")
+
+
+class SparseEmbedding:
+    """Distributed lookup table (reference DistributedLookupTable /
+    distributed/ps sparse table): pulls rows per batch, pushes row grads."""
+
+    def __init__(self, trainer: PsTrainer, table: str, embedding_dim: int):
+        self.trainer = trainer
+        self.table = table
+        self.dim = embedding_dim
+        self._last = None  # (unique_ids, inverse) of the live batch
+
+    def forward(self, ids):
+        from ...core.tensor import Tensor
+        import jax.numpy as jnp
+
+        flat = np.asarray(ids.numpy() if hasattr(ids, "numpy") else ids,
+                          "int64").ravel()
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        rows = self.trainer.pull(self.table, uniq)
+        self._last = (uniq, inverse, tuple(np.shape(
+            ids.numpy() if hasattr(ids, "numpy") else ids)))
+        out = rows[inverse].reshape(*self._last[2], self.dim)
+        t = Tensor(jnp.asarray(out))
+        t.stop_gradient = False
+        return t
+
+    __call__ = forward
+
+    def push_grad(self, grad, wait=True):
+        """Push d(loss)/d(embedding_out) back as row gradients."""
+        assert self._last is not None, "forward must run before push_grad"
+        uniq, inverse, shape = self._last
+        g = np.asarray(grad.numpy() if hasattr(grad, "numpy") else grad,
+                       "float32").reshape(-1, self.dim)
+        acc = np.zeros((len(uniq), self.dim), "float32")
+        np.add.at(acc, inverse, g)
+        self.trainer.push(self.table, uniq, acc, wait=wait)

@@ -3,3 +3,9 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaMoEConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer,
     llama_param_count, llama_flops_per_token, apply_rotary_pos_emb,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTModel, GPTForCausalLM, GPTAttention, gpt_param_count,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertModel, BertForPretraining, BertForSequenceClassification,
+)

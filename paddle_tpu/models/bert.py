@@ -1,0 +1,188 @@
+"""BERT-family masked LM (reference lineage: the ERNIE/BERT configs the
+reference repo's fleet stack trains; model recipe is the published BERT).
+
+TPU-first: same parallel layer kit as llama.py/gpt.py (Column/RowParallel over
+'mp', shard constraints over dp/sdp), bidirectional flash/SDPA attention,
+post-LN encoder blocks, MLM + NSP pretraining heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import creation, manipulation
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from .llama import _mark_seq
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    use_recompute: bool = False
+    dtype: str = "float32"
+
+    @staticmethod
+    def bert_base(**overrides):
+        return BertConfig(**overrides)
+
+    @staticmethod
+    def bert_large(**overrides):
+        return BertConfig(**{**dict(hidden_size=1024, num_hidden_layers=24,
+                                    num_attention_heads=16,
+                                    intermediate_size=4096), **overrides})
+
+    @staticmethod
+    def tiny(**overrides):
+        return BertConfig(**{**dict(vocab_size=256, hidden_size=64,
+                                    num_hidden_layers=2, num_attention_heads=4,
+                                    intermediate_size=128,
+                                    max_position_embeddings=64,
+                                    hidden_dropout_prob=0.0,
+                                    attention_probs_dropout_prob=0.0),
+                             **overrides})
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.word_embeddings = VocabParallelEmbedding(config.vocab_size,
+                                                      config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
+                                                config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
+                                                  config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None):
+        s = input_ids.shape[1]
+        pos = creation.arange(0, s, dtype="int64")
+        emb = self.word_embeddings(input_ids) + self.position_embeddings(pos)
+        if token_type_ids is None:
+            token_type_ids = creation.zeros(list(input_ids.shape), dtype="int64")
+        emb = emb + self.token_type_embeddings(token_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertLayer(nn.Layer):
+    """Post-LN encoder block (original BERT recipe)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // config.num_attention_heads
+        self.qkv = ColumnParallelLinear(h, 3 * h, has_bias=True,
+                                        gather_output=False)
+        self.attn_out = RowParallelLinear(h, h, has_bias=True,
+                                          input_is_parallel=True)
+        self.attn_norm = nn.LayerNorm(h, config.layer_norm_eps)
+        self.ffn_in = ColumnParallelLinear(h, config.intermediate_size,
+                                           has_bias=True, gather_output=False)
+        self.ffn_out = RowParallelLinear(config.intermediate_size, h,
+                                         has_bias=True, input_is_parallel=True)
+        self.ffn_norm = nn.LayerNorm(h, config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.attn_dropout_p = config.attention_probs_dropout_prob
+
+    def forward(self, hidden, attn_mask=None):
+        b, s = hidden.shape[0], hidden.shape[1]
+        qkv = manipulation.reshape(self.qkv(hidden),
+                                   [b, s, 3, self.num_heads, self.head_dim])
+        q = manipulation.squeeze(manipulation.slice(qkv, [2], [0], [1]), [2])
+        k = manipulation.squeeze(manipulation.slice(qkv, [2], [1], [2]), [2])
+        v = manipulation.squeeze(manipulation.slice(qkv, [2], [2], [3]), [2])
+        attn = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=False,
+            dropout_p=self.attn_dropout_p if self.training else 0.0)
+        attn = manipulation.reshape(attn, [b, s, self.num_heads * self.head_dim])
+        hidden = self.attn_norm(hidden + self.dropout(self.attn_out(attn)))
+        mlp = self.ffn_out(F.gelu(self.ffn_in(hidden)))
+        hidden = self.ffn_norm(hidden + self.dropout(mlp))
+        return _mark_seq(hidden)
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.layers = nn.LayerList(
+            [BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        mask = None
+        if attention_mask is not None:
+            # [b, s] 1/0 -> additive [b, 1, 1, s]
+            m = manipulation.unsqueeze(attention_mask, [1, 2])
+            mask = (1.0 - m.astype("float32")) * -1e4
+        hidden = _mark_seq(self.embeddings(input_ids, token_type_ids))
+        for layer in self.layers:
+            if self.config.use_recompute and self.training:
+                from ..distributed.utils_recompute import recompute
+
+                hidden = recompute(layer, hidden, mask)
+            else:
+                hidden = layer(hidden, mask)
+        pooled = F.tanh(self.pooler(hidden[:, 0]))
+        return hidden, pooled
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (the original pretraining objective)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.mlm_transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.mlm_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
+        self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
+        self.nsp_head = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_labels=None):
+        hidden, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.mlm_norm(F.gelu(self.mlm_transform(hidden)))
+        w = self.bert.embeddings.word_embeddings.weight  # tied decoder
+        logits = h.matmul(manipulation.transpose(w, [1, 0])) + self.mlm_bias
+        nsp_logits = self.nsp_head(pooled)
+        if masked_lm_labels is None:
+            return logits, nsp_logits
+        v = self.config.vocab_size
+        mlm_loss = F.cross_entropy(
+            manipulation.reshape(logits, [-1, v]),
+            manipulation.reshape(masked_lm_labels, [-1]), ignore_index=-100)
+        loss = mlm_loss
+        if next_sentence_labels is not None:
+            loss = loss + F.cross_entropy(nsp_logits, next_sentence_labels)
+        return loss
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, config: BertConfig, num_classes=2):
+        super().__init__()
+        self.bert = BertModel(config)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.classifier = nn.Linear(config.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                labels=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is not None:
+            return F.cross_entropy(logits, labels)
+        return logits

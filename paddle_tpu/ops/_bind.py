@@ -1,118 +1,60 @@
 """Attach the op surface to Tensor as methods + dunders.
 
 Plays the role of the generated pybind tensor methods in the reference
-(paddle/fluid/pybind/eager_method.cc): every functional op with a leading
-tensor arg becomes a Tensor method.
+(paddle/fluid/pybind/eager_method.cc). The surface itself lives in
+ops/api.yaml (the api.yaml-codegen SSoT, SURVEY §7(g)); tools/gen_op_api.py
+turns it into ops/_api_registry.py, which this binder walks.
 """
 from __future__ import annotations
 
 from ..core.tensor import Tensor
 from . import comparison, creation, linalg, manipulation, math, reduction
+from ._api_registry import DUNDERS, INPLACE, METHODS
 
-_METHOD_SOURCES = [math, reduction, manipulation, linalg, comparison]
-
-_METHODS = [
-    # math
-    "add", "subtract", "multiply", "divide", "floor_divide", "remainder", "mod",
-    "pow", "maximum", "minimum", "fmax", "fmin", "atan2", "exp", "expm1", "log",
-    "log2", "log10", "log1p", "sqrt", "rsqrt", "abs", "neg", "sign", "sin",
-    "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh", "asinh",
-    "acosh", "atanh", "floor", "ceil", "round", "trunc", "reciprocal", "square",
-    "erf", "erfinv", "sigmoid", "lgamma", "digamma", "frac", "conj", "angle",
-    "real", "imag", "logit", "isnan", "isinf", "isfinite", "logical_and",
-    "logical_or", "logical_xor", "logical_not", "bitwise_and", "bitwise_or",
-    "bitwise_xor", "bitwise_not", "scale", "clip", "cumsum", "cumprod", "lerp",
-    "kron", "trace", "diff", "nan_to_num",
-    # reduction
-    "sum", "mean", "prod", "max", "min", "amax", "amin", "all", "any",
-    "logsumexp", "std", "var", "argmax", "argmin", "median", "quantile",
-    "count_nonzero", "nansum", "nanmean",
-    # manipulation
-    "cast", "astype", "reshape", "transpose", "t", "flatten", "squeeze",
-    "unsqueeze", "split", "chunk", "unbind", "tile", "expand", "broadcast_to",
-    "expand_as", "flip", "roll", "rot90", "gather", "gather_nd",
-    "take_along_axis", "put_along_axis", "scatter", "scatter_nd_add",
-    "index_select", "index_sample", "topk", "argsort", "sort", "unique",
-    "pad", "repeat_interleave", "masked_select", "masked_fill", "nonzero",
-    "moveaxis", "slice", "numel",
-    # linalg
-    "matmul", "bmm", "dot", "mm", "mv", "norm", "dist", "cholesky", "inverse",
-    "qr", "svd", "solve", "det", "matrix_power", "cross", "outer", "inner",
-    "histogram", "bincount",
-    # comparison
-    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
-    "less_equal", "allclose", "isclose", "equal_all", "is_empty",
-]
-
-
-def _find(name):
-    for mod in _METHOD_SOURCES:
-        fn = getattr(mod, name, None)
-        if fn is not None:
-            return fn
-    raise AttributeError(name)
+_MODULES = {"math": math, "reduction": reduction, "manipulation": manipulation,
+            "linalg": linalg, "comparison": comparison}
 
 
 def _bind():
-    for name in _METHODS:
-        fn = _find(name)
-        if not hasattr(Tensor, name):
-            setattr(Tensor, name, fn)
+    for module_name, names in METHODS.items():
+        mod = _MODULES[module_name]
+        for name in names:
+            fn = getattr(mod, name)
+            if not hasattr(Tensor, name):
+                setattr(Tensor, name, fn)
 
-    # dunders
-    Tensor.__add__ = lambda s, o: math.add(s, o)
-    Tensor.__radd__ = lambda s, o: math.add(o, s)
-    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
-    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
-    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
-    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
-    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
-    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
-    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
-    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
-    Tensor.__mod__ = lambda s, o: math.remainder(s, o)
-    Tensor.__pow__ = lambda s, o: math.pow(s, o)
-    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    for dunder, (module_name, op, reflected) in DUNDERS.items():
+        fn = getattr(_MODULES[module_name], op)
+        if reflected:
+            setattr(Tensor, dunder, lambda s, o, _f=fn: _f(o, s))
+        else:
+            setattr(Tensor, dunder, lambda s, o, _f=fn: _f(s, o))
     Tensor.__neg__ = lambda s: math.neg(s)
     Tensor.__abs__ = lambda s: math.abs(s)
-    Tensor.__matmul__ = lambda s, o: linalg.matmul(s, o)
-    Tensor.__rmatmul__ = lambda s, o: linalg.matmul(o, s)
-    Tensor.__eq__ = lambda s, o: comparison.equal(s, o)
-    Tensor.__ne__ = lambda s, o: comparison.not_equal(s, o)
-    Tensor.__gt__ = lambda s, o: comparison.greater_than(s, o)
-    Tensor.__ge__ = lambda s, o: comparison.greater_equal(s, o)
-    Tensor.__lt__ = lambda s, o: comparison.less_than(s, o)
-    Tensor.__le__ = lambda s, o: comparison.less_equal(s, o)
-    Tensor.__and__ = lambda s, o: math.logical_and(s, o)
-    Tensor.__or__ = lambda s, o: math.logical_or(s, o)
-    Tensor.__xor__ = lambda s, o: math.logical_xor(s, o)
     Tensor.__invert__ = lambda s: math.logical_not(s)
     Tensor.__getitem__ = manipulation.getitem
     Tensor.__setitem__ = manipulation.setitem
 
     # in-place variants (paddle `op_` convention): compute out-of-place, rebind
-    def _make_inplace(opname):
-        fn = _find(opname)
-
+    def _make_inplace(fn, opname):
         def inplace(self, *a, **k):
             return self._rebind(fn(self, *a, **k))
 
         inplace.__name__ = opname + "_"
         return inplace
 
-    for opname in ["add", "subtract", "multiply", "divide", "clip", "scale",
-                   "exp", "sqrt", "reciprocal", "floor", "ceil", "round",
-                   "squeeze", "unsqueeze", "reshape", "flatten", "cast"]:
-        setattr(Tensor, opname + "_", _make_inplace(opname))
+    for opname in INPLACE:
+        fn = next((f for mod in _MODULES.values()
+                   if (f := getattr(mod, opname, None)) is not None), None)
+        if fn is None:  # fail at bind time, naming the offender
+            raise AttributeError(
+                f"api.yaml inplace op {opname!r} resolves in no ops module")
+        setattr(Tensor, opname + "_", _make_inplace(fn, opname))
 
     def zero_(self):
-        from . import creation
-
         return self._rebind(creation.zeros_like(self))
 
     def fill_(self, value):
-        from . import creation
-
         return self._rebind(creation.full_like(self, value))
 
     Tensor.zero_ = zero_

@@ -115,8 +115,7 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
     num_frames = x.shape[-1]
     env = _overlap_add(jnp.broadcast_to(
         wsq.data, (n_fft, num_frames)), hop_length=hop_length, axis=-1)
-    env = Tensor(jnp.where(env.data > 1e-11, env.data, 1.0)) \
-        if isinstance(env, Tensor) else Tensor(jnp.where(env > 1e-11, env, 1.0))
+    env = Tensor(jnp.where(env.data > 1e-11, env.data, 1.0))  # floor the envelope
     out = out / env
     if center:
         p = n_fft // 2

@@ -105,9 +105,7 @@ def matmul(x, y, name=None):
 def add(x, y, name=None):
     a, b = _unwrap(x), _unwrap(y)
     if isinstance(a, jsparse.BCOO) and isinstance(b, jsparse.BCOO):
-        return SparseCooTensor(jsparse.bcoo_add_indices_dedupe(
-            a, b)) if hasattr(jsparse, "bcoo_add_indices_dedupe") else \
-            SparseCooTensor((a + b).sum_duplicates())
+        return SparseCooTensor((a + b).sum_duplicates())
     out = (a.todense() if isinstance(a, jsparse.BCOO) else a) + \
         (b.todense() if isinstance(b, jsparse.BCOO) else b)
     return Tensor(out)

@@ -69,7 +69,8 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         data = boxes.data if isinstance(boxes, Tensor) else boxes
         cat = category_idxs.data if isinstance(category_idxs, Tensor) \
             else jnp.asarray(category_idxs)
-        offset = (data.max() + 1.0) * cat.astype(data.dtype)
+        span = data.max() - data.min() + 1.0  # works for negative coords too
+        offset = span * cat.astype(data.dtype)
         boxes = Tensor(data + offset[:, None])
     keep_sorted, order = _nms_keep_mask(boxes, scores,
                                         iou_threshold=float(iou_threshold))
@@ -381,7 +382,6 @@ class DeformConv2D(nn.Layer):
         self.dilation = dilation
         self.deformable_groups = deformable_groups
         self.groups = groups
-        from .. import nn as _nn
         from ..nn import initializer as I
 
         self.weight = self.create_parameter(

@@ -1,0 +1,185 @@
+"""GPT + BERT model-family tests, incl. TP-sharded parity on the 8-CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import (BertConfig, BertForPretraining,
+                               BertForSequenceClassification, GPTConfig,
+                               GPTForCausalLM)
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def _ids(shape, vocab=256, seed=0):
+    return paddle.to_tensor(
+        np.random.default_rng(seed).integers(0, vocab, shape).astype("int64"))
+
+
+def test_gpt_loss_and_grads():
+    paddle.seed(0)
+    gpt = GPTForCausalLM(GPTConfig.tiny())
+    ids = _ids((2, 16))
+    loss = gpt(ids, labels=ids)
+    assert np.isfinite(float(loss))
+    loss.backward()
+    assert all(p.grad is not None for p in gpt.parameters())
+
+
+def test_gpt_train_step_converges():
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+
+    paddle.seed(1)
+    gpt = GPTForCausalLM(GPTConfig.tiny())
+    o = opt.AdamW(learning_rate=1e-3, parameters=gpt.parameters())
+    step = jit.TrainStep(gpt, lambda m, x: m(x, labels=x), o)
+    ids = _ids((4, 32), seed=3)
+    losses = [float(step(ids)) for _ in range(12)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_gpt_generate_extends_sequence():
+    paddle.seed(2)
+    gpt = GPTForCausalLM(GPTConfig.tiny())
+    gpt.eval()
+    ids = _ids((1, 5))
+    out = gpt.generate(ids, max_new_tokens=4)
+    assert out.shape == [1, 9]
+    np.testing.assert_array_equal(_np(out)[:, :5], _np(ids))
+
+
+def test_bert_pretraining_and_classification():
+    paddle.seed(3)
+    cfg = BertConfig.tiny()
+    bert = BertForPretraining(cfg)
+    ids = _ids((2, 16))
+    mlm = _ids((2, 16), seed=5)
+    nsp = paddle.to_tensor(np.asarray([0, 1], "int64"))
+    loss = bert(ids, masked_lm_labels=mlm, next_sentence_labels=nsp)
+    assert np.isfinite(float(loss))
+    loss.backward()
+
+    clf = BertForSequenceClassification(cfg, num_classes=3)
+    logits = clf(ids)
+    assert logits.shape == [2, 3]
+
+
+def test_bert_attention_mask_changes_output():
+    paddle.seed(4)
+    cfg = BertConfig.tiny()
+    bert = BertForPretraining(cfg)
+    bert.eval()
+    ids = _ids((1, 8))
+    full = paddle.to_tensor(np.ones((1, 8), "int64"))
+    half = paddle.to_tensor(np.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], "int64"))
+    out_full, _ = bert(ids, attention_mask=full)
+    out_half, _ = bert(ids, attention_mask=half)
+    assert not np.allclose(_np(out_full), _np(out_half))
+
+
+def test_gpt_tensor_parallel_matches_single():
+    """mp=4 sharded loss equals the unsharded loss (GSPMD parity)."""
+    paddle.seed(5)
+    ids = _ids((2, 16), seed=7)
+    ref = GPTForCausalLM(GPTConfig.tiny())
+    loss_ref = float(ref(ids, labels=ids))
+
+    env = dist.init_mesh(dp=2, mp=4)
+    try:
+        paddle.seed(5)
+        par = GPTForCausalLM(GPTConfig.tiny())
+        from paddle_tpu.distributed.parallel import place_model
+
+        place_model(par)
+        loss_par = float(par(ids, labels=ids))
+    finally:
+        dist.reset_mesh()
+    np.testing.assert_allclose(loss_par, loss_ref, rtol=2e-4)
+
+
+def test_bert_tensor_parallel_matches_single():
+    paddle.seed(6)
+    ids = _ids((2, 16), seed=9)
+    mlm = _ids((2, 16), seed=11)
+    ref = BertForPretraining(BertConfig.tiny())
+    ref.eval()
+    loss_ref = float(ref(ids, masked_lm_labels=mlm))
+
+    env = dist.init_mesh(mp=4, dp=2)
+    try:
+        paddle.seed(6)
+        par = BertForPretraining(BertConfig.tiny())
+        par.eval()
+        from paddle_tpu.distributed.parallel import place_model
+
+        place_model(par)
+        loss_par = float(par(ids, masked_lm_labels=mlm))
+    finally:
+        dist.reset_mesh()
+    np.testing.assert_allclose(loss_par, loss_ref, rtol=2e-4)
+
+
+# -- parameter-server mode ----------------------------------------------------
+
+def test_parameter_server_pull_push_sgd():
+    from paddle_tpu.distributed.ps import ParameterServer, PsTrainer
+
+    store = dist.TCPStore(is_master=True, world_size=1)
+    try:
+        ps = ParameterServer(store).create_table("emb", (100, 8), lr=0.5).run()
+        trainer = PsTrainer(store)
+        ids = np.asarray([3, 7, 3], "int64")
+        rows = trainer.pull("emb", np.unique(ids))
+        assert rows.shape == (2, 8)
+        grads = np.ones((2, 8), "float32")
+        trainer.push("emb", np.unique(ids), grads, wait=True)
+        rows2 = trainer.pull("emb", np.unique(ids))
+        np.testing.assert_allclose(rows2, rows - 0.5 * grads, rtol=1e-6)
+        ps.stop()
+    finally:
+        store.close()
+
+
+def test_sparse_embedding_learns():
+    from paddle_tpu.distributed.ps import (ParameterServer, PsTrainer,
+                                           SparseEmbedding)
+    import paddle_tpu.nn.functional as F
+
+    store = dist.TCPStore(is_master=True, world_size=1)
+    try:
+        ps = ParameterServer(store).create_table("tbl", (50, 4), lr=0.3).run()
+        emb = SparseEmbedding(PsTrainer(store), "tbl", 4)
+        ids = paddle.to_tensor(np.asarray([[1, 2], [2, 3]], "int64"))
+        target = paddle.ones([2, 2, 4])
+        losses = []
+        for _ in range(25):
+            out = emb(ids)
+            loss = F.mse_loss(out, target)
+            losses.append(float(loss))
+            loss.backward()
+            emb.push_grad(out.grad, wait=True)
+        assert losses[-1] < losses[0] * 0.1, losses[::6]
+        ps.stop()
+    finally:
+        store.close()
+
+
+def test_gpt_cached_generate_matches_uncached():
+    paddle.seed(7)
+    gpt = GPTForCausalLM(GPTConfig.tiny())
+    gpt.eval()
+    ids = _ids((2, 6), seed=13)
+    fast = gpt.generate(ids, max_new_tokens=5, use_cache=True)
+    slow = gpt.generate(ids, max_new_tokens=5, use_cache=False)
+    np.testing.assert_array_equal(_np(fast), _np(slow))
+
+
+def test_gpt_param_count_exact():
+    from paddle_tpu.models import gpt_param_count
+
+    gpt = GPTForCausalLM(GPTConfig.tiny())
+    actual = sum(int(np.prod(p.shape)) for p in gpt.parameters())
+    assert gpt_param_count(gpt.config) == actual
