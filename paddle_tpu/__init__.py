@@ -84,7 +84,8 @@ def _try_import(names):
 _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "distributed", "regularizer", "autograd", "profiler", "text",
               "distribution", "static", "incubate", "device", "hapi",
-              "inference", "utils", "fft", "signal", "sparse", "onnx"])
+              "inference", "utils", "fft", "signal", "sparse", "onnx",
+              "version", "sysconfig", "quantization"])
 try:
     from .hapi import Model, summary, flops  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402

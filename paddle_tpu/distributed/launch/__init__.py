@@ -57,7 +57,9 @@ def main(argv=None):
     parser.add_argument("--rank", type=int, default=None, help="this host's index")
     parser.add_argument("--devices", default=None,
                         help="accepted for reference-compat; chips are auto-discovered")
-    parser.add_argument("--nproc_per_node", default=None, help="reference-compat; ignored")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="spawn N local processes (PS trainers / CPU "
+                        "emulation); TPU SPMD normally uses 1")
     parser.add_argument("--log_dir", default=None)
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
@@ -68,6 +70,31 @@ def main(argv=None):
         os.environ.setdefault("PADDLE_TRAINERS_NUM", str(args.nnodes))
         if args.rank is not None:
             os.environ.setdefault("PADDLE_TRAINER_ID", str(args.rank))
+
+    if args.nproc_per_node and args.nproc_per_node > 1:
+        # gang-spawn with per-rank env + logs; fail fast on first bad exit.
+        # Global rank = host_rank * nproc + local_rank so multi-node gangs
+        # don't collide; children run init_from_env themselves (jax state
+        # cannot cross the fork).
+        from .process import ProcessContext
+
+        nproc = args.nproc_per_node
+        host_rank = args.rank or 0
+        world = args.nnodes * nproc
+
+        def rank_envs(local_rank):
+            return {"PADDLE_TRAINER_ID": str(host_rank * nproc + local_rank),
+                    "PADDLE_TRAINERS_NUM": str(world),
+                    "PADDLE_LOCAL_RANK": str(local_rank)}
+
+        cmd = [sys.executable, args.script] + args.script_args
+        ctx = ProcessContext.start(cmd, nproc, log_dir=args.log_dir,
+                                   extra_env_fn=rank_envs)
+        rc = ctx.wait()
+        if rc != 0:
+            sys.exit(rc)
+        return
+
     if args.nnodes > 1:
         init_from_env()
 

@@ -1,0 +1,125 @@
+"""Local process management for the launcher.
+
+Reference: python/paddle/distributed/fleet/launch_utils.py
+(start_local_trainers:480, watch_local_trainers, terminate_local_procs) and
+distributed/run/ controllers — spawn one process per rank with wired env,
+tee logs per rank, watch for failures, kill the gang on first error.
+
+On TPU one process normally drives all local chips, so multi-process spawn
+serves the *non-SPMD* roles: parameter-server trainers/servers, CPU-mesh
+emulation, elastic restarts.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class ProcEntry:
+    def __init__(self, rank: int, proc: subprocess.Popen, log_path=None,
+                 log_fh=None):
+        self.rank = rank
+        self.proc = proc
+        self.log_path = log_path
+        self.log_fh = log_fh
+
+
+class ProcessContext:
+    """The gang of local ranks (TrainerProc list role, launch_utils.py:432)."""
+
+    def __init__(self, entries: List[ProcEntry]):
+        self.entries = entries
+
+    @staticmethod
+    def start(cmd: List[str], nprocs: int, base_env: Optional[Dict] = None,
+              log_dir: Optional[str] = None, rank_env: str = "PADDLE_TRAINER_ID",
+              extra_env_fn=None) -> "ProcessContext":
+        """Spawn `nprocs` copies of cmd; rank r gets rank_env=r (+ world size)
+        and logs to `<log_dir>/workerlog.<r>` like the reference."""
+        entries = []
+        for r in range(nprocs):
+            env = dict(os.environ)
+            env.update(base_env or {})
+            env[rank_env] = str(r)
+            env.setdefault("PADDLE_TRAINERS_NUM", str(nprocs))
+            if extra_env_fn is not None:
+                env.update(extra_env_fn(r))
+            log_fh = None
+            log_path = None
+            if log_dir:
+                os.makedirs(log_dir, exist_ok=True)
+                log_path = os.path.join(log_dir, f"workerlog.{r}")
+                log_fh = open(log_path, "wb")
+            proc = subprocess.Popen(
+                cmd, env=env,
+                stdout=log_fh if log_fh else None,
+                stderr=subprocess.STDOUT if log_fh else None)
+            entries.append(ProcEntry(r, proc, log_path, log_fh))
+        return ProcessContext(entries)
+
+    def poll(self) -> Optional[int]:
+        """None while all alive; 0 when all exited cleanly; first non-zero
+        exit code on failure (the watch_local_trainers contract)."""
+        codes = [e.proc.poll() for e in self.entries]
+        for c in codes:
+            if c is not None and c != 0:
+                return c
+        if all(c == 0 for c in codes):
+            return 0
+        return None
+
+    def wait(self, timeout: Optional[float] = None, poll_interval=0.2) -> int:
+        """Block until the gang finishes; kill everyone on first failure."""
+        deadline = None if timeout is None else time.time() + timeout
+        while True:
+            rc = self.poll()
+            if rc == 0:
+                self._close_logs()
+                return 0
+            if rc is not None:
+                self.terminate()
+                return rc
+            if deadline is not None and time.time() > deadline:
+                self.terminate()
+                raise TimeoutError(f"gang did not finish within {timeout}s")
+            time.sleep(poll_interval)
+
+    def terminate(self, grace: float = 3.0):
+        """SIGTERM then SIGKILL stragglers (terminate_local_procs role)."""
+        for e in self.entries:
+            if e.proc.poll() is None:
+                try:
+                    e.proc.terminate()
+                except OSError:
+                    pass
+        deadline = time.time() + grace
+        for e in self.entries:
+            while e.proc.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if e.proc.poll() is None:
+                try:
+                    e.proc.kill()
+                except OSError:
+                    pass
+        self._close_logs()
+
+    def _close_logs(self):
+        for e in self.entries:
+            if e.log_fh:
+                try:
+                    e.log_fh.close()
+                except OSError:
+                    pass
+                e.log_fh = None
+
+    def logs(self) -> Dict[int, str]:
+        out = {}
+        for e in self.entries:
+            if e.log_path and os.path.exists(e.log_path):
+                with open(e.log_path, "rb") as f:
+                    out[e.rank] = f.read().decode(errors="replace")
+        return out

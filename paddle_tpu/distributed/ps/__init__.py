@@ -43,19 +43,27 @@ class ParameterServer:
     """Holds sparse tables; applies pushed row-gradients (table_manager role,
     reference ps/table/memory_sparse_table.cc)."""
 
-    def __init__(self, store: TCPStore, server_id: int = 0):
+    def __init__(self, store: TCPStore, server_id: int = 0,
+                 request_timeout: int = 10):
         self.store = _own_client(store)
+        # bounded gets: a trainer dying mid-request must not wedge serving
+        # for the full default 900s (see _loop's retry handling)
+        self.store._lib.tcpstore_set_timeout(self.store._fd,
+                                             int(request_timeout))
+        self.store.timeout = int(request_timeout)
         self.server_id = server_id
         self.tables: Dict[str, np.ndarray] = {}
         self.lr: Dict[str, float] = {}
+        self._mu = threading.Lock()  # create_table vs serving loop
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def create_table(self, name: str, shape, lr: float = 0.1, init_std=0.01,
                      seed: int = 0):
         rng = np.random.RandomState(seed)
-        self.tables[name] = (rng.randn(*shape) * init_std).astype("float32")
-        self.lr[name] = float(lr)
+        with self._mu:
+            self.tables[name] = (rng.randn(*shape) * init_std).astype("float32")
+            self.lr[name] = float(lr)
         self.store.set(f"ps/{name}/meta", _dumps(np.asarray(shape, "int64")))
         return self
 
@@ -68,40 +76,74 @@ class ParameterServer:
         self._thread.start()
         return self
 
+    MAX_REQUEST_RETRIES = 3  # ticks before a payload-less request is skipped
+
     def _loop(self, poll_interval):
+        import sys
+
         served_pull: Dict[str, int] = {}
         served_push: Dict[str, int] = {}
+        retries: Dict[tuple, int] = {}
+
+        def give_up(kind, name, served):
+            """A trainer died between bumping the counter and writing its
+            payload: after MAX_REQUEST_RETRIES timeouts, skip that id so the
+            table keeps serving everyone else."""
+            k = served.get(name, 0) + 1
+            key = (kind, name, k)
+            retries[key] = retries.get(key, 0) + 1
+            if retries[key] >= self.MAX_REQUEST_RETRIES:
+                print(f"ParameterServer[{name}]: abandoning {kind} request "
+                      f"{k} (no payload after {retries[key]} attempts)",
+                      file=sys.stderr)
+                served[name] = k
+                retries.pop(key, None)
+
         while not self._stop.is_set():
-            for name, table in self.tables.items():
+            with self._mu:
+                snapshot = list(self.tables.items())
+            for name, table in snapshot:
                 # pulls: trainer writes ids, bumps request counter
-                n_req = self.store.add(f"ps/{name}/pull_req", 0)
-                k = served_pull.get(name, 0)
-                while k < n_req:
-                    k += 1
-                    ids = _loads(self.store.get(f"ps/{name}/pull/{k}/ids"))
-                    rows = table[ids]
-                    self.store.set(f"ps/{name}/pull/{k}/rows", _dumps(rows))
-                    self.store.delete_key(f"ps/{name}/pull/{k}/ids")
-                served_pull[name] = k
+                try:
+                    n_req = self.store.add(f"ps/{name}/pull_req", 0)
+                    while served_pull.get(name, 0) < n_req:
+                        k = served_pull.get(name, 0) + 1
+                        ids = _loads(self.store.get(f"ps/{name}/pull/{k}/ids"))
+                        rows = table[ids]
+                        self.store.set(f"ps/{name}/pull/{k}/rows", _dumps(rows))
+                        self.store.delete_key(f"ps/{name}/pull/{k}/ids")
+                        served_pull[name] = k  # progress survives a later retry
+                except TimeoutError:
+                    give_up("pull", name, served_pull)
+                except Exception as e:  # pragma: no cover - defensive
+                    print(f"ParameterServer[{name}]: {e!r}", file=sys.stderr)
                 # pushes: trainer writes (ids, grads), bumps counter
-                n_push = self.store.add(f"ps/{name}/push_req", 0)
-                k = served_push.get(name, 0)
-                while k < n_push:
-                    k += 1
-                    ids = _loads(self.store.get(f"ps/{name}/push/{k}/ids"))
-                    grads = _loads(self.store.get(f"ps/{name}/push/{k}/grads"))
-                    np.subtract.at(table, ids, self.lr[name] * grads)
-                    # per-request ack, then free the payload keys
-                    self.store.set(f"ps/{name}/push/{k}/done", b"1")
-                    self.store.delete_key(f"ps/{name}/push/{k}/ids")
-                    self.store.delete_key(f"ps/{name}/push/{k}/grads")
-                served_push[name] = k
+                try:
+                    n_push = self.store.add(f"ps/{name}/push_req", 0)
+                    while served_push.get(name, 0) < n_push:
+                        k = served_push.get(name, 0) + 1
+                        ids = _loads(self.store.get(f"ps/{name}/push/{k}/ids"))
+                        grads = _loads(
+                            self.store.get(f"ps/{name}/push/{k}/grads"))
+                        np.subtract.at(table, ids, self.lr[name] * grads)
+                        # per-request ack, then free the payload keys
+                        self.store.set(f"ps/{name}/push/{k}/done", b"1")
+                        self.store.delete_key(f"ps/{name}/push/{k}/ids")
+                        self.store.delete_key(f"ps/{name}/push/{k}/grads")
+                        served_push[name] = k
+                except TimeoutError:
+                    give_up("push", name, served_push)
+                except Exception as e:  # pragma: no cover - defensive
+                    print(f"ParameterServer[{name}]: {e!r}", file=sys.stderr)
             self._stop.wait(poll_interval)
 
     def stop(self):
         self._stop.set()
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            # outlast a get blocked for the full request timeout
+            self._thread.join(timeout=self.store.timeout + 2)
+            if self._thread.is_alive():  # pragma: no cover - defensive
+                return  # leak the fd rather than close it under the thread
         self.store.close()
 
 

@@ -152,6 +152,8 @@ class BertForPretraining(nn.Layer):
         self.mlm_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
         self.mlm_bias = self.create_parameter([config.vocab_size], is_bias=True)
         self.nsp_head = nn.Linear(config.hidden_size, 2)
+        if config.dtype == "bfloat16":
+            self.to(dtype="bfloat16")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 masked_lm_labels=None, next_sentence_labels=None):
@@ -178,6 +180,8 @@ class BertForSequenceClassification(nn.Layer):
         self.bert = BertModel(config)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
         self.classifier = nn.Linear(config.hidden_size, num_classes)
+        if config.dtype == "bfloat16":
+            self.to(dtype="bfloat16")
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 labels=None):

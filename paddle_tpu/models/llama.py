@@ -365,9 +365,14 @@ def _fused_linear_ce(hidden2d, w, labels1d, *, chunk, ignore_index):
 
     n = hidden2d.shape[0]
     n_chunks = max(n // chunk, 1)
-    c = n // n_chunks
-    h3 = hidden2d[: n_chunks * c].reshape(n_chunks, c, hidden2d.shape[1])
-    l2 = labels1d[: n_chunks * c].reshape(n_chunks, c)
+    c = -(-n // n_chunks)  # ceil: every token contributes
+    pad = n_chunks * c - n
+    if pad:
+        hidden2d = jnp.pad(hidden2d, ((0, pad), (0, 0)))
+        labels1d = jnp.pad(labels1d, (0, pad),
+                           constant_values=ignore_index)  # padded rows masked
+    h3 = hidden2d.reshape(n_chunks, c, hidden2d.shape[1])
+    l2 = labels1d.reshape(n_chunks, c)
 
     def body(acc, xs):
         h, lab = xs

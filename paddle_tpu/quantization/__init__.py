@@ -1,0 +1,209 @@
+"""paddle.quantization (reference: python/paddle/fluid/contrib/slim/quantization
+— QuantizationTransformPass / ImperativeQuantAware + fake_quantize ops under
+paddle/fluid/operators/fake_quantize_op.cc).
+
+TPU-native: fake-quant is one dispatched primitive with a straight-through
+vjp (the fake_quantize_dequantize kernel role); QAT swaps Linear/Conv2D for
+fake-quant wrappers; PTQ observes abs-max over calibration batches and
+converts weights to int8 + scale (simulated dequant at matmul time — XLA
+int8 matmul feeds the MXU on current TPUs via bf16 upcast).
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import primitive
+from ..core.tensor import Tensor
+from .. import nn
+
+__all__ = ["fake_quant", "FakeQuantAbsMax", "QuantedLinear", "QuantedConv2D",
+           "QAT", "PTQ", "quant_linear_int8"]
+
+
+@primitive("fake_quant_dequant")
+def _fake_qdq(x, scale, *, bits):
+    qmax = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * qmax), -qmax, qmax)
+    return q * s / qmax
+
+
+@_fake_qdq.defvjp
+def _fake_qdq_vjp(ct, out, primals, *, bits):
+    """Straight-through estimator: pass grads where |x| <= scale."""
+    x, scale = primals
+    mask = (jnp.abs(x) <= jnp.maximum(scale, 1e-9)).astype(ct.dtype)
+    return ct * mask, None
+
+
+def fake_quant(x, scale, bits=8):
+    """Quantize-dequantize with STE backward (fake_quantize_dequantize role)."""
+    return _fake_qdq(x, scale, bits=int(bits))
+
+
+class FakeQuantAbsMax(nn.Layer):
+    """Moving-average abs-max observer + fake quant (reference
+    FakeQuantMovingAverageAbsMax)."""
+
+    def __init__(self, bits=8, momentum=0.9):
+        super().__init__()
+        self.bits = bits
+        self.momentum = momentum
+        from ..ops import creation
+
+        # scale == 0 means "never observed" — persisted through state_dict,
+        # so a restored EMA continues instead of restarting from the batch max
+        self.register_buffer("scale", creation.zeros([]))
+
+    def forward(self, x):
+        import numpy as np
+
+        seen = float(np.asarray(self.scale.data)) > 0.0
+        if self.training:
+            from ..ops import reduction as R
+
+            cur = R.max(x.abs()).astype("float32")
+            if not seen:
+                self.scale.data = cur.data
+            else:
+                self.scale.data = (self.momentum * self.scale.data
+                                   + (1 - self.momentum) * cur.data)
+        elif not seen:
+            return x  # uncalibrated eval: pass through rather than zero out
+        return fake_quant(x, self.scale, self.bits)
+
+
+class QuantedLinear(nn.Layer):
+    """Linear with weight + activation fake quant (QAT wrapper role)."""
+
+    def __init__(self, layer: nn.Linear, bits=8):
+        super().__init__()
+        self.inner = layer
+        self.bits = bits
+        self.act_quant = FakeQuantAbsMax(bits)
+
+    def forward(self, x):
+        from ..ops import reduction as R
+
+        x = self.act_quant(x)
+        w = self.inner.weight
+        w_scale = R.max(w.abs()).astype("float32")
+        wq = fake_quant(w, w_scale, self.bits)
+        from ..nn import functional as F
+
+        return F.linear(x, wq, self.inner.bias)
+
+
+class QuantedConv2D(nn.Layer):
+    def __init__(self, layer: nn.Conv2D, bits=8):
+        super().__init__()
+        self.inner = layer
+        self.bits = bits
+        self.act_quant = FakeQuantAbsMax(bits)
+
+    def forward(self, x):
+        from ..ops import reduction as R
+        from ..nn import functional as F
+
+        x = self.act_quant(x)
+        w = self.inner.weight
+        wq = fake_quant(w, R.max(w.abs()).astype("float32"), self.bits)
+        return F.conv2d(x, wq, self.inner.bias, self.inner._stride,
+                        self.inner._padding, self.inner._dilation,
+                        self.inner._groups)
+
+
+class QAT:
+    """Quant-aware training driver (reference ImperativeQuantAware.quantize)."""
+
+    def __init__(self, bits=8):
+        self.bits = bits
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        """Swap quantizable sublayers in place; returns the model."""
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, nn.Linear):
+                model._sub_layers[name] = QuantedLinear(sub, self.bits)
+            elif isinstance(sub, nn.Conv2D):
+                model._sub_layers[name] = QuantedConv2D(sub, self.bits)
+            else:
+                self.quantize(sub)
+        return model
+
+
+def quant_linear_int8(weight) -> tuple:
+    """weight -> (int8 ndarray, float scale): the PTQ convert step."""
+    w = np.asarray(weight.data if isinstance(weight, Tensor) else weight,
+                   "float32")
+    scale = float(np.abs(w).max()) or 1e-9
+    q = np.clip(np.round(w / scale * 127.0), -127, 127).astype(np.int8)
+    return q, scale
+
+
+class _Int8Linear(nn.Layer):
+    """Inference-only int8 linear: int8 weights + scale; activations are
+    statically quantized with the calibrated abs-max when one was observed
+    (the reference's activation-scale use in PostTrainingQuantization)."""
+
+    def __init__(self, qweight: np.ndarray, scale: float, bias,
+                 act_scale: Optional[float] = None, bits: int = 8):
+        super().__init__()
+        self.register_buffer("qweight", Tensor(jnp.asarray(qweight)))
+        self.scale = scale
+        self.act_scale = act_scale
+        self.bits = bits
+        self.bias = bias
+
+    def forward(self, x):
+        from ..nn import functional as F
+
+        if self.act_scale:
+            x = fake_quant(x, Tensor(jnp.asarray(self.act_scale, jnp.float32)),
+                           self.bits)
+        w = (self.qweight.astype(str(x.dtype)) * (self.scale / 127.0))
+        return F.linear(x, w, self.bias)
+
+
+class PTQ:
+    """Post-training quantization (reference PostTrainingQuantization):
+    calibrate activations, convert Linear weights to int8 + scale."""
+
+    def __init__(self, bits=8):
+        self.bits = bits
+        self._observed: Dict[int, float] = {}
+        self._hooks = []
+
+    def quantize(self, model: nn.Layer) -> nn.Layer:
+        """Install activation observers; run calibration batches, then
+        convert()."""
+        for _, sub in model.named_sublayers():
+            if isinstance(sub, nn.Linear):
+                def hook(l, ins, outs):
+                    x = ins[0]
+                    cur = float(np.abs(np.asarray(x.data)).max())
+                    self._observed[id(l)] = max(self._observed.get(id(l), 0.0),
+                                                cur)
+                self._hooks.append(sub.register_forward_pre_hook(
+                    lambda l, ins, _h=hook: _h(l, ins, None)))
+        return model
+
+    def convert(self, model: nn.Layer) -> nn.Layer:
+        for h in self._hooks:
+            h.remove()
+        self._hooks = []
+        self._convert(model)
+        return model
+
+    def _convert(self, model: nn.Layer):
+        for name, sub in list(model._sub_layers.items()):
+            if isinstance(sub, nn.Linear):
+                q, scale = quant_linear_int8(sub.weight)
+                model._sub_layers[name] = _Int8Linear(
+                    q, scale, sub.bias,
+                    act_scale=self._observed.get(id(sub)), bits=self.bits)
+            else:
+                self._convert(sub)

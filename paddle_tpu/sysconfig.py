@@ -1,0 +1,12 @@
+"""paddle.sysconfig (reference: python/paddle/sysconfig.py)."""
+import os
+
+
+def get_include():
+    """Headers for native extensions (the device plugin C ABI lives here)."""
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "device", "ext")
+
+
+def get_lib():
+    return os.path.dirname(os.path.abspath(__file__))

@@ -1,0 +1,24 @@
+"""paddle.version (reference: generated python/paddle/version.py)."""
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+cuda_version = "False"  # TPU build
+cudnn_version = "False"
+istaged = True
+commit = "tpu-native"
+
+
+def show():
+    print(f"full_version: {full_version}")
+    print(f"commit: {commit}")
+    print("cuda: False (TPU/XLA build)")
+
+
+def cuda():
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -183,3 +183,67 @@ def test_gpt_param_count_exact():
     gpt = GPTForCausalLM(GPTConfig.tiny())
     actual = sum(int(np.prod(p.shape)) for p in gpt.parameters())
     assert gpt_param_count(gpt.config) == actual
+
+
+# -- launcher process management ----------------------------------------------
+
+def test_process_context_gang_success(tmp_path):
+    import sys
+    from paddle_tpu.distributed.launch.process import ProcessContext
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "print(f'hello from rank {rank} of', os.environ['PADDLE_TRAINERS_NUM'])\n")
+    ctx = ProcessContext.start([sys.executable, str(script)], nprocs=3,
+                               log_dir=str(tmp_path / "logs"))
+    assert ctx.wait(timeout=60) == 0
+    logs = ctx.logs()
+    assert len(logs) == 3
+    for r in range(3):
+        assert f"hello from rank {r} of 3" in logs[r]
+
+
+def test_process_context_kills_gang_on_failure(tmp_path):
+    import sys
+    from paddle_tpu.distributed.launch.process import ProcessContext
+
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys, time\n"
+        "if os.environ['PADDLE_TRAINER_ID'] == '1':\n"
+        "    sys.exit(7)\n"
+        "time.sleep(60)\n")
+    ctx = ProcessContext.start([sys.executable, str(script)], nprocs=3,
+                               log_dir=str(tmp_path / "logs"))
+    t0 = __import__('time').time()
+    rc = ctx.wait(timeout=60)
+    assert rc == 7
+    assert __import__('time').time() - t0 < 30  # gang killed, not waited out
+    assert all(e.proc.poll() is not None for e in ctx.entries)
+
+
+def test_fused_ce_counts_every_token():
+    """Odd token counts must not silently drop the tail from the loss."""
+    import jax.numpy as jnp
+    from paddle_tpu.models.llama import _fused_linear_ce
+
+    rng = np.random.default_rng(0)
+    # n=9 with chunk=4 -> n_chunks=2, c=5, pad=1: exercises the padding path
+    h = rng.standard_normal((9, 8)).astype("float32")
+    w = rng.standard_normal((8, 11)).astype("float32")
+    lab = rng.integers(0, 11, (9,)).astype("int32")
+    fused = float(np.asarray(_fused_linear_ce(
+        paddle.to_tensor(h), paddle.to_tensor(w), paddle.to_tensor(lab),
+        chunk=4, ignore_index=-100).data))
+    logits = h @ w
+    logp = logits - np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) - logits.max(-1, keepdims=True)
+    ref = -np.mean([logp[i, lab[i]] for i in range(9)])
+    np.testing.assert_allclose(fused, ref, rtol=1e-4)
+
+
+def test_bert_bfloat16_config_applies():
+    bert = __import__("paddle_tpu.models", fromlist=["BertForPretraining"]) \
+        .BertForPretraining(BertConfig.tiny(dtype="bfloat16"))
+    assert str(bert.bert.embeddings.word_embeddings.weight.dtype).endswith("bfloat16")

@@ -1,0 +1,91 @@
+"""QAT fake-quant + PTQ int8 conversion."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu import quantization as Q
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_fake_quant_roundtrip_and_ste():
+    x = paddle.to_tensor(np.linspace(-1, 1, 9).astype("float32"),
+                         stop_gradient=False)
+    scale = paddle.to_tensor(np.asarray(1.0, "float32"))
+    out = Q.fake_quant(x, scale, bits=8)
+    # values snap to the 127-level grid
+    np.testing.assert_allclose(_np(out), np.round(_np(x) * 127) / 127,
+                               atol=1e-6)
+    out.sum().backward()
+    np.testing.assert_allclose(_np(x.grad), np.ones(9), atol=1e-6)  # STE
+
+    # out-of-range values pass no grad
+    y = paddle.to_tensor(np.asarray([0.5, 2.0], "float32"), stop_gradient=False)
+    Q.fake_quant(y, scale).sum().backward()
+    np.testing.assert_allclose(_np(y.grad), [1.0, 0.0], atol=1e-6)
+
+
+def test_qat_swaps_layers_and_trains():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    Q.QAT(bits=8).quantize(net)
+    assert isinstance(net[0], Q.QuantedLinear)
+    assert isinstance(net[2], Q.QuantedLinear)
+    opt = paddle.optimizer.Adam(0.01, parameters=net.parameters())
+    x = paddle.randn([32, 8])
+    y = paddle.randint(0, 2, [32])
+    l0 = None
+    for _ in range(25):
+        loss = F.cross_entropy(net(x), y)
+        if l0 is None:
+            l0 = float(loss)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert float(loss) < l0 * 0.7
+
+
+def test_ptq_convert_int8_close_to_fp32():
+    paddle.seed(1)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    net.eval()
+    x = paddle.randn([8, 16])
+    ref = _np(net(x))
+    ptq = Q.PTQ()
+    ptq.quantize(net)
+    net(x)  # calibration pass
+    ptq.convert(net)
+    from paddle_tpu.quantization import _Int8Linear
+
+    assert isinstance(net[0], _Int8Linear)
+    assert str(net[0].qweight.dtype) == "paddle.int8" or "int8" in str(net[0].qweight.dtype)
+    out = _np(net(x))
+    # int8 weight quantization: small relative error
+    rel = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert rel < 0.05, rel
+
+
+def test_qat_eval_before_training_passes_through():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Linear(4, 4))
+    ref = _np(net(paddle.ones([2, 4])))
+    Q.QAT().quantize(net)
+    net.eval()
+    out = _np(net(paddle.ones([2, 4])))
+    # weight fake-quant still applies, but activations must not zero out
+    assert np.abs(out).max() > 1e-3
+    np.testing.assert_allclose(out, ref, rtol=0.05, atol=0.02)
+
+
+def test_ptq_uses_observed_activation_scale():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(4, 4))
+    ptq = Q.PTQ()
+    ptq.quantize(net)
+    net.eval()
+    net(paddle.ones([2, 4]) * 3.0)  # calibration: abs-max 3.0
+    ptq.convert(net)
+    assert abs(net[0].act_scale - 3.0) < 1e-5
