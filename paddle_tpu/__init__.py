@@ -88,6 +88,7 @@ _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "version", "sysconfig", "quantization"])
 try:
     from .hapi import Model, summary, flops  # noqa: F401,E402
+    from .hapi import hub  # noqa: F401,E402
     from .hapi import callbacks  # noqa: F401,E402
 except ImportError:
     pass

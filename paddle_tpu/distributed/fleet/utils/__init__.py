@@ -1,0 +1,3 @@
+"""fleet.utils (reference: python/paddle/distributed/fleet/utils/)."""
+from .fs import LocalFS, HDFSClient, FS  # noqa: F401
+from ...utils_recompute import recompute  # noqa: F401

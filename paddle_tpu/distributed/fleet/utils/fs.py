@@ -1,0 +1,216 @@
+"""Filesystem abstraction (reference: python/paddle/distributed/fleet/utils/
+fs.py — FS base, LocalFS, HDFSClient shelling out to `hadoop fs`).
+
+LocalFS is fully functional; HDFSClient keeps the same surface and shells out
+to the hadoop CLI when one exists (none ships in this image — constructing it
+without a client raises the same way the reference does without JAVA_HOME).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List
+
+
+class ExecuteError(Exception):
+    pass
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FSTimeOut(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        if overwrite and self.is_exist(fs_dst_path):
+            self.delete(fs_dst_path)
+        return self.rename(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """reference fs.py:119 LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for entry in os.listdir(fs_path):
+            full = os.path.join(fs_path, entry)
+            (dirs if os.path.isdir(full) else files).append(entry)
+        return dirs, files
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        if not self.is_exist(fs_src_path):
+            raise FSFileNotExistsError(fs_src_path)
+        if self.is_exist(fs_dst_path):
+            raise FSFileExistsError(fs_dst_path)
+        os.rename(fs_src_path, fs_dst_path)
+
+    def need_upload_download(self):
+        return False
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path):
+            if not exist_ok:
+                raise FSFileExistsError(fs_path)
+            return
+        open(fs_path, "a").close()
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient(FS):
+    """reference fs.py:423 — shells out to `hadoop fs`. The hadoop CLI is not
+    in this image; the constructor verifies availability up front."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=5 * 60 * 1000,
+                 sleep_inter=1000, retry_times=2):
+        self._hadoop = (os.path.join(hadoop_home, "bin", "hadoop")
+                        if hadoop_home else shutil.which("hadoop"))
+        if not self._hadoop or not os.path.exists(self._hadoop):
+            raise ExecuteError(
+                "HDFSClient needs the hadoop CLI (hadoop_home/bin/hadoop); "
+                "none found in this environment")
+        self._configs = configs or {}
+        self.time_out = time_out
+        self.sleep_inter = sleep_inter
+        self.retry_times = max(int(retry_times), 1)
+
+    def _run(self, args: List[str]) -> str:
+        import time
+
+        cmd = [self._hadoop, "fs"] + args
+        last = None
+        for attempt in range(self.retry_times):
+            try:
+                proc = subprocess.run(cmd, capture_output=True, text=True,
+                                      timeout=self.time_out / 1000)
+            except subprocess.TimeoutExpired as e:
+                raise FSTimeOut(f"{' '.join(cmd)} timed out after "
+                                f"{self.time_out}ms") from e
+            if proc.returncode == 0:
+                return proc.stdout
+            last = ExecuteError(f"{' '.join(cmd)}: {proc.stderr}")
+            if attempt + 1 < self.retry_times:
+                time.sleep(self.sleep_inter / 1000)
+        raise last
+
+    def is_exist(self, fs_path):
+        try:
+            self._run(["-test", "-e", fs_path])
+            return True
+        except ExecuteError:
+            return False
+
+    def is_dir(self, fs_path):
+        try:
+            self._run(["-test", "-d", fs_path])
+            return True
+        except ExecuteError:
+            return False
+
+    def is_file(self, fs_path):
+        return self.is_exist(fs_path) and not self.is_dir(fs_path)
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []  # LocalFS-substitutable (reference behavior)
+        out = self._run(["-ls", fs_path])
+        dirs, files = [], []
+        for line in out.splitlines():
+            parts = line.split()
+            if len(parts) < 8:
+                continue
+            name = os.path.basename(parts[-1])
+            (dirs if parts[0].startswith("d") else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        self._run(["-mkdir", "-p", fs_path])
+
+    def delete(self, fs_path):
+        self._run(["-rm", "-r", "-f", fs_path])
+
+    def upload(self, local_path, fs_path):
+        self._run(["-put", local_path, fs_path])
+
+    def download(self, fs_path, local_path):
+        self._run(["-get", fs_path, local_path])
+
+    def rename(self, fs_src_path, fs_dst_path):
+        self._run(["-mv", fs_src_path, fs_dst_path])
+
+    def need_upload_download(self):
+        return True
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path) and not exist_ok:
+            raise FSFileExistsError(fs_path)
+        self._run(["-touchz", fs_path])

@@ -1,0 +1,59 @@
+"""paddle.hub (reference: python/paddle/hapi/hub.py — hubconf.py-driven model
+loading). Zero-egress environment: only source='local' works; github/gitee
+sources raise with a clear message instead of attempting a download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir: str):
+    path = os.path.join(repo_dir, HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"hub: no {HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    module = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(module)
+    finally:
+        sys.path.remove(repo_dir)
+    return module
+
+
+def _require_local(source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} needs network access, unavailable in this "
+            f"environment; clone the repo and use source='local'")
+
+
+def list(repo_dir, source="local", force_reload=False):
+    """Entry-point names exported by the repo's hubconf.py."""
+    _require_local(source)
+    m = _load_hubconf(repo_dir)
+    return [name for name in dir(m)
+            if callable(getattr(m, name)) and not name.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):
+    _require_local(source)
+    m = _load_hubconf(repo_dir)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hub: no entry point {model!r} in {repo_dir}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate a hubconf entry point."""
+    _require_local(source)
+    m = _load_hubconf(repo_dir)
+    fn = getattr(m, model, None)
+    if fn is None or not callable(fn):
+        raise ValueError(f"hub: no entry point {model!r} in {repo_dir}")
+    return fn(**kwargs)
