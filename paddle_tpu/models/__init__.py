@@ -9,3 +9,6 @@ from .gpt import (  # noqa: F401
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertForSequenceClassification,
 )
+from .dit import (  # noqa: F401
+    DiTConfig, DiT, DiTBlock, GaussianDiffusion,
+)

@@ -1,0 +1,134 @@
+"""DiT diffusion transformer (BASELINE config 4 family)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import DiT, DiTConfig, GaussianDiffusion
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def test_dit_zero_init_outputs_zero():
+    """adaLN-Zero: the network is the zero map at init (final_proj zeroed)."""
+    paddle.seed(0)
+    model = DiT(DiTConfig.tiny())
+    model.eval()
+    out = model(paddle.randn([2, 3, 8, 8]),
+                paddle.to_tensor(np.asarray([1, 50], "int32")),
+                paddle.randint(0, 10, [2]))
+    assert out.shape == [2, 3, 8, 8]
+    np.testing.assert_allclose(_np(out), 0.0, atol=1e-6)
+
+
+def test_dit_training_reduces_loss():
+    paddle.seed(1)
+    model = DiT(DiTConfig.tiny())
+    diff = GaussianDiffusion(num_timesteps=100)
+    opt = paddle.optimizer.AdamW(2e-3, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x0 = paddle.to_tensor(rng.standard_normal((8, 3, 8, 8)).astype("float32"))
+    y = paddle.to_tensor(rng.integers(0, 10, (8,)).astype("int64"))
+    # fixed t/noise so the objective is deterministic and must fit
+    t = paddle.to_tensor(np.full((8,), 50, "int32"))
+    noise = paddle.to_tensor(rng.standard_normal((8, 3, 8, 8)).astype("float32"))
+    losses = []
+    for _ in range(30):
+        loss = diff.training_loss(model, x0, y, t=t, noise=noise)
+        losses.append(float(loss))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+
+
+def test_dit_train_step_compiles():
+    from paddle_tpu import jit
+
+    paddle.seed(2)
+    model = DiT(DiTConfig.tiny())
+    diff = GaussianDiffusion(num_timesteps=50)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    step = jit.TrainStep(model, lambda m, x, y: diff.training_loss(m, x, y),
+                         opt)
+    x = paddle.randn([4, 3, 8, 8])
+    y = paddle.randint(0, 10, [4])
+    l1 = float(step(x, y))
+    l2 = float(step(x, y))
+    assert np.isfinite(l1) and np.isfinite(l2)
+
+
+def test_ddim_sampler_shapes_and_determinism():
+    paddle.seed(3)
+    model = DiT(DiTConfig.tiny())
+    model.eval()
+    diff = GaussianDiffusion(num_timesteps=100)
+    y = paddle.to_tensor(np.asarray([3, 7], "int64"))
+    a = _np(diff.ddim_sample(model, (2, 3, 8, 8), y, steps=4, seed=5))
+    b = _np(diff.ddim_sample(model, (2, 3, 8, 8), y, steps=4, seed=5))
+    np.testing.assert_array_equal(a, b)
+    assert a.shape == (2, 3, 8, 8)
+
+
+def test_dit_tensor_parallel_matches_single():
+    paddle.seed(4)
+    x = paddle.randn([2, 3, 8, 8])
+    t = paddle.to_tensor(np.asarray([10, 20], "int32"))
+    y = paddle.to_tensor(np.asarray([1, 2], "int64"))
+
+    paddle.seed(7)
+    ref = DiT(DiTConfig.tiny())
+    ref.eval()
+    # perturb final_proj away from zero so outputs are informative
+    ref.final_proj.weight.set_value(
+        np.random.default_rng(0).standard_normal(
+            tuple(ref.final_proj.weight.shape)).astype("float32") * 0.02)
+    out_ref = _np(ref(x, t, y))
+
+    env = dist.init_mesh(mp=4, dp=2)
+    try:
+        paddle.seed(7)
+        par = DiT(DiTConfig.tiny())
+        par.eval()
+        par.final_proj.weight.set_value(
+            np.random.default_rng(0).standard_normal(
+                tuple(par.final_proj.weight.shape)).astype("float32") * 0.02)
+        from paddle_tpu.distributed.parallel import place_model
+
+        place_model(par)
+        out_par = _np(par(x, t, y))
+    finally:
+        dist.reset_mesh()
+    np.testing.assert_allclose(out_par, out_ref, rtol=1e-4, atol=1e-5)
+
+
+def test_ddim_eta_nonzero_differs_and_learn_sigma_raises():
+    paddle.seed(5)
+    model = DiT(DiTConfig.tiny())
+    model.eval()
+    diff = GaussianDiffusion(num_timesteps=50)
+    y = paddle.to_tensor(np.asarray([0, 1], "int64"))
+    det = _np(diff.ddim_sample(model, (2, 3, 8, 8), y, steps=4, seed=9))
+    stoch = _np(diff.ddim_sample(model, (2, 3, 8, 8), y, steps=4, seed=9,
+                                 eta=1.0))
+    assert not np.allclose(det, stoch)
+    # same seed + same eta stays deterministic
+    stoch2 = _np(diff.ddim_sample(model, (2, 3, 8, 8), y, steps=4, seed=9,
+                                  eta=1.0))
+    np.testing.assert_array_equal(stoch, stoch2)
+    with pytest.raises(NotImplementedError):
+        DiT(DiTConfig.tiny(learn_sigma=True))
+
+
+def test_ddim_sample_in_training_mode_is_deterministic():
+    paddle.seed(6)
+    model = DiT(DiTConfig.tiny())
+    model.train()  # sampler must force eval internally (CFG dropout off)
+    diff = GaussianDiffusion(num_timesteps=50)
+    y = paddle.to_tensor(np.asarray([2], "int64"))
+    a = _np(diff.ddim_sample(model, (1, 3, 8, 8), y, steps=3, seed=1))
+    b = _np(diff.ddim_sample(model, (1, 3, 8, 8), y, steps=3, seed=1))
+    np.testing.assert_array_equal(a, b)
+    assert model.training  # restored afterwards
