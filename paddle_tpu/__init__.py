@@ -19,6 +19,7 @@ from .framework import (  # noqa: F401
     is_compiled_with_tpu, set_flags, get_flags,
 )
 from .core import Tensor, no_grad, enable_grad, is_grad_enabled, set_grad_enabled  # noqa: F401
+from .framework.dtype import iinfo, finfo  # noqa: F401
 from .ops import *  # noqa: F401,F403
 from .ops import creation as _creation  # noqa: F401
 

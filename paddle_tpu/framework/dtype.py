@@ -94,3 +94,52 @@ def is_floating(dtype) -> bool:
 def is_integer(dtype) -> bool:
     d = convert_dtype(dtype)
     return jnp.issubdtype(d, jnp.integer) or d == bool_
+
+
+def _raw_dtype_name(dtype):
+    """The user-requested width, BEFORE x64 canonicalization: an info query
+    must report true int64/float64 limits even though tensor storage narrows."""
+    name = str(dtype)
+    return name.replace("paddle.", "").replace("paddle_tpu.", "")
+
+
+class iinfo:
+    """paddle.iinfo (reference: pybind tensor.cc iinfo binding)."""
+
+    def __init__(self, dtype):
+        import numpy as np
+
+        info = np.iinfo(np.dtype(_raw_dtype_name(dtype)))
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+    def __repr__(self):
+        return f"iinfo(min={self.min}, max={self.max}, dtype={self.dtype})"
+
+
+class finfo:
+    """paddle.finfo."""
+
+    def __init__(self, dtype):
+        import jax.numpy as jnp
+
+        name = _raw_dtype_name(dtype)
+        if name == "float64":
+            import numpy as np
+
+            info = np.finfo(np.float64)
+        else:
+            info = jnp.finfo(jnp.dtype(convert_dtype(name)))
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.tiny)
+        self.resolution = float(info.resolution)
+        self.bits = int(info.bits)
+        self.dtype = str(info.dtype)
+
+    def __repr__(self):
+        return f"finfo(min={self.min}, max={self.max}, dtype={self.dtype})"

@@ -17,7 +17,7 @@ from .. import nn
 from ..core.dispatch import primitive
 from ..core.tensor import Tensor
 from ..nn import functional as F
-from ..ops import creation, manipulation
+from ..ops import manipulation
 from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear,
 )
@@ -55,6 +55,22 @@ class DiTConfig:
                                    hidden_size=64, num_hidden_layers=2,
                                    num_attention_heads=4, num_classes=10),
                             **overrides})
+
+
+def _sincos_pos_embed_2d(dim, grid_size):
+    """Fixed 2D sin-cos positional table [grid*grid, dim] (DiT recipe)."""
+    import numpy as np
+
+    assert dim % 4 == 0, "hidden_size must be divisible by 4 for 2D sin-cos"
+    quarter = dim // 4
+    omega = 1.0 / (10000 ** (np.arange(quarter, dtype=np.float64) / quarter))
+    pos = np.arange(grid_size, dtype=np.float64)
+    out = np.einsum("p,q->pq", pos, omega)  # [grid, dim/4]
+    emb_1d = np.concatenate([np.sin(out), np.cos(out)], axis=1)  # [grid, dim/2]
+    emb_h = np.repeat(emb_1d[:, None, :], grid_size, axis=1)
+    emb_w = np.repeat(emb_1d[None, :, :], grid_size, axis=0)
+    full = np.concatenate([emb_h, emb_w], axis=-1)  # [grid, grid, dim]
+    return jnp.asarray(full.reshape(grid_size * grid_size, dim), jnp.float32)
 
 
 @primitive("dit_timestep_embed")
@@ -164,9 +180,12 @@ class DiT(nn.Layer):
         self.num_patches = (c.input_size // c.patch_size) ** 2
         patch_dim = c.patch_size * c.patch_size * c.in_channels
         self.patch_proj = nn.Linear(patch_dim, c.hidden_size)
-        self.pos_embed = self.create_parameter(
-            [1, self.num_patches, c.hidden_size],
-            default_initializer=nn.initializer.Normal(std=0.02))
+        # fixed 2D sin-cos positions, frozen (published DiT recipe)
+        grid = c.input_size // c.patch_size
+        self.register_buffer(
+            "pos_embed",
+            Tensor(_sincos_pos_embed_2d(c.hidden_size, grid)[None]),
+            persistable=False)
         self.t_embed = TimestepEmbedder(c.hidden_size)
         self.y_embed = LabelEmbedder(c.num_classes, c.hidden_size,
                                      c.class_dropout_prob)
@@ -226,7 +245,8 @@ class GaussianDiffusion:
         betas = np.linspace(beta_start, beta_end, num_timesteps,
                             dtype=np.float32)
         alphas = 1.0 - betas
-        self.alphas_bar = jnp.asarray(np.cumprod(alphas))
+        self._alphas_bar_np = np.cumprod(alphas)  # host copy: sampler scalars
+        self.alphas_bar = jnp.asarray(self._alphas_bar_np)
         self.betas = jnp.asarray(betas)
 
     def q_sample(self, x0, t, noise):
@@ -276,8 +296,8 @@ class GaussianDiffusion:
                 for i, t_host in enumerate(ts):
                     t = Tensor(jnp.full((shape[0],), int(t_host), jnp.int32))
                     eps = model(x, t, y)
-                    ab_t = float(self.alphas_bar[int(t_host)])
-                    ab_prev = float(self.alphas_bar[int(ts[i + 1])]) \
+                    ab_t = float(self._alphas_bar_np[int(t_host)])
+                    ab_prev = float(self._alphas_bar_np[int(ts[i + 1])]) \
                         if i + 1 < len(ts) else 1.0
                     x0_pred = (x - float(math.sqrt(1 - ab_t)) * eps) \
                         / float(math.sqrt(ab_t))

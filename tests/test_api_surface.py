@@ -120,3 +120,12 @@ def test_local_fs_operations(tmp_path):
     assert fs.is_exist(str(tmp_path / "a" / "y.txt"))
     fs.delete(d)
     assert not fs.is_exist(d)
+
+
+def test_iinfo_finfo():
+    ii = paddle.iinfo("int8")
+    assert ii.min == -128 and ii.max == 127 and ii.bits == 8
+    fi = paddle.finfo("float32")
+    assert fi.bits == 32 and fi.eps > 0
+    bf = paddle.finfo(paddle.bfloat16)
+    assert bf.bits == 16
