@@ -124,14 +124,13 @@ class finfo:
 
     def __init__(self, dtype):
         import jax.numpy as jnp
+        import numpy as np
 
         name = _raw_dtype_name(dtype)
-        if name == "float64":
-            import numpy as np
-
-            info = np.finfo(np.float64)
-        else:
-            info = jnp.finfo(jnp.dtype(convert_dtype(name)))
+        if name in ("bfloat16", "float16", "float32"):  # numpy lacks bf16
+            info = jnp.finfo(jnp.dtype(name))
+        else:  # float64/complex128/... must keep their true width
+            info = np.finfo(np.dtype(name))
         self.min = float(info.min)
         self.max = float(info.max)
         self.eps = float(info.eps)

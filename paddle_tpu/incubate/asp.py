@@ -1,0 +1,129 @@
+"""ASP — automatic structured (2:4) sparsity (reference:
+python/paddle/fluid/contrib/sparsity/asp.py — prune_model computes 2:4 masks,
+a decorated optimizer re-masks after every step so pruned weights stay zero).
+
+TPU note: XLA has no sparse-tensor-core path, so 2:4 here preserves the
+*algorithmic* contract (train a network whose weights satisfy the 2:4
+pattern, exportable to hardware that exploits it); masking is a dense
+elementwise multiply the compiler fuses into the optimizer update.
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..core.tensor import Tensor
+
+__all__ = ["calculate_density", "create_mask", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_EXCLUDED: List[str] = []
+
+
+def set_excluded_layers(param_names):
+    _EXCLUDED.extend(param_names)
+
+
+def reset_excluded_layers():
+    _EXCLUDED.clear()
+
+
+def calculate_density(tensor) -> float:
+    arr = np.asarray(tensor.data if isinstance(tensor, Tensor) else tensor)
+    return float((arr != 0).sum() / arr.size)
+
+
+def _nm_mask_last_axis(flat: np.ndarray, n, m) -> np.ndarray:
+    cols = flat.shape[1]
+    if cols % m != 0:
+        return np.ones_like(flat)  # non-divisible shapes stay dense
+    groups = np.abs(flat).reshape(flat.shape[0], cols // m, m)
+    order = np.argsort(-groups, axis=-1)
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[..., :n], 1.0, axis=-1)
+    return mask.reshape(flat.shape)
+
+
+def create_mask(weight, n=2, m=4) -> np.ndarray:
+    """n:m mask grouped along the REDUCTION dim (the sparse-tensor-core
+    contract; reference asp.py transposes FC weights for the same reason):
+    Linear [in, out] groups over `in`; Conv OIHW groups over in*kh*kw."""
+    arr = np.asarray(weight.data if isinstance(weight, Tensor) else weight,
+                     "float32")
+    if arr.ndim == 2:  # [in, out]: reduction is axis 0
+        return _nm_mask_last_axis(arr.T.copy(), n, m).T.copy()
+    # conv-style [out, in, ...]: reduction is everything after axis 0
+    flat = arr.reshape(arr.shape[0], -1)
+    return _nm_mask_last_axis(flat, n, m).reshape(arr.shape)
+
+
+def _prunable(model: nn.Layer):
+    for name, p in model.named_parameters():
+        if p is None or name in _EXCLUDED:
+            continue
+        if p.ndim >= 2 and min(p.shape[-2:]) >= 4:
+            yield name, p
+
+
+def prune_model(model: nn.Layer, n=2, m=4, mask_algo="mask_1d") -> Dict[str, np.ndarray]:
+    """Apply n:m masks to every prunable weight; returns {name: mask}
+    (reference asp.py prune_model)."""
+    masks = {}
+    for name, p in _prunable(model):
+        mask = create_mask(p, n, m)
+        p.data = p.data * jnp.asarray(mask, p.data.dtype)
+        masks[name] = mask
+    return masks
+
+
+class ASPOptimizerWrapper:
+    """Re-applies the sparsity masks after every optimizer step
+    (reference OptimizerWithSparsityGuarantee)."""
+
+    def __init__(self, optimizer, model: nn.Layer, n=2, m=4):
+        self.inner = optimizer
+        self.model = model
+        self.n, self.m = n, m
+        self._masks = None
+
+    def _ensure_masks(self):
+        if self._masks is None:
+            host_masks = prune_model(self.model, self.n, self.m)
+            params = dict(self.model.named_parameters())
+            # device-resident masks + cached param refs: re-masking costs one
+            # fused multiply per weight, no per-step host uploads
+            self._masks = [(params[name],
+                            jnp.asarray(mask, params[name].data.dtype))
+                           for name, mask in host_masks.items()]
+        return self._masks
+
+    def step(self):
+        masks = self._ensure_masks()
+        self.inner.step()
+        for p, mask in masks:
+            p.data = p.data * mask
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        """Mask-aware minimize (the reference decorates this entry point)."""
+        self.step()
+        return None, None
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def __getattr__(self, item):  # delegate the rest (get_lr, state_dict, ...)
+        return getattr(self.inner, item)
+
+
+def decorate(optimizer, model: nn.Layer = None, n=2, m=4):
+    """reference asp.py decorate: wrap the optimizer so pruned weights stay
+    pruned through training."""
+    if model is None:
+        raise ValueError("decorate needs the model whose weights are pruned")
+    return ASPOptimizerWrapper(optimizer, model, n, m)

@@ -89,3 +89,43 @@ def test_ptq_uses_observed_activation_scale():
     net(paddle.ones([2, 4]) * 3.0)  # calibration: abs-max 3.0
     ptq.convert(net)
     assert abs(net[0].act_scale - 3.0) < 1e-5
+
+
+# -- ASP 2:4 structured sparsity ----------------------------------------------
+
+def test_asp_mask_is_2_of_4_along_reduction():
+    from paddle_tpu.incubate import asp
+
+    w = paddle.randn([16, 8])  # Linear [in, out]: reduction dim is axis 0
+    mask = asp.create_mask(w)
+    groups = mask.T.reshape(8, 4, 4)  # group along `in`
+    np.testing.assert_array_equal(groups.sum(-1), 2.0)
+    # keeps the two largest magnitudes per reduction group
+    arr = np.abs(_np(w)).T.reshape(8, 4, 4)
+    kept = np.take_along_axis(arr, np.argsort(-arr, -1)[..., :2], -1).sum()
+    masked = (np.abs(_np(w)) * mask).sum()
+    np.testing.assert_allclose(masked, kept, rtol=1e-5)
+    # conv OIHW: reduction is in*kh*kw
+    cw = paddle.randn([4, 2, 2, 2])
+    cm = asp.create_mask(cw)
+    np.testing.assert_array_equal(cm.reshape(4, 2, 4).sum(-1), 2.0)
+
+
+def test_asp_training_preserves_sparsity():
+    from paddle_tpu.incubate import asp
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = asp.decorate(
+        paddle.optimizer.Adam(0.01, parameters=net.parameters()), model=net)
+    x = paddle.randn([16, 16])
+    y = paddle.randint(0, 4, [16])
+    for _ in range(5):
+        loss = F.cross_entropy(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    for name, p in net.named_parameters():
+        if p.ndim == 2:
+            assert abs(asp.calculate_density(p) - 0.5) < 1e-6, name
+    assert np.isfinite(float(loss))
