@@ -35,6 +35,8 @@ BLACK_LIST = {
     "exp", "log", "reduce_std", "reduce_var", "nll_loss_op", "bce_op",
     "bce_logits_op", "mse_loss_op", "cumsum",
     "softmax_ce_weighted_op", "nll_loss_weighted_op",
+    # pixel coordinates need full f32 mantissa (bf16 quantizes beyond ~256)
+    "grid_sample_op", "affine_grid_op",
 }
 
 _STATE = {"enabled": False, "dtype": None, "level": "O1",

@@ -5,5 +5,9 @@ from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
 from . import rnn as rnn_mod  # noqa: F401
 from .rnn import rnn, birnn  # noqa: F401
+from .vision import (  # noqa: F401
+    grid_sample, affine_grid, fold, pixel_unshuffle, channel_shuffle,
+    pairwise_distance,
+)
 
 from ...ops.manipulation import one_hot  # noqa: F401

@@ -1,0 +1,182 @@
+"""Vision functionals: sampling/warping ops (reference:
+python/paddle/nn/functional/vision.py — grid_sample/affine_grid over
+grid_sampler_op.cu; fold/pixel ops in common.py).
+
+TPU-native: grid_sample is one vmapped bilinear gather primitive with
+per-corner zero-padding weights (grid_sample semantics — deliberately NOT the
+roi_align-style clamped bilinear in vision/ops.py), affine_grid is pure index
+math, fold is a scatter-add — all single fused executables.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+
+__all__ = ["grid_sample", "affine_grid", "fold", "pixel_unshuffle",
+           "channel_shuffle", "pairwise_distance"]
+
+
+@primitive("grid_sample_op")
+def _grid_sample(x, grid, *, mode, padding_mode, align_corners):
+    """x [N,C,H,W]; grid [N,Ho,Wo,2] in [-1,1] (x then y, paddle layout)."""
+    N, C, H, W = x.shape
+
+    def unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) * 0.5 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) * 0.5
+
+    gx = unnormalize(grid[..., 0], W)  # [N,Ho,Wo]
+    gy = unnormalize(grid[..., 1], H)
+
+    def reflect(coord, size):
+        if size == 1:
+            return jnp.zeros_like(coord)
+        if align_corners:
+            span = 2.0 * (size - 1)
+            coord = jnp.abs(coord) % span
+            return jnp.where(coord > size - 1, span - coord, coord)
+        span = 2.0 * size
+        coord = (coord + 0.5) % span
+        coord = jnp.where(coord > size, span - coord, coord) - 0.5
+        return jnp.clip(coord, 0, size - 1)
+
+    if padding_mode == "border":
+        gx = jnp.clip(gx, 0, W - 1)
+        gy = jnp.clip(gy, 0, H - 1)
+    elif padding_mode == "reflection":
+        gx = reflect(gx, W)
+        gy = reflect(gy, H)
+
+    def sample_one(feat, yy, xx):
+        if mode == "nearest":
+            xi = jnp.clip(jnp.round(xx), 0, W - 1).astype(jnp.int32)
+            yi = jnp.clip(jnp.round(yy), 0, H - 1).astype(jnp.int32)
+            out = feat[:, yi, xi]
+            if padding_mode == "zeros":
+                valid = ((xx >= -0.5) & (xx <= W - 0.5)
+                         & (yy >= -0.5) & (yy <= H - 0.5))
+                out = out * valid.astype(feat.dtype)
+            return out
+        # bilinear with out-of-range zeroing for padding_mode == "zeros"
+        x0 = jnp.floor(xx)
+        y0 = jnp.floor(yy)
+        wx = xx - x0
+        wy = yy - y0
+        out = 0.0
+        for dy, wyv in ((0, 1 - wy), (1, wy)):
+            for dx, wxv in ((0, 1 - wx), (1, wx)):
+                xi = x0 + dx
+                yi = y0 + dy
+                inside = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+                xi_c = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+                yi_c = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+                v = feat[:, yi_c, xi_c]
+                w = wyv * wxv
+                if padding_mode == "zeros":
+                    w = w * inside.astype(feat.dtype)
+                out = out + v * w
+        return out
+
+    return jax.vmap(sample_one)(x, gy, gx)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError("mode must be bilinear or nearest")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError("padding_mode must be zeros/border/reflection")
+    return _grid_sample(x, grid, mode=mode, padding_mode=padding_mode,
+                        align_corners=bool(align_corners))
+
+
+@primitive("affine_grid_op")
+def _affine_grid(theta, *, out_h, out_w, align_corners):
+    """theta [N,2,3] -> sampling grid [N,H,W,2] (x,y in [-1,1])."""
+    if align_corners:
+        ys = jnp.linspace(-1.0, 1.0, out_h)
+        xs = jnp.linspace(-1.0, 1.0, out_w)
+    else:
+        ys = (jnp.arange(out_h) * 2 + 1) / out_h - 1.0
+        xs = (jnp.arange(out_w) * 2 + 1) / out_w - 1.0
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ones = jnp.ones_like(gx)
+    base = jnp.stack([gx, gy, ones], axis=-1)  # [H,W,3]
+    # sampling coordinates need full f32: no bf16 MXU shortcut here
+    return jnp.einsum("nij,hwj->nhwi", theta, base, precision="highest")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    n, c, h, w = [int(v) for v in out_shape]
+    return _affine_grid(theta, out_h=h, out_w=w,
+                        align_corners=bool(align_corners))
+
+
+@primitive("fold_op")
+def _fold(x, *, output_sizes, kernel_sizes, strides, paddings, dilations):
+    """Inverse of unfold: [N, C*kh*kw, L] -> [N, C, H, W] via scatter-add."""
+    N = x.shape[0]
+    kh, kw = kernel_sizes
+    sh, sw = strides
+    ph, pw = paddings
+    dh, dw = dilations
+    H, W = output_sizes
+    C = x.shape[1] // (kh * kw)
+    oh = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    ow = (W + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+    cols = x.reshape(N, C, kh, kw, oh, ow)
+    out = jnp.zeros((N, C, H + 2 * ph, W + 2 * pw), x.dtype)
+    for i in range(kh):
+        for j in range(kw):
+            ys = i * dh
+            xs = j * dw
+            out = out.at[:, :, ys: ys + sh * oh: sh,
+                         xs: xs + sw * ow: sw].add(cols[:, :, i, j])
+    return out[:, :, ph: ph + H, pw: pw + W]
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(int(a) for a in v)
+
+    return _fold(x, output_sizes=_pair(output_sizes),
+                 kernel_sizes=_pair(kernel_sizes), strides=_pair(strides),
+                 paddings=_pair(paddings), dilations=_pair(dilations))
+
+
+@primitive("pixel_unshuffle_op")
+def _pixel_unshuffle(x, *, factor):
+    n, c, h, w = x.shape
+    r = factor
+    x = x.reshape(n, c, h // r, r, w // r, r)
+    return x.transpose(0, 1, 3, 5, 2, 4).reshape(n, c * r * r, h // r, w // r)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    return _pixel_unshuffle(x, factor=int(downscale_factor))
+
+
+@primitive("channel_shuffle_op")
+def _channel_shuffle(x, *, groups):
+    n, c, h, w = x.shape
+    x = x.reshape(n, groups, c // groups, h, w)
+    return x.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    return _channel_shuffle(x, groups=int(groups))
+
+
+@primitive("pairwise_distance_op")
+def _pairwise_distance(x, y, *, p, epsilon, keepdim):
+    d = x - y + epsilon
+    return jnp.linalg.norm(d, ord=p, axis=-1, keepdims=keepdim)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    return _pairwise_distance(x, y, p=float(p), epsilon=float(epsilon),
+                              keepdim=bool(keepdim))

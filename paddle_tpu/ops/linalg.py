@@ -331,3 +331,48 @@ def _multi_dot(*xs):
 
 def multi_dot(x, name=None):
     return _multi_dot(*x)
+
+
+@primitive("linalg_lstsq")
+def _lstsq(a, b, *, rcond):
+    sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+    return sol, res, rank.astype(jnp.int32), sv
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    """Least squares (reference linalg.lstsq over gels)."""
+    return _lstsq(x, y, rcond=rcond)
+
+
+@primitive("linalg_cond")
+def _cond(x, *, p):
+    return jnp.linalg.cond(x, p=p)
+
+
+def cond(x, p=None, name=None):
+    """Condition number (reference linalg.cond)."""
+    return _cond(x, p=p if p in (None, 1, -1, 2, -2) or isinstance(p, str)
+                 else float(p))
+
+
+def eig(x, name=None):
+    """General (complex) eigendecomposition.
+
+    Host LAPACK op: general eig has no TPU/XLA lowering and this runtime's
+    PJRT tunnel forbids host callbacks, so the matrix is pulled to host,
+    decomposed with numpy, and the (complex, nondifferentiable) results
+    re-uploaded. Eager-only — do not call inside jit-traced code; use eigh
+    for the symmetric case, which lowers natively."""
+    import numpy as np
+
+    from ..core.tensor import Tensor as _T
+
+    arr = np.asarray(x.data if isinstance(x, _T) else x)
+    cdtype = np.complex64 if arr.dtype in (np.float32, np.complex64) \
+        else np.complex128
+    vals, vecs = np.linalg.eig(arr)
+    # complex results live on the host CPU backend: TPU tunnels may not
+    # accept complex uploads, and callers consume eigenvalues host-side
+    cpu = jax.devices("cpu")[0]
+    return (_T(jax.device_put(vals.astype(cdtype), cpu)),
+            _T(jax.device_put(vecs.astype(cdtype), cpu)))

@@ -247,3 +247,79 @@ def test_model_average_state_dict_does_not_crash():
     avg = ModelAverage(parameters=net.parameters())
     sd = avg.state_dict()
     assert "global_step" in sd
+
+
+# -- grid sampling / fold / linalg long tail ----------------------------------
+
+def test_grid_sample_identity_and_modes():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([1, 2, 6, 6])
+    theta = paddle.to_tensor(np.asarray([[[1, 0, 0], [0, 1, 0]]], "float32"))
+    grid = F.affine_grid(theta, [1, 2, 6, 6])
+    out = _np(F.grid_sample(x, grid))
+    np.testing.assert_allclose(out, _np(x), atol=1e-4)
+    near = F.grid_sample(x, grid, mode="nearest")
+    np.testing.assert_allclose(_np(near), _np(x), atol=1e-4)
+    # zeros padding: far out-of-range grid samples to 0
+    far = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, "float32"))
+    np.testing.assert_allclose(_np(F.grid_sample(x, far)), 0.0, atol=1e-6)
+    # border padding clamps instead
+    border = _np(F.grid_sample(x, far, padding_mode="border"))
+    np.testing.assert_allclose(border[0, :, 0, 0], _np(x)[0, :, -1, -1],
+                               atol=1e-5)
+
+
+def test_grid_sample_gradients_flow():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([1, 1, 4, 4])
+    x.stop_gradient = False
+    theta = paddle.to_tensor(np.asarray([[[0.9, 0, 0.1], [0, 0.9, 0]]],
+                                        "float32"), stop_gradient=False)
+    grid = F.affine_grid(theta, [1, 1, 4, 4])
+    F.grid_sample(x, grid).sum().backward()
+    assert x.grad is not None and theta.grad is not None
+
+
+def test_fold_inverts_unfold():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([2, 3, 8, 8])
+    cols = F.unfold(x, 2, strides=2)
+    back = F.fold(cols, 8, 2, strides=2)
+    np.testing.assert_allclose(_np(back), _np(x), atol=1e-5)
+    # overlapping windows accumulate (scatter-add semantics)
+    cols2 = F.unfold(paddle.ones([1, 1, 4, 4]), 3, strides=1, paddings=1)
+    acc = _np(F.fold(cols2, 4, 3, strides=1, paddings=1))
+    assert acc.max() == 9.0 and acc[0, 0, 0, 0] == 4.0
+
+
+def test_pixel_unshuffle_channel_shuffle_roundtrip():
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([1, 2, 4, 4])
+    down = F.pixel_unshuffle(x, 2)
+    assert down.shape == [1, 8, 2, 2]
+    up = F.pixel_shuffle(down, 2)
+    np.testing.assert_allclose(_np(up), _np(x), atol=1e-6)
+    cs = F.channel_shuffle(paddle.randn([1, 6, 2, 2]), 3)
+    assert cs.shape == [1, 6, 2, 2]
+
+
+def test_linalg_lstsq_cond_eig():
+    from paddle_tpu.ops import linalg as L
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((8, 3)).astype("float32")
+    b = rng.standard_normal((8, 2)).astype("float32")
+    sol, res, rank, sv = L.lstsq(paddle.to_tensor(a), paddle.to_tensor(b))
+    ref, *_ = np.linalg.lstsq(a, b, rcond=None)
+    np.testing.assert_allclose(_np(sol), ref, rtol=1e-3, atol=1e-4)
+    c = float(_np(L.cond(paddle.to_tensor(np.diag([4.0, 1.0]).astype("float32")))))
+    np.testing.assert_allclose(c, 4.0, rtol=1e-5)
+    m = np.asarray([[0.0, -1.0], [1.0, 0.0]], "float32")  # rotation: eig ±i
+    vals, vecs = L.eig(paddle.to_tensor(m))
+    got = np.sort_complex(_np(vals))
+    np.testing.assert_allclose(np.sort_complex(np.linalg.eigvals(m)), got,
+                               atol=1e-5)
