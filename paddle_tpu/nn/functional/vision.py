@@ -157,6 +157,8 @@ def _pixel_unshuffle(x, *, factor):
 
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError("pixel_unshuffle supports NCHW only")
     return _pixel_unshuffle(x, factor=int(downscale_factor))
 
 
@@ -168,6 +170,8 @@ def _channel_shuffle(x, *, groups):
 
 
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format != "NCHW":
+        raise NotImplementedError("channel_shuffle supports NCHW only")
     return _channel_shuffle(x, groups=int(groups))
 
 

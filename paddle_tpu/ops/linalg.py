@@ -376,3 +376,17 @@ def eig(x, name=None):
     cpu = jax.devices("cpu")[0]
     return (_T(jax.device_put(vals.astype(cdtype), cpu)),
             _T(jax.device_put(vecs.astype(cdtype), cpu)))
+
+
+@primitive("tensordot_op")
+def _tensordot(x, y, *, axes):
+    return jnp.tensordot(x, y, axes=axes)
+
+
+def tensordot(x, y, axes=2, name=None):
+    if isinstance(axes, (list, tuple)):
+        axes = tuple(tuple(int(v) for v in a) if isinstance(a, (list, tuple))
+                     else int(a) for a in axes)
+    else:
+        axes = int(axes)
+    return _tensordot(x, y, axes=axes)

@@ -621,3 +621,117 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
 def _shard_index(x, *, shard_size, shard_id, ignore_value):
     in_shard = (x // shard_size) == shard_id
     return jnp.where(in_shard, x % shard_size, ignore_value)
+
+
+@primitive("searchsorted_op", nondiff=True)
+def _searchsorted(sorted_seq, values, *, right):
+    return jnp.searchsorted(sorted_seq, values,
+                            side="right" if right else "left").astype(jnp.int64)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    out = _searchsorted(sorted_sequence, values, right=bool(right))
+    return cast(out, "int32") if out_int32 else out
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    """reference ops: bucketize == searchsorted with 1-D boundaries."""
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+@primitive("diag_embed_op")
+def _diag_embed(x, *, offset, dim1, dim2):
+    idx = jnp.arange(x.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(x.shape[:-1] + (x.shape[-1] + abs(offset),) * 2, x.dtype)
+    out = out.at[..., rows, cols].set(x)
+    if (dim1, dim2) != (-2, -1):
+        out = jnp.moveaxis(out, (-2, -1), (dim1, dim2))
+    return out
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    return _diag_embed(input, offset=int(offset), dim1=int(dim1), dim2=int(dim2))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    """Eager host op (data-dependent output size, like unique). axis=None
+    flattens; an integer axis deduplicates consecutive equal slices."""
+    import numpy as np
+
+    arr = np.asarray(x.data if isinstance(x, Tensor) else x)
+    if axis is None:
+        arr = arr.reshape(-1)
+        changed = arr[1:] != arr[:-1]
+    else:
+        axis = int(axis) % arr.ndim
+        arr = np.moveaxis(arr, axis, 0)
+        changed = np.any(arr[1:] != arr[:-1],
+                         axis=tuple(range(1, arr.ndim)))
+    keep = np.concatenate([[True], changed]) if arr.shape[0] else \
+        np.zeros((0,), bool)
+    uniq = arr[keep]
+    if axis is not None:
+        uniq = np.moveaxis(uniq, 0, axis)
+    results = [Tensor(jnp.asarray(uniq))]
+    if return_inverse:
+        results.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.append(idx, arr.shape[0]))
+        results.append(Tensor(jnp.asarray(counts)))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+@primitive("take_op")
+def _take(x, index, *, mode):
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    idx = index.astype(jnp.int32)
+    if mode == "wrap":
+        idx = ((idx % n) + n) % n
+    elif mode == "clip":
+        idx = jnp.clip(idx, 0, n - 1)
+    else:  # raise-mode: negative python-style indices
+        idx = jnp.where(idx < 0, idx + n, idx)
+    return flat[idx]
+
+
+def take(x, index, mode="raise", name=None):
+    if mode not in ("raise", "wrap", "clip"):
+        raise ValueError("mode must be raise/wrap/clip")
+    if mode == "raise":
+        # eager host bounds check: XLA gathers clamp silently
+        import numpy as np
+
+        idx = np.asarray(index.data if isinstance(index, Tensor) else index)
+        n = int(np.prod(x.shape))
+        if idx.size and (idx.min() < -n or idx.max() >= n):
+            raise IndexError(
+                f"take: index out of range for tensor with {n} elements "
+                f"(got min {idx.min()}, max {idx.max()})")
+    return _take(x, index, mode=mode)
+
+
+@primitive("index_add_op")
+def _index_add(x, index, value, *, axis):
+    axis = axis % x.ndim
+    return x.at[(builtins.slice(None),) * axis + (index,)].add(value)
+
+
+def index_add(x, index, axis, value, name=None):
+    return _index_add(x, index, value, axis=int(axis))
+
+
+@primitive("index_put_op")
+def _index_put(x, value, *indices, accumulate):
+    idx = tuple(indices)
+    if accumulate:
+        return x.at[idx].add(value)
+    return x.at[idx].set(value)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    return _index_put(x, value, *indices, accumulate=bool(accumulate))

@@ -323,3 +323,66 @@ def test_linalg_lstsq_cond_eig():
     got = np.sort_complex(_np(vals))
     np.testing.assert_allclose(np.sort_complex(np.linalg.eigvals(m)), got,
                                atol=1e-5)
+
+
+def test_new_indexing_ops():
+    from paddle_tpu.ops import manipulation as M
+    from paddle_tpu.ops import linalg as L
+
+    seq = paddle.to_tensor(np.asarray([1.0, 3.0, 5.0, 7.0], "float32"))
+    vals = paddle.to_tensor(np.asarray([0.0, 3.0, 8.0], "float32"))
+    np.testing.assert_array_equal(_np(M.searchsorted(seq, vals)), [0, 1, 4])
+    np.testing.assert_array_equal(_np(M.searchsorted(seq, vals, right=True)),
+                                  [0, 2, 4])
+    np.testing.assert_array_equal(_np(M.bucketize(vals, seq)), [0, 1, 4])
+
+    d = _np(M.diag_embed(paddle.to_tensor(np.asarray([1.0, 2.0], "float32"))))
+    np.testing.assert_array_equal(d, [[1, 0], [0, 2]])
+    d1 = _np(M.diag_embed(paddle.to_tensor(np.asarray([3.0], "float32")),
+                          offset=1))
+    np.testing.assert_array_equal(d1, [[0, 3], [0, 0]])
+
+    u, inv, cnt = M.unique_consecutive(
+        paddle.to_tensor(np.asarray([1, 1, 2, 2, 2, 3, 1], "int64")),
+        return_inverse=True, return_counts=True)
+    np.testing.assert_array_equal(_np(u), [1, 2, 3, 1])
+    np.testing.assert_array_equal(_np(cnt), [2, 3, 1, 1])
+
+    x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+    np.testing.assert_array_equal(
+        _np(M.take(x, paddle.to_tensor(np.asarray([0, 5, -1], "int64")))),
+        [0, 5, 5])
+    np.testing.assert_array_equal(
+        _np(M.take(x, paddle.to_tensor(np.asarray([7], "int64")), mode="wrap")),
+        [1])
+
+    added = M.index_add(paddle.zeros([3, 2]),
+                        paddle.to_tensor(np.asarray([0, 2], "int64")), 0,
+                        paddle.ones([2, 2]))
+    np.testing.assert_array_equal(_np(added), [[1, 1], [0, 0], [1, 1]])
+
+    put = M.index_put(paddle.zeros([2, 2]),
+                      (paddle.to_tensor(np.asarray([0, 1], "int64")),
+                       paddle.to_tensor(np.asarray([1, 0], "int64"))),
+                      paddle.to_tensor(np.asarray([5.0, 6.0], "float32")))
+    np.testing.assert_array_equal(_np(put), [[0, 5], [6, 0]])
+
+    td = L.tensordot(paddle.ones([2, 3]), paddle.ones([3, 4]), axes=1)
+    np.testing.assert_array_equal(_np(td), np.full((2, 4), 3.0))
+
+
+def test_indexing_ops_edge_cases():
+    from paddle_tpu.ops import manipulation as M
+
+    # negative axis index_add
+    out = M.index_add(paddle.zeros([3, 2]),
+                      paddle.to_tensor(np.asarray([1], "int64")), -1,
+                      paddle.ones([3, 1]))
+    np.testing.assert_array_equal(_np(out), [[0, 1], [0, 1], [0, 1]])
+    # unique_consecutive along an axis
+    rows = paddle.to_tensor(np.asarray([[1, 2], [1, 2], [3, 4]], "int64"))
+    u = M.unique_consecutive(rows, axis=0)
+    np.testing.assert_array_equal(_np(u), [[1, 2], [3, 4]])
+    # take raise-mode bounds
+    with pytest.raises(IndexError):
+        M.take(paddle.ones([4]), paddle.to_tensor(np.asarray([9], "int64")))
