@@ -33,21 +33,22 @@ class DataParallel(Layer):
                  group=None):
         super().__init__()
         self._layers = layers
-        self._grad_sync_enabled = True
 
     def forward(self, *inputs, **kwargs):
         return self._layers(*inputs, **kwargs)
 
     @contextlib.contextmanager
     def no_sync(self):
-        """Gradient-sync pause (reference :540). Meaningful for the eager
-        multi-step accumulate pattern; compiled steps handle accumulation via
-        gradient_merge instead."""
-        self._grad_sync_enabled = False
-        try:
-            yield
-        finally:
-            self._grad_sync_enabled = True
+        """Gradient-sync pause (reference parallel.py:540).
+
+        In the reference, backward fires bucketed NCCL all-reduces per step;
+        no_sync suppresses them so micro-batch grads accumulate locally. Under
+        single-controller GSPMD there is no per-step sync to suppress: grads
+        are computed on the global batch view and the cross-replica reduction
+        is fused into the one compiled backward, so eager accumulation between
+        optimizer steps is communication-free by construction. The context
+        manager is therefore a semantic no-op kept for API compatibility."""
+        yield
 
     def scale_loss(self, loss):
         return loss

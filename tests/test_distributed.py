@@ -183,9 +183,8 @@ def test_data_parallel_wrapper():
     x = paddle.randn([8, 4])
     out = dp(x)
     assert out.shape == [8, 4]
-    with dp.no_sync():
-        assert not dp._grad_sync_enabled
-    assert dp._grad_sync_enabled
+    with dp.no_sync():  # semantic no-op under GSPMD; must not raise
+        dp(x)
     assert len(dp.parameters()) == 2
 
 
