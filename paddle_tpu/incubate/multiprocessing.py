@@ -1,0 +1,136 @@
+"""Shared-memory ndarray handoff (reference roles:
+python/paddle/incubate/multiprocessing/reductions.py + the DataLoader's
+shared-memory path, paddle/fluid/memory/allocation/mmap_allocator.cc and
+fluid/dataloader/flat.py use_shared_memory).
+
+Worker processes serialize large numpy arrays into POSIX shared memory and
+send only (name, shape, dtype) descriptors through the queue; the parent maps
+the segment, copies into its own buffer, and unlinks. This removes the
+pickle+pipe copy for image-sized samples (the queue then carries bytes-sized
+metadata regardless of sample size).
+"""
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Any
+
+import numpy as np
+
+_MIN_SHARED_BYTES = 16 * 1024  # below this the pickle path is cheaper
+
+
+class _ShmDescriptor:
+    """Picklable handle to a shared-memory-resident ndarray. Holds the
+    np.dtype object itself (str() does not round-trip structured dtypes)."""
+
+    __slots__ = ("name", "shape", "dtype")
+
+    def __init__(self, name: str, shape, dtype: np.dtype):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+def _untrack(shm: shared_memory.SharedMemory):
+    """The creator's resource_tracker must forget the segment: the RECEIVER
+    unlinks it, and a tracked-but-gone segment makes every worker exit spam
+    'leaked shared_memory objects' warnings (pre-3.13 SharedMemory issue)."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(shm._name, "shared_memory")
+    except Exception:  # pragma: no cover - tracker API drift
+        pass
+
+
+def to_shared(arr: np.ndarray) -> _ShmDescriptor:
+    """Copy an ndarray into a fresh shared segment (sender side)."""
+    if arr.dtype.hasobject:
+        raise TypeError("object-dtype arrays cannot use shared memory")
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    try:
+        view = np.ndarray(arr.shape, arr.dtype, buffer=shm.buf)
+        view[...] = arr
+        desc = _ShmDescriptor(shm.name, arr.shape, arr.dtype)
+    except BaseException:
+        shm.close()
+        shm.unlink()  # never leak a half-initialized segment
+        raise
+    _untrack(shm)
+    shm.close()  # the segment persists until the receiver unlinks it
+    return desc
+
+
+def from_shared(desc: _ShmDescriptor, unlink: bool = True) -> np.ndarray:
+    """Materialize and (by default) free a shared segment (receiver side)."""
+    shm = shared_memory.SharedMemory(name=desc.name)
+    # NOTE: on 3.12 attaching does NOT register with the resource tracker, so
+    # no unregister here — only the creator side untracks (see to_shared)
+    try:
+        view = np.ndarray(desc.shape, desc.dtype, buffer=shm.buf)
+        out = np.array(view)  # own copy: segment can be freed immediately
+    finally:
+        shm.close()
+        if unlink:
+            try:
+                shm.unlink()
+            except FileNotFoundError:  # pragma: no cover - already freed
+                pass
+    return out
+
+
+def share_sample_tree(sample: Any) -> Any:
+    """Replace large ndarrays in a (possibly nested) sample with descriptors.
+    On any failure, segments already created for this tree are released
+    before the exception propagates (no per-batch leaks)."""
+    done = []
+
+    def walk(s):
+        if isinstance(s, np.ndarray) and s.nbytes >= _MIN_SHARED_BYTES \
+                and not s.dtype.hasobject:
+            d = to_shared(s)
+            done.append(d)
+            return d
+        if isinstance(s, tuple):
+            return tuple(walk(v) for v in s)
+        if isinstance(s, list):
+            return [walk(v) for v in s]
+        if isinstance(s, dict):
+            return {k: walk(v) for k, v in s.items()}
+        return s
+
+    try:
+        return walk(sample)
+    except BaseException:
+        for d in done:
+            release_sample_tree(d)
+        raise
+
+
+def restore_sample_tree(sample: Any) -> Any:
+    if isinstance(sample, _ShmDescriptor):
+        return from_shared(sample)
+    if isinstance(sample, tuple):
+        return tuple(restore_sample_tree(s) for s in sample)
+    if isinstance(sample, list):
+        return [restore_sample_tree(s) for s in sample]
+    if isinstance(sample, dict):
+        return {k: restore_sample_tree(v) for k, v in sample.items()}
+    return sample
+
+
+def release_sample_tree(sample: Any):
+    """Free descriptors that were never restored (error/shutdown paths)."""
+    if isinstance(sample, _ShmDescriptor):
+        try:
+            shm = shared_memory.SharedMemory(name=sample.name)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+    elif isinstance(sample, (list, tuple)):
+        for s in sample:
+            release_sample_tree(s)
+    elif isinstance(sample, dict):
+        for s in sample.values():
+            release_sample_tree(s)

@@ -296,6 +296,7 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.worker_init_fn = worker_init_fn
+        self.use_shared_memory = use_shared_memory
         self.prefetch = max(2, prefetch_factor) if use_buffer_reader else 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
@@ -389,17 +390,21 @@ def get_worker_info():
 
 
 def _worker_loop(dataset, index_queue, result_queue, worker_id,
-                 num_workers, base_seed, worker_init_fn):
+                 num_workers, base_seed, worker_init_fn, use_shared_memory):
     """Worker process body (reference: fluid/dataloader/dataloader_iter.py
     _worker_loop). Fetches samples by index and returns the raw sample lists —
     collation into Tensors happens in the parent so jax (and device transfer)
-    stays off the forked workers entirely."""
+    stays off the forked workers entirely. With use_shared_memory, large
+    ndarrays travel as POSIX shm descriptors instead of pickled pipe bytes
+    (the reference's shared-memory LoDTensor handoff, dataloader/flat.py)."""
     global _WORKER_INFO
     _WORKER_INFO = WorkerInfo(worker_id, num_workers, base_seed + worker_id,
                               dataset)
     np.random.seed((base_seed + worker_id) % (2 ** 31))
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
+    if use_shared_memory:
+        from ..incubate.multiprocessing import share_sample_tree
     while True:
         task = index_queue.get()
         if task is None:
@@ -407,6 +412,8 @@ def _worker_loop(dataset, index_queue, result_queue, worker_id,
         batch_id, indices = task
         try:
             samples = [dataset[i] for i in indices]
+            if use_shared_memory:
+                samples = [share_sample_tree(s) for s in samples]
             result_queue.put((batch_id, samples, None))
         except Exception as e:  # propagate to parent
             result_queue.put((batch_id, None, e))
@@ -433,7 +440,8 @@ class _MultiprocessIterator:
                 target=_worker_loop,
                 args=(loader.dataset, self.index_queues[wid], self.result_queue,
                       wid, self.num_workers, base_seed,
-                      getattr(loader, "worker_init_fn", None)),
+                      getattr(loader, "worker_init_fn", None),
+                      getattr(loader, "use_shared_memory", False)),
                 daemon=True)
             w.start()
             self.workers.append(w)
@@ -478,6 +486,10 @@ class _MultiprocessIterator:
         samples = self.cache.pop(self.next_yield)
         self.next_yield += 1
         self._dispatch()
+        if getattr(self.loader, "use_shared_memory", False):
+            from ..incubate.multiprocessing import restore_sample_tree
+
+            samples = [restore_sample_tree(s) for s in samples]
         return self.loader.collate_fn(samples)
 
     def _shutdown(self):
@@ -491,6 +503,24 @@ class _MultiprocessIterator:
             if w.is_alive():
                 w.terminate()
         self.workers = []
+        if getattr(self.loader, "use_shared_memory", False):
+            # free undelivered shm segments (early-exit / error paths): both
+            # the reorder cache AND whatever is still in the result queue
+            import queue as _q
+
+            from ..incubate.multiprocessing import release_sample_tree
+
+            for samples in self.cache.values():
+                if samples:
+                    release_sample_tree(samples)
+            self.cache = {}
+            while True:
+                try:
+                    _, samples, _err = self.result_queue.get_nowait()
+                except (_q.Empty, OSError, ValueError):
+                    break
+                if samples:
+                    release_sample_tree(samples)
 
     def __del__(self):  # pragma: no cover - GC path
         try:
