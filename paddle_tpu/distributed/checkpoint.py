@@ -23,6 +23,20 @@ from jax.sharding import NamedSharding
 
 from ..core.tensor import Tensor
 
+def _np_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name to numpy, including ml_dtypes (bfloat16, float8_*).
+
+    np.dtype('bfloat16') raises TypeError — the extension dtypes register as
+    types on ml_dtypes, not as numpy string aliases.
+    """
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
 def _spec_to_json(spec) -> list:
     if spec is None:
         return []
@@ -98,10 +112,20 @@ def save_state_dict(state_dict: Dict, path: str, process_rank: Optional[int] = N
 def _assemble(path: str, entry: dict) -> np.ndarray:
     """Rebuild the global ndarray from saved shards (converter.merge role)."""
     shape = tuple(entry["global_shape"])
-    out = np.empty(shape, dtype=entry["dtype"])
+    out = np.empty(shape, dtype=_np_dtype(entry["dtype"]))
     filled = np.zeros(shape, dtype=bool) if shape else None
     for sh in entry["shards"]:
         data = np.load(os.path.join(path, sh["file"]))
+        if data.dtype != out.dtype:
+            if (data.dtype.kind == "V"
+                    and data.dtype.itemsize == out.dtype.itemsize):
+                # np.save writes ml_dtypes arrays with a void descr ('V2');
+                # the bytes are right, only the type tag is lost.
+                data = data.view(out.dtype)
+            else:
+                raise ValueError(
+                    f"shard {sh['file']} dtype {data.dtype} does not match "
+                    f"manifest dtype {out.dtype}")
         idx = tuple(slice(a, b) for a, b in zip(sh["starts"], sh["stops"]))
         out[idx] = data
         if filled is not None:
@@ -150,7 +174,7 @@ def load_state_dict(state_dict: Dict, path: str, strict: bool = True):
             if tuple(arr.shape) != tuple(tgt.shape):
                 raise ValueError(
                     f"{key}: checkpoint shape {arr.shape} != target {tgt.shape}")
-            new = jnp.asarray(arr.astype(np.dtype(str(tgt.dtype))))
+            new = jnp.asarray(arr.astype(_np_dtype(str(tgt.dtype))))
             sharding = tgt.sharding
             if isinstance(sharding, NamedSharding):
                 new = jax.device_put(new, sharding)  # reshard onto target mesh

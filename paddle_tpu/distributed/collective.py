@@ -295,12 +295,104 @@ def barrier(group=None):
 # Under the single-controller SPMD runtime every "rank" lives in this process,
 # so p2p is a host-coordinated device-to-device handoff through a mailbox; the
 # in-trace path for compiled pipelines is ppermute (below), which is what the
-# 1F1B schedule uses. Multi-controller send/recv would ride the same mailbox
-# over the TCPStore control plane.
+# 1F1B schedule uses. Across gang-spawned processes (PS trainers, CPU-mesh
+# emulation, multi-host) the same API rides the native TCPStore control plane:
+# sender claims a sequence number with add() and set()s the pickled payload,
+# receiver wait()s on the next sequence key — ordered, typed, inter-process.
 
 _P2P_BOX: dict = {}
 _P2P_LOCK = threading.Lock()
 _P2P_CV = threading.Condition(_P2P_LOCK)
+
+_P2P_STORE = None          # TCPStore channel for inter-process p2p
+_P2P_RECV_SEQ: dict = {}   # (src, dst, tag) -> last consumed sequence number
+_P2P_CHAN_LOCK = threading.Lock()  # guards store init + per-message sequencing
+
+
+def _proc_rank_world():
+    """(process rank, process world) from launcher env or jax.distributed."""
+    import os
+
+    w = os.environ.get("PADDLE_TRAINERS_NUM")
+    r = os.environ.get("PADDLE_TRAINER_ID")
+    if w is not None and int(w) > 1:
+        return int(r or 0), int(w)
+    try:
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def init_p2p_channel(store=None):
+    """Attach a Store for inter-process send/recv.
+
+    With no argument, builds a TCPStore from PADDLE_P2P_ENDPOINT (process
+    rank 0 hosts the daemon). The launcher's gang spawn exports this endpoint
+    automatically; standalone multi-process setups set it by hand or pass a
+    connected TCPStore. PADDLE_MASTER is deliberately NOT used as a fallback:
+    that port belongs to the jax.distributed coordinator.
+    """
+    global _P2P_STORE
+    with _P2P_CHAN_LOCK:
+        if store is not None:
+            _P2P_STORE = store
+            return _P2P_STORE
+        if _P2P_STORE is not None:
+            return _P2P_STORE
+        import os
+        import time
+
+        endpoint = os.environ.get("PADDLE_P2P_ENDPOINT")
+        if not endpoint or ":" not in endpoint:
+            raise RuntimeError(
+                "send/recv across processes needs a store endpoint: set "
+                "PADDLE_P2P_ENDPOINT=host:port (process rank 0 hosts the "
+                "daemon; paddle_tpu.distributed.launch sets this for gangs) "
+                "or call init_p2p_channel(store) with a connected TCPStore")
+        from .store import TCPStore
+
+        host, port = endpoint.rsplit(":", 1)
+        rank, world = _proc_rank_world()
+        if rank == 0:
+            _P2P_STORE = TCPStore(host="0.0.0.0", port=int(port),
+                                  is_master=True, world_size=world)
+        else:
+            deadline = time.time() + 60
+            last = None
+            while time.time() < deadline:
+                try:
+                    _P2P_STORE = TCPStore(host=host, port=int(port),
+                                          is_master=False, world_size=world)
+                    break
+                except RuntimeError as e:  # master not up yet
+                    last = e
+                    time.sleep(0.2)
+            else:
+                raise RuntimeError(
+                    f"cannot reach p2p store at {endpoint}: {last}")
+        return _P2P_STORE
+
+
+def _p2p_pack(data) -> bytes:
+    import pickle
+
+    import numpy as np
+
+    arr = np.asarray(data)
+    return pickle.dumps({"dtype": str(arr.dtype), "shape": arr.shape,
+                         "raw": arr.tobytes()})
+
+
+def _p2p_unpack(payload: bytes):
+    import pickle
+
+    import numpy as np
+
+    from .checkpoint import _np_dtype
+
+    d = pickle.loads(payload)
+    return np.frombuffer(d["raw"], dtype=_np_dtype(d["dtype"])).reshape(
+        d["shape"])
 
 
 class P2POp:
@@ -325,40 +417,79 @@ class P2POp:
 
 
 def send(tensor, dst=0, group=None, sync_op=True, tag=0, src=None):
-    """Deposit `tensor`'s value for rank `dst` (device-resident copy).
+    """Send `tensor`'s value to rank `dst`.
 
-    `src` defaults to this process's rank; pass it explicitly when emulating
-    multiple ranks in one process (single-controller pipeline prototyping).
+    In-process ranks (single controller) use a device-resident mailbox; when
+    the launcher gang-spawned multiple processes, the payload travels through
+    the native TCPStore channel (see init_p2p_channel). `src` defaults to this
+    process's rank; pass it explicitly when emulating multiple ranks in one
+    process (single-controller pipeline prototyping).
     """
+    prank, world = _proc_rank_world()
+    if src is None:
+        src = prank if world > 1 else get_rank(group)
+    if world > 1 and dst != prank:
+        # Multi-process mode: dst/src are PROCESS ranks (one controller per
+        # process; PS trainers / CPU gangs). Device-rank p2p inside a compiled
+        # program is ppermute's job, not this channel's.
+        if not (0 <= dst < world):
+            raise ValueError(
+                f"send: dst={dst} is not a process rank (world={world}); "
+                "across processes send/recv address processes, not devices")
+        store = init_p2p_channel()
+        seq = store.add(f"_p2p/{src}/{dst}/{tag}/seq", 1)
+        store.set(f"_p2p/{src}/{dst}/{tag}/{seq}", _p2p_pack(
+            tensor.data if hasattr(tensor, "data") else tensor))
+        return P2POp()
     env = get_mesh_env()
     data = tensor.data if hasattr(tensor, "data") else jnp.asarray(tensor)
     if env is not None:
         devices = env.mesh.devices.reshape(-1)
         if dst < len(devices):
             data = jax.device_put(data, devices[dst])
-    if src is None:
-        src = get_rank(group)
     with _P2P_CV:
         _P2P_BOX.setdefault((src, dst, tag), []).append(data)
         _P2P_CV.notify_all()
     return P2POp()
 
 
-def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None):
+def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None,
+         timeout=60.0):
     """Fill `tensor` in place with the next message from rank `src`.
 
     `dst` defaults to this process's rank; pass the rank you are emulating to
-    retrieve a message addressed elsewhere (see send)."""
+    retrieve a message addressed elsewhere (see send). `timeout` bounds the
+    in-process mailbox wait; the inter-process path uses the store's timeout.
+    """
+    prank, world = _proc_rank_world()
     if dst is None:
-        dst = get_rank(group)
-    with _P2P_CV:
-        ok = _P2P_CV.wait_for(
-            lambda: _P2P_BOX.get((src, dst, tag)), timeout=60.0)
-        if not ok:
-            raise RuntimeError(
-                f"recv: no message from rank {src} to rank {dst} (tag {tag}); "
-                f"if the sender used dst!=your rank, pass recv(..., dst=...)")
-        data = _P2P_BOX[(src, dst, tag)].pop(0)
+        dst = prank if world > 1 else get_rank(group)
+    if world > 1 and src != prank:
+        if not (0 <= src < world):
+            raise ValueError(
+                f"recv: src={src} is not a process rank (world={world}); "
+                "across processes send/recv address processes, not devices")
+        store = init_p2p_channel()
+        key = (src, dst, tag)
+        # sequencing is serialized so concurrent irecvs on the same channel
+        # each consume a distinct message exactly once
+        with _P2P_CHAN_LOCK:
+            seq = _P2P_RECV_SEQ.get(key, 0) + 1
+            _P2P_RECV_SEQ[key] = seq
+        skey = f"_p2p/{src}/{dst}/{tag}/{seq}"
+        store.wait([skey])
+        data = jnp.asarray(_p2p_unpack(store.get(skey)))
+        store.delete_key(skey)
+    else:
+        with _P2P_CV:
+            ok = _P2P_CV.wait_for(
+                lambda: _P2P_BOX.get((src, dst, tag)), timeout=timeout)
+            if not ok:
+                raise RuntimeError(
+                    f"recv: no message from rank {src} to rank {dst} (tag {tag}) "
+                    f"after {timeout}s; if the sender used dst!=your rank, pass "
+                    f"recv(..., dst=...)")
+            data = _P2P_BOX[(src, dst, tag)].pop(0)
     if hasattr(tensor, "data"):
         if tuple(tensor.shape) != tuple(data.shape):
             raise ValueError(

@@ -82,6 +82,22 @@ def main(argv=None):
         host_rank = args.rank or 0
         world = args.nnodes * nproc
 
+        # p2p/PS control-plane endpoint (distinct from the jax.distributed
+        # coordinator port in --master): single host picks a free local port;
+        # multi-node derives master_port+1 on the master host so every node
+        # agrees without a second flag.
+        if not os.environ.get("PADDLE_P2P_ENDPOINT"):
+            if args.nnodes > 1 and args.master:
+                mhost, mport = args.master.rsplit(":", 1)
+                os.environ["PADDLE_P2P_ENDPOINT"] = f"{mhost}:{int(mport) + 1}"
+            else:
+                import socket
+
+                with socket.socket() as s:
+                    s.bind(("127.0.0.1", 0))
+                    free_port = s.getsockname()[1]
+                os.environ["PADDLE_P2P_ENDPOINT"] = f"127.0.0.1:{free_port}"
+
         def rank_envs(local_rank):
             return {"PADDLE_TRAINER_ID": str(host_rank * nproc + local_rank),
                     "PADDLE_TRAINERS_NUM": str(world),

@@ -103,12 +103,32 @@ class TCPStore(Store):
             self._lib.tcpstore_set_timeout(self._fd, self.timeout)
         self._lock = threading.Lock()
 
+    def _drop_connection(self):
+        """Invalidate + reopen the socket after a timed-out request.
+
+        The server worker may still be blocked on the old request and will
+        eventually write its reply to the old fd; reusing that fd would let the
+        next request parse the stale reply as its own (silently wrong values).
+        Caller holds self._lock.
+        """
+        if self._fd >= 0:
+            self._lib.tcpstore_close(self._fd)
+        self._fd = self._lib.tcpstore_connect(self.host.encode(), self.port)
+        if self._fd < 0:
+            raise RuntimeError(
+                f"TCPStore: lost connection to {self.host}:{self.port} and "
+                "could not reconnect")
+        if self.timeout > 0:
+            self._lib.tcpstore_set_timeout(self._fd, self.timeout)
+
     # -- Store API -----------------------------------------------------------
     def set(self, key: str, value):
         data = value if isinstance(value, (bytes, bytearray)) else str(value).encode()
         with self._lock:
             rc = self._lib.tcpstore_set(self._fd, key.encode(), len(key.encode()),
                                         bytes(data), len(data))
+            if rc != 0:
+                self._drop_connection()
         if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
@@ -119,6 +139,8 @@ class TCPStore(Store):
             buf = ctypes.create_string_buffer(cap)
             with self._lock:
                 n = self._lib.tcpstore_get(self._fd, k, len(k), buf, cap)
+                if n < 0:
+                    self._drop_connection()
             if n < 0:
                 raise TimeoutError(
                     f"TCPStore.get({key}) failed or timed out after "
@@ -131,6 +153,8 @@ class TCPStore(Store):
         k = key.encode()
         with self._lock:
             out = self._lib.tcpstore_add(self._fd, k, len(k), int(amount))
+            if out == -(2 ** 63):
+                self._drop_connection()
         if out == -(2 ** 63):
             raise RuntimeError("TCPStore.add failed")
         return int(out)
@@ -141,6 +165,8 @@ class TCPStore(Store):
             k = key.encode()
             with self._lock:
                 rc = self._lib.tcpstore_wait(self._fd, k, len(k))
+                if rc != 0:
+                    self._drop_connection()
             if rc != 0:
                 raise TimeoutError(
                     f"TCPStore.wait({key}) failed or timed out after "

@@ -404,18 +404,28 @@ def _worker_loop(dataset, index_queue, result_queue, worker_id,
     if worker_init_fn is not None:
         worker_init_fn(worker_id)
     if use_shared_memory:
-        from ..incubate.multiprocessing import share_sample_tree
+        from ..incubate.multiprocessing import (release_sample_tree,
+                                                share_sample_tree)
     while True:
         task = index_queue.get()
         if task is None:
             break
         batch_id, indices = task
+        shared = []
         try:
             samples = [dataset[i] for i in indices]
             if use_shared_memory:
-                samples = [share_sample_tree(s) for s in samples]
+                for s in samples:  # collected so a mid-batch failure can free
+                    shared.append(share_sample_tree(s))
+                samples = shared
             result_queue.put((batch_id, samples, None))
         except Exception as e:  # propagate to parent
+            if use_shared_memory:
+                for s in shared:  # don't leak segments from earlier samples
+                    try:
+                        release_sample_tree(s)
+                    except Exception:
+                        pass
             result_queue.put((batch_id, None, e))
 
 

@@ -37,15 +37,30 @@ def load(name: str, sources: List[str], extra_cxx_cflags: Optional[List[str]] = 
     out = os.path.join(build_dir, f"lib{name}.so")
     newest_src = max(os.path.getmtime(s) for s in sources)
     if not os.path.exists(out) or os.path.getmtime(out) < newest_src:
-        cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
-               + (extra_cxx_cflags or []) + list(sources)
-               + ["-o", out + ".tmp", "-lpthread"])
-        if verbose:
-            print(" ".join(cmd))
-        proc = subprocess.run(cmd, capture_output=True, text=True)
-        if proc.returncode != 0:
-            raise RuntimeError(f"cpp_extension build failed:\n{proc.stderr}")
-        os.replace(out + ".tmp", out)
+        # Gang-spawned processes race to build on first use: serialize with a
+        # file lock and write to a pid-unique temp so two g++ runs can't
+        # interleave into one corrupt .so.
+        import fcntl
+
+        lock_path = out + ".lock"
+        with open(lock_path, "w") as lockf:
+            fcntl.flock(lockf, fcntl.LOCK_EX)
+            try:
+                if (not os.path.exists(out)
+                        or os.path.getmtime(out) < newest_src):
+                    tmp = f"{out}.{os.getpid()}.tmp"
+                    cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
+                           + (extra_cxx_cflags or []) + list(sources)
+                           + ["-o", tmp, "-lpthread"])
+                    if verbose:
+                        print(" ".join(cmd))
+                    proc = subprocess.run(cmd, capture_output=True, text=True)
+                    if proc.returncode != 0:
+                        raise RuntimeError(
+                            f"cpp_extension build failed:\n{proc.stderr}")
+                    os.replace(tmp, out)
+            finally:
+                fcntl.flock(lockf, fcntl.LOCK_UN)
     return ctypes.CDLL(out)
 
 

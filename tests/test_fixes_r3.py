@@ -1,0 +1,156 @@
+"""Regression tests for round-2 advisor findings (ADVICE.md) + p2p transport."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+class TestBf16Checkpoint:
+    def test_bf16_roundtrip(self, tmp_path):
+        """ADVICE high: ml_dtypes arrays save with a void descr; load must
+        reinterpret instead of failing with 'No cast function available'."""
+        x = paddle.ones([4, 3], dtype="bfloat16") * 1.5
+        path = os.path.join(str(tmp_path), "bf16")
+        dist.save_state_dict({"x": x}, path)
+        y = paddle.zeros([4, 3], dtype="bfloat16")
+        dist.load_state_dict({"x": y}, path)
+        assert str(y.dtype).endswith("bfloat16")
+        np.testing.assert_array_equal(
+            _np(y).astype(np.float32), np.full((4, 3), 1.5, np.float32))
+
+    def test_bf16_into_f32_target(self, tmp_path):
+        x = paddle.full([2, 2], 0.25, dtype="bfloat16")
+        path = os.path.join(str(tmp_path), "bf16b")
+        dist.save_state_dict({"x": x}, path)
+        y = paddle.zeros([2, 2], dtype="float32")
+        dist.load_state_dict({"x": y}, path)
+        np.testing.assert_allclose(_np(y), 0.25)
+
+
+class TestStoreDesync:
+    def test_timeout_then_correct_reply(self):
+        """ADVICE medium: after a client-side timeout the fd holds a stale
+        in-flight reply; the store must drop + reconnect so the next request
+        doesn't parse the stale reply as its own."""
+        from paddle_tpu.distributed.store import TCPStore
+
+        master = TCPStore(is_master=True, world_size=1, timeout=1)
+        try:
+            setter = TCPStore(host="127.0.0.1", port=master.port,
+                              world_size=1)
+            with pytest.raises(TimeoutError):
+                master.wait(["never-set-key"])
+            # unblock the stuck server worker; its reply goes to the dead fd
+            setter.set("never-set-key", b"late")
+            master.set("k2", b"v2")
+            assert master.get("k2") == b"v2"
+            # counter integrity after the desync event
+            assert master.add("ctr", 5) == 5
+            assert master.add("ctr", 1) == 6
+        finally:
+            master.close()
+
+
+class TestRecvTimeout:
+    def test_recv_timeout_parameter(self):
+        """ADVICE low: recv's mailbox wait must honor a caller timeout."""
+        import time
+
+        t0 = time.time()
+        with pytest.raises(RuntimeError, match="after 0.2s"):
+            dist.recv(paddle.zeros([2]), src=0, tag=777, timeout=0.2)
+        assert time.time() - t0 < 5.0
+
+
+_P2P_WORKER = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    rank = int(os.environ["PADDLE_TRAINER_ID"])
+    out_dir = sys.argv[1]
+    if rank == 0:
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        dist.send(x, dst=1, tag=3)
+        y = paddle.zeros([4])
+        dist.recv(y, src=1, tag=4)
+        np.testing.assert_array_equal(np.asarray(y.data), [9., 9., 9., 9.])
+        # ordered delivery: two messages, same tag
+        dist.send(paddle.full([1], 1.0), dst=1, tag=5)
+        dist.send(paddle.full([1], 2.0), dst=1, tag=5)
+    else:
+        y = paddle.zeros([2, 3])
+        dist.recv(y, src=0, tag=3)
+        np.testing.assert_array_equal(
+            np.asarray(y.data), np.arange(6, dtype=np.float32).reshape(2, 3))
+        dist.send(paddle.full([4], 9.0), dst=0, tag=4)
+        a, b = paddle.zeros([1]), paddle.zeros([1])
+        dist.recv(a, src=0, tag=5)
+        dist.recv(b, src=0, tag=5)
+        assert float(a.data[0]) == 1.0 and float(b.data[0]) == 2.0
+    with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+        f.write("ok")
+""")
+
+
+class TestCrossProcessP2P:
+    def test_two_process_send_recv(self, tmp_path):
+        """VERDICT #4: send/recv must round-trip across gang-spawned
+        processes via the TCPStore channel, not the in-process mailbox."""
+        from paddle_tpu.distributed.launch.process import ProcessContext
+
+        script = tmp_path / "p2p_worker.py"
+        script.write_text(_P2P_WORKER)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {"PADDLE_P2P_ENDPOINT": f"127.0.0.1:{port}",
+               "PADDLE_TRAINERS_NUM": "2",
+               "JAX_PLATFORMS": "cpu",
+               "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+        ctx = ProcessContext.start(
+            [sys.executable, str(script), str(tmp_path)], 2,
+            base_env=env, log_dir=str(tmp_path / "logs"))
+        rc = ctx.wait(timeout=120)
+        if rc != 0:
+            logs = ""
+            for r in (0, 1):
+                p = tmp_path / "logs" / f"workerlog.{r}"
+                if p.exists():
+                    logs += f"--- rank {r} ---\n" + p.read_text()[-2000:]
+            pytest.fail(f"gang exited rc={rc}\n{logs}")
+        assert (tmp_path / "ok.0").exists() and (tmp_path / "ok.1").exists()
+
+
+class TestBuildRace:
+    def test_concurrent_load_same_lib(self, tmp_path):
+        """ADVICE low: concurrent first-use builds must not corrupt the .so."""
+        src = tmp_path / "mini.cpp"
+        src.write_text('extern "C" int forty_two() { return 42; }\n')
+        code = textwrap.dedent(f"""
+            import sys
+            sys.path.insert(0, {str(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))!r})
+            from paddle_tpu.utils import cpp_extension
+            lib = cpp_extension.load("mini", [{str(src)!r}],
+                                     build_directory={str(tmp_path)!r})
+            assert lib.forty_two() == 42
+        """)
+        procs = [subprocess.Popen([sys.executable, "-c", code],
+                                  stderr=subprocess.PIPE) for _ in range(4)]
+        for p in procs:
+            _, err = p.communicate(timeout=120)
+            assert p.returncode == 0, err.decode()
