@@ -304,9 +304,11 @@ _P2P_BOX: dict = {}
 _P2P_LOCK = threading.Lock()
 _P2P_CV = threading.Condition(_P2P_LOCK)
 
-_P2P_STORE = None          # TCPStore channel for inter-process p2p
-_P2P_RECV_SEQ: dict = {}   # (src, dst, tag) -> last consumed sequence number
+_P2P_STORE = None          # TCPStore channel for inter-process p2p (sends)
+_P2P_RECV_SEQ: dict = {}   # (src, dst, tag) -> highest reserved sequence
+_P2P_ABANDONED: dict = {}  # (src, dst, tag) -> seqs reserved but not consumed
 _P2P_CHAN_LOCK = threading.Lock()  # guards store init + per-message sequencing
+_P2P_RECV_LOCAL = threading.local()  # per-thread store conn for blocking waits
 
 
 def _proc_rank_world():
@@ -371,6 +373,23 @@ def init_p2p_channel(store=None):
                 raise RuntimeError(
                     f"cannot reach p2p store at {endpoint}: {last}")
         return _P2P_STORE
+
+
+def _recv_channel():
+    """Per-thread store connection for blocking recv waits (the shared client
+    serializes requests under one lock; parking a wait there would deadlock
+    the irecv+send exchange pattern)."""
+    store = getattr(_P2P_RECV_LOCAL, "store", None)
+    if store is None:
+        from .store import TCPStore
+
+        main = _P2P_STORE
+        store = TCPStore(host=main.host if main.host != "0.0.0.0"
+                         else "127.0.0.1",
+                         port=main.port, is_master=False,
+                         world_size=main.world_size)
+        _P2P_RECV_LOCAL.store = store
+    return store
 
 
 def _p2p_pack(data) -> bytes:
@@ -469,16 +488,30 @@ def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None,
             raise ValueError(
                 f"recv: src={src} is not a process rank (world={world}); "
                 "across processes send/recv address processes, not devices")
-        store = init_p2p_channel()
+        init_p2p_channel()
+        # blocking waits ride a per-thread connection: the shared client's
+        # lock must stay free so a concurrent send (irecv+send exchange) can
+        # proceed while this thread is parked in wait()
+        store = _recv_channel()
         key = (src, dst, tag)
-        # sequencing is serialized so concurrent irecvs on the same channel
-        # each consume a distinct message exactly once
+        # reserve a sequence so concurrent irecvs on one channel each consume
+        # a distinct message exactly once; failed reservations are recycled
         with _P2P_CHAN_LOCK:
-            seq = _P2P_RECV_SEQ.get(key, 0) + 1
-            _P2P_RECV_SEQ[key] = seq
+            abandoned = _P2P_ABANDONED.setdefault(key, [])
+            if abandoned:
+                seq = min(abandoned)
+                abandoned.remove(seq)
+            else:
+                seq = _P2P_RECV_SEQ.get(key, 0) + 1
+                _P2P_RECV_SEQ[key] = seq
         skey = f"_p2p/{src}/{dst}/{tag}/{seq}"
-        store.wait([skey])
-        data = jnp.asarray(_p2p_unpack(store.get(skey)))
+        try:
+            store.wait([skey])
+            data = jnp.asarray(_p2p_unpack(store.get(skey)))
+        except BaseException:
+            with _P2P_CHAN_LOCK:  # let a retry pick this message up
+                _P2P_ABANDONED.setdefault(key, []).append(seq)
+            raise
         store.delete_key(skey)
     else:
         with _P2P_CV:

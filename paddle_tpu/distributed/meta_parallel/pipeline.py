@@ -76,17 +76,74 @@ def ppermute_pipeline(run_stage: Callable, x_mb, pp_size: int, axis: str = "pp",
     return outs
 
 
-def microbatch(x, num_microbatches: int):
-    """[b, ...] -> [M, b/M, ...] keeping the batch sharding on the mb dim."""
+def _batch_shard_degree(env) -> int:
+    if env is None:
+        env = require_mesh_env()
+    d = 1
+    for ax in ("dp", "sdp"):
+        d *= max(env.get_dim(ax), 1)
+    return d
+
+
+def choose_microbatches(batch: int, desired: int, env=None) -> int:
+    """Largest M <= desired with batch % (M * d) == 0, so each microbatch
+    spans every dp/sdp shard (keeps the pipeline handoff resharding-free).
+    Falls back to the largest divisor of batch when nothing spans; warns
+    whenever the answer differs from what the caller configured."""
+    d = _batch_shard_degree(env)
+    chosen = 1
+    for m in range(min(desired, max(batch // d, 1)), 0, -1):
+        if batch % (m * d) == 0:
+            chosen = m
+            break
+    else:
+        for m in range(min(desired, batch), 0, -1):
+            if batch % m == 0:
+                chosen = m
+                break
+    if chosen != desired:
+        import warnings
+
+        warnings.warn(
+            f"pipeline microbatches clamped {desired} -> {chosen} so batch "
+            f"{batch} divides into microbatches spanning all {d} data shards "
+            f"(larger pipeline bubble; raise the batch size to keep M)")
+    return chosen
+
+
+def microbatch(x, num_microbatches: int, env=None):
+    """[b, ...] -> [M, b/M, ...].
+
+    The batch dim is sharded over dp/sdp (shard-major sample order). A plain
+    reshape would land that sharding on the microbatch-INDEX dim, putting each
+    tick's microbatch on a subset of dp replicas — GSPMD then replicates
+    ("involuntary full rematerialization"). Instead interleave so every dp
+    shard contributes 1/dp of EVERY microbatch: [d, M, b/(d*M)] -> swap ->
+    [M, d, b/(d*M)] -> merge. All three steps are layout-preserving for a
+    dim0-sharded input, so the pipeline sees dp sharding on the mb dim.
+    """
     b = x.shape[0]
-    if b % num_microbatches != 0:
-        raise ValueError(
-            f"batch {b} not divisible by {num_microbatches} microbatches")
-    return x.reshape((num_microbatches, b // num_microbatches) + x.shape[1:])
+    M = num_microbatches
+    if b % M != 0:
+        raise ValueError(f"batch {b} not divisible by {M} microbatches")
+    d = _batch_shard_degree(env)
+    if d > 1 and b % (d * M) == 0:
+        x = x.reshape((d, M, b // (d * M)) + x.shape[1:])
+        x = x.swapaxes(0, 1)
+        return x.reshape((M, b // M) + x.shape[3:])
+    return x.reshape((M, b // M) + x.shape[1:])
 
 
-def unmicrobatch(x_mb):
-    return x_mb.reshape((x_mb.shape[0] * x_mb.shape[1],) + x_mb.shape[2:])
+def unmicrobatch(x_mb, env=None):
+    """Inverse of microbatch (same interleaving, same env)."""
+    M, mb = x_mb.shape[0], x_mb.shape[1]
+    b = M * mb
+    d = _batch_shard_degree(env)
+    if d > 1 and b % (d * M) == 0:
+        x = x_mb.reshape((M, d, mb // d) + x_mb.shape[2:])
+        x = x.swapaxes(0, 1)
+        return x.reshape((b,) + x.shape[3:])
+    return x_mb.reshape((b,) + x_mb.shape[2:])
 
 
 def pipeline_shard_map(stage_fn: Callable, env: MeshEnv, n_stage_args: int,

@@ -55,7 +55,8 @@ class PipelineLayer(Layer):
     the whole model); segmentation metadata drives the compiled-PP path."""
 
     def __init__(self, layers, num_stages=None, topology=None, loss_fn=None,
-                 seg_method="uniform", recompute_interval=0, **kwargs):
+                 seg_method="uniform", recompute_interval=0,
+                 num_microbatches=None, **kwargs):
         super().__init__()
         self._loss_fn = loss_fn
         self._topo = topology
@@ -63,6 +64,7 @@ class PipelineLayer(Layer):
             num_stages = topology.get_dim("pipe")
         self._num_stages = num_stages or 1
         self._recompute_interval = recompute_interval
+        self._num_microbatches = num_microbatches
 
         self.descs: List = list(layers)
         self._shared = {}
@@ -84,8 +86,64 @@ class PipelineLayer(Layer):
                 built.append(_FnLayer(d))
             else:
                 raise TypeError(f"bad pipeline element {d!r}")
-        self.run_function = LayerList(built)
         self.segment_parts = self._segment(len(built), self._num_stages, seg_method)
+        self._built = built
+        self._pipeline_engaged = self._try_compile_pipeline(built)
+        if not self._pipeline_engaged:
+            self.run_function = LayerList(built)
+            self._exec = self.run_function
+
+    def maybe_compile_pipeline(self) -> bool:
+        """Engage the compiled-PP path if a 'pp' mesh is live NOW.
+
+        The reference flow constructs the PipelineLayer before fleet sets up
+        the topology; when no mesh existed at __init__ time, fleet's
+        PipelineParallel wrapper calls this once it does. Must run before the
+        optimizer captures parameters() — stacking re-registers the run's
+        parameters."""
+        if self._pipeline_engaged:
+            return True
+        engaged = self._try_compile_pipeline(self._built)
+        if engaged:
+            self._pipeline_engaged = True
+        return engaged
+
+    def _try_compile_pipeline(self, built) -> bool:
+        """Compiled-PP path: when the mesh has a 'pp' axis, stack the longest
+        homogeneous run of layers over it and ppermute-pipeline that run; edge
+        layers (embedding/head/norm) stay GSPMD-auto around it. This is the
+        reference's forward_backward_pipeline role for ANY LayerDesc model,
+        not a per-model feature."""
+        from ..mesh import get_mesh_env
+        from .stage_stack import StackedStageRun, find_homogeneous_run
+
+        env = get_mesh_env()
+        pp = env.get_dim("pp") if env is not None else 1
+        if pp <= 1:
+            return False
+        run = find_homogeneous_run(built, min_len=max(pp, 2))
+        if run is None:
+            import warnings
+
+            warnings.warn(
+                "PipelineLayer: mesh has pp>1 but no homogeneous layer run "
+                "was found to pipeline; executing sequentially (every stage "
+                "replicated). Repeated identical blocks pipeline best.")
+            return False
+        lo, hi = run
+        k = ((hi - lo) // pp) * pp  # each stage holds k/pp layers
+        if k < pp:
+            return False
+        hi = lo + k
+        stack = StackedStageRun(
+            built[lo:hi], num_microbatches=self._num_microbatches,
+            recompute=self._recompute_interval > 0)
+        # raw per-layer list kept for get_stage_layers/introspection (layers
+        # inside the run are param-stripped shells; the stack is canonical)
+        self.run_function = built
+        self._pipelined_span = (lo, hi)
+        self._exec = LayerList(built[:lo] + [stack] + built[hi:])
+        return True
 
     @staticmethod
     def _segment(n, stages, seg_method):
@@ -109,9 +167,12 @@ class PipelineLayer(Layer):
         return [self.run_function[i] for i in range(lo, hi)]
 
     def forward(self, x):
-        for i, layer in enumerate(self.run_function):
-            if self._recompute_interval > 0 and i % self._recompute_interval == 0 \
-                    and self.training:
+        from .stage_stack import StackedStageRun
+
+        for i, layer in enumerate(self._exec):
+            if (self._recompute_interval > 0 and self.training
+                    and i % self._recompute_interval == 0
+                    and not isinstance(layer, StackedStageRun)):
                 from ..utils_recompute import recompute
 
                 x = recompute(layer, x)

@@ -1,0 +1,164 @@
+"""Generic compiled pipeline execution for homogeneous layer runs.
+
+Reference: fleet/meta_parallel/pipeline_parallel.py:80 (forward_backward_pipeline,
+the 1F1B schedule driving ANY PipelineLayer) + pp_layers.py:132. The reference
+executes each stage in its own process and exchanges activations over NCCL p2p.
+
+TPU-native mapping: a contiguous run of structurally identical layers (the
+transformer blocks of a GPT/BERT/Llama/DiT) has its parameters stacked on a
+leading stage dim sharded over 'pp'; ONE compiled program runs the microbatch
+pipeline with lax.ppermute stage handoffs (see pipeline.py). Heterogeneous
+edge layers (embedding, head, final norm) execute outside the run under plain
+GSPMD — they are cheap and their params are placed by their own specs. This is
+the same schedule 1F1B produces, expressed as a compiler-visible scan: autodiff
+of the tick scan IS the cooldown pipeline, and jax.checkpoint around the stage
+body bounds live activations to O(microbatch) exactly like early-backward.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...core.dispatch import primitive
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer, Parameter
+from ..mesh import get_mesh_env
+
+_RUN_REGISTRY = {}
+
+
+def layer_signature(layer: Layer):
+    """Structural identity: same class + same named param shapes/dtypes means
+    two layers can share one stacked stage body."""
+    params = tuple((n, tuple(p.shape), str(p.dtype))
+                   for n, p in sorted(layer.named_parameters()))
+    if not params:
+        return None  # param-less layers (activations) are never stacked
+    return (type(layer).__qualname__, params)
+
+
+class StackedStageRun(Layer):
+    """A run of structurally identical layers executed as a stacked scan —
+    pipelined over 'pp' when the mesh has that axis, plain lax.scan otherwise.
+
+    Takes ALREADY-BUILT layers (each independently initialized so the stacked
+    init matches building them separately); keeps layers[0] as the traced
+    template and re-registers the stacked arrays as this Layer's Parameters.
+    """
+
+    def __init__(self, layers: List[Layer], num_microbatches: Optional[int] = None,
+                 recompute: bool = False):
+        super().__init__()
+        if not layers:
+            raise ValueError("StackedStageRun needs at least one layer")
+        sig = layer_signature(layers[0])
+        if sig is None or any(layer_signature(l) != sig for l in layers[1:]):
+            raise ValueError("layers are not structurally identical")
+        self.depth = len(layers)
+        self.num_microbatches = num_microbatches
+        self.recompute = recompute
+        self._template = [layers[0]]  # list-wrapped: hidden from sublayers
+        env = get_mesh_env()
+        pp = env.get_dim("pp") if env is not None else 1
+        from jax.sharding import PartitionSpec as P
+
+        self._names = []
+        per_layer = [dict(l.named_parameters()) for l in layers]
+        for name, p in layers[0].named_parameters():
+            stacked = Parameter(jnp.stack([pl[name].data for pl in per_layer]))
+            base = tuple(p.dist_spec) if p.dist_spec is not None else (None,) * p.ndim
+            stacked.dist_spec = P(*((("pp" if pp > 1 else None),) + base))
+            stacked.stop_gradient = p.stop_gradient
+            safe = name.replace(".", "__")
+            self.add_parameter(safe, stacked)
+            self._names.append((safe, name))
+        # free the duplicate per-layer arrays (the stacked copy is canonical;
+        # layer 0 stays intact as the template's mutation slots)
+        for l in layers[1:]:
+            for n, p in l.named_parameters():
+                p.data = jnp.zeros((0,), p.data.dtype)
+        _RUN_REGISTRY[id(self)] = self
+
+    def forward(self, hidden):
+        stacked = [self._parameters[safe] for safe, _ in self._names]
+        out, aux = _run_stack(hidden, *stacked, _run_id=id(self),
+                              use_recompute=self.recompute and self.training,
+                              microbatches=self.num_microbatches or 0)
+        from ...nn.layer import moe as moe_mod
+
+        moe_mod.record_aux(aux)
+        return out
+
+
+@primitive("pp_stage_stack")
+def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches):
+    from ...core import autograd
+    from ...nn.layer import moe as moe_mod
+
+    run = _RUN_REGISTRY[_run_id]
+    template = run._template[0]
+    tparams = [dict(template.named_parameters())[orig] for _, orig in run._names]
+
+    def body(carry, slices):
+        saved = [p.data for p in tparams]
+        try:
+            for p, s in zip(tparams, slices):
+                p.data = s
+            with moe_mod.collect_aux() as bucket, autograd.no_grad():
+                out = template(Tensor(carry)).data
+        finally:
+            for p, a in zip(tparams, saved):
+                p.data = a
+        aux = sum((t.data for t in bucket), jnp.zeros((), jnp.float32))
+        return out, aux
+
+    env = get_mesh_env()
+    pp = env.get_dim("pp") if env is not None else 1
+    if pp > 1:
+        from .pipeline import (choose_microbatches, microbatch,
+                               pipeline_shard_map, unmicrobatch)
+
+        if run.depth % pp != 0:
+            raise ValueError(
+                f"stacked run depth {run.depth} must be divisible by pp={pp}")
+        M = choose_microbatches(hidden.shape[0], microbatches or 2 * pp, env)
+
+        def stage_fn(h, *stacked_local):
+            out, aux = jax.lax.scan(body, h, tuple(stacked_local))
+            return out, jnp.sum(aux)
+
+        x_mb = microbatch(hidden, M, env)
+        piped = pipeline_shard_map(stage_fn, env, len(stacked),
+                                   remat=use_recompute, with_aux=True)
+        out_mb, aux = piped(x_mb, *stacked)
+        return unmicrobatch(out_mb, env), aux / M
+
+    if use_recompute:
+        body = jax.checkpoint(body)
+    out, aux = jax.lax.scan(body, hidden, tuple(stacked))
+    return out, jnp.sum(aux)
+
+
+def _run_stack(hidden, *stacked, _run_id, use_recompute, microbatches):
+    return _run_stack_fn(hidden, *stacked, _run_id=_run_id,
+                         use_recompute=use_recompute, microbatches=microbatches)
+
+
+def find_homogeneous_run(layers: List[Layer], min_len: int = 2):
+    """Longest contiguous [lo, hi) of structurally identical layers — the
+    pipelineable middle of a LayerDesc model (reference _segment_network's
+    'layer:<Pattern>' balancing picks the same repeated blocks)."""
+    best = (0, 0)
+    i, n = 0, len(layers)
+    while i < n:
+        sig = layer_signature(layers[i])
+        j = i + 1
+        if sig is not None:
+            while j < n and layer_signature(layers[j]) == sig:
+                j += 1
+        if j - i > best[1] - best[0]:
+            best = (i, j)
+        i = j
+    return best if best[1] - best[0] >= min_len else None

@@ -138,6 +138,19 @@ class ShardedTrainStep:
         # (stage 3 arrives via dist_spec; stages 1/2 shard state while the
         # param stays replicated)
         self.zero_stage = int(getattr(optimizer, "_zero_stage", 0))
+        self.offload = bool(getattr(optimizer, "_offload", False))
+        if self.offload:
+            # reference sharding_utils.py offload: master weights + optimizer
+            # state pinned to host memory; see _build_offload
+            self._cpu = jax.devices("cpu")[0]
+            for p in self.train_params:
+                st = opt._accumulators[id(p)]
+                opt._accumulators[id(p)] = {
+                    k: jax.device_put(v, self._cpu) for k, v in st.items()}
+            self._master = [
+                jax.device_put(jnp.asarray(p.data, jnp.float32), self._cpu)
+                for p in self.train_params]
+            return
         # place optimizer state at its (possibly ZeRO-sharded) placement
         for p in self.train_params:
             st = opt._accumulators[id(p)]
@@ -237,9 +250,102 @@ class ShardedTrainStep:
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
                        donate_argnums=donate)
 
+    def _build_offload(self, batch_arrays):
+        """Two executables instead of one: fwd+bwd on the mesh, update on the
+        host CPU device where the fp32 master + optimizer state live.
+        Per step the grads stream host-ward and the freshly-cast params stream
+        device-ward — the HBM never holds optimizer state."""
+        env = self.env
+        opt = self.optimizer
+        model, loss_fn = self.target, self.loss_fn
+        rule = type(opt)._rule
+        hyper = opt._hyper()
+        wd = opt._weight_decay
+        decoupled = opt._decoupled
+        clip = opt._grad_clip
+        train_params = self.train_params
+        frozen = self.frozen
+        dtypes = [p.data.dtype for p in train_params]
+        wd_flags = tuple(
+            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
+            for p in train_params)
+
+        from ..jit import _Binder
+
+        def fwd_bwd(params, frozen_arrays, rngkey, *batch):
+            random_mod.default_generator().set_trace_key(rngkey)
+            try:
+                def loss_of(param_arrays):
+                    ts = train_params + frozen
+                    with _Binder(ts) as b:
+                        b.bind(list(param_arrays) + list(frozen_arrays))
+                        with autograd.no_grad():
+                            loss = loss_fn(model, *[Tensor(a) for a in batch])
+                    return loss.data.astype(jnp.float32)
+
+                return jax.value_and_grad(loss_of)(tuple(params))
+            finally:
+                random_mod.default_generator().clear_trace_key()
+
+        def update(master, grads, states, lr, step_no):
+            grads = [g.astype(jnp.float32) for g in grads]
+            if clip is not None:
+                grads = clip._apply_jax(grads)
+            new_m, new_s, new_p = [], [], []
+            for p, g, s, flag, dt in zip(master, grads, states, wd_flags, dtypes):
+                if wd and not decoupled and flag:
+                    g = g + wd * p
+                hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
+                np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+                if wd and decoupled and flag:
+                    np_ = np_ - lr * wd * p
+                new_m.append(np_)
+                new_s.append(ns)
+                new_p.append(np_.astype(dt))
+            return new_m, new_s, new_p
+
+        param_sh = [param_sharding(p, env) for p in train_params]
+        frozen_sh = [param_sharding(p, env) for p in frozen]
+        if self.batch_specs is not None:
+            batch_sh = [env.sharding_for(s) for s in self.batch_specs]
+        else:
+            batch_sh = [env.sharding_for(self._default_batch_spec(a)) for a in batch_arrays]
+        repl = env.replicated()
+        jit_fwd = jax.jit(fwd_bwd,
+                          in_shardings=(param_sh, frozen_sh, repl, *batch_sh),
+                          out_shardings=(repl, tuple(param_sh)))
+        jit_upd = jax.jit(update, donate_argnums=(0, 2))  # cpu via placement
+        return jit_fwd, jit_upd
+
+    def _call_offload(self, arrays):
+        opt = self.optimizer
+        if self._jitted is None:
+            self._jitted = self._build_offload(arrays)
+            self._param_sh = [param_sharding(p, self.env) for p in self.train_params]
+        jit_fwd, jit_upd = self._jitted
+        params = [p.data for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        loss, grads = jit_fwd(params, frozen_arrays, random_mod.next_key(), *arrays)
+        grads_host = [jax.device_put(g, self._cpu) for g in grads]
+        del grads
+        states = [opt._accumulators[id(p)] for p in self.train_params]
+        lr = jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), self._cpu)
+        step_no = jax.device_put(jnp.asarray(opt._global_step + 1, jnp.int32),
+                                 self._cpu)
+        self._master, new_s, new_p = jit_upd(self._master, grads_host, states,
+                                             lr, step_no)
+        for p, s in zip(self.train_params, new_s):
+            opt._accumulators[id(p)] = s
+        for p, a, sh in zip(self.train_params, new_p, self._param_sh):
+            p.data = jax.device_put(a, sh)
+        opt._global_step += 1
+        return Tensor(loss)
+
     def __call__(self, *batch):
         opt = self.optimizer
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        if self.offload:
+            return self._call_offload(arrays)
         if self._jitted is None:
             self._jitted = self._build(arrays)
         params = [p.data for p in self.train_params]

@@ -30,7 +30,12 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
     if level not in ("os", "os_g", "p_g_os"):
         raise ValueError(f"bad sharding level {level!r}")
     if offload:
-        raise NotImplementedError("CPU offload: planned (host memory via jax.device_put)")
+        # reference sharding_utils.py offload / sharding_stage3.py:50
+        # offload=True: fp32 master params + optimizer state live on host
+        # memory; ShardedTrainStep splits the step into a mesh fwd+bwd
+        # executable and a host update executable (grads stream down, fresh
+        # params stream up) — HBM holds only params+grads+activations.
+        optimizer._offload = True
     if level == "p_g_os":
         # full parameter sharding
         apply_sharding_specs(model, env, axis="sdp")

@@ -4,7 +4,8 @@ from .llama import (  # noqa: F401
     llama_param_count, llama_flops_per_token, apply_rotary_pos_emb,
 )
 from .gpt import (  # noqa: F401
-    GPTConfig, GPTModel, GPTForCausalLM, GPTAttention, gpt_param_count,
+    GPTConfig, GPTModel, GPTForCausalLM, GPTAttention, GPTForCausalLMPipe,
+    gpt_param_count,
 )
 from .bert import (  # noqa: F401
     BertConfig, BertModel, BertForPretraining, BertForSequenceClassification,

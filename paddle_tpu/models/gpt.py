@@ -215,3 +215,72 @@ def gpt_param_count(config: GPTConfig) -> int:
     per_layer = 4 * h * h + 2 * h * i + i + 9 * h
     return (L * per_layer + config.vocab_size * h
             + config.max_position_embeddings * h + 2 * h)
+
+
+# -- pipeline-parallel preset -------------------------------------------------
+# Reference: fleetx GPTForPretrainingPipe (PipelineLayer of SharedLayerDesc
+# embedding + GPTBlock LayerDescs + tied head), trained via
+# PipelineParallel.train_batch. Here the PipelineLayer auto-detects the
+# homogeneous GPTBlock run and ppermute-pipelines it over the mesh's pp axis.
+
+class _GPTEmbeddingPipe(nn.Layer):
+    """ids -> hidden (token + learned position embeddings); doubles as the
+    tied LM head via SharedLayerDesc forward_func."""
+
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.embed_tokens = VocabParallelEmbedding(config.vocab_size,
+                                                   config.hidden_size)
+        self.embed_positions = nn.Embedding(config.max_position_embeddings,
+                                            config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+
+    def forward(self, input_ids):
+        s = input_ids.shape[1]
+        pos = creation.arange(0, s, dtype="int64")
+        hidden = self.embed_tokens(input_ids) + self.embed_positions(pos)
+        return _mark_seq(self.drop(hidden))
+
+
+def _gpt_tied_logits(embed: _GPTEmbeddingPipe, hidden):
+    return hidden.matmul(manipulation.transpose(embed.embed_tokens.weight,
+                                                [1, 0]))
+
+
+class _GPTFinalNormPipe(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.ln_f = nn.LayerNorm(config.hidden_size, config.layer_norm_epsilon)
+
+    def forward(self, hidden):
+        return self.ln_f(hidden)
+
+
+def _gpt_shifted_ce(logits, labels):
+    b, s, v = logits.shape
+    lg = manipulation.reshape(logits[:, :-1, :], [-1, v]).astype("float32")
+    lab = manipulation.reshape(labels[:, 1:], [-1])
+    return F.cross_entropy(lg, lab)
+
+
+def GPTForCausalLMPipe(config: GPTConfig, **pipeline_kwargs):
+    """PipelineLayer view of GPTForCausalLM: same math (tied embeddings,
+    pre-LN blocks), expressed as LayerDescs so fleet's PipelineParallel
+    train_batch drives the compiled ppermute pipeline for the block run."""
+    from ..distributed.meta_parallel import (LayerDesc, PipelineLayer,
+                                             SharedLayerDesc)
+
+    descs = [
+        SharedLayerDesc("embed", _GPTEmbeddingPipe, None, "embed_tokens.weight",
+                        config),
+        *[LayerDesc(GPTBlock, config) for _ in range(config.num_hidden_layers)],
+        LayerDesc(_GPTFinalNormPipe, config),
+        SharedLayerDesc("embed", _GPTEmbeddingPipe, _gpt_tied_logits,
+                        "embed_tokens.weight", config),
+    ]
+    pipe = PipelineLayer(layers=descs, loss_fn=_gpt_shifted_ce,
+                         **pipeline_kwargs)
+    if config.dtype == "bfloat16":
+        pipe.to(dtype="bfloat16")
+    return pipe

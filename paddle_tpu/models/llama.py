@@ -27,6 +27,7 @@ from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding, mark_sharding,
 )
 from ..distributed.mesh import get_mesh_env
+from ..distributed.meta_parallel.stage_stack import StackedStageRun
 
 
 @dataclass
@@ -212,120 +213,24 @@ class LlamaDecoderLayer(nn.Layer):
         return _mark_seq(hidden)
 
 
-class ScanDecoderStack(nn.Layer):
+class ScanDecoderStack(StackedStageRun):
     """The decoder stack as ONE lax.scan over stacked per-layer parameters.
 
     TPU-first: compile time and program size are O(1) in depth (an unrolled
     32-layer graph breaks compile budgets), weights for layer l live in the
     leading dim of each stacked parameter — which shards over 'pp' when that
-    axis is active (stage-placed weights, the GSPMD pipeline idiom).
+    axis is active (stage-placed weights, the GSPMD pipeline idiom). The
+    stacking/pipelining machinery is the framework-generic StackedStageRun
+    (distributed.meta_parallel.stage_stack); this subclass only supplies the
+    independently-initialized LlamaDecoderLayer protos and config plumbing.
     """
 
     def __init__(self, config: LlamaConfig):
-        super().__init__()
+        protos = [LlamaDecoderLayer(config)
+                  for _ in range(config.num_hidden_layers)]
+        super().__init__(protos, num_microbatches=config.pp_microbatches,
+                         recompute=config.use_recompute)
         self.config = config
-        L = config.num_hidden_layers
-        # template layer supplies structure + math; its params are replaced by
-        # slices of the stacked params at each scan step
-        template = LlamaDecoderLayer(config)
-        self._template = [template]  # hidden from the sublayer registry
-        self._names = []
-        env = get_mesh_env()
-        pp = env.get_dim("pp") if env is not None else 1
-        from ..nn.layer.layers import Parameter
-        from jax.sharding import PartitionSpec as P
-
-        # init each layer independently (distinct RNG draws), stack on dim 0
-        protos = [template] + [LlamaDecoderLayer(config) for _ in range(L - 1)]
-        proto_params = [dict(pl.named_parameters()) for pl in protos]
-        for name, p in template.named_parameters():
-            stacked = Parameter(jnp.stack([pp_[name].data for pp_ in proto_params]))
-            base_spec = tuple(p.dist_spec) if p.dist_spec is not None else (None,) * p.ndim
-            stacked.dist_spec = P(*((("pp" if pp > 1 else None),) + base_spec))
-            safe = name.replace(".", "__")
-            self.add_parameter(safe, stacked)
-            self._names.append((safe, name))
-        _STACK_REGISTRY[id(self)] = self
-
-    def forward(self, hidden):
-        stacked = [self._parameters[safe] for safe, _ in self._names]
-        has_moe = getattr(self.config, "num_experts", 0) > 1
-        out = _scan_stack(
-            hidden, *stacked, _stack_id=id(self), has_moe=has_moe,
-            use_recompute=self.config.use_recompute and self.training)
-        if has_moe:
-            from ..nn.layer import moe as moe_mod
-
-            out, aux = out
-            moe_mod.record_aux(aux)
-        return out
-
-
-_STACK_REGISTRY = {}
-
-
-@primitive("llama_scan_stack")
-def _scan_stack_fn(hidden, *stacked, _stack_id, use_recompute, has_moe=False):
-    import jax
-    from ..nn.layer import moe as moe_mod
-
-    stack = _STACK_REGISTRY[_stack_id]
-    template = stack._template[0]
-    tparams = [dict(template.named_parameters())[orig] for _, orig in stack._names]
-
-    def body(carry, slices):
-        saved = [p.data for p in tparams]
-        try:
-            for p, s in zip(tparams, slices):
-                p.data = s
-            from ..core import autograd
-
-            with moe_mod.collect_aux() as bucket, autograd.no_grad():
-                out = template(Tensor(carry)).data
-        finally:
-            for p, a in zip(tparams, saved):
-                p.data = a
-        aux = sum((t.data for t in bucket), jnp.zeros((), jnp.float32))
-        return out, aux
-
-    env = get_mesh_env()
-    pp = env.get_dim("pp") if env is not None else 1
-    if pp > 1:
-        # compiled microbatch pipeline: manual over 'pp' (ppermute handoffs),
-        # auto/GSPMD over dp/mp/cp/sdp inside each stage. Each device's local
-        # slice of the stacked params is its stage's L/pp layers, applied by
-        # an inner scan per tick.
-        from ..distributed.meta_parallel.pipeline import (
-            microbatch, pipeline_shard_map, unmicrobatch)
-
-        L = stack.config.num_hidden_layers
-        if L % pp != 0:
-            raise ValueError(
-                f"num_hidden_layers={L} must be divisible by pp={pp} "
-                f"(each pipeline stage holds L/pp layers)")
-        M = stack.config.pp_microbatches or 2 * pp
-
-        def stage_fn(h, *stacked_local):
-            out, aux = jax.lax.scan(body, h, tuple(stacked_local))
-            return out, jnp.sum(aux)
-
-        x_mb = microbatch(hidden, M)
-        piped = pipeline_shard_map(stage_fn, env, len(stacked),
-                                   remat=use_recompute, with_aux=True)
-        out_mb, aux = piped(x_mb, *stacked)
-        out = unmicrobatch(out_mb)
-        # per-microbatch aux values average to the full-batch value
-        return (out, aux / M) if has_moe else out
-
-    if use_recompute:
-        body = jax.checkpoint(body)
-    out, aux = jax.lax.scan(body, hidden, tuple(stacked))
-    return (out, jnp.sum(aux)) if has_moe else out
-
-
-def _scan_stack(hidden, *stacked, _stack_id, use_recompute, has_moe=False):
-    return _scan_stack_fn(hidden, *stacked, _stack_id=_stack_id,
-                          use_recompute=use_recompute, has_moe=has_moe)
 
 
 class LlamaModel(nn.Layer):

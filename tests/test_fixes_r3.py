@@ -101,6 +101,14 @@ _P2P_WORKER = textwrap.dedent("""
         dist.recv(a, src=0, tag=5)
         dist.recv(b, src=0, tag=5)
         assert float(a.data[0]) == 1.0 and float(b.data[0]) == 2.0
+    # irecv-then-send exchange must not deadlock (blocking wait rides its own
+    # store connection, so the concurrent send can still reach the daemon)
+    peer = 1 - rank
+    buf = paddle.zeros([2])
+    task = dist.irecv(buf, src=peer, tag=8)
+    dist.send(paddle.full([2], float(rank)), dst=peer, tag=8)
+    assert task.wait(60), "exchange deadlocked"
+    np.testing.assert_array_equal(np.asarray(buf.data), [peer, peer])
     with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
         f.write("ok")
 """)
