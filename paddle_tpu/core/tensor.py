@@ -114,7 +114,19 @@ class Tensor:
     def __bool__(self):
         if self.size != 1:
             raise ValueError("The truth value of a multi-element Tensor is ambiguous")
-        return bool(self.data.item())
+        try:
+            return bool(self.data.item())
+        except Exception as e:
+            if "Tracer" in type(e).__name__ or "Concretization" in str(type(e)):
+                raise TypeError(
+                    "data-dependent Python control flow on a traced Tensor: "
+                    "this branch cannot be captured. Use "
+                    "paddle.static.nn.cond / while_loop, or keep the if/while "
+                    "simple (plain-name assignments or two-arm returns) so "
+                    "paddle.jit.to_static auto-converts it "
+                    "(reference: dygraph_to_static/program_translator.py)."
+                ) from e
+            raise
 
     def __len__(self):
         if not self.data.shape:

@@ -43,4 +43,6 @@ class ParallelEnv:
         self.trainer_endpoints = []
 
 from . import auto_parallel  # noqa: F401,E402
-from .auto_parallel import shard_tensor, shard_op, ProcessMesh  # noqa: F401,E402
+from .auto_parallel import (  # noqa: F401,E402
+    shard_tensor, shard_op, ProcessMesh, Engine, propose_mesh, complete_specs,
+)

@@ -14,8 +14,8 @@ from typing import List, Optional, Sequence
 import jax
 from jax.sharding import NamedSharding, PartitionSpec
 
-from ..core.tensor import Tensor
-from .mesh import get_mesh_env, require_mesh_env
+from ...core.tensor import Tensor
+from ..mesh import get_mesh_env, require_mesh_env
 
 
 class ProcessMesh:
@@ -118,3 +118,7 @@ def shard_op(op_fn, dist_attr=None, out_shard_specs=None):
         return type(out)(constrained) if multi else constrained[0]
 
     return wrapped
+
+
+from .completion import complete_specs  # noqa: E402,F401
+from .engine import Engine, propose_mesh  # noqa: E402,F401

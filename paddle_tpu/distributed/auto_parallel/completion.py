@@ -1,0 +1,333 @@
+"""Sharding completion: propagate user seeds through the captured jaxpr.
+
+Reference: python/paddle/distributed/auto_parallel/completion.py:126
+(Completer.complete_forward_annotation — walks the program, filling each op's
+dist_attr from its neighbors via per-op SPMD rules). TPU-native re-design:
+the "program" is the jaxpr of the captured loss function, the dist_attr is a
+per-dimension mesh-axis assignment, and the rules cover the structural
+primitives (dot_general / reshape / transpose / broadcast / elementwise),
+recursing into pjit/remat sub-jaxprs. The result is a proposed PartitionSpec
+for every parameter — GSPMD then partitions the actual computation, so this
+layer only has to *choose* specs, never rewrite programs.
+
+Propagation is a forward+backward fixpoint: each rule can push axis
+assignments from inputs to outputs and back. Conflicts (two different axes
+claiming one dimension) resolve to the first writer; a dimension whose size
+the axis degree does not divide stays unsharded.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..mesh import MeshEnv
+
+# spec representation: tuple of (axis-name | None) per tensor dim
+
+
+def _meet(a: Optional[tuple], b: Optional[tuple]):
+    """Merge two candidate specs for one var (first writer wins per dim)."""
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return tuple(x if x is not None else y for x, y in zip(a, b))
+
+
+class _Prop:
+    def __init__(self, env: MeshEnv):
+        self.env = env
+        self.spec: Dict[int, tuple] = {}  # id(var) -> dim specs
+        self.changed = False
+
+    def get(self, v) -> Optional[tuple]:
+        if type(v).__name__ == "Literal":
+            return None
+        return self.spec.get(id(v))
+
+    def degree(self, ax) -> int:
+        """Axis degree; a tuple entry (multi-axis sharding of one dim)
+        multiplies its members' degrees."""
+        if isinstance(ax, (tuple, list)):
+            d = 1
+            for a in ax:
+                d *= max(self.env.get_dim(a), 1)
+            return d
+        return self.env.get_dim(ax)
+
+    def set(self, v, s: Optional[tuple]):
+        if s is None or type(v).__name__ == "Literal":
+            return
+        ndim = len(getattr(v.aval, "shape", ()))
+        if len(s) != ndim:
+            return
+        # drop axes that do not divide the dim (mirror of the reference's
+        # dims_mapping validity check)
+        shape = v.aval.shape
+        s = tuple(ax if ax is not None and shape[i] % max(self.degree(ax), 1) == 0
+                  and self.degree(ax) > 1 else None
+                  for i, ax in enumerate(s))
+        old = self.spec.get(id(v))
+        new = _meet(old, s)
+        if new != old:
+            self.spec[id(v)] = new
+            self.changed = True
+
+
+def _rule_dot(p: _Prop, eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    out = eqn.outvars[0]
+    ls, rs = p.get(lhs), p.get(rhs)
+    lnd = len(lhs.aval.shape)
+    rnd = len(rhs.aval.shape)
+    lfree = [d for d in range(lnd) if d not in lc and d not in lb]
+    rfree = [d for d in range(rnd) if d not in rc and d not in rb]
+    # out dims: batch..., lhs free..., rhs free...
+    nb = len(lb)
+    out_spec = [None] * len(out.aval.shape)
+    if ls is not None:
+        for i, d in enumerate(lb):
+            out_spec[i] = ls[d]
+        for i, d in enumerate(lfree):
+            out_spec[nb + i] = ls[d]
+    if rs is not None:
+        for i, d in enumerate(rb):
+            out_spec[i] = _meet((out_spec[i],), (rs[d],))[0]
+        for i, d in enumerate(rfree):
+            out_spec[nb + len(lfree) + i] = rs[d]
+    p.set(out, tuple(out_spec))
+    # backward: out -> operands; contracting dims couple lhs<->rhs
+    os = p.get(out)
+    if os is not None:
+        l_new = [None] * lnd
+        r_new = [None] * rnd
+        for i, d in enumerate(lb):
+            l_new[d] = os[i]
+        for i, d in enumerate(rb):
+            r_new[d] = os[i]
+        for i, d in enumerate(lfree):
+            l_new[d] = os[nb + i]
+        for i, d in enumerate(rfree):
+            r_new[d] = os[nb + len(lfree) + i]
+        if rs is not None:
+            for i, d in enumerate(lc):
+                l_new[d] = _meet((l_new[d],), (rs[rc[i]],))[0]
+        if ls is not None:
+            for i, d in enumerate(rc):
+                r_new[d] = _meet((r_new[d],), (ls[lc[i]],))[0]
+        p.set(lhs, tuple(l_new))
+        p.set(rhs, tuple(r_new))
+
+
+def _factor_groups(src_shape, dst_shape):
+    """Reshape dim correspondence as aligned groups: [(src_dims, dst_dims)]
+    with equal products per group. A contiguous row-major split/merge keeps a
+    merged dim's sharding iff it lands on the group's MAJOR (first) dim —
+    e.g. [b,s,h] -> [b,s,heads,hd] maps h's axis onto heads."""
+    groups = []
+    si = di = 0
+    while si < len(src_shape) or di < len(dst_shape):
+        s_dims, d_dims = [], []
+        sprod = dprod = 1
+        while True:
+            if sprod == dprod and s_dims and d_dims:
+                break
+            if sprod <= dprod and si < len(src_shape):
+                s_dims.append(si)
+                sprod *= src_shape[si]
+                si += 1
+            elif di < len(dst_shape):
+                d_dims.append(di)
+                dprod *= dst_shape[di]
+                di += 1
+            else:
+                break
+        if s_dims or d_dims:
+            groups.append((s_dims, d_dims))
+        else:
+            break
+    return groups
+
+
+def _map_group_spec(spec_dims, src_dims, dst_dims, dst_shape, env):
+    """Move one group's sharding across a reshape (major-dim rule)."""
+    out = {}
+    if not src_dims or not dst_dims:
+        return out
+    if len(src_dims) == 1 and len(dst_dims) == 1:
+        out[dst_dims[0]] = spec_dims.get(src_dims[0])
+        return out
+    # split/merge: only the major src dim's sharding survives, landing on the
+    # major dst dim (contiguous chunks line up only there), and only when the
+    # axis degree divides that dst dim
+    ax = spec_dims.get(src_dims[0])
+    minor_sharded = any(spec_dims.get(d) is not None for d in src_dims[1:])
+    if ax is not None and not minor_sharded:
+        deg = 1
+        for a in (ax if isinstance(ax, (tuple, list)) else (ax,)):
+            deg *= max(env.get_dim(a), 1)
+        if dst_shape[dst_dims[0]] % deg == 0:
+            out[dst_dims[0]] = ax
+    return out
+
+
+def _rule_reshape(p: _Prop, eqn):
+    x, out = eqn.invars[0], eqn.outvars[0]
+    groups = _factor_groups(x.aval.shape, out.aval.shape)
+    xs, os = p.get(x), p.get(out)
+    if xs is not None:
+        spec = [None] * len(out.aval.shape)
+        for s_dims, d_dims in groups:
+            m = _map_group_spec({d: xs[d] for d in s_dims}, s_dims, d_dims,
+                                out.aval.shape, p.env)
+            for d, ax in m.items():
+                spec[d] = ax
+        p.set(out, tuple(spec))
+    if os is not None:
+        spec = [None] * len(x.aval.shape)
+        for s_dims, d_dims in groups:
+            m = _map_group_spec({d: os[d] for d in d_dims}, d_dims, s_dims,
+                                x.aval.shape, p.env)
+            for d, ax in m.items():
+                spec[d] = ax
+        p.set(x, tuple(spec))
+
+
+def _rule_transpose(p: _Prop, eqn):
+    x, out = eqn.invars[0], eqn.outvars[0]
+    perm = eqn.params["permutation"]
+    xs, os = p.get(x), p.get(out)
+    if xs is not None:
+        p.set(out, tuple(xs[d] for d in perm))
+    if os is not None:
+        inv = [None] * len(perm)
+        for i, d in enumerate(perm):
+            inv[d] = os[i]
+        p.set(x, tuple(inv))
+
+
+def _rule_broadcast(p: _Prop, eqn):
+    x, out = eqn.invars[0], eqn.outvars[0]
+    bdims = eqn.params["broadcast_dimensions"]
+    xs, os = p.get(x), p.get(out)
+    if xs is not None:
+        spec = [None] * len(out.aval.shape)
+        for i, d in enumerate(bdims):
+            if x.aval.shape[i] == out.aval.shape[d]:
+                spec[d] = xs[i]
+        p.set(out, tuple(spec))
+    if os is not None:
+        spec = [None] * len(x.aval.shape)
+        for i, d in enumerate(bdims):
+            if x.aval.shape[i] == out.aval.shape[d]:
+                spec[i] = os[d]
+        p.set(x, tuple(spec))
+
+
+def _rule_reduce(p: _Prop, eqn):
+    x, out = eqn.invars[0], eqn.outvars[0]
+    axes = eqn.params.get("axes", ())
+    xs, os = p.get(x), p.get(out)
+    keep = [d for d in range(len(x.aval.shape)) if d not in axes]
+    if xs is not None:
+        p.set(out, tuple(xs[d] for d in keep))
+    if os is not None:
+        spec = [None] * len(x.aval.shape)
+        for i, d in enumerate(keep):
+            spec[d] = os[i]
+        p.set(x, tuple(spec))
+
+
+def _rule_elementwise(p: _Prop, eqn):
+    """Same-shape inputs/outputs exchange specs freely (covers unary math,
+    binary arithmetic post-broadcast, select, convert, and the conservative
+    fallback for unknown primitives with a shape-matching operand)."""
+    out_shapes = [tuple(o.aval.shape) for o in eqn.outvars]
+    for out, oshape in zip(eqn.outvars, out_shapes):
+        for x in eqn.invars:
+            if getattr(x, "aval", None) is None:
+                continue
+            if tuple(getattr(x.aval, "shape", ())) == oshape:
+                s = p.get(x)
+                if s is not None:
+                    p.set(out, s)
+                s2 = p.get(out)
+                if s2 is not None:
+                    p.set(x, s2)
+
+
+_SUB_JAXPR_PARAMS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr")
+
+
+def _sub_jaxpr(eqn):
+    for key in _SUB_JAXPR_PARAMS:
+        j = eqn.params.get(key)
+        if j is not None:
+            return j
+    return None
+
+
+def _walk(p: _Prop, jaxpr):
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub = _sub_jaxpr(eqn)
+        if sub is not None:
+            inner = sub.jaxpr if hasattr(sub, "jaxpr") else sub
+            # bridge outer<->inner vars both ways, then recurse
+            for ov, iv in zip(eqn.invars, inner.invars):
+                s = p.get(ov)
+                if s is not None:
+                    p.set(iv, s)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                s2 = p.get(ov)
+                if s2 is not None:
+                    p.set(iv, s2)
+            _walk(p, inner)
+            # bridge back out: results forward, and backward-propagated
+            # operand constraints (how a seed inside reaches outer params)
+            for ov, iv in zip(eqn.outvars, inner.outvars):
+                s = p.get(iv)
+                if s is not None:
+                    p.set(ov, s)
+            for ov, iv in zip(eqn.invars, inner.invars):
+                s = p.get(iv)
+                if s is not None:
+                    p.set(ov, s)
+        elif name == "dot_general":
+            _rule_dot(p, eqn)
+        elif name == "reshape":
+            _rule_reshape(p, eqn)
+        elif name == "transpose":
+            _rule_transpose(p, eqn)
+        elif name == "broadcast_in_dim":
+            _rule_broadcast(p, eqn)
+        elif name in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+                      "argmax", "argmin"):
+            _rule_reduce(p, eqn)
+        else:
+            _rule_elementwise(p, eqn)
+
+
+def complete_specs(fn, example_args, seeds: Dict[int, Sequence],
+                   env: MeshEnv, n_outputs: Optional[int] = None,
+                   max_iters: int = 8) -> List[Optional[tuple]]:
+    """Propagate `seeds` ({arg_index: spec tuple}) through fn's jaxpr.
+
+    Returns a proposed spec (tuple of axis names/None) for EVERY positional
+    argument of `fn` (flat list of arrays). The reference's
+    complete_forward_annotation over program_desc, done over a jaxpr.
+    """
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = closed.jaxpr
+    p = _Prop(env)
+    for idx, spec in seeds.items():
+        p.set(jaxpr.invars[idx], tuple(spec))
+    for _ in range(max_iters):
+        p.changed = False
+        _walk(p, jaxpr)
+        if not p.changed:
+            break
+    return [p.get(v) for v in jaxpr.invars]

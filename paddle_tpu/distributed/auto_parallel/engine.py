@@ -1,0 +1,201 @@
+"""Auto-parallel Engine: prepare/fit over a planned + completed sharding.
+
+Reference: python/paddle/distributed/auto_parallel/engine.py:64 (Engine
+wrapping model+loss+optimizer: prepare builds the distributed program via
+Planner/Completer/Partitioner, fit runs it) and planner.py / cost_model.py
+(mesh-degree choice). TPU-native mapping:
+  Planner   -> propose_mesh(): memory-model heuristic choosing axis degrees
+  Completer -> completion.complete_specs() over the captured jaxpr
+  Partitioner + executor -> GSPMD via ShardedTrainStep (one pjit'ed step)
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from ...core.tensor import Tensor
+from ..mesh import get_mesh_env, init_mesh, require_mesh_env
+from .completion import complete_specs
+
+
+def propose_mesh(n_devices: int, param_bytes: int, num_heads: int = 0,
+                 hbm_bytes: float = 16e9, zero: bool = True) -> Dict[str, int]:
+    """Choose mesh axis degrees (the planner/cost-model role, planner.py).
+
+    Memory model per device: params + grads (param dtype) + Adam moments
+    (fp32 pair) must fit in ~60% of HBM (rest is activations/workspace).
+    Tensor-parallel degree mp divides that footprint; ZeRO ('sharding')
+    divides optimizer state over the data-parallel ranks first since it
+    costs less communication than mp. Whatever remains is dp.
+    """
+    budget = hbm_bytes * 0.6
+    state_bytes = param_bytes * (1 + 1 + 4)  # grads + 2 fp32 moments (bf16 p)
+    mp = 1
+    while mp < n_devices:
+        per_dev = state_bytes / mp
+        if zero:  # ZeRO shards optimizer state over the remaining ranks
+            dp = n_devices // mp
+            per_dev = (param_bytes * 2) / mp + (param_bytes * 4) / (mp * dp)
+        if per_dev <= budget:
+            break
+        if num_heads and num_heads % (mp * 2) != 0:
+            break  # don't split heads unevenly
+        mp *= 2
+    dp = n_devices // mp
+    axes = {}
+    if mp > 1:
+        axes["mp"] = mp
+    if dp > 1:
+        axes["sharding" if zero else "dp"] = dp
+    if not axes:
+        axes["dp"] = n_devices
+    return axes
+
+
+class Engine:
+    """reference engine.py:64. prepare() plans + completes the sharding,
+    fit/evaluate/predict drive compiled steps."""
+
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = getattr(optimizer, "_inner_opt", optimizer)
+        self.metrics = metrics
+        self.strategy = strategy
+        self._step = None
+        self._prepared = False
+        self.proposed_specs: Dict[str, Optional[tuple]] = {}
+
+    # -- planning + completion ----------------------------------------------
+    def _ensure_mesh(self):
+        env = get_mesh_env()
+        if env is not None:
+            return env
+        import jax
+
+        param_bytes = sum(
+            p.size * np.dtype(str(p.dtype).split(".")[-1].replace(
+                "bfloat16", "uint16")).itemsize
+            for p in self.model.parameters())
+        heads = getattr(getattr(self.model, "config", None),
+                        "num_attention_heads", 0)
+        axes = propose_mesh(len(jax.devices()), param_bytes, heads)
+        return init_mesh(**axes)
+
+    def _loss_fn(self, m, *batch):
+        if self.loss is None:
+            return m(*batch)
+        out = m(*batch[:-1])
+        return self.loss(out, batch[-1])
+
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
+                sample_batch=None):
+        """Plan the mesh (if absent), complete parameter shardings from any
+        user shard_tensor seeds, and compile the train step lazily."""
+        env = self._ensure_mesh()
+        if sample_batch is not None:
+            self._complete(env, sample_batch)
+        self._prepared = True
+        return self
+
+    def _complete(self, env, sample_batch):
+        from ...jit import _Binder
+        from ...core import autograd
+
+        model = self.model
+        params = [p for _, p in model.named_parameters()]
+        names = [n for n, _ in model.named_parameters()]
+        arrays = [p.data for p in params]
+        batch_arrays = [b.data if isinstance(b, Tensor) else np.asarray(b)
+                        for b in sample_batch]
+
+        def flat_fn(*flat):
+            ps, batch = flat[:len(params)], flat[len(params):]
+            with _Binder(params) as b:
+                b.bind(list(ps))
+                with autograd.no_grad():
+                    loss = self._loss_fn(model, *[Tensor(a) for a in batch])
+            return loss.data
+
+        seeds = {}
+        for i, p in enumerate(params):
+            if p.dist_spec is not None:
+                seeds[i] = tuple(p.dist_spec) + (None,) * (
+                    p.ndim - len(tuple(p.dist_spec)))
+        # batch dim0 rides the data axes (the feed-sharding seed)
+        data_axes = tuple(ax for ax in ("dp", "sdp") if env.get_dim(ax) > 1)
+        for j, a in enumerate(batch_arrays):
+            if getattr(a, "ndim", 0) >= 1 and data_axes:
+                seeds[len(params) + j] = (data_axes,) + (None,) * (a.ndim - 1)
+        specs = complete_specs(flat_fn, arrays + batch_arrays, seeds, env)
+        for name, p, spec in zip(names, params, specs[:len(params)]):
+            self.proposed_specs[name] = spec
+            if p.dist_spec is None and spec is not None and any(
+                    s is not None for s in spec):
+                p.dist_spec = P(*spec)
+        return self.proposed_specs
+
+    # -- execution -----------------------------------------------------------
+    def _ensure_step(self, batch):
+        if self._step is None:
+            from ..parallel import ShardedTrainStep
+
+            if not self._prepared:
+                self.prepare(sample_batch=batch)
+            self._step = ShardedTrainStep(self.model, self._loss_fn,
+                                          self.optimizer)
+        return self._step
+
+    def fit(self, train_data, epochs=1, batch_size=32, steps_per_epoch=None,
+            log_freq=0, verbose=0):
+        from ... import io as pio
+
+        if isinstance(train_data, pio.DataLoader):
+            loader = train_data
+        else:
+            loader = pio.DataLoader(train_data, batch_size=batch_size,
+                                    shuffle=False, drop_last=True)
+        history = []
+        for ep in range(epochs):
+            for it, batch in enumerate(loader):
+                step = self._ensure_step(batch)
+                loss = step(*batch)
+                if steps_per_epoch and it + 1 >= steps_per_epoch:
+                    break
+            history.append(float(loss))
+            if log_freq and verbose:
+                print(f"epoch {ep}: loss {float(loss):.4f}")
+        return history
+
+    def evaluate(self, eval_data, batch_size=32, steps=None):
+        from ... import io as pio
+        from ...core import autograd
+
+        loader = eval_data if isinstance(eval_data, pio.DataLoader) else \
+            pio.DataLoader(eval_data, batch_size=batch_size, drop_last=True)
+        losses = []
+        with autograd.no_grad():
+            for it, batch in enumerate(loader):
+                losses.append(float(self._loss_fn(self.model, *batch)))
+                if steps and it + 1 >= steps:
+                    break
+        return {"loss": float(np.mean(losses))}
+
+    def predict(self, data, batch_size=32, steps=None):
+        from ... import io as pio
+        from ...core import autograd
+
+        loader = data if isinstance(data, pio.DataLoader) else \
+            pio.DataLoader(data, batch_size=batch_size)
+        outs = []
+        with autograd.no_grad():
+            for it, batch in enumerate(loader):
+                outs.append(self.model(*batch))
+                if steps and it + 1 >= steps:
+                    break
+        return outs

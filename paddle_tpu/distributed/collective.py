@@ -308,7 +308,7 @@ _P2P_STORE = None          # TCPStore channel for inter-process p2p (sends)
 _P2P_RECV_SEQ: dict = {}   # (src, dst, tag) -> highest reserved sequence
 _P2P_ABANDONED: dict = {}  # (src, dst, tag) -> seqs reserved but not consumed
 _P2P_CHAN_LOCK = threading.Lock()  # guards store init + per-message sequencing
-_P2P_RECV_LOCAL = threading.local()  # per-thread store conn for blocking waits
+_P2P_RECV_POOL: list = []          # reusable store conns for blocking waits
 
 
 def _proc_rank_world():
@@ -375,21 +375,32 @@ def init_p2p_channel(store=None):
         return _P2P_STORE
 
 
-def _recv_channel():
-    """Per-thread store connection for blocking recv waits (the shared client
-    serializes requests under one lock; parking a wait there would deadlock
-    the irecv+send exchange pattern)."""
-    store = getattr(_P2P_RECV_LOCAL, "store", None)
-    if store is None:
+class _RecvChannel:
+    """Checked-out store connection for one blocking recv wait.
+
+    The shared client serializes requests under one lock; parking a wait
+    there would deadlock the irecv+send exchange pattern. Connections are
+    pooled (not per-thread) because irecv spawns a fresh thread per call —
+    a thread-keyed cache would open a new TCP connection per message."""
+
+    def __enter__(self):
+        with _P2P_CHAN_LOCK:
+            if _P2P_RECV_POOL:
+                self.store = _P2P_RECV_POOL.pop()
+                return self.store
         from .store import TCPStore
 
         main = _P2P_STORE
-        store = TCPStore(host=main.host if main.host != "0.0.0.0"
-                         else "127.0.0.1",
-                         port=main.port, is_master=False,
-                         world_size=main.world_size)
-        _P2P_RECV_LOCAL.store = store
-    return store
+        self.store = TCPStore(host=main.host if main.host != "0.0.0.0"
+                              else "127.0.0.1",
+                              port=main.port, is_master=False,
+                              world_size=main.world_size)
+        return self.store
+
+    def __exit__(self, *exc):
+        with _P2P_CHAN_LOCK:
+            _P2P_RECV_POOL.append(self.store)
+        return False
 
 
 def _p2p_pack(data) -> bytes:
@@ -489,10 +500,6 @@ def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None,
                 f"recv: src={src} is not a process rank (world={world}); "
                 "across processes send/recv address processes, not devices")
         init_p2p_channel()
-        # blocking waits ride a per-thread connection: the shared client's
-        # lock must stay free so a concurrent send (irecv+send exchange) can
-        # proceed while this thread is parked in wait()
-        store = _recv_channel()
         key = (src, dst, tag)
         # reserve a sequence so concurrent irecvs on one channel each consume
         # a distinct message exactly once; failed reservations are recycled
@@ -505,14 +512,18 @@ def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None,
                 seq = _P2P_RECV_SEQ.get(key, 0) + 1
                 _P2P_RECV_SEQ[key] = seq
         skey = f"_p2p/{src}/{dst}/{tag}/{seq}"
+        # blocking waits ride a pooled dedicated connection: the shared
+        # client's lock must stay free so a concurrent send (irecv+send
+        # exchange) can proceed while this thread is parked in wait()
         try:
-            store.wait([skey])
-            data = jnp.asarray(_p2p_unpack(store.get(skey)))
+            with _RecvChannel() as store:
+                store.wait([skey])
+                data = jnp.asarray(_p2p_unpack(store.get(skey)))
+                store.delete_key(skey)
         except BaseException:
             with _P2P_CHAN_LOCK:  # let a retry pick this message up
                 _P2P_ABANDONED.setdefault(key, []).append(seq)
             raise
-        store.delete_key(skey)
     else:
         with _P2P_CV:
             ok = _P2P_CV.wait_for(

@@ -128,3 +128,62 @@ from ..core.dispatch import primitive  # noqa: E402  (Tensor-level op wrapper)
 @primitive("ring_attention")
 def _ring_attention_prim(q, k, v, *, causal, scale):
     return _ring_bshd(q, k, v, causal, scale)
+
+
+# -- Ulysses (all-to-all head-sharded) context parallelism --------------------
+# SURVEY §5: "Ulysses a2a over ICI as a mesh axis". Complementary to the ring:
+# instead of streaming K/V chunks around, one all_to_all converts the
+# sequence sharding into a head sharding (each rank holds ALL positions of
+# h/cp heads), runs ordinary flash attention on the full sequence locally,
+# and a second all_to_all restores the sequence sharding. Two a2a hops of
+# activation-sized traffic versus cp-1 ppermute hops of K/V — the better
+# trade at moderate cp degrees when heads divide evenly (DeepSpeed-Ulysses
+# recipe, re-expressed as XLA collectives on the mesh).
+
+def ulysses_attention_bshd(q, k, v, causal=True, scale=None,
+                           env: MeshEnv = None, axis: str = "cp"):
+    """q/k/v: [b, s, h, d] with s (dim 1) sharded over `axis`."""
+    env = env or get_mesh_env()
+    cp = env.get_dim(axis) if env is not None else 1
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    from ..kernels.flash_attention import flash_attention
+
+    if cp <= 1:
+        return flash_attention(q, k, v, causal=causal, scale=scale)
+    h = q.shape[2]
+    if h % cp != 0:
+        raise ValueError(
+            f"ulysses needs num_heads ({h}) divisible by cp={cp}; "
+            "use ring attention (cp_impl='ring') for this head count")
+
+    def local(ql, kl, vl):
+        # [b, s/cp, h, d] -> [b, s, h/cp, d]: scatter heads, gather sequence
+        qh = lax.all_to_all(ql, axis, split_axis=2, concat_axis=1, tiled=True)
+        kh = lax.all_to_all(kl, axis, split_axis=2, concat_axis=1, tiled=True)
+        vh = lax.all_to_all(vl, axis, split_axis=2, concat_axis=1, tiled=True)
+        oh = flash_attention(qh, kh, vh, causal=causal, scale=float(scale))
+        # [b, s, h/cp, d] -> [b, s/cp, h, d]: scatter sequence, gather heads
+        return lax.all_to_all(oh, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    return jax.shard_map(
+        local, mesh=env.mesh,
+        in_specs=(P(None, axis), P(None, axis), P(None, axis)),
+        out_specs=P(None, axis), axis_names={axis}, check_vma=False,
+    )(q, k, v)
+
+
+@primitive("ulysses_attention")
+def _ulysses_attention_prim(q, k, v, *, causal, scale):
+    return ulysses_attention_bshd(q, k, v, causal, scale)
+
+
+def ulysses_attention(q, k, v, causal=True, scale=None, env: MeshEnv = None):
+    """Paddle layout [b, s, h, d], seq sharded over 'cp'. Differentiable."""
+    from ..core.tensor import Tensor
+
+    if isinstance(q, Tensor):
+        return _ulysses_attention_prim(
+            q, k, v, causal=bool(causal),
+            scale=scale if scale is None else float(scale))
+    return ulysses_attention_bshd(q, k, v, causal, scale, env)

@@ -111,3 +111,139 @@ class ElasticManager:
         if self._thread is not None:
             self._thread.join(timeout=2)
         return ElasticStatus.COMPLETED if completed else ElasticStatus.EXIT
+
+
+class ElasticController:
+    """The end-to-end elastic loop: spawn → watch → restart at new world size.
+
+    Reference manager.py:130 + launch.py elastic mode: the etcd watcher
+    notices a dead node and relaunches training with the survivors; training
+    scripts resume from their checkpoint. Here: the controller owns the
+    TCPStore master and the local gang (launch/process.py); a worker death
+    (process exit or stale heartbeat) triggers RESTART — the gang is torn
+    down and re-spawned at the surviving world size with PADDLE_RESTART_ID
+    bumped, and each worker's script reloads its checkpoint on entry.
+    """
+
+    def __init__(self, cmd, np: int, min_np: int, max_np: Optional[int] = None,
+                 log_dir: Optional[str] = None, heartbeat_timeout: float = 5.0,
+                 extra_env: Optional[Dict[str, str]] = None):
+        self.cmd = list(cmd)
+        self.np = int(np)
+        self.min_np = int(min_np)
+        self.max_np = int(max_np or np)
+        self.log_dir = log_dir
+        self.heartbeat_timeout = heartbeat_timeout
+        self.extra_env = dict(extra_env or {})
+        self.store = TCPStore(is_master=True, world_size=1)
+        self.events: List[Dict] = []  # RESTART/ERROR/COMPLETED audit trail
+
+    def _spawn(self, world: int, restart_id: int):
+        from ..launch.process import ProcessContext
+
+        # reset heartbeat state: the previous generation's (now stale)
+        # timestamps must not condemn freshly spawned workers before their
+        # first beat
+        for r in range(self.max_np):
+            self.store.delete_key(f"elastic/worker/{r}")
+            self.store.delete_key(f"elastic/worker/{r}/published")
+        env = dict(self.extra_env)
+        env.update({
+            "PADDLE_ELASTIC_ENDPOINT": f"127.0.0.1:{self.store.port}",
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_RESTART_ID": str(restart_id),
+        })
+        log_dir = None
+        if self.log_dir:
+            log_dir = f"{self.log_dir}/r{restart_id}"
+        return ProcessContext.start(self.cmd, world, base_env=env,
+                                    log_dir=log_dir)
+
+    def _stale_ranks(self, world: int) -> List[int]:
+        """Ranks that registered heartbeats but went silent for longer than
+        heartbeat_timeout — a HUNG worker (process alive, training stuck).
+        Workers that never registered (non-elastic scripts) are exempt."""
+        import json as _json
+        import time as _t
+
+        stale = []
+        for r in range(world):
+            try:
+                if self.store.add(f"elastic/worker/{r}/published", 0) < 1:
+                    continue  # never heartbeated: not participating
+                raw = self.store.get(f"elastic/worker/{r}")
+                ts = _json.loads(raw)["ts"]
+            except Exception:
+                continue
+            if _t.time() - ts > self.heartbeat_timeout:
+                stale.append(r)
+        return stale
+
+    def run(self, max_restarts: int = 3, poll_interval: float = 0.2,
+            timeout: Optional[float] = None) -> ElasticStatus:
+        import time as _t
+
+        world = self.np
+        restart_id = 0
+        deadline = None if timeout is None else _t.time() + timeout
+        ctx = self._spawn(world, restart_id)
+        while True:
+            if deadline is not None and _t.time() > deadline:
+                ctx.terminate()
+                self.events.append({"status": "error", "reason": "timeout"})
+                return ElasticStatus.ERROR
+            codes = [e.proc.poll() for e in ctx.entries]
+            if all(c == 0 for c in codes):
+                self.events.append({"status": "completed", "world": world})
+                return ElasticStatus.COMPLETED
+            dead = [e.rank for e, c in zip(ctx.entries, codes)
+                    if c is not None and c != 0]
+            if not dead:
+                # hung workers (alive but heartbeat-silent) count as dead:
+                # kill them so the restart path below takes over
+                for r in self._stale_ranks(world):
+                    entry = ctx.entries[r]
+                    if entry.proc.poll() is None:
+                        try:
+                            entry.proc.kill()
+                            entry.proc.wait(timeout=5)
+                        except OSError:
+                            pass
+                        self.events.append({"status": "hung", "rank": r})
+                        dead.append(r)
+            if dead:
+                survivors = world - len(dead)
+                if survivors < self.min_np or restart_id >= max_restarts:
+                    ctx.terminate()
+                    self.events.append({
+                        "status": "error", "dead": dead, "world": world})
+                    return ElasticStatus.ERROR
+                # the reference's RESTART path: tear down, relaunch smaller
+                ctx.terminate()
+                restart_id += 1
+                world = survivors
+                self.events.append({"status": "restart", "dead": dead,
+                                    "world": world, "restart_id": restart_id})
+                ctx = self._spawn(world, restart_id)
+            _t.sleep(poll_interval)
+
+    def close(self):
+        self.store.close()
+
+
+def elastic_worker_env():
+    """Worker-side: (rank, world, restart_id, store client) from the
+    controller's env; registers heartbeating via ElasticManager."""
+    import os
+
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+    world = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    restart_id = int(os.environ.get("PADDLE_RESTART_ID", 0))
+    endpoint = os.environ.get("PADDLE_ELASTIC_ENDPOINT")
+    store = None
+    manager = None
+    if endpoint:
+        host, port = endpoint.rsplit(":", 1)
+        store = TCPStore(host=host, port=int(port), world_size=world)
+        manager = ElasticManager(store, rank, world).register()
+    return rank, world, restart_id, store, manager

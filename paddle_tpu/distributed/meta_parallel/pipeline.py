@@ -44,7 +44,9 @@ def ppermute_pipeline(run_stage: Callable, x_mb, pp_size: int, axis: str = "pp",
     idx = lax.axis_index(axis)
     perm = [(i, i + 1) for i in range(pp_size - 1)]
     if remat:
-        run_stage = jax.checkpoint(run_stage)
+        from .stage_stack import remat_wrap
+
+        run_stage = remat_wrap(run_stage)
 
     def tick(carry, t):
         state, outs, aux_acc = carry

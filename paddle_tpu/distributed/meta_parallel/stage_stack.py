@@ -29,6 +29,26 @@ from ..mesh import get_mesh_env
 _RUN_REGISTRY = {}
 
 
+def remat_wrap(fn):
+    """jax.checkpoint with the policy chosen by FLAGS_remat_policy:
+    '' = full remat (save inputs only, recompute everything — min memory),
+    'dots' = save dot/matmul outputs without batch dims (skip re-running the
+    MXU work in backward at the cost of activation HBM — the reference's
+    selective-recompute tier)."""
+    try:
+        from ...framework import flags as flags_mod
+
+        pol = flags_mod.get_flags("FLAGS_remat_policy")["FLAGS_remat_policy"]
+    except Exception:
+        pol = ""
+    policy = None
+    if pol == "dots":
+        policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    elif pol == "dots_all":
+        policy = jax.checkpoint_policies.dots_saveable
+    return jax.checkpoint(fn, policy=policy)
+
+
 def layer_signature(layer: Layer):
     """Structural identity: same class + same named param shapes/dtypes means
     two layers can share one stacked stage body."""
@@ -75,10 +95,16 @@ class StackedStageRun(Layer):
             self.add_parameter(safe, stacked)
             self._names.append((safe, name))
         # free the duplicate per-layer arrays (the stacked copy is canonical;
-        # layer 0 stays intact as the template's mutation slots)
+        # layer 0 stays intact as the template's mutation slots). Every
+        # per-layer param is marked so an optimizer that captured them BEFORE
+        # stacking (wrong fleet order: optimizer before distributed_model)
+        # fails loudly instead of silently training dead buffers.
         for l in layers[1:]:
             for n, p in l.named_parameters():
                 p.data = jnp.zeros((0,), p.data.dtype)
+                p._stacked_into = self
+        for n, p in layers[0].named_parameters():
+            p._stacked_into = self
         _RUN_REGISTRY[id(self)] = self
 
     def forward(self, hidden):
@@ -136,7 +162,7 @@ def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches):
         return unmicrobatch(out_mb, env), aux / M
 
     if use_recompute:
-        body = jax.checkpoint(body)
+        body = remat_wrap(body)
     out, aux = jax.lax.scan(body, hidden, tuple(stacked))
     return out, jnp.sum(aux)
 

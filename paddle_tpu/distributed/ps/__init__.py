@@ -1,25 +1,35 @@
 """Parameter-server mode (reference: paddle/fluid/distributed/ps/ +
-python/paddle/distributed/fleet PS strategies — pserver processes hold dense/
-sparse tables; trainers pull params and push grads).
+python/paddle/distributed/ps/the_one_ps.py:796 — pserver processes hold
+dense/sparse tables; trainers pull params and push grads through brpc).
 
-TPU-native scope: dense training belongs to SPMD/GSPMD, so the PS here covers
-the role SPMD cannot: giant sparse embedding tables that never fit a chip and
-update sparsely. Tables live server-side; the wire is the native TCPStore
-(store/store.cpp), values as raw ndarray bytes — trainers pull rows for the
-batch, compute on-device, and push row gradients back for a server-side SGD
-update (async, like the reference's async PS mode).
+TPU-native scope: dense SPMD training belongs to GSPMD; the PS covers what
+SPMD cannot — giant sparse embedding tables that never fit a chip and update
+sparsely. Architecture mirrored from the reference at reduced scale:
+
+  * multi-server row sharding: sparse row r lives on server ``r % n_servers``
+    (the reference's key-hash table shards, brpc_ps_client.h routing); dense
+    tables split into contiguous chunks, one per server.
+  * batched wire ops: one request carries the whole batch's unique rows (ids
+    + rows/grads as single ndarray payloads over the native TCPStore).
+  * AsyncCommunicator: background push thread with a bounded queue (the
+    reference's communicator.cc send queue / async PS mode).
+
+Servers and trainers are gang-spawned processes (launch/process.py); the
+rendezvous/wire is the native TCPStore daemon (store/store.cpp).
 """
 from __future__ import annotations
 
 import io
+import queue
 import threading
-from typing import Dict, Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from ..store import TCPStore
 
-__all__ = ["ParameterServer", "PsTrainer", "SparseEmbedding"]
+__all__ = ["ParameterServer", "PsTrainer", "SparseEmbedding",
+           "AsyncCommunicator"]
 
 
 def _dumps(arr: np.ndarray) -> bytes:
@@ -39,11 +49,20 @@ def _own_client(store: TCPStore) -> TCPStore:
                     world_size=store.world_size, timeout=store.timeout)
 
 
-class ParameterServer:
-    """Holds sparse tables; applies pushed row-gradients (table_manager role,
-    reference ps/table/memory_sparse_table.cc)."""
+def _dense_chunks(total: int, n: int) -> List[int]:
+    """Chunk offsets [0, ..., total]: server s owns [off[s], off[s+1])."""
+    base, extra = divmod(total, n)
+    offs = [0]
+    for s in range(n):
+        offs.append(offs[-1] + base + (1 if s < extra else 0))
+    return offs
 
-    def __init__(self, store: TCPStore, server_id: int = 0,
+
+class ParameterServer:
+    """Holds this server's shard of every table; applies pushed gradients
+    (reference ps/table/memory_sparse_table.cc + dense table)."""
+
+    def __init__(self, store: TCPStore, server_id: int = 0, n_servers: int = 1,
                  request_timeout: int = 10):
         self.store = _own_client(store)
         # bounded gets: a trainer dying mid-request must not wedge serving
@@ -51,20 +70,46 @@ class ParameterServer:
         self.store._lib.tcpstore_set_timeout(self.store._fd,
                                              int(request_timeout))
         self.store.timeout = int(request_timeout)
-        self.server_id = server_id
-        self.tables: Dict[str, np.ndarray] = {}
+        self.server_id = int(server_id)
+        self.n_servers = int(n_servers)
+        self.tables: Dict[str, np.ndarray] = {}   # sparse shards [rows/n, d]
+        self.dense: Dict[str, np.ndarray] = {}    # dense chunks (flat)
         self.lr: Dict[str, float] = {}
         self._mu = threading.Lock()  # create_table vs serving loop
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
+    @property
+    def _pfx(self) -> str:
+        return f"ps/s{self.server_id}"
+
     def create_table(self, name: str, shape, lr: float = 0.1, init_std=0.01,
                      seed: int = 0):
+        """Sparse table: this server materializes rows r % n_servers == id.
+        All servers draw from the same seed so the sharded init equals the
+        single-server init row-for-row."""
+        rows, dim = int(shape[0]), int(shape[1])
         rng = np.random.RandomState(seed)
+        full = (rng.randn(rows, dim) * init_std).astype("float32")
         with self._mu:
-            self.tables[name] = (rng.randn(*shape) * init_std).astype("float32")
+            self.tables[name] = np.ascontiguousarray(
+                full[self.server_id::self.n_servers])
             self.lr[name] = float(lr)
-        self.store.set(f"ps/{name}/meta", _dumps(np.asarray(shape, "int64")))
+        self.store.set(f"ps/{name}/meta",
+                       _dumps(np.asarray([rows, dim, self.n_servers], "int64")))
+        return self
+
+    def create_dense_table(self, name: str, init: np.ndarray, lr: float = 0.1):
+        """Dense table: contiguous chunk of the flattened parameter."""
+        flat = np.asarray(init, "float32").ravel()
+        offs = _dense_chunks(flat.size, self.n_servers)
+        with self._mu:
+            self.dense[name] = flat[offs[self.server_id]:
+                                    offs[self.server_id + 1]].copy()
+            self.lr[name] = float(lr)
+        self.store.set(f"ps/{name}/dmeta",
+                       _dumps(np.asarray(list(np.shape(init)) +
+                                         [self.n_servers], "int64")))
         return self
 
     # -- serving loop --------------------------------------------------------
@@ -81,60 +126,72 @@ class ParameterServer:
     def _loop(self, poll_interval):
         import sys
 
-        served_pull: Dict[str, int] = {}
-        served_push: Dict[str, int] = {}
+        served: Dict[tuple, int] = {}
         retries: Dict[tuple, int] = {}
 
-        def give_up(kind, name, served):
+        def give_up(kind, name):
             """A trainer died between bumping the counter and writing its
             payload: after MAX_REQUEST_RETRIES timeouts, skip that id so the
             table keeps serving everyone else."""
-            k = served.get(name, 0) + 1
+            k = served.get((kind, name), 0) + 1
             key = (kind, name, k)
             retries[key] = retries.get(key, 0) + 1
             if retries[key] >= self.MAX_REQUEST_RETRIES:
                 print(f"ParameterServer[{name}]: abandoning {kind} request "
                       f"{k} (no payload after {retries[key]} attempts)",
                       file=sys.stderr)
-                served[name] = k
+                served[(kind, name)] = k
                 retries.pop(key, None)
+
+        def drain(kind, name, handler):
+            try:
+                n_req = self.store.add(f"{self._pfx}/{name}/{kind}_req", 0)
+                while served.get((kind, name), 0) < n_req:
+                    k = served.get((kind, name), 0) + 1
+                    handler(name, k)
+                    served[(kind, name)] = k
+            except TimeoutError:
+                give_up(kind, name)
+            except Exception as e:  # pragma: no cover - defensive
+                print(f"ParameterServer[{name}]: {e!r}", file=sys.stderr)
+
+        def h_pull(name, k):
+            table = self.tables[name]
+            ids = _loads(self.store.get(f"{self._pfx}/{name}/pull/{k}/ids"))
+            rows = table[ids // self.n_servers]  # ids are GLOBAL row numbers
+            self.store.set(f"{self._pfx}/{name}/pull/{k}/rows", _dumps(rows))
+            self.store.delete_key(f"{self._pfx}/{name}/pull/{k}/ids")
+
+        def h_push(name, k):
+            table = self.tables[name]
+            ids = _loads(self.store.get(f"{self._pfx}/{name}/push/{k}/ids"))
+            grads = _loads(self.store.get(f"{self._pfx}/{name}/push/{k}/grads"))
+            np.subtract.at(table, ids // self.n_servers,
+                           self.lr[name] * grads)
+            self.store.set(f"{self._pfx}/{name}/push/{k}/done", b"1")
+            self.store.delete_key(f"{self._pfx}/{name}/push/{k}/ids")
+            self.store.delete_key(f"{self._pfx}/{name}/push/{k}/grads")
+
+        def h_dpull(name, k):
+            chunk = self.dense[name]
+            self.store.set(f"{self._pfx}/{name}/dpull/{k}/rows", _dumps(chunk))
+
+        def h_dpush(name, k):
+            grads = _loads(self.store.get(f"{self._pfx}/{name}/dpush/{k}/g"))
+            self.dense[name] -= self.lr[name] * grads
+            self.store.set(f"{self._pfx}/{name}/dpush/{k}/done", b"1")
+            self.store.delete_key(f"{self._pfx}/{name}/dpush/{k}/g")
 
         while not self._stop.is_set():
             with self._mu:
-                snapshot = list(self.tables.items())
-            for name, table in snapshot:
-                # pulls: trainer writes ids, bumps request counter
-                try:
-                    n_req = self.store.add(f"ps/{name}/pull_req", 0)
-                    while served_pull.get(name, 0) < n_req:
-                        k = served_pull.get(name, 0) + 1
-                        ids = _loads(self.store.get(f"ps/{name}/pull/{k}/ids"))
-                        rows = table[ids]
-                        self.store.set(f"ps/{name}/pull/{k}/rows", _dumps(rows))
-                        self.store.delete_key(f"ps/{name}/pull/{k}/ids")
-                        served_pull[name] = k  # progress survives a later retry
-                except TimeoutError:
-                    give_up("pull", name, served_pull)
-                except Exception as e:  # pragma: no cover - defensive
-                    print(f"ParameterServer[{name}]: {e!r}", file=sys.stderr)
-                # pushes: trainer writes (ids, grads), bumps counter
-                try:
-                    n_push = self.store.add(f"ps/{name}/push_req", 0)
-                    while served_push.get(name, 0) < n_push:
-                        k = served_push.get(name, 0) + 1
-                        ids = _loads(self.store.get(f"ps/{name}/push/{k}/ids"))
-                        grads = _loads(
-                            self.store.get(f"ps/{name}/push/{k}/grads"))
-                        np.subtract.at(table, ids, self.lr[name] * grads)
-                        # per-request ack, then free the payload keys
-                        self.store.set(f"ps/{name}/push/{k}/done", b"1")
-                        self.store.delete_key(f"ps/{name}/push/{k}/ids")
-                        self.store.delete_key(f"ps/{name}/push/{k}/grads")
-                        served_push[name] = k
-                except TimeoutError:
-                    give_up("push", name, served_push)
-                except Exception as e:  # pragma: no cover - defensive
-                    print(f"ParameterServer[{name}]: {e!r}", file=sys.stderr)
+                sparse = list(self.tables)
+                dense = list(self.dense)
+            for name in sparse:
+                drain("pull", name, h_pull)
+                drain("push", name, h_push)
+            for name in dense:
+                drain("dpull", name, h_dpull)
+                drain("dpush", name, h_dpush)
             self._stop.wait(poll_interval)
 
     def stop(self):
@@ -148,40 +205,154 @@ class ParameterServer:
 
 
 class PsTrainer:
-    """Trainer-side pull/push client (reference fleet communicator role)."""
+    """Trainer-side client routing batched pulls/pushes across the server
+    shards (reference brpc_ps_client.h fan-out + region merge)."""
 
-    def __init__(self, store: TCPStore):
+    def __init__(self, store: TCPStore, n_servers: int = 1):
         self.store = _own_client(store)
+        self.n_servers = int(n_servers)
+
+    def _route(self, ids: np.ndarray):
+        """Per-server (server_id, local_positions, server_ids) split."""
+        owner = ids % self.n_servers
+        out = []
+        for s in range(self.n_servers):
+            pos = np.nonzero(owner == s)[0]
+            if len(pos):
+                out.append((s, pos, ids[pos]))
+        return out
 
     def pull(self, table: str, ids: np.ndarray) -> np.ndarray:
-        req = self.store.add(f"ps/{table}/pull_req", 1)
-        self.store.set(f"ps/{table}/pull/{req}/ids",
-                       _dumps(np.asarray(ids, "int64")))
-        # get() blocks until the server answers this request id
-        rows = _loads(self.store.get(f"ps/{table}/pull/{req}/rows"))
-        self.store.delete_key(f"ps/{table}/pull/{req}/rows")
-        return rows
+        ids = np.asarray(ids, "int64")
+        meta = _loads(self.store.get(f"ps/{table}/meta"))
+        dim = int(meta[1])
+        out = np.empty((len(ids), dim), "float32")
+        routed = self._route(ids)
+        # pipeline: write every server's request first, then read replies
+        reqs = []
+        for s, pos, sids in routed:
+            req = self.store.add(f"ps/s{s}/{table}/pull_req", 1)
+            self.store.set(f"ps/s{s}/{table}/pull/{req}/ids", _dumps(sids))
+            reqs.append((s, pos, req))
+        for s, pos, req in reqs:
+            rows = _loads(self.store.get(f"ps/s{s}/{table}/pull/{req}/rows"))
+            self.store.delete_key(f"ps/s{s}/{table}/pull/{req}/rows")
+            out[pos] = rows
+        return out
 
     def push(self, table: str, ids: np.ndarray, grads: np.ndarray,
              wait: bool = False):
-        req = self.store.add(f"ps/{table}/push_req", 1)
-        self.store.set(f"ps/{table}/push/{req}/grads",
-                       _dumps(np.asarray(grads, "float32")))
-        self.store.set(f"ps/{table}/push/{req}/ids",
-                       _dumps(np.asarray(ids, "int64")))
+        ids = np.asarray(ids, "int64")
+        grads = np.asarray(grads, "float32")
+        reqs = []
+        for s, pos, sids in self._route(ids):
+            req = self.store.add(f"ps/s{s}/{table}/push_req", 1)
+            self.store.set(f"ps/s{s}/{table}/push/{req}/grads",
+                           _dumps(grads[pos]))
+            self.store.set(f"ps/s{s}/{table}/push/{req}/ids", _dumps(sids))
+            reqs.append((s, req))
         if wait:  # per-request ack: immune to other trainers' pushes
-            self.store.wait([f"ps/{table}/push/{req}/done"])
-            self.store.delete_key(f"ps/{table}/push/{req}/done")
+            for s, req in reqs:
+                self.store.wait([f"ps/s{s}/{table}/push/{req}/done"])
+                self.store.delete_key(f"ps/s{s}/{table}/push/{req}/done")
+
+    # -- dense tables --------------------------------------------------------
+    def pull_dense(self, table: str) -> np.ndarray:
+        meta = _loads(self.store.get(f"ps/{table}/dmeta"))
+        shape, n = tuple(int(d) for d in meta[:-1]), int(meta[-1])
+        reqs = []
+        for s in range(n):
+            req = self.store.add(f"ps/s{s}/{table}/dpull_req", 1)
+            reqs.append((s, req))
+        chunks = []
+        for s, req in reqs:
+            chunks.append(_loads(
+                self.store.get(f"ps/s{s}/{table}/dpull/{req}/rows")))
+            self.store.delete_key(f"ps/s{s}/{table}/dpull/{req}/rows")
+        return np.concatenate(chunks).reshape(shape)
+
+    def push_dense(self, table: str, grad: np.ndarray, wait: bool = False):
+        meta = _loads(self.store.get(f"ps/{table}/dmeta"))
+        n = int(meta[-1])
+        flat = np.asarray(grad, "float32").ravel()
+        offs = _dense_chunks(flat.size, n)
+        reqs = []
+        for s in range(n):
+            req = self.store.add(f"ps/s{s}/{table}/dpush_req", 1)
+            self.store.set(f"ps/s{s}/{table}/dpush/{req}/g",
+                           _dumps(flat[offs[s]:offs[s + 1]]))
+            reqs.append((s, req))
+        if wait:
+            for s, req in reqs:
+                self.store.wait([f"ps/s{s}/{table}/dpush/{req}/done"])
+                self.store.delete_key(f"ps/s{s}/{table}/dpush/{req}/done")
+
+
+class AsyncCommunicator:
+    """Background push thread with a bounded send queue (reference
+    communicator.cc AsyncCommunicator: grads queue up, a worker drains them;
+    a full queue back-pressures the trainer instead of growing unbounded)."""
+
+    def __init__(self, trainer: PsTrainer, max_queue: int = 64):
+        self.trainer = trainer
+        self.q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self.errors: List[Exception] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        import sys
+
+        while True:
+            try:
+                item = self.q.get(timeout=0.1)
+            except queue.Empty:
+                if self._stop.is_set():
+                    return
+                continue
+            try:
+                kind, table, a, b = item
+                if kind == "sparse":
+                    self.trainer.push(table, a, b, wait=True)
+                else:
+                    self.trainer.push_dense(table, a, wait=True)
+            except Exception as e:
+                # a failed push must not kill the drain thread: later items
+                # would never be applied and flush()/stop() would hang on
+                # q.join(). Record and keep draining.
+                self.errors.append(e)
+                print(f"AsyncCommunicator: push to {table!r} failed: {e!r}",
+                      file=sys.stderr)
+            finally:
+                self.q.task_done()
+
+    def push(self, table: str, ids, grads):
+        self.q.put(("sparse", table, ids, grads))  # blocks when full
+
+    def push_dense(self, table: str, grad):
+        self.q.put(("dense", table, grad, None))
+
+    def flush(self):
+        """Block until every queued push has been applied server-side."""
+        self.q.join()
+
+    def stop(self):
+        self.flush()
+        self._stop.set()
+        self._thread.join(timeout=5)
 
 
 class SparseEmbedding:
     """Distributed lookup table (reference DistributedLookupTable /
     distributed/ps sparse table): pulls rows per batch, pushes row grads."""
 
-    def __init__(self, trainer: PsTrainer, table: str, embedding_dim: int):
+    def __init__(self, trainer: PsTrainer, table: str, embedding_dim: int,
+                 communicator: Optional[AsyncCommunicator] = None):
         self.trainer = trainer
         self.table = table
         self.dim = embedding_dim
+        self.communicator = communicator
         self._last = None  # (unique_ids, inverse) of the live batch
 
     def forward(self, ids):
@@ -202,11 +373,15 @@ class SparseEmbedding:
     __call__ = forward
 
     def push_grad(self, grad, wait=True):
-        """Push d(loss)/d(embedding_out) back as row gradients."""
+        """Push d(loss)/d(embedding_out) back as row gradients; rides the
+        AsyncCommunicator when one is attached (async PS mode)."""
         assert self._last is not None, "forward must run before push_grad"
         uniq, inverse, shape = self._last
         g = np.asarray(grad.numpy() if hasattr(grad, "numpy") else grad,
                        "float32").reshape(-1, self.dim)
         acc = np.zeros((len(uniq), self.dim), "float32")
         np.add.at(acc, inverse, g)
-        self.trainer.push(self.table, uniq, acc, wait=wait)
+        if self.communicator is not None:
+            self.communicator.push(self.table, uniq, acc)
+        else:
+            self.trainer.push(self.table, uniq, acc, wait=wait)

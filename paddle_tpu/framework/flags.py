@@ -87,3 +87,10 @@ define_flag("call_stack_level", 1,
             "Error-report verbosity (reference: enforce.h FLAGS_call_stack_level).")
 define_flag("profiler_host_spans", True,
             "Record host-side RecordEvent spans while a Profiler is active.")
+define_flag("flash_block_q", 0,
+            "flash-attention q block size (0 = kernel default 256)")
+define_flag("flash_block_k", 0,
+            "flash-attention k block size (0 = kernel default 512)")
+define_flag("remat_policy", "",
+            "recompute policy for scanned stacks: ''=full remat, 'dots'=save "
+            "non-batch matmul outputs, 'dots_all'=save all matmul outputs")

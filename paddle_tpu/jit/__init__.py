@@ -62,6 +62,19 @@ class StaticLayer:
         self._is_layer = isinstance(layer_or_fn, Layer)
         self._target = layer_or_fn
         self._cache = {}
+        # AST-lite dy2static (program_translator.py:775 role): rewrite simple
+        # tensor-dependent if/while into runtime-dispatched cond/while_loop
+        from .dy2static import convert_to_static
+
+        if self._is_layer:
+            fwd = type(layer_or_fn).forward
+            conv = convert_to_static(fwd)
+            if conv is not fwd:
+                import types as _types
+
+                layer_or_fn.forward = _types.MethodType(conv, layer_or_fn)
+        else:
+            self._target = convert_to_static(layer_or_fn)
 
     def __call__(self, *args, **kwargs):
         if kwargs:

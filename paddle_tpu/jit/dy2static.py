@@ -1,0 +1,249 @@
+"""AST-lite dygraph-to-static conversion.
+
+Reference: python/paddle/fluid/dygraph/dygraph_to_static/program_translator.py
+:775 + ifelse_transformer.py / loop_transformer.py — the reference transpiles
+EVERY Python `if`/`while` into runtime-dispatched control-flow ops so
+tensor-dependent branches work under tracing.
+
+TPU-native lite version: an ast pass rewrites the *simple* shapes —
+  * `if t: return a` / `else: return b`          -> __pt_if(t, fa, fb)
+  * `if t:` assigning plain names in each branch -> branch closures returning
+    the assigned tuple, dispatched through __pt_if
+  * `while t:` whose body assigns plain names    -> __pt_while carry loop
+into `paddle_tpu.static.nn.cond` / `while_loop`, which run plain Python when
+the predicate is concrete and lower to `lax.cond`/`lax.while_loop` when it is
+traced. Anything more complex is left untouched — tracing such code then hits
+Tensor.__bool__'s pointer error instead of silently specializing a branch.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+from typing import List, Optional
+
+
+def _runtime_if(pred, true_fn, false_fn):
+    from ..static import nn as static_nn
+
+    return static_nn.cond(pred, true_fn, false_fn)
+
+
+def _runtime_while(cond_fn, body_fn, loop_vars):
+    from ..static import nn as static_nn
+
+    out = static_nn.while_loop(cond_fn, body_fn, list(loop_vars))
+    return tuple(out)
+
+
+def _assigned_names(stmts) -> Optional[List[str]]:
+    """Plain Name targets assigned in stmts; None if anything else happens
+    (calls with side effects are fine — only the statement SHAPE matters)."""
+    names = []
+    for st in stmts:
+        if isinstance(st, ast.Assign):
+            for t in st.targets:
+                if isinstance(t, ast.Name):
+                    names.append(t.id)
+                elif isinstance(t, ast.Tuple) and all(
+                        isinstance(e, ast.Name) for e in t.elts):
+                    names.extend(e.id for e in t.elts)
+                else:
+                    return None
+        elif isinstance(st, ast.AugAssign):
+            if isinstance(st.target, ast.Name):
+                names.append(st.target.id)
+            else:
+                return None
+        else:
+            return None
+    return names
+
+
+def _loaded_names(stmts) -> set:
+    out = set()
+    for st in stmts:
+        for node in ast.walk(st):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                out.add(node.id)
+            if isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                              ast.Name):
+                out.add(node.target.id)
+    return out
+
+
+def _branch_fn(name: str, stmts, targets: List[str], params: List[str]):
+    """def <name>(p=p, ...): <stmts>; return (targets...)"""
+    args = ast.arguments(
+        posonlyargs=[], args=[ast.arg(arg=p) for p in params], vararg=None,
+        kwonlyargs=[], kw_defaults=[], kwarg=None,
+        defaults=[ast.Name(id=p, ctx=ast.Load()) for p in params])
+    ret = ast.Return(value=ast.Tuple(
+        elts=[ast.Name(id=t, ctx=ast.Load()) for t in targets],
+        ctx=ast.Load()))
+    return ast.FunctionDef(name=name, args=args, body=list(stmts) + [ret],
+                           decorator_list=[], returns=None)
+
+
+class _CtrlFlow(ast.NodeTransformer):
+    def __init__(self):
+        self.changed = False
+        self.n = 0
+
+    def _uid(self):
+        self.n += 1
+        return self.n
+
+    # `if`/`while` nested in defs/lambdas keep their own scope — don't touch
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_AsyncFunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        # pattern A: both arms are a single `return <expr>`
+        if (len(node.body) == 1 and isinstance(node.body[0], ast.Return)
+                and len(node.orelse) == 1
+                and isinstance(node.orelse[0], ast.Return)
+                and node.body[0].value is not None
+                and node.orelse[0].value is not None):
+            self.changed = True
+            call = ast.Call(
+                func=ast.Name(id="__pt_if", ctx=ast.Load()),
+                args=[node.test,
+                      ast.Lambda(args=_no_args(), body=node.body[0].value),
+                      ast.Lambda(args=_no_args(), body=node.orelse[0].value)],
+                keywords=[])
+            return ast.copy_location(ast.Return(value=call), node)
+        # pattern B: both arms only assign plain names
+        body_names = _assigned_names(node.body)
+        else_names = _assigned_names(node.orelse) if node.orelse else []
+        if body_names is None or else_names is None or not (body_names or
+                                                            else_names):
+            return node
+        targets = sorted(set(body_names) | set(else_names))
+        uid = self._uid()
+        reads = _loaded_names(node.body) | _loaded_names(node.orelse)
+        params = [t for t in targets if t in reads]
+        tfn = _branch_fn(f"__pt_true_{uid}", node.body, targets, params)
+        ffn = _branch_fn(f"__pt_false_{uid}", node.orelse or [], targets,
+                         params)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=t, ctx=ast.Store()) for t in targets],
+                ctx=ast.Store())],
+            value=ast.Call(func=ast.Name(id="__pt_if", ctx=ast.Load()),
+                           args=[node.test,
+                                 ast.Name(id=tfn.name, ctx=ast.Load()),
+                                 ast.Name(id=ffn.name, ctx=ast.Load())],
+                           keywords=[]))
+        self.changed = True
+        return [ast.copy_location(x, node) for x in (tfn, ffn, assign)]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse:
+            return node
+        carry = _assigned_names(node.body)
+        if not carry:
+            return node
+        carry = sorted(set(carry))
+        uid = self._uid()
+        cargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=c) for c in carry], vararg=None,
+            kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=f"__pt_cond_{uid}", args=cargs,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None)
+        body_fn = ast.FunctionDef(
+            name=f"__pt_body_{uid}", args=cargs,
+            body=list(node.body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Load()) for c in carry],
+                ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in carry],
+                ctx=ast.Store())],
+            value=ast.Call(
+                func=ast.Name(id="__pt_while", ctx=ast.Load()),
+                args=[ast.Name(id=cond_fn.name, ctx=ast.Load()),
+                      ast.Name(id=body_fn.name, ctx=ast.Load()),
+                      ast.List(elts=[ast.Name(id=c, ctx=ast.Load())
+                                     for c in carry], ctx=ast.Load())],
+                keywords=[]))
+        self.changed = True
+        return [ast.copy_location(x, node)
+                for x in (cond_fn, body_fn, assign)]
+
+
+def _no_args():
+    return ast.arguments(posonlyargs=[], args=[], vararg=None, kwonlyargs=[],
+                         kw_defaults=[], kwarg=None, defaults=[])
+
+
+def _normalize_fallthrough(tree):
+    """`if t: return A` followed by `return B` -> explicit else, so the
+    two-arm return pattern fires (the most common early-return shape)."""
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            stmts = getattr(node, field, None)
+            if not isinstance(stmts, list):
+                continue
+            for i in range(len(stmts) - 1):
+                st, nxt = stmts[i], stmts[i + 1]
+                if (isinstance(st, ast.If) and not st.orelse
+                        and len(st.body) == 1
+                        and isinstance(st.body[0], ast.Return)
+                        and st.body[0].value is not None
+                        and isinstance(nxt, ast.Return)
+                        and nxt.value is not None):
+                    st.orelse = [nxt]
+                    del stmts[i + 1]
+                    break
+
+
+def convert_to_static(fn):
+    """Rewrite fn's simple tensor-dependent if/while into runtime-dispatched
+    control flow. Returns fn unchanged when there is nothing to convert or
+    the source is unavailable/has closures (lite scope)."""
+    raw = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    if getattr(raw, "__closure__", None):
+        return fn  # free variables can't be rebound through exec
+    try:
+        src = textwrap.dedent(inspect.getsource(raw))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []
+    _normalize_fallthrough(fdef)
+    tr = _CtrlFlow()
+    # transform only the top-level function's body (nested defs keep scope)
+    new_body = []
+    for st in fdef.body:
+        out = tr.visit(st)
+        new_body.extend(out if isinstance(out, list) else [out])
+    fdef.body = new_body
+    if not tr.changed:
+        return fn
+    ast.fix_missing_locations(tree)
+    glb = dict(raw.__globals__)
+    glb["__pt_if"] = _runtime_if
+    glb["__pt_while"] = _runtime_while
+    loc: dict = {}
+    exec(compile(tree, f"<dy2static:{raw.__name__}>", "exec"), glb, loc)
+    new_fn = functools.wraps(raw)(loc[fdef.name])
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn

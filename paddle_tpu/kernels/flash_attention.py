@@ -30,8 +30,12 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-DEFAULT_BLOCK_Q = 256
-DEFAULT_BLOCK_K = 512
+# Tuned on v5e (round-3 sweep, 1.16B Llama @ seq 2048, bench.py config):
+# (q,k)=(256,512) 49.5% MFU, (512,512) 52.4%, (512,1024) 54.8%,
+# (1024,1024) 55.6% <- best; (1024,2048) exceeds VMEM. Override per-call or
+# via FLAGS_flash_block_q/k.
+DEFAULT_BLOCK_Q = 1024
+DEFAULT_BLOCK_K = 1024
 _NEG = -1e30
 
 
@@ -333,21 +337,35 @@ def _flash_lse_bwd(causal, scale, block_q, block_k, res, cts):
 _flash_lse_bhsd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 
+def _default_blocks():
+    """Tunable via FLAGS_flash_block_q / FLAGS_flash_block_k (live-read so a
+    bench sweep or user config changes take effect without re-import)."""
+    try:
+        from ..framework import flags as flags_mod
+
+        f = flags_mod.get_flags(["FLAGS_flash_block_q", "FLAGS_flash_block_k"])
+        return (int(f.get("FLAGS_flash_block_q") or DEFAULT_BLOCK_Q),
+                int(f.get("FLAGS_flash_block_k") or DEFAULT_BLOCK_K))
+    except Exception:
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+
+
 def flash_attention_with_lse(q, k, v, offset=0, causal=False, scale=None,
-                             block_q: int = DEFAULT_BLOCK_Q,
-                             block_k: int = DEFAULT_BLOCK_K):
+                             block_q: int = None, block_k: int = None):
     """q/k/v: [bh, s, d]. Returns (out [bh, sq, d], lse [bh, sq] fp32).
     `offset` shifts q's global positions for the causal mask (ring attention)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    dq_, dk_ = _default_blocks()
+    block_q = dq_ if block_q is None else block_q
+    block_k = dk_ if block_k is None else block_k
     return _flash_lse_bhsd(q, k, v, jnp.asarray(offset, jnp.int32),
                            bool(causal), float(scale), int(block_q),
                            int(block_k))
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K):
+                    block_q: int = None, block_k: int = None):
     """q/k/v: [batch, seq, heads, head_dim] (paddle layout). Differentiable."""
     b, sq, h, d = q.shape
     sk = k.shape[1]

@@ -45,6 +45,7 @@ class LlamaConfig:
     use_recompute: bool = False
     scan_layers: bool = True  # lax.scan over decoder stack: O(1) compile in depth
     pp_microbatches: int = 0  # microbatches for the pp pipeline (0 = 2*pp)
+    cp_impl: str = "ring"  # context-parallel attention: 'ring' | 'ulysses'
     dtype: str = "bfloat16"
 
     @staticmethod
@@ -164,10 +165,16 @@ class LlamaAttention(nn.Layer):
             v = manipulation.repeat_interleave(v, rep, axis=2)
         env = get_mesh_env()
         if cache is None and env is not None and env.get_dim("cp") > 1:
-            # context parallel: K/V ring over the cp axis, O((s/cp)^2) memory
-            from ..distributed.context_parallel import ring_attention
+            # context parallel over the cp axis: K/V ring (default) or
+            # Ulysses a2a head sharding, per config.cp_impl
+            if getattr(self.config, "cp_impl", "ring") == "ulysses":
+                from ..distributed.context_parallel import ulysses_attention
 
-            out = ring_attention(q, k, v, causal=True)
+                out = ulysses_attention(q, k, v, causal=True)
+            else:
+                from ..distributed.context_parallel import ring_attention
+
+                out = ring_attention(q, k, v, causal=True)
         else:
             out = F.scaled_dot_product_attention(q, k, v, is_causal=cache is None,
                                                  training=self.training)

@@ -66,6 +66,16 @@ class Optimizer:
 
     # -- step ----------------------------------------------------------------
     def step(self):
+        if not getattr(self, "_stack_checked", False):
+            self._stack_checked = True
+            for p in self._parameter_list:
+                if getattr(p, "_stacked_into", None) is not None:
+                    raise RuntimeError(
+                        "optimizer holds a parameter that was later stacked "
+                        "into a compiled pipeline run (StackedStageRun); its "
+                        "buffer is dead. Create the optimizer AFTER "
+                        "fleet.distributed_model / PipelineLayer engagement, "
+                        "from model.parameters() at that point.")
         params = [p for p in self._parameter_list if not p.stop_gradient and p.grad is not None]
         if not params:
             self._finish_step()
@@ -378,3 +388,69 @@ class LarsMomentum(Optimizer):
                              lr * coeff * p_norm / denom, lr)
         v = mu * state["velocity"].astype(jnp.float32) + local_lr * (gf + wd * pf)
         return (pf - v).astype(p.dtype), {"velocity": v.astype(state["velocity"].dtype)}
+
+
+class Adafactor(Optimizer):
+    """Adafactor (Shazeer & Stern 2018) — the TPU big-model optimizer
+    (T5/PaLM recipe): second moments FACTORED into per-row/per-column
+    accumulators, so optimizer state is O(n+m) per [n, m] matrix instead of
+    O(n*m). On one 16GB chip this is what lets multi-billion-parameter
+    models train resident (Adam's fp32 moment pair alone would be 8
+    bytes/param). No reference counterpart (paddle ships Adam-family);
+    included because the TPU-native bench path needs it.
+    """
+
+    def __init__(self, learning_rate=0.01, beta1=0.0, decay_rate=0.8,
+                 epsilon1=1e-30, epsilon2=1e-3, clip_threshold=1.0,
+                 multiply_by_parameter_scale=True, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {
+            "beta1": float(beta1), "decay": float(decay_rate),
+            "eps1": float(epsilon1), "eps2": float(epsilon2),
+            "clip": float(clip_threshold),
+            "pscale": float(bool(multiply_by_parameter_scale)),
+        }
+
+    def _init_state(self, p):
+        if p.ndim >= 2:
+            st = {"vr": jnp.zeros(p.shape[:-1], jnp.float32),
+                  "vc": jnp.zeros(p.shape[:-2] + p.shape[-1:], jnp.float32)}
+        else:
+            st = {"v": jnp.zeros(p.shape, jnp.float32)}
+        if self._hyper_defaults["beta1"] > 0.0:
+            st["m"] = jnp.zeros_like(p)
+        return st
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        eps1, eps2 = hyper["eps1"], hyper["eps2"]
+        gf = g.astype(jnp.float32)
+        pf = p.astype(jnp.float32)
+        t = step.astype(jnp.float32)
+        beta2t = 1.0 - jnp.power(t, -hyper["decay"])
+        g2 = gf * gf + eps1
+        new = {}
+        if "v" in state:
+            v = beta2t * state["v"] + (1 - beta2t) * g2
+            new["v"] = v
+            vhat = v
+        else:
+            vr = beta2t * state["vr"] + (1 - beta2t) * jnp.mean(g2, axis=-1)
+            vc = beta2t * state["vc"] + (1 - beta2t) * jnp.mean(g2, axis=-2)
+            new["vr"], new["vc"] = vr, vc
+            # rank-1 reconstruction: vr vc^T / mean(vr)
+            denom = jnp.mean(vr, axis=-1, keepdims=True)
+            vhat = (vr / denom)[..., None] * vc[..., None, :]
+        u = gf / jnp.sqrt(vhat)
+        rms_u = jnp.sqrt(jnp.mean(u * u))
+        u = u / jnp.maximum(1.0, rms_u / hyper["clip"])
+        if "m" in state:
+            m = hyper["beta1"] * state["m"].astype(jnp.float32) + \
+                (1 - hyper["beta1"]) * u
+            new["m"] = m.astype(state["m"].dtype)
+            u = m
+        scale = jnp.where(
+            hyper["pscale"] > 0,
+            jnp.maximum(eps2, jnp.sqrt(jnp.mean(pf * pf))), 1.0)
+        return (pf - lr * scale * u).astype(p.dtype), new

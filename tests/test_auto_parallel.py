@@ -1,0 +1,109 @@
+"""Auto-parallel planner v1: Engine + Completer + degree chooser.
+
+Reference: auto_parallel/engine.py:64 (Engine.prepare/fit),
+completion.py:126 (Completer propagation), planner.py (degree choice).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from jax.sharding import PartitionSpec as P
+
+
+class _MLPBlock(nn.Layer):
+    """Llama-style gated MLP with PLAIN Linears — no hand annotations."""
+
+    def __init__(self, h, i):
+        super().__init__()
+        self.gate = nn.Linear(h, i, bias_attr=False)
+        self.up = nn.Linear(h, i, bias_attr=False)
+        self.down = nn.Linear(i, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down(F.silu(self.gate(x)) * self.up(x))
+
+
+@pytest.mark.dist
+class TestCompleter:
+    def test_seed_propagates_to_hand_written_tp(self):
+        """Seeding ONE weight with the column-parallel spec must complete the
+        other two to the hand-written Megatron pattern: up=column
+        P(None,'mp'), down=row P('mp',None)."""
+        dist.reset_mesh()
+        dist.init_mesh(mp=2, dp=4)
+        paddle.seed(0)
+        net = _MLPBlock(16, 32)
+        net.gate.weight.dist_spec = P(None, "mp")  # the user seed
+
+        eng = dist.Engine(model=net, loss=lambda o, y: F.mse_loss(o, y),
+                          optimizer=opt.AdamW(learning_rate=1e-3,
+                                              parameters=net.parameters()))
+        x = paddle.randn([8, 16])
+        y = paddle.randn([8, 16])
+        eng.prepare(sample_batch=(x, y))
+        sp = eng.proposed_specs
+        assert tuple(net.up.weight.dist_spec) == (None, "mp"), sp
+        assert tuple(net.down.weight.dist_spec) == ("mp", None), sp
+        dist.reset_mesh()
+
+    def test_fit_runs_with_completed_sharding(self):
+        dist.reset_mesh()
+        dist.init_mesh(mp=2, dp=4)
+        paddle.seed(1)
+        net = nn.Sequential(_MLPBlock(16, 32), _MLPBlock(16, 32))
+        net[0].gate.weight.dist_spec = P(None, "mp")
+        o = opt.AdamW(learning_rate=5e-3, parameters=net.parameters())
+        eng = dist.Engine(model=net, loss=lambda out, y: F.mse_loss(out, y),
+                          optimizer=o)
+        rng = np.random.RandomState(0)
+
+        class DS:
+            def __len__(self):
+                return 32
+
+            def __getitem__(self, i):
+                x = rng.rand(16).astype("float32")
+                return x, x * 0.5
+
+        hist = eng.fit(DS(), epochs=2, batch_size=8)
+        assert len(hist) == 2 and np.isfinite(hist[-1])
+        dist.reset_mesh()
+
+    def test_reshape_split_carries_axis_to_major_dim(self):
+        """[b,s,h]->[b,s,heads,hd] keeps the 'mp' sharding on heads."""
+        import jax.numpy as jnp
+
+        dist.reset_mesh()
+        env = dist.init_mesh(mp=2, dp=4)
+        from paddle_tpu.distributed.auto_parallel.completion import complete_specs
+
+        def fn(x, w):
+            h = jnp.matmul(x, w)          # [b, s, 8]
+            h4 = h.reshape(2, 4, 4, 2)    # heads=4, hd=2
+            return jnp.sum(h4)
+
+        x = jnp.zeros((2, 4, 8), jnp.float32)
+        w = jnp.zeros((8, 8), jnp.float32)
+        specs = complete_specs(fn, (x, w), {1: (None, "mp")}, env)
+        assert specs[1] == (None, "mp")
+        dist.reset_mesh()
+
+
+class TestPlanner:
+    def test_small_model_pure_data_parallel(self):
+        axes = dist.propose_mesh(8, param_bytes=int(1e6), num_heads=8)
+        assert axes.get("mp", 1) == 1 and (axes.get("sharding") == 8
+                                           or axes.get("dp") == 8)
+
+    def test_huge_model_gets_tensor_parallel(self):
+        # 30B params bf16: even ZeRO over 8 ranks cannot fit 16GB -> mp rises
+        axes = dist.propose_mesh(8, param_bytes=int(60e9), num_heads=32)
+        assert axes.get("mp", 1) >= 2
+
+    def test_head_divisibility_respected(self):
+        axes = dist.propose_mesh(8, param_bytes=int(60e9), num_heads=2)
+        assert axes.get("mp", 1) <= 2

@@ -1,0 +1,123 @@
+"""AST-lite dy2static (reference program_translator.py:775): tensor-dependent
+Python if/while agree between eager and to_static."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def test_return_if_matches_eager():
+    def f(x):
+        if x.sum() > 0:
+            return x * 2
+        else:
+            return x - 1
+
+    st = paddle.jit.to_static(f)
+    for v in ([1.0, 2.0], [-5.0, 1.0]):
+        x = paddle.to_tensor(v)
+        np.testing.assert_allclose(st(x).numpy(), f(x).numpy())
+
+
+def test_assign_if_matches_eager():
+    def f(x):
+        y = x * 0.5
+        if x.mean() > 0:
+            y = y + 10.0
+            z = y * 2.0
+        else:
+            z = y - 3.0
+        return z + x
+
+    st = paddle.jit.to_static(f)
+    for v in ([2.0, 4.0], [-2.0, -4.0]):
+        x = paddle.to_tensor(v)
+        np.testing.assert_allclose(st(x).numpy(), f(x).numpy(), rtol=1e-6)
+
+
+def test_augassign_branch():
+    def f(x):
+        acc = x * 1.0
+        if x.sum() > 0:
+            acc += 5.0
+        else:
+            acc -= 5.0
+        return acc
+
+    st = paddle.jit.to_static(f)
+    for v in ([3.0], [-3.0]):
+        x = paddle.to_tensor(v)
+        np.testing.assert_allclose(st(x).numpy(), f(x).numpy())
+
+
+def test_tensor_while_matches_eager():
+    def f(x):
+        s = x * 1.0
+        while s.sum() < 100.0:
+            s = s * 2.0
+        return s
+
+    st = paddle.jit.to_static(f)
+    x = paddle.to_tensor([1.5, 2.0])
+    np.testing.assert_allclose(st(x).numpy(), f(x).numpy())
+
+
+def test_layer_forward_converted():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                return h * 2.0
+            else:
+                return h * -1.0
+
+    paddle.seed(0)
+    net = Gate()
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+    eager = net(x).numpy()
+    st = paddle.jit.to_static(net)
+    np.testing.assert_allclose(st(x).numpy(), eager, rtol=1e-6)
+
+
+def test_python_if_still_python_when_concrete():
+    """Concrete (non-traced) predicates keep plain Python semantics."""
+    def f(x, flag):
+        if flag:
+            return x + 1
+        else:
+            return x - 1
+
+    st = paddle.jit.to_static(f)
+    x = paddle.to_tensor([1.0])
+    np.testing.assert_allclose(st(x, True).numpy(), [2.0])
+    np.testing.assert_allclose(st(x, False).numpy(), [0.0])
+
+
+def test_unconvertible_branch_raises_pointer():
+    def f(x):
+        if x.sum() > 0:  # branch body does IO-ish work: not convertible
+            print("positive")
+            return x
+        return x * -1.0
+
+    st = paddle.jit.to_static(f)
+    with pytest.raises(TypeError, match="static.nn.cond"):
+        st(paddle.to_tensor([1.0]))
+
+
+def test_return_if_fallthrough():
+    """`if t: return A` + bare `return B` (no else) converts too."""
+    def f(x):
+        if x.sum() > 0:
+            return x * 3.0
+        return x * -2.0
+
+    st = paddle.jit.to_static(f)
+    for v in ([1.0], [-1.0]):
+        x = paddle.to_tensor(v)
+        np.testing.assert_allclose(st(x).numpy(), f(x).numpy())

@@ -76,7 +76,8 @@ class TestRecvTimeout:
 _P2P_WORKER = textwrap.dedent("""
     import os, sys
     import numpy as np
-    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+    jax.config.update("jax_platforms", "cpu")  # env var is pinned by site cfg
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
 

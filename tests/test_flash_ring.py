@@ -133,3 +133,76 @@ class TestRingAttention:
         with_cp = run(2)
         np.testing.assert_allclose(with_cp, no_cp, rtol=2e-5)
         dist.reset_mesh()
+
+
+@pytest.mark.dist
+class TestUlyssesAttention:
+    """SURVEY §5: Ulysses a2a head-shard CP alongside ring attention."""
+
+    def test_parity_and_grads_cp4(self):
+        dist.reset_mesh()
+        env = dist.init_mesh(cp=4, dp=2)
+        from paddle_tpu.distributed.context_parallel import ulysses_attention_bshd
+
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(2, 128, 8, 32), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 128, 8, 32), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 128, 8, 32), jnp.float32)
+
+        uo = jax.jit(lambda a, b, c: ulysses_attention_bshd(
+            a, b, c, causal=True, env=env))(q, k, v)
+        qm = jnp.moveaxis(q, 2, 1).reshape(16, 128, 32)
+        km = jnp.moveaxis(k, 2, 1).reshape(16, 128, 32)
+        vm = jnp.moveaxis(v, 2, 1).reshape(16, 128, 32)
+        fo, _ = _xla_ref(qm, km, vm, True)
+        np.testing.assert_allclose(
+            np.asarray(jnp.moveaxis(uo, 2, 1).reshape(16, 128, 32)),
+            np.asarray(fo), rtol=2e-4, atol=2e-5)
+
+        g1 = jax.jit(jax.grad(lambda a, b, c: jnp.sum(ulysses_attention_bshd(
+            a, b, c, causal=True, env=env) ** 2), (0, 1, 2)))(q, k, v)
+        g2 = jax.grad(
+            lambda a, b, c: jnp.sum(_xla_ref(
+                jnp.moveaxis(a, 2, 1).reshape(16, 128, 32),
+                jnp.moveaxis(b, 2, 1).reshape(16, 128, 32),
+                jnp.moveaxis(c, 2, 1).reshape(16, 128, 32), True)[0] ** 2),
+            (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+        dist.reset_mesh()
+
+    def test_llama_ulysses_matches_ring_and_nocp(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+        def run(cp, impl):
+            dist.reset_mesh()
+            dist.init_mesh(cp=cp, dp=8 // cp)
+            paddle.seed(5)
+            cfg = LlamaConfig.tiny(num_hidden_layers=2, hidden_size=64,
+                                   intermediate_size=128, num_attention_heads=4,
+                                   num_key_value_heads=4, vocab_size=128,
+                                   cp_impl=impl)
+            m = LlamaForCausalLM(cfg)
+            o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+            step = dist.ShardedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+            ids = paddle.to_tensor(
+                np.random.RandomState(0).randint(0, 128, (8, 64)).astype("int32"))
+            return [float(step(ids, ids)) for _ in range(3)]
+
+        no_cp = run(1, "ring")
+        ulys = run(2, "ulysses")
+        ring = run(2, "ring")
+        np.testing.assert_allclose(ulys, no_cp, rtol=2e-5)
+        np.testing.assert_allclose(ulys, ring, rtol=2e-5)
+        dist.reset_mesh()
+
+    def test_head_count_not_divisible_raises(self):
+        dist.reset_mesh()
+        env = dist.init_mesh(cp=4, dp=2)
+        from paddle_tpu.distributed.context_parallel import ulysses_attention_bshd
+
+        q = jnp.zeros((1, 128, 6, 16), jnp.float32)
+        with pytest.raises(ValueError, match="divisible by cp"):
+            ulysses_attention_bshd(q, q, q, causal=True, env=env)
+        dist.reset_mesh()

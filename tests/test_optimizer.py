@@ -131,3 +131,56 @@ def test_scheduler_drives_optimizer():
     (w * 1.0).sum().backward()
     o.step()  # lr 0.05
     np.testing.assert_allclose(w.numpy(), [0.85], rtol=1e-5)
+
+
+class TestAdafactor:
+    def _np_adafactor(self, p, g, state, lr, t, decay=0.8, eps1=1e-30,
+                      eps2=1e-3, clip=1.0):
+        beta2t = 1.0 - t ** -decay
+        g2 = g * g + eps1
+        vr = beta2t * state["vr"] + (1 - beta2t) * g2.mean(-1)
+        vc = beta2t * state["vc"] + (1 - beta2t) * g2.mean(-2)
+        vhat = (vr / vr.mean(-1, keepdims=True))[..., None] * vc[..., None, :]
+        u = g / np.sqrt(vhat)
+        u = u / max(1.0, np.sqrt((u * u).mean()) / clip)
+        scale = max(eps2, np.sqrt((p * p).mean()))
+        return p - lr * scale * u, {"vr": vr, "vc": vc}
+
+    def test_matches_numpy_oracle(self):
+        import paddle_tpu.optimizer as opt
+
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(6, 4).astype("float32")
+        g_np = rng.randn(6, 4).astype("float32") * 0.1
+
+        w = paddle.to_tensor(w0.copy(), stop_gradient=False)
+        o = opt.Adafactor(learning_rate=0.1, parameters=[w])
+        state = {"vr": np.zeros(6, "float32"), "vc": np.zeros(4, "float32")}
+        ref = w0.copy()
+        for t in range(1, 4):
+            (w * paddle.to_tensor(g_np)).sum().backward()
+            o.step()
+            o.clear_grad()
+            ref, state = self._np_adafactor(ref, g_np, state, 0.1, float(t))
+            np.testing.assert_allclose(w.numpy(), ref, rtol=2e-5, atol=1e-6)
+
+    def test_factored_state_is_small(self):
+        import paddle_tpu.optimizer as opt
+
+        w = paddle.to_tensor(np.zeros((128, 64), "float32"),
+                             stop_gradient=False)
+        o = opt.Adafactor(learning_rate=0.01, parameters=[w])
+        st = o._init_state(w.data)
+        assert st["vr"].shape == (128,) and st["vc"].shape == (64,)
+        total = sum(v.size for v in st.values())
+        assert total == 128 + 64  # O(n+m), not O(n*m)
+
+    def test_vector_param_unfactored(self):
+        import paddle_tpu.optimizer as opt
+
+        b = paddle.to_tensor(np.ones(16, "float32"), stop_gradient=False)
+        o = opt.Adafactor(learning_rate=0.05, parameters=[b])
+        (b * 2.0).sum().backward()
+        o.step()
+        o.clear_grad()
+        assert float(b.numpy().mean()) < 1.0  # moved along the gradient
