@@ -162,11 +162,16 @@ class Engine:
                                     shuffle=False, drop_last=True)
         history = []
         for ep in range(epochs):
+            loss = None
             for it, batch in enumerate(loader):
                 step = self._ensure_step(batch)
                 loss = step(*batch)
                 if steps_per_epoch and it + 1 >= steps_per_epoch:
                     break
+            if loss is None:
+                raise ValueError(
+                    "Engine.fit: the loader yielded no batches (dataset "
+                    "smaller than batch_size with drop_last?)")
             history.append(float(loss))
             if log_freq and verbose:
                 print(f"epoch {ep}: loss {float(loss):.4f}")
@@ -195,7 +200,11 @@ class Engine:
         outs = []
         with autograd.no_grad():
             for it, batch in enumerate(loader):
-                outs.append(self.model(*batch))
+                # datasets built for fit yield (features..., label); predict
+                # feeds the model only what fit's forward saw
+                feats = batch[:-1] if (self.loss is not None
+                                       and len(batch) > 1) else batch
+                outs.append(self.model(*feats))
                 if steps and it + 1 >= steps:
                     break
         return outs

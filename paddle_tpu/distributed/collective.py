@@ -397,7 +397,15 @@ class _RecvChannel:
                               world_size=main.world_size)
         return self.store
 
-    def __exit__(self, *exc):
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            # the socket may hold a stale in-flight reply (timed-out wait):
+            # discard it rather than hand the desync to the next recv
+            try:
+                self.store.close()
+            except Exception:
+                pass
+            return False
         with _P2P_CHAN_LOCK:
             _P2P_RECV_POOL.append(self.store)
         return False
@@ -519,11 +527,14 @@ def recv(tensor, src=0, group=None, sync_op=True, tag=0, dst=None,
             with _RecvChannel() as store:
                 store.wait([skey])
                 data = jnp.asarray(_p2p_unpack(store.get(skey)))
-                store.delete_key(skey)
         except BaseException:
             with _P2P_CHAN_LOCK:  # let a retry pick this message up
                 _P2P_ABANDONED.setdefault(key, []).append(seq)
             raise
+        # after a successful read the message is CONSUMED: a delete failure
+        # must propagate without recycling the seq (a retry would re-deliver)
+        with _RecvChannel() as store:
+            store.delete_key(skey)
     else:
         with _P2P_CV:
             ok = _P2P_CV.wait_for(

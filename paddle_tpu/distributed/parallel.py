@@ -124,13 +124,9 @@ class ShardedTrainStep:
         self.target = model
         opt = optimizer
         self.train_params = [p for p in opt._parameter_list if not p.stop_gradient]
-        for p in self.train_params:
-            if getattr(p, "_stacked_into", None) is not None:
-                raise RuntimeError(
-                    "optimizer holds a parameter that was later stacked into "
-                    "a compiled pipeline run (StackedStageRun); its buffer is "
-                    "dead. Create the optimizer AFTER fleet.distributed_model "
-                    "/ PipelineLayer engagement, from model.parameters().")
+        from ..nn.layer.layers import check_not_stacked
+
+        check_not_stacked(self.train_params)
         named = dict(model.named_parameters())
         buffers = list(getattr(inner, "named_buffers", lambda: [])())
         train_ids = {id(p) for p in self.train_params}

@@ -87,13 +87,25 @@ class ParameterServer:
                      seed: int = 0):
         """Sparse table: this server materializes rows r % n_servers == id.
         All servers draw from the same seed so the sharded init equals the
-        single-server init row-for-row."""
+        single-server init row-for-row; rows are drawn in bounded blocks so
+        peak memory is O(block), not O(full table) — the point of sharding
+        giant tables."""
         rows, dim = int(shape[0]), int(shape[1])
         rng = np.random.RandomState(seed)
-        full = (rng.randn(rows, dim) * init_std).astype("float32")
+        n_own = len(range(self.server_id, rows, self.n_servers))
+        shard = np.empty((n_own, dim), "float32")
+        block = max(1, min(rows, (1 << 22) // max(dim, 1)))  # ~16MB f32
+        out = 0
+        for start in range(0, rows, block):
+            stop = min(start + block, rows)
+            # the row-major randn stream is identical to one full-table draw
+            chunk = (rng.randn(stop - start, dim) * init_std).astype("float32")
+            first = (self.server_id - start) % self.n_servers
+            mine = chunk[first::self.n_servers]
+            shard[out:out + len(mine)] = mine
+            out += len(mine)
         with self._mu:
-            self.tables[name] = np.ascontiguousarray(
-                full[self.server_id::self.n_servers])
+            self.tables[name] = shard
             self.lr[name] = float(lr)
         self.store.set(f"ps/{name}/meta",
                        _dumps(np.asarray([rows, dim, self.n_servers], "int64")))

@@ -23,18 +23,40 @@ def recompute(function, *args, **kwargs):
     """fleet.utils.recompute(fn, *inputs): don't store fn's intermediates;
     recompute them during backward."""
     kwargs.pop("preserve_rng_state", True)
+    from ..nn.layer.layers import Layer
+
+    target = function
+    cache_key = id(function)
     if kwargs:
-        raise NotImplementedError("recompute with extra kwargs")
+        if any(isinstance(v, Tensor) for v in kwargs.values()):
+            raise ValueError(
+                "recompute: pass Tensor arguments positionally (keyword "
+                "Tensors would be excluded from gradient tracking)")
+        # non-tensor config kwargs close over the function (static under the
+        # remat trace, like the reference's **kwargs pass-through); the cache
+        # keys on (fn, kwargs) so repeated calls reuse one compiled remat
+        import functools
+
+        try:
+            cache_key = (id(function), tuple(sorted(kwargs.items())))
+            hash(cache_key)  # sorted() alone doesn't prove value hashability
+        except TypeError:  # unhashable kwarg value: no caching
+            import warnings
+
+            warnings.warn(
+                "recompute: unhashable kwarg values disable the remat cache "
+                "— every call retraces and recompiles. Pass hashable config "
+                "(tuples instead of lists) to cache the compiled remat.")
+            cache_key = None
+        function = functools.partial(function, **kwargs)
     if not all(isinstance(a, Tensor) for a in args):
         return function(*args)
     if all(t.stop_gradient for t in args) or not autograd.is_grad_enabled():
         return function(*args)
 
-    from ..nn.layer.layers import Layer
+    params = list(target.parameters()) if isinstance(target, Layer) else []
 
-    params = list(function.parameters()) if isinstance(function, Layer) else []
-
-    cached = _REMAT_CACHE.get(id(function))
+    cached = _REMAT_CACHE.get(cache_key) if cache_key is not None else None
     if cached is None:
         n_args = len(args)
 
@@ -62,6 +84,7 @@ def recompute(function, *args, **kwargs):
 
         prim = Primitive(f"recompute_{id(function)}", jax.checkpoint(raw))
         cached = (prim, function)  # hold fn ref so id() stays unique
-        _REMAT_CACHE[id(function)] = cached
+        if cache_key is not None:
+            _REMAT_CACHE[cache_key] = cached
     prim = cached[0]
     return prim(random_mod.next_key(), *args, *params)

@@ -63,48 +63,99 @@ class StaticLayer:
         self._target = layer_or_fn
         self._cache = {}
         # AST-lite dy2static (program_translator.py:775 role): rewrite simple
-        # tensor-dependent if/while into runtime-dispatched cond/while_loop
+        # tensor-dependent if/while into runtime-dispatched cond/while_loop.
+        # The conversion is scoped to THIS wrapper — the user's layer object
+        # keeps its original eager forward (no instance mutation).
         from .dy2static import convert_to_static
 
+        self._converted_forward = None
         if self._is_layer:
             fwd = type(layer_or_fn).forward
             conv = convert_to_static(fwd)
             if conv is not fwd:
                 import types as _types
 
-                layer_or_fn.forward = _types.MethodType(conv, layer_or_fn)
+                self._converted_forward = _types.MethodType(conv, layer_or_fn)
         else:
             self._target = convert_to_static(layer_or_fn)
 
     def __call__(self, *args, **kwargs):
-        if kwargs:
-            raise NotImplementedError("to_static call with kwargs")
-        arrays = [a.data if isinstance(a, Tensor) else a for a in args]
+        # Tensor kwargs become traced inputs; everything else is static
+        # (part of the compile-cache key), matching paddle's StaticFunction
+        # kwargs contract.
+        import numpy as _np
+
+        def _is_data(v):
+            return isinstance(v, (Tensor, jax.Array, _np.ndarray))
+
+        kw_tensor = {k: v for k, v in sorted(kwargs.items()) if _is_data(v)}
+        kw_static = {k: v for k, v in kwargs.items() if k not in kw_tensor}
+        try:
+            static_key = tuple(sorted(kw_static.items()))
+            hash(static_key)
+        except TypeError:
+            raise TypeError(
+                "to_static: non-Tensor keyword arguments must be hashable "
+                f"(got {sorted(kw_static)})")
+        # positional args: data is traced; plain Python values are STATIC
+        # (python semantics preserved, cache key per value) like the
+        # reference's StaticFunction
+        data_idx = tuple(i for i, a in enumerate(args) if _is_data(a))
+        static_args = tuple((i, a) for i, a in enumerate(args)
+                            if not _is_data(a))
+        try:
+            hash(static_args)
+        except TypeError:
+            raise TypeError(
+                "to_static: non-Tensor positional arguments must be hashable")
+        arrays = [args[i].data if isinstance(args[i], Tensor) else args[i]
+                  for i in data_idx]
+        kw_arrays = [v.data if isinstance(v, Tensor) else v
+                     for v in kw_tensor.values()]
+        kw_names = tuple(kw_tensor)
         if self._is_layer:
             named, buffers = _collect_params(self._target)
             tensors = [p for _, p in named] + [b for _, b in buffers]
-            key = ("layer", self._target.training, len(tensors))
+            key = ("layer", self._target.training, len(tensors), kw_names,
+                   static_key, data_idx, static_args)
         else:
             tensors = []
-            key = ("fn",)
+            key = ("fn", kw_names, static_key, data_idx, static_args)
         jitted = self._cache.get(key)
         if jitted is None:
             target, is_layer = self._target, self._is_layer
 
-            def run(param_arrays, input_arrays, rngkey):
+            converted = self._converted_forward
+
+            def run(param_arrays, input_arrays, kw_input_arrays, rngkey):
                 random_mod.default_generator().set_trace_key(rngkey)
+                kw = dict(zip(kw_names, (Tensor(a) for a in kw_input_arrays)))
+                kw.update(kw_static)
+                # interleave traced data and static python args back into
+                # the original positional order
+                full = dict(static_args)
+                for i, a in zip(data_idx, input_arrays):
+                    full[i] = Tensor(a)
+                pos = [full[i] for i in sorted(full)]
+                swapped = False
                 try:
                     if is_layer:
+                        if converted is not None:
+                            # dy2static forward only inside this capture
+                            target.forward = converted
+                            swapped = True
                         named, buffers = _collect_params(target)
                         ts = [p for _, p in named] + [b for _, b in buffers]
                         with _Binder(ts) as b:
                             b.bind(param_arrays)
                             with autograd.no_grad():
-                                out = target(*[Tensor(a) for a in input_arrays])
+                                out = target(*pos, **kw)
                     else:
                         with autograd.no_grad():
-                            out = target(*[Tensor(a) for a in input_arrays])
+                            out = target(*pos, **kw)
                 finally:
+                    if swapped:
+                        del target.forward  # restore the class method
                     random_mod.default_generator().clear_trace_key()
                 return jax.tree_util.tree_map(
                     lambda t: t.data if isinstance(t, Tensor) else t, out,
@@ -113,7 +164,7 @@ class StaticLayer:
             jitted = jax.jit(run)
             self._cache[key] = jitted
         param_arrays = [t.data for t in tensors]
-        out = jitted(param_arrays, arrays, random_mod.next_key())
+        out = jitted(param_arrays, arrays, kw_arrays, random_mod.next_key())
         return jax.tree_util.tree_map(Tensor, out)
 
     # paddle API-compat

@@ -62,16 +62,32 @@ def _assigned_names(stmts) -> Optional[List[str]]:
     return names
 
 
-def _loaded_names(stmts) -> set:
-    out = set()
+def _read_before_write(stmts, extra_reads=()) -> set:
+    """Names loaded before their first assignment across the statement
+    sequence — i.e. names the branch needs to pre-exist."""
+    assigned: set = set()
+    reads: set = set(extra_reads)
     for st in stmts:
         for node in ast.walk(st):
-            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
-                out.add(node.id)
             if isinstance(node, ast.AugAssign) and isinstance(node.target,
                                                               ast.Name):
-                out.add(node.target.id)
-    return out
+                if node.target.id not in assigned:
+                    reads.add(node.target.id)
+        for node in ast.walk(st):
+            if (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                    and node.id not in assigned):
+                reads.add(node.id)
+        for node in ast.walk(st):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Name):
+                        assigned.add(t.id)
+                    elif isinstance(t, ast.Tuple):
+                        assigned.update(e.id for e in t.elts
+                                        if isinstance(e, ast.Name))
+    return reads
 
 
 def _branch_fn(name: str, stmts, targets: List[str], params: List[str]):
@@ -122,15 +138,26 @@ class _CtrlFlow(ast.NodeTransformer):
                       ast.Lambda(args=_no_args(), body=node.orelse[0].value)],
                 keywords=[])
             return ast.copy_location(ast.Return(value=call), node)
-        # pattern B: both arms only assign plain names
+        # pattern B: both arms only assign plain names. A target assigned in
+        # one arm only is convertible ONLY when that arm reads it before
+        # writing (proof it pre-exists) — otherwise the other arm's return
+        # would unbind a name that eager code never touched (e.g. a dead
+        # store), so the whole `if` stays unconverted.
         body_names = _assigned_names(node.body)
         else_names = _assigned_names(node.orelse) if node.orelse else []
         if body_names is None or else_names is None or not (body_names or
                                                             else_names):
             return node
-        targets = sorted(set(body_names) | set(else_names))
+        bset, eset = set(body_names), set(else_names)
+        rbw_body = _read_before_write(node.body)
+        rbw_else = _read_before_write(node.orelse)
+        for t in bset ^ eset:  # assigned in exactly one arm
+            own_rbw = rbw_body if t in bset else rbw_else
+            if t not in own_rbw:
+                return node
+        targets = sorted(bset | eset)
         uid = self._uid()
-        reads = _loaded_names(node.body) | _loaded_names(node.orelse)
+        reads = rbw_body | rbw_else
         params = [t for t in targets if t in reads]
         tfn = _branch_fn(f"__pt_true_{uid}", node.body, targets, params)
         ffn = _branch_fn(f"__pt_false_{uid}", node.orelse or [], targets,
@@ -155,6 +182,12 @@ class _CtrlFlow(ast.NodeTransformer):
         if not carry:
             return node
         carry = sorted(set(carry))
+        # every carried name must provably pre-exist (read before written in
+        # test/body) — a loop-local temp would be unbound in the initial
+        # carry list where the eager loop ran fine
+        pre = _read_before_write([ast.Expr(value=node.test)] + node.body)
+        if any(c not in pre for c in carry):
+            return node
         uid = self._uid()
         cargs = ast.arguments(
             posonlyargs=[], args=[ast.arg(arg=c) for c in carry], vararg=None,
@@ -226,7 +259,17 @@ def convert_to_static(fn):
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
         return fn
-    fdef.decorator_list = []
+
+    def _is_to_static(dec) -> bool:
+        node = dec.func if isinstance(dec, ast.Call) else dec
+        name = node.attr if isinstance(node, ast.Attribute) else \
+            getattr(node, "id", "")
+        return name in ("to_static", "convert_to_static")
+
+    # drop only the to_static-family decorators (they triggered this call);
+    # behavioral decorators like no_grad re-apply on exec
+    fdef.decorator_list = [d for d in fdef.decorator_list
+                           if not _is_to_static(d)]
     _normalize_fallthrough(fdef)
     tr = _CtrlFlow()
     # transform only the top-level function's body (nested defs keep scope)
@@ -242,7 +285,10 @@ def convert_to_static(fn):
     glb["__pt_if"] = _runtime_if
     glb["__pt_while"] = _runtime_while
     loc: dict = {}
-    exec(compile(tree, f"<dy2static:{raw.__name__}>", "exec"), glb, loc)
+    try:
+        exec(compile(tree, f"<dy2static:{raw.__name__}>", "exec"), glb, loc)
+    except Exception:  # e.g. a decorator that only resolves in a closure
+        return fn
     new_fn = functools.wraps(raw)(loc[fdef.name])
     if isinstance(fn, types.MethodType):
         return types.MethodType(new_fn, fn.__self__)

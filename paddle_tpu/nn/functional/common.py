@@ -307,8 +307,15 @@ def _conv2d_transpose(x, w, *, stride, padding, dilation, out_pad, groups):
 
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      groups=1, dilation=1, data_format="NCHW", output_size=None, name=None):
+    if data_format == "NHWC":  # compute in NCHW, transpose at the edges
+        from ...ops import manipulation as _m
+
+        out = conv2d_transpose(_m.transpose(x, [0, 3, 1, 2]), weight, bias,
+                               stride, padding, output_padding, groups,
+                               dilation, "NCHW", output_size)
+        return _m.transpose(out, [0, 2, 3, 1])
     if data_format != "NCHW":
-        raise NotImplementedError("conv2d_transpose only supports NCHW")
+        raise ValueError(f"conv2d_transpose: bad data_format {data_format!r}")
     st, pd, dl = _pair(stride), _pair(padding), _pair(dilation)
     op = _pair(output_padding)
     if output_size is not None:
@@ -412,11 +419,30 @@ def _adaptive_max_pool2d_any(x, *, out_hw):
     return _adaptive_pool2d_body(x, out_hw, lambda v, ax: jnp.max(v, axis=ax))
 
 
+@primitive("adaptive_max_pool2d_mask_op", nondiff=True)
+def _adaptive_max_pool2d_mask(x, *, out_hw):
+    """Flattened H*W argmax index per output cell (the reference's mask)."""
+    n, c, h, w = x.shape
+    oh, ow = out_hw
+    rows = []
+    for hs, he in _adaptive_bins(h, oh):
+        cols = []
+        for ws, we in _adaptive_bins(w, ow):
+            win = x[:, :, hs:he, ws:we].reshape(n, c, -1)
+            flat = jnp.argmax(win, axis=-1)
+            wh = we - ws
+            gh = hs + flat // wh
+            gw = ws + flat % wh
+            cols.append(gh * w + gw)
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2).astype(jnp.int32)
+
+
 def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_max_pool2d_any(x, out_hw=_pair(output_size))
     if return_mask:
-        raise NotImplementedError(
-            "adaptive_max_pool2d return_mask=True is not supported yet")
-    return _adaptive_max_pool2d_any(x, out_hw=_pair(output_size))
+        return out, _adaptive_max_pool2d_mask(x, out_hw=_pair(output_size))
+    return out
 
 
 @primitive("interpolate_nearest_op")
@@ -427,6 +453,16 @@ def _interp_nearest(x, *, size):
 @primitive("interpolate_bilinear_op")
 def _interp_bilinear(x, *, size)  :
     return jax.image.resize(x, x.shape[:2] + size, method="bilinear")
+
+
+@primitive("interpolate_bicubic_op")
+def _interp_bicubic(x, *, size):
+    return jax.image.resize(x, x.shape[:2] + size, method="cubic")
+
+
+@primitive("interpolate_trilinear_op")
+def _interp_trilinear(x, *, size):
+    return jax.image.resize(x, x.shape[:2] + size, method="linear")
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",
@@ -442,7 +478,14 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         return _interp_nearest(x, size=tuple(size))
     if mode in ("bilinear", "linear"):
         return _interp_bilinear(x, size=tuple(size))
-    raise NotImplementedError(f"interpolate mode {mode}")
+    if mode in ("bicubic", "cubic"):
+        return _interp_bicubic(x, size=tuple(size))
+    if mode == "area":
+        # paddle's area mode IS adaptive average pooling over the target grid
+        return _adaptive_avg_pool2d(x, out_hw=tuple(size))
+    if mode == "trilinear" and x.ndim == 5:
+        return _interp_trilinear(x, size=tuple(size))
+    raise ValueError(f"interpolate: unsupported mode {mode!r}")
 
 
 def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,

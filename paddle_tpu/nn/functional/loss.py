@@ -192,11 +192,34 @@ def _bce_logits(x, y, *, reduction):
     return _reduce(loss, reduction)
 
 
+@primitive("bce_logits_weighted_op")
+def _bce_logits_w(x, y, weight, pos_weight, *, reduction, has_w, has_pw):
+    if has_pw:
+        import jax
+
+        # pos_weight scales the positive term: L = -[pw*y*logσ(x) +
+        # (1-y)*logσ(-x)], stable via log-sigmoids
+        loss = -(pos_weight * y * jax.nn.log_sigmoid(x)
+                 + (1 - y) * jax.nn.log_sigmoid(-x))
+    else:
+        loss = jnp.maximum(x, 0) - x * y + jnp.log1p(jnp.exp(-jnp.abs(x)))
+    if has_w:
+        loss = loss * weight
+    return _reduce(loss, reduction)
+
+
 def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
                                      pos_weight=None, name=None):
-    if weight is not None or pos_weight is not None:
-        raise NotImplementedError("bce_with_logits weights")
-    return _bce_logits(logit, label, reduction=reduction)
+    if weight is None and pos_weight is None:
+        return _bce_logits(logit, label, reduction=reduction)
+    from ...ops import creation
+
+    one = creation.ones_like(label)
+    return _bce_logits_w(
+        logit, label, weight if weight is not None else one,
+        pos_weight if pos_weight is not None else one,
+        reduction=reduction, has_w=weight is not None,
+        has_pw=pos_weight is not None)
 
 
 @primitive("kl_div_op")

@@ -157,8 +157,14 @@ def _pixel_unshuffle(x, *, factor):
 
 
 def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    if data_format == "NHWC":
+        from ...ops import manipulation as _m
+
+        out = _pixel_unshuffle(_m.transpose(x, [0, 3, 1, 2]),
+                               factor=int(downscale_factor))
+        return _m.transpose(out, [0, 2, 3, 1])
     if data_format != "NCHW":
-        raise NotImplementedError("pixel_unshuffle supports NCHW only")
+        raise ValueError(f"pixel_unshuffle: bad data_format {data_format!r}")
     return _pixel_unshuffle(x, factor=int(downscale_factor))
 
 
@@ -170,8 +176,14 @@ def _channel_shuffle(x, *, groups):
 
 
 def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    if data_format == "NHWC":
+        from ...ops import manipulation as _m
+
+        out = _channel_shuffle(_m.transpose(x, [0, 3, 1, 2]),
+                               groups=int(groups))
+        return _m.transpose(out, [0, 2, 3, 1])
     if data_format != "NCHW":
-        raise NotImplementedError("channel_shuffle supports NCHW only")
+        raise ValueError(f"channel_shuffle: bad data_format {data_format!r}")
     return _channel_shuffle(x, groups=int(groups))
 
 

@@ -38,6 +38,20 @@ class Parameter(Tensor):
         self.dist_spec = None
 
 
+def check_not_stacked(params):
+    """Reject parameters whose buffers were stacked into a compiled pipeline
+    run after capture (wrong fleet order: optimizer before
+    distributed_model) — training them would silently update dead arrays."""
+    for p in params:
+        if getattr(p, "_stacked_into", None) is not None:
+            raise RuntimeError(
+                "optimizer holds a parameter that was later stacked into a "
+                "compiled pipeline run (StackedStageRun); its buffer is "
+                "dead. Create the optimizer AFTER fleet.distributed_model / "
+                "PipelineLayer engagement, from model.parameters() at that "
+                "point.")
+
+
 class Layer:
     def __init__(self, name_scope=None, dtype="float32"):
         self.training = True

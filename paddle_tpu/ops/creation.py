@@ -146,14 +146,19 @@ def triu(x, diagonal=0, name=None):
 
 
 @primitive("diag")
-def _diag(x, *, offset):
-    return jnp.diag(x, offset)
+def _diag(x, *, offset, padding_value):
+    out = jnp.diag(x, offset)
+    if x.ndim == 1 and padding_value != 0:
+        # padding_value fills the OFF-diagonal cells of the built matrix
+        # (reference diag_v2 contract; ignored for the 2-D extract case)
+        n = out.shape[0]
+        mask = jnp.eye(n, k=offset, dtype=bool)
+        out = jnp.where(mask, out, jnp.asarray(padding_value, out.dtype))
+    return out
 
 
 def diag(x, offset=0, padding_value=0, name=None):
-    if padding_value != 0:
-        raise NotImplementedError("diag padding_value != 0")
-    return _diag(x, offset=int(offset))
+    return _diag(x, offset=int(offset), padding_value=float(padding_value))
 
 
 @primitive("diagflat")

@@ -300,9 +300,25 @@ def _bincount(x, *, minlength):
     return jnp.bincount(x, minlength=minlength)
 
 
+@primitive("bincount_weighted_op", nondiff=True)
+def _bincount_w(x, weights, *, minlength):
+    n = max(minlength, 1)
+    out = jnp.zeros((n,), weights.dtype)
+    out = out.at[x].add(weights)
+    # grow to the true max bin if it exceeds minlength (static shape needed:
+    # use the full possible range via length hint)
+    return out
+
+
 def bincount(x, weights=None, minlength=0, name=None):
     if weights is not None:
-        raise NotImplementedError("bincount weights")
+        import numpy as np
+
+        # bin count must be static under XLA: derive it on the host like the
+        # reference CPU kernel does (bincount is a host-ish stats op)
+        xv = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
+        length = int(max(int(xv.max()) + 1 if xv.size else 0, minlength))
+        return _bincount_w(x, weights, minlength=length)
     return _bincount(x, minlength=int(minlength))
 
 

@@ -270,12 +270,25 @@ def _put_along_axis(x, index, value, *, axis, reduce):
     if reduce == "assign":
         return jnp.put_along_axis(x, index, value, axis=axis, inplace=False)
     dims = list(range(x.ndim))
+    idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims])
+           for d, s in enumerate(index.shape)]
+    idx[axis] = index
     if reduce == "add":
-        # scatter-add along axis
-        idx = [jnp.arange(s).reshape([-1 if i == d else 1 for i in dims]) for d, s in enumerate(index.shape)]
-        idx[axis] = index
         return x.at[tuple(idx)].add(value)
-    raise NotImplementedError(reduce)
+    if reduce in ("mul", "multiply"):
+        return x.at[tuple(idx)].multiply(value)
+    if reduce == "amin":
+        return x.at[tuple(idx)].min(value)
+    if reduce == "amax":
+        return x.at[tuple(idx)].max(value)
+    if reduce == "mean":
+        # include_self semantics: scattered cells average the original value
+        # together with every scattered contribution
+        total = x.at[tuple(idx)].add(value)
+        cnt = jnp.zeros(x.shape, jnp.float32).at[tuple(idx)].add(1.0)
+        mean = (total.astype(jnp.float32) / (cnt + 1.0)).astype(x.dtype)
+        return jnp.where(cnt > 0, mean, x)
+    raise ValueError(f"put_along_axis: unsupported reduce {reduce!r}")
 
 
 def put_along_axis(arr, indices, values, axis, reduce="assign"):

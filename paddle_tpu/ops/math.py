@@ -334,7 +334,10 @@ def _diff(x, *, n, axis):
 
 def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
     if prepend is not None or append is not None:
-        raise NotImplementedError("diff prepend/append")
+        from .manipulation import concat
+
+        parts = [p for p in (prepend, x, append) if p is not None]
+        x = concat(parts, axis=int(axis))
     return _diff(x, n=int(n), axis=int(axis))
 
 

@@ -68,14 +68,9 @@ class Optimizer:
     def step(self):
         if not getattr(self, "_stack_checked", False):
             self._stack_checked = True
-            for p in self._parameter_list:
-                if getattr(p, "_stacked_into", None) is not None:
-                    raise RuntimeError(
-                        "optimizer holds a parameter that was later stacked "
-                        "into a compiled pipeline run (StackedStageRun); its "
-                        "buffer is dead. Create the optimizer AFTER "
-                        "fleet.distributed_model / PipelineLayer engagement, "
-                        "from model.parameters() at that point.")
+            from ..nn.layer.layers import check_not_stacked
+
+            check_not_stacked(self._parameter_list)
         params = [p for p in self._parameter_list if not p.stop_gradient and p.grad is not None]
         if not params:
             self._finish_step()

@@ -121,3 +121,58 @@ def test_return_if_fallthrough():
     for v in ([1.0], [-1.0]):
         x = paddle.to_tensor(v)
         np.testing.assert_allclose(st(x).numpy(), f(x).numpy())
+
+
+def test_dead_store_branch_not_converted():
+    """A target assigned in only one arm with no prior read (dead store)
+    must NOT convert — converted code would unbind it; eager runs fine."""
+    def f(x, flag):
+        y = x * 0.5
+        if flag:
+            y = y + 1.0
+            extra = y * 2.0  # dead store, true-arm only
+        else:
+            y = y - 1.0
+        return y
+
+    st = paddle.jit.to_static(f)
+    x = paddle.to_tensor([2.0])
+    np.testing.assert_allclose(st(x, True).numpy(), f(x, True).numpy())
+    np.testing.assert_allclose(st(x, False).numpy(), f(x, False).numpy())
+
+
+def test_loop_local_temp_not_converted():
+    def f(x, n):
+        s = x * 1.0
+        i = 0
+        while i < n:  # concrete loop with a loop-local temp
+            tmp = s * 2.0
+            s = tmp + 1.0
+            i = i + 1
+        return s
+
+    st = paddle.jit.to_static(f)
+    x = paddle.to_tensor([1.0])
+    np.testing.assert_allclose(st(x, 3).numpy(), f(x, 3).numpy())
+
+
+def test_to_static_does_not_mutate_layer():
+    class Gate(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 4)
+
+        def forward(self, x):
+            h = self.fc(x)
+            if h.mean() > 0:
+                return h * 2.0
+            return h * -1.0
+
+    import paddle_tpu.nn as nn_mod
+
+    paddle.seed(0)
+    net = Gate()
+    original_forward = net.forward
+    paddle.jit.to_static(net)
+    # the instance must keep its eager forward (no persistent rebinding)
+    assert net.forward.__func__ is original_forward.__func__

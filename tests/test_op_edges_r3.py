@@ -1,0 +1,163 @@
+"""Round-3 op edge-case burndown (VERDICT #9): each formerly-raising path now
+works, checked against numpy oracles."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a))
+
+
+class TestMathEdges:
+    def test_diff_prepend_append(self):
+        x = np.array([1.0, 3.0, 6.0, 10.0], "float32")
+        pre = np.array([0.0], "float32")
+        app = np.array([15.0, 21.0], "float32")
+        got = paddle.diff(_t(x), prepend=_t(pre), append=_t(app))
+        np.testing.assert_allclose(
+            got.numpy(), np.diff(x, prepend=pre, append=app))
+
+    def test_diag_padding_value(self):
+        x = np.array([1.0, 2.0, 3.0], "float32")
+        got = paddle.diag(_t(x), padding_value=9.0)
+        ref = np.full((3, 3), 9.0, "float32")
+        np.fill_diagonal(ref, x)
+        np.testing.assert_allclose(got.numpy(), ref)
+        # offset case
+        got2 = paddle.diag(_t(x), offset=1, padding_value=-1.0)
+        ref2 = np.full((4, 4), -1.0, "float32")
+        for i in range(3):
+            ref2[i, i + 1] = x[i]
+        np.testing.assert_allclose(got2.numpy(), ref2)
+        # 2-D extract ignores padding_value
+        m = np.arange(9, dtype="float32").reshape(3, 3)
+        np.testing.assert_allclose(
+            paddle.diag(_t(m), padding_value=5.0).numpy(), np.diag(m))
+
+    def test_bincount_weights(self):
+        x = np.array([0, 1, 1, 3, 3, 3], "int64")
+        w = np.array([0.5, 1.0, 2.0, 0.1, 0.2, 0.3], "float32")
+        got = paddle.bincount(_t(x), weights=_t(w))
+        np.testing.assert_allclose(got.numpy(), np.bincount(x, w),
+                                   rtol=1e-6)
+        got2 = paddle.bincount(_t(x), weights=_t(w), minlength=8)
+        np.testing.assert_allclose(got2.numpy(),
+                                   np.bincount(x, w, minlength=8), rtol=1e-6)
+
+    @pytest.mark.parametrize("reduce", ["mul", "amin", "amax", "mean"])
+    def test_put_along_axis_reduce_modes(self, reduce):
+        x = np.arange(12, dtype="float32").reshape(3, 4) + 1.0
+        idx = np.array([[0], [1], [2]], "int64")
+        val = np.full((3, 1), 2.0, "float32")
+        got = paddle.put_along_axis(_t(x), _t(idx), _t(val), axis=1,
+                                    reduce=reduce).numpy()
+        ref = x.copy()
+        for r in range(3):
+            c = idx[r, 0]
+            if reduce == "mul":
+                ref[r, c] *= 2.0
+            elif reduce == "amin":
+                ref[r, c] = min(ref[r, c], 2.0)
+            elif reduce == "amax":
+                ref[r, c] = max(ref[r, c], 2.0)
+            else:  # mean, include_self
+                ref[r, c] = (ref[r, c] + 2.0) / 2.0
+        np.testing.assert_allclose(got, ref)
+
+
+class TestNNEdges:
+    def test_conv2d_transpose_nhwc(self):
+        paddle.seed(0)
+        x = np.random.RandomState(0).rand(2, 5, 5, 3).astype("float32")
+        w = np.random.RandomState(1).rand(3, 4, 3, 3).astype("float32")
+        nhwc = F.conv2d_transpose(_t(x), _t(w), stride=2, output_padding=1,
+                                  data_format="NHWC")
+        nchw = F.conv2d_transpose(_t(x.transpose(0, 3, 1, 2)), _t(w),
+                                  stride=2, output_padding=1,
+                                  data_format="NCHW")
+        np.testing.assert_allclose(nhwc.numpy(),
+                                   nchw.numpy().transpose(0, 2, 3, 1),
+                                   rtol=1e-5)
+
+    def test_interpolate_bicubic_and_area(self):
+        x = np.random.RandomState(2).rand(1, 2, 8, 8).astype("float32")
+        up = F.interpolate(_t(x), size=(16, 16), mode="bicubic")
+        assert up.shape == [1, 2, 16, 16]
+        area = F.interpolate(_t(x), size=(4, 4), mode="area")
+        ref = x.reshape(1, 2, 4, 2, 4, 2).mean((3, 5))
+        np.testing.assert_allclose(area.numpy(), ref, rtol=1e-5)
+
+    def test_bce_with_logits_weight_pos_weight(self):
+        logit = np.array([[0.5, -1.0], [2.0, 0.0]], "float32")
+        label = np.array([[1.0, 0.0], [0.0, 1.0]], "float32")
+        w = np.array([[1.0, 2.0], [0.5, 1.0]], "float32")
+        pw = np.array([[3.0, 3.0], [3.0, 3.0]], "float32")
+
+        def sig(v):
+            return 1 / (1 + np.exp(-v))
+
+        ref = -(pw * label * np.log(sig(logit))
+                + (1 - label) * np.log(1 - sig(logit))) * w
+        got = F.binary_cross_entropy_with_logits(
+            _t(logit), _t(label), weight=_t(w), pos_weight=_t(pw),
+            reduction="none")
+        np.testing.assert_allclose(got.numpy(), ref, rtol=1e-5)
+        got_m = F.binary_cross_entropy_with_logits(
+            _t(logit), _t(label), weight=_t(w), reduction="mean")
+        ref_m = (-(label * np.log(sig(logit))
+                   + (1 - label) * np.log(1 - sig(logit))) * w).mean()
+        np.testing.assert_allclose(float(got_m), ref_m, rtol=1e-5)
+
+    def test_pixel_unshuffle_channel_shuffle_nhwc(self):
+        x = np.random.RandomState(3).rand(2, 4, 4, 4).astype("float32")
+        pu = F.pixel_unshuffle(_t(x), 2, data_format="NHWC")
+        pu_ref = F.pixel_unshuffle(_t(x.transpose(0, 3, 1, 2)), 2)
+        np.testing.assert_allclose(pu.numpy(),
+                                   pu_ref.numpy().transpose(0, 2, 3, 1))
+        cs = F.channel_shuffle(_t(x), 2, data_format="NHWC")
+        cs_ref = F.channel_shuffle(_t(x.transpose(0, 3, 1, 2)), 2)
+        np.testing.assert_allclose(cs.numpy(),
+                                   cs_ref.numpy().transpose(0, 2, 3, 1))
+
+    def test_adaptive_max_pool2d_return_mask(self):
+        x = np.random.RandomState(4).rand(1, 1, 4, 6).astype("float32")
+        out, mask = F.adaptive_max_pool2d(_t(x), (2, 3), return_mask=True)
+        np.testing.assert_allclose(
+            out.numpy(), x.reshape(1, 1, 2, 2, 3, 2).max((3, 5)), rtol=1e-6)
+        flat = x[0, 0].ravel()
+        for oh in range(2):
+            for ow in range(3):
+                np.testing.assert_allclose(
+                    flat[int(mask.numpy()[0, 0, oh, ow])],
+                    out.numpy()[0, 0, oh, ow])
+
+
+class TestCaptureEdges:
+    def test_to_static_with_kwargs(self):
+        def f(x, y=None, scale=1.0):
+            out = x * scale
+            if y is not None:
+                out = out + y
+            return out
+
+        st = paddle.jit.to_static(f)
+        x = _t(np.ones(3, "float32"))
+        y = _t(np.full(3, 2.0, "float32"))
+        np.testing.assert_allclose(st(x, y=y, scale=3.0).numpy(),
+                                   [5.0, 5.0, 5.0])
+        np.testing.assert_allclose(st(x, scale=2.0).numpy(), [2.0, 2.0, 2.0])
+
+    def test_recompute_with_kwargs(self):
+        import paddle_tpu.distributed as dist
+
+        def f(x, scale=1.0):
+            return (x * scale).sum()
+
+        x = paddle.to_tensor(np.ones(4, "float32"))
+        x.stop_gradient = False
+        out = dist.recompute(f, x, scale=3.0)
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0] * 4)
