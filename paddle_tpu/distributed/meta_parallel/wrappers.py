@@ -144,25 +144,84 @@ class PipelineParallel(_MetaParallelBase):
 
 
 class HybridParallelOptimizer:
-    """reference hybrid_parallel_optimizer.py: wraps the inner optimizer; grad
-    sync across mp/sharding groups is a compiled-step concern under SPMD, so
-    step() delegates; the wrapper keeps API + grad-clip semantics."""
+    """reference hybrid_parallel_optimizer.py + the strategy meta-optimizer
+    roles (fleet/meta_optimizers/{lamb,lars,gradient_merge}_optimizer.py):
+
+    - grad sync across mp/sharding groups is a compiled-step concern under
+      SPMD, so step() delegates; the wrapper keeps API + grad-clip semantics
+    - strategy.lamb / strategy.lars swap the update rule like the reference
+      meta-optimizers rewrite the program's optimizer ops
+    - strategy.gradient_merge applies the inner update only every k_steps
+      backward passes (grads accumulate on the eager tape between them, so
+      no extra buffers are needed), averaging when configured."""
 
     def __init__(self, optimizer, hcg=None, strategy=None):
-        self._inner_opt = optimizer
+        self._inner_opt = self._maybe_swap_rule(optimizer, strategy)
         self._hcg = hcg
+        self._gm_k = 1
+        self._gm_avg = True
+        self._gm_count = 0
+        if strategy is not None and getattr(strategy, "gradient_merge", False):
+            self._gm_k = int(strategy.gradient_merge_configs.get("k_steps", 1))
+            self._gm_avg = bool(strategy.gradient_merge_configs.get("avg",
+                                                                    True))
+
+    @staticmethod
+    def _maybe_swap_rule(optimizer, strategy):
+        if strategy is None:
+            return optimizer
+        from ...optimizer import Lamb, LarsMomentum
+
+        if getattr(strategy, "lamb", False) and not isinstance(optimizer,
+                                                               Lamb):
+            # carry the inner optimizer's hypers across the swap (the
+            # reference meta-optimizer maps them from the strategy proto)
+            hyp = getattr(optimizer, "_hyper_defaults", {})
+            wd = getattr(optimizer, "_weight_decay", 0.0) or 0.01
+            return Lamb(learning_rate=optimizer._learning_rate,
+                        lamb_weight_decay=wd,
+                        beta1=hyp.get("beta1", 0.9),
+                        beta2=hyp.get("beta2", 0.999),
+                        epsilon=hyp.get("eps", 1e-6),
+                        parameters=optimizer._parameter_list,
+                        grad_clip=optimizer._grad_clip)
+        if getattr(strategy, "lars", False) and not isinstance(
+                optimizer, LarsMomentum):
+            hyp = getattr(optimizer, "_hyper_defaults", {})
+            return LarsMomentum(learning_rate=optimizer._learning_rate,
+                                momentum=hyp.get("momentum", 0.9),
+                                parameters=optimizer._parameter_list,
+                                grad_clip=optimizer._grad_clip)
+        return optimizer
 
     def __getattr__(self, item):
         return getattr(self._inner_opt, item)
 
     def step(self):
+        if self._gm_k > 1:
+            self._gm_count += 1
+            if self._gm_count % self._gm_k:
+                return  # accumulate: grads keep summing on the tape
+            if self._gm_avg:
+                for p in self._inner_opt._parameter_list:
+                    if p.grad is not None:
+                        p.grad.data = p.grad.data / self._gm_k
         self._inner_opt.step()
 
     def clear_grad(self):
+        # under gradient merge, grads must survive until the k-th step
+        if self._gm_k > 1 and self._gm_count % self._gm_k:
+            return
         self._inner_opt.clear_grad()
 
-    def minimize(self, *a, **k):
-        return self._inner_opt.minimize(*a, **k)
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        # route through the wrapper's own step/clear_grad so gradient-merge
+        # gating applies to the minimize() API too
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
 
 
 class HybridParallelGradScaler:

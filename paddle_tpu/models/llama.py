@@ -45,6 +45,7 @@ class LlamaConfig:
     use_recompute: bool = False
     scan_layers: bool = True  # lax.scan over decoder stack: O(1) compile in depth
     pp_microbatches: int = 0  # microbatches for the pp pipeline (0 = 2*pp)
+    ce_chunk: int = 2048  # fused lm_head+CE token-chunk size
     cp_impl: str = "ring"  # context-parallel attention: 'ring' | 'ulysses'
     dtype: str = "bfloat16"
 
@@ -327,7 +328,9 @@ class LlamaForCausalLM(nn.Layer):
             h2 = manipulation.reshape(h, [-1, self.config.hidden_size])
             lab1 = manipulation.reshape(lab, [-1])
             loss = _fused_linear_ce(h2, self.lm_head.weight, lab1,
-                                    chunk=2048, ignore_index=-100)
+                                    chunk=getattr(self.config, "ce_chunk",
+                                                  2048),
+                                    ignore_index=-100)
             if aux is not None:
                 loss = loss + getattr(self.config, "aux_loss_weight", 0.0) * aux
             return loss

@@ -243,11 +243,16 @@ def _convert_eqn(g: _Graph, eqn, env: Dict[int, str]):
         r = g.add("Reshape", [inp(0), g.const(np.asarray(interim, np.int64))])
         set_out(g.add("Expand",
                       [r, g.const(np.asarray(out_shape, np.int64))]))
-    elif name in ("reduce_sum", "reduce_max", "reduce_min", "reduce_prod"):
-        op = {"reduce_sum": "ReduceSum", "reduce_max": "ReduceMax",
-              "reduce_min": "ReduceMin", "reduce_prod": "ReduceProd"}[name]
+    elif name == "reduce_sum":
+        # ReduceSum takes axes as an INPUT from opset 13
         axes = g.const(np.asarray(eqn.params["axes"], np.int64))
-        set_out(g.add(op, [inp(0), axes], keepdims=0))
+        set_out(g.add("ReduceSum", [inp(0), axes], keepdims=0))
+    elif name in ("reduce_max", "reduce_min", "reduce_prod"):
+        # axes stay an ATTRIBUTE for these until opset 18
+        op = {"reduce_max": "ReduceMax", "reduce_min": "ReduceMin",
+              "reduce_prod": "ReduceProd"}[name]
+        set_out(g.add(op, [inp(0)], keepdims=0,
+                      axes=list(eqn.params["axes"])))
     elif name == "convert_element_type":
         to = _DTYPE[str(np.dtype(eqn.params["new_dtype"]))]
         set_out(g.add("Cast", [inp(0)], to=to))

@@ -313,11 +313,22 @@ def _bincount_w(x, weights, *, minlength):
 def bincount(x, weights=None, minlength=0, name=None):
     if weights is not None:
         import numpy as np
+        import jax.core as jcore
 
-        # bin count must be static under XLA: derive it on the host like the
-        # reference CPU kernel does (bincount is a host-ish stats op)
-        xv = np.asarray(x.numpy() if hasattr(x, "numpy") else x)
-        length = int(max(int(xv.max()) + 1 if xv.size else 0, minlength))
+        data = x.data if hasattr(x, "data") else x
+        if isinstance(data, jcore.Tracer):
+            # bin count must be static under XLA: inside a trace the caller
+            # supplies it via minlength (the host-max derivation needs a
+            # concrete value)
+            if minlength <= 0:
+                raise ValueError(
+                    "bincount with weights under jit/to_static needs "
+                    "minlength (> max(x)) — the output length cannot depend "
+                    "on traced values")
+            length = int(minlength)
+        else:
+            xv = np.asarray(data)
+            length = int(max(int(xv.max()) + 1 if xv.size else 0, minlength))
         return _bincount_w(x, weights, minlength=length)
     return _bincount(x, minlength=int(minlength))
 

@@ -420,3 +420,59 @@ def test_global_scatter_gather_roundtrip():
     assert np.allclose(y2.numpy()[:, :, 1, :], 0.0)
     assert not np.allclose(y2.numpy()[:, :, 0, :], 0.0)
     dist.reset_mesh()
+
+
+@pytest.mark.dist
+def test_gradient_merge_strategy():
+    """strategy.gradient_merge: update applies every k steps on the summed
+    (averaged) grads — parity with one big-batch step
+    (reference meta_optimizers/gradient_merge_optimizer.py)."""
+    dist.reset_mesh()
+    dist.init_mesh(dp=8)
+    from paddle_tpu.distributed import fleet
+
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": True}
+    fleet.init(is_collective=True, strategy=strat)
+    paddle.seed(3)
+    net = nn.Linear(8, 8)
+    w0 = net.weight.numpy().copy()
+    o = fleet.distributed_optimizer(
+        opt.SGD(learning_rate=0.1, parameters=net.parameters()))
+    x1 = paddle.to_tensor(np.random.RandomState(0).rand(4, 8).astype("float32"))
+    x2 = paddle.to_tensor(np.random.RandomState(1).rand(4, 8).astype("float32"))
+    for x in (x1, x2):
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    w_merged = net.weight.numpy().copy()
+
+    # reference: single step on the averaged gradient of both microbatches
+    paddle.seed(3)
+    net2 = nn.Linear(8, 8)
+    o2 = opt.SGD(learning_rate=0.1, parameters=net2.parameters())
+    ((net2(x1) ** 2).mean() + (net2(x2) ** 2).mean()).backward()
+    for p in net2.parameters():
+        if p.grad is not None:
+            p.grad.data = p.grad.data / 2
+    o2.step()
+    np.testing.assert_allclose(w_merged, net2.weight.numpy(), rtol=1e-5)
+    dist.reset_mesh()
+
+
+@pytest.mark.dist
+def test_lamb_strategy_swaps_rule():
+    dist.reset_mesh()
+    dist.init_mesh(dp=8)
+    from paddle_tpu.distributed import fleet
+
+    strat = fleet.DistributedStrategy()
+    strat.lamb = True
+    fleet.init(is_collective=True, strategy=strat)
+    net = nn.Linear(4, 4)
+    o = fleet.distributed_optimizer(
+        opt.AdamW(learning_rate=0.01, parameters=net.parameters()))
+    assert type(o._inner_opt).__name__ == "Lamb"
+    dist.reset_mesh()
