@@ -177,7 +177,8 @@ class HybridParallelOptimizer:
             # carry the inner optimizer's hypers across the swap (the
             # reference meta-optimizer maps them from the strategy proto)
             hyp = getattr(optimizer, "_hyper_defaults", {})
-            wd = getattr(optimizer, "_weight_decay", 0.0) or 0.01
+            wd = getattr(optimizer, "_weight_decay", None)
+            wd = 0.01 if wd is None else float(wd)  # explicit 0.0 stays 0.0
             return Lamb(learning_rate=optimizer._learning_rate,
                         lamb_weight_decay=wd,
                         beta1=hyp.get("beta1", 0.9),
@@ -200,6 +201,7 @@ class HybridParallelOptimizer:
     def step(self):
         if self._gm_k > 1:
             self._gm_count += 1
+            self._gm_just_stepped = True
             if self._gm_count % self._gm_k:
                 return  # accumulate: grads keep summing on the tape
             if self._gm_avg:
@@ -209,18 +211,23 @@ class HybridParallelOptimizer:
         self._inner_opt.step()
 
     def clear_grad(self):
-        # under gradient merge, grads must survive until the k-th step
         if self._gm_k > 1 and self._gm_count % self._gm_k:
-            return
+            if getattr(self, "_gm_just_stepped", False):
+                # normal post-step clear inside an accumulation window:
+                # grads must survive until the k-th step
+                self._gm_just_stepped = False
+                return
+            # clear WITHOUT a step = the loop is dropping a bad batch:
+            # discard the whole window (count rewinds to the window start)
+            self._gm_count -= self._gm_count % self._gm_k
+        self._gm_just_stepped = False
         self._inner_opt.clear_grad()
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
-        # route through the wrapper's own step/clear_grad so gradient-merge
-        # gating applies to the minimize() API too
-        loss.backward()
+        # mirror base Optimizer.minimize (caller has already run backward);
+        # routing through self.step() keeps gradient-merge gating
         self.step()
-        self.clear_grad()
         return None, None
 
 

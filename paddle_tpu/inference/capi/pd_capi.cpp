@@ -1,0 +1,143 @@
+// C inference API (reference: paddle/fluid/inference/capi_exp/pd_inference_api.h
+// PD_* surface). The predictor itself is the framework's Python Predictor over
+// a jit.save StableHLO artifact; this library embeds CPython so C/C++/Go hosts
+// link one .so and never touch Python. All entry points are GIL-correct both
+// when this library OWNS the interpreter (pure C host) and when it is loaded
+// INTO a Python process (tests via ctypes).
+#include <Python.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+
+namespace {
+
+struct PdPredictor {
+  PyObject* obj;  // paddle_tpu.inference.Predictor
+};
+
+bool g_we_initialized = false;
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() { st = PyGILState_Ensure(); }
+  ~Gil() { PyGILState_Release(st); }
+};
+
+PyObject* bridge() {  // paddle_tpu.inference.capi_bridge (imported once)
+  static PyObject* mod = nullptr;
+  if (!mod) mod = PyImport_ImportModule("paddle_tpu.inference.capi_bridge");
+  return mod;
+}
+
+thread_local std::string g_last_error;
+
+void capture_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_last_error = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Initialize the embedded interpreter (no-op inside a Python host).
+int PD_Init() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    g_we_initialized = true;
+    PyEval_SaveThread();  // release the GIL for PyGILState_Ensure users
+  }
+  return 0;
+}
+
+const char* PD_GetLastError() { return g_last_error.c_str(); }
+
+// Load a predictor from a jit.save prefix (the .pdmodel/.pdiparams pair).
+void* PD_PredictorCreate(const char* model_prefix) {
+  PD_Init();
+  Gil gil;
+  PyObject* mod = bridge();
+  if (!mod) { capture_error(); return nullptr; }
+  PyObject* pred =
+      PyObject_CallMethod(mod, "create", "s", model_prefix);
+  if (!pred) { capture_error(); return nullptr; }
+  return new PdPredictor{pred};
+}
+
+// Run on one float32 input; copies the float32 output into out_buf.
+// Returns the number of output elements, or -1 on error (out_cap too small
+// included — call with out_cap=0 to query the size via a dry result).
+int64_t PD_PredictorRunFloat(void* handle, const float* data,
+                             const int64_t* shape, int ndim, float* out_buf,
+                             int64_t out_cap, int64_t* out_shape,
+                             int* out_ndim) {
+  if (!handle) return -1;
+  Gil gil;
+  PdPredictor* p = static_cast<PdPredictor*>(handle);
+  int64_t n = 1;
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i) {
+    n *= shape[i];
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), n * sizeof(float));
+  PyObject* res = PyObject_CallMethod(bridge(), "run_f32", "OOO", p->obj, raw,
+                                      shp);
+  Py_DECREF(raw);
+  Py_DECREF(shp);
+  if (!res) { capture_error(); return -1; }
+  // res = (bytes, shape tuple)
+  PyObject* out_bytes = PyTuple_GetItem(res, 0);
+  PyObject* out_shp = PyTuple_GetItem(res, 1);
+  Py_ssize_t nbytes = PyBytes_Size(out_bytes);
+  int64_t count = nbytes / static_cast<int64_t>(sizeof(float));
+  int odim = static_cast<int>(PyTuple_Size(out_shp));
+  if (out_ndim) *out_ndim = odim;
+  if (out_shape) {
+    for (int i = 0; i < odim; ++i)
+      out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(out_shp, i));
+  }
+  if (out_buf && out_cap >= count) {
+    std::memcpy(out_buf, PyBytes_AsString(out_bytes),
+                count * sizeof(float));
+  } else if (out_buf) {
+    Py_DECREF(res);
+    g_last_error = "output buffer too small";
+    return -1;
+  }
+  Py_DECREF(res);
+  return count;
+}
+
+int PD_PredictorGetInputNum(void* handle) {
+  if (!handle) return -1;
+  Gil gil;
+  PdPredictor* p = static_cast<PdPredictor*>(handle);
+  PyObject* names = PyObject_CallMethod(p->obj, "get_input_names", nullptr);
+  if (!names) { capture_error(); return -1; }
+  int n = static_cast<int>(PyList_Size(names));
+  Py_DECREF(names);
+  return n;
+}
+
+void PD_PredictorDestroy(void* handle) {
+  if (!handle) return;
+  Gil gil;
+  PdPredictor* p = static_cast<PdPredictor*>(handle);
+  Py_XDECREF(p->obj);
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,46 @@
+"""Python side of the C inference API (inference/capi/pd_capi.cpp).
+
+Kept pointer-free: tensors cross the ABI as bytes + shape tuples, so the C
+layer needs no numpy C API and the bridge stays version-proof.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def create(model_prefix: str):
+    from . import Config, create_predictor
+
+    return create_predictor(Config(model_prefix))
+
+
+def run_f32(predictor, raw: bytes, shape):
+    arr = np.frombuffer(raw, np.float32).reshape(tuple(int(d) for d in shape))
+    out = predictor.run([arr])[0]
+    out = np.ascontiguousarray(np.asarray(out), np.float32)
+    return out.tobytes(), tuple(int(d) for d in out.shape)
+
+
+def load_capi_lib():
+    """Build (once) and return the ctypes handle of libpd_capi.so — the
+    artifact a C/C++/Go host links against."""
+    import os
+    import subprocess
+
+    from ..utils import cpp_extension
+
+    import sysconfig
+
+    src_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "capi")
+    inc = subprocess.run(["python3-config", "--includes"],
+                         capture_output=True, text=True).stdout.split()
+    ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
+                        capture_output=True, text=True)
+    if ld.returncode == 0 and ld.stdout.strip():
+        ldflags = ld.stdout.split()
+    else:  # derive from the running interpreter
+        v = sysconfig.get_config_var
+        ldflags = [f"-L{v('LIBDIR')}", f"-lpython{v('LDVERSION')}"]
+    return cpp_extension.load(
+        "pd_capi", [os.path.join(src_dir, "pd_capi.cpp")],
+        build_directory=src_dir, extra_cxx_cflags=inc, extra_ldflags=ldflags)

@@ -8,3 +8,13 @@ from .reduction import *  # noqa: F401,F403
 from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .comparison import *  # noqa: F401,F403
+
+
+def grad_kind(name: str) -> str:
+    """Gradient mechanism declared for a primitive in ops/backward.yaml
+    (the reference's forward/backward api pairing): 'auto_vjp',
+    'custom_vjp', or 'nondiff'. Raises KeyError for undeclared primitives —
+    new ops must declare their grad story in the YAML."""
+    from ._grad_registry import GRAD_KIND
+
+    return GRAD_KIND[name]

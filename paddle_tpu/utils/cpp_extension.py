@@ -27,14 +27,22 @@ def CUDAExtension(*args, **kwargs):
 
 
 def load(name: str, sources: List[str], extra_cxx_cflags: Optional[List[str]] = None,
-         build_directory: Optional[str] = None, verbose: bool = False, **kwargs):
+         build_directory: Optional[str] = None, verbose: bool = False,
+         extra_ldflags: Optional[List[str]] = None, **kwargs):
     """Compile host C++ sources into a shared library and return the ctypes
     handle (the reference returns an imported python module of generated stubs;
     callers here bind the C ABI directly)."""
     build_dir = build_directory or os.path.join(
         os.path.dirname(os.path.abspath(sources[0])), "_build")
     os.makedirs(build_dir, exist_ok=True)
-    out = os.path.join(build_dir, f"lib{name}.so")
+    # the flags participate in the cache identity: same sources with a
+    # changed command line must NOT reuse the previously linked .so
+    import hashlib
+
+    flag_sig = hashlib.sha1(" ".join(
+        (extra_cxx_cflags or []) + ["|"] + (extra_ldflags or [])
+    ).encode()).hexdigest()[:8]
+    out = os.path.join(build_dir, f"lib{name}-{flag_sig}.so")
     newest_src = max(os.path.getmtime(s) for s in sources)
     if not os.path.exists(out) or os.path.getmtime(out) < newest_src:
         # Gang-spawned processes race to build on first use: serialize with a
@@ -51,7 +59,8 @@ def load(name: str, sources: List[str], extra_cxx_cflags: Optional[List[str]] = 
                     tmp = f"{out}.{os.getpid()}.tmp"
                     cmd = (["g++", "-O2", "-shared", "-fPIC", "-std=c++17"]
                            + (extra_cxx_cflags or []) + list(sources)
-                           + ["-o", tmp, "-lpthread"])
+                           + ["-o", tmp, "-lpthread"]
+                           + (extra_ldflags or []))
                     if verbose:
                         print(" ".join(cmd))
                     proc = subprocess.run(cmd, capture_output=True, text=True)

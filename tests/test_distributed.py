@@ -476,3 +476,36 @@ def test_lamb_strategy_swaps_rule():
         opt.AdamW(learning_rate=0.01, parameters=net.parameters()))
     assert type(o._inner_opt).__name__ == "Lamb"
     dist.reset_mesh()
+
+
+@pytest.mark.dist
+def test_gradient_merge_drop_bad_batch():
+    """clear_grad WITHOUT step = drop the batch: window restarts clean."""
+    dist.reset_mesh()
+    dist.init_mesh(dp=8)
+    from paddle_tpu.distributed import fleet
+
+    strat = fleet.DistributedStrategy()
+    strat.gradient_merge = True
+    strat.gradient_merge_configs = {"k_steps": 2, "avg": False}
+    fleet.init(is_collective=True, strategy=strat)
+    paddle.seed(4)
+    net = nn.Linear(4, 4)
+    o = fleet.distributed_optimizer(
+        opt.SGD(learning_rate=0.1, parameters=net.parameters()))
+    x = paddle.to_tensor(np.ones((2, 4), "float32"))
+
+    # poisoned batch: backward, then drop via clear_grad (no step)
+    (net(x) * 100.0).mean().backward()
+    o.clear_grad()
+    assert net.parameters()[0].grad is None or \
+        float(np.abs(net.parameters()[0].grad.numpy()).max()) == 0.0
+
+    # a full clean window of 2 microbatches then applies only their grads
+    w0 = net.weight.numpy().copy()
+    for _ in range(2):
+        (net(x)).mean().backward()
+        o.step()
+        o.clear_grad()
+    assert not np.allclose(net.weight.numpy(), w0)
+    dist.reset_mesh()

@@ -1,0 +1,71 @@
+"""C inference API (reference capi_exp PD_* surface): build the native .so,
+drive it through ctypes the way a C host would."""
+import ctypes
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    d = tmp_path_factory.mktemp("capi_model")
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    net.eval()
+    x = paddle.randn([2, 8])
+    prefix = str(d / "model")
+    paddle.jit.save(net, prefix, input_spec=[x])
+    return prefix, net, x
+
+
+def test_capi_roundtrip(saved_model):
+    prefix, net, x = saved_model
+    from paddle_tpu.inference.capi_bridge import load_capi_lib
+
+    lib = load_capi_lib()
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p]
+    lib.PD_PredictorRunFloat.restype = ctypes.c_int64
+    lib.PD_PredictorRunFloat.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int)]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    lib.PD_PredictorGetInputNum.argtypes = [ctypes.c_void_p]
+    lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
+
+    h = lib.PD_PredictorCreate(prefix.encode())
+    assert h, lib.PD_GetLastError()
+    assert lib.PD_PredictorGetInputNum(h) == 1
+
+    data = np.asarray(x.numpy(), np.float32)
+    shape = (ctypes.c_int64 * 2)(*data.shape)
+    out = np.zeros(2 * 4, np.float32)
+    out_shape = (ctypes.c_int64 * 8)()
+    out_ndim = ctypes.c_int(0)
+    n = lib.PD_PredictorRunFloat(
+        h, data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, 2,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
+        out_shape, ctypes.byref(out_ndim))
+    assert n == 8, lib.PD_GetLastError()
+    assert out_ndim.value == 2 and list(out_shape[:2]) == [2, 4]
+    np.testing.assert_allclose(out.reshape(2, 4), net(x).numpy(),
+                               rtol=1e-4, atol=1e-5)
+    lib.PD_PredictorDestroy(h)
+
+
+def test_capi_error_reporting(saved_model):
+    from paddle_tpu.inference.capi_bridge import load_capi_lib
+
+    lib = load_capi_lib()
+    lib.PD_PredictorCreate.restype = ctypes.c_void_p
+    lib.PD_PredictorCreate.argtypes = [ctypes.c_char_p]
+    lib.PD_GetLastError.restype = ctypes.c_char_p
+    h = lib.PD_PredictorCreate(b"/nonexistent/model")
+    assert not h
+    assert lib.PD_GetLastError()
