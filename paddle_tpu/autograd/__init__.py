@@ -157,3 +157,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
     if not retain_graph:
         for t in tensors:
             t._grad_node = None
+
+from .functional import (  # noqa: F401,E402
+    vjp, jvp, jacobian, batch_jacobian, hessian, batch_hessian, vhp,
+)

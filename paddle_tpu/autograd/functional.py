@@ -1,0 +1,192 @@
+"""paddle.autograd functional transforms.
+
+Reference: python/paddle/autograd/functional.py:87,174,248,390,536,681,807
+(vjp/jvp/jacobian/batch_jacobian/hessian/batch_hessian/vhp built from repeated
+paddle.grad calls and double-grad program rewrites).
+
+TPU-native mapping: these ARE jax's functional transforms — jax.vjp/jvp/
+jacrev/hessian/vmap — applied at the array level with Tensor marshalling at
+the boundary. No tape or double-grad machinery is involved, so higher-order
+derivatives (hessian-of-anything) compose for free.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..core import autograd as _engine
+
+__all__ = ["vjp", "jvp", "jacobian", "batch_jacobian", "hessian",
+           "batch_hessian", "vhp"]
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _arrays(xs) -> List:
+    return [x.data if isinstance(x, Tensor) else jnp.asarray(x) for x in xs]
+
+
+def _tensors(arrs, like=None):
+    out = [Tensor(a) for a in arrs]
+    if like is not None and not isinstance(like, (list, tuple)):
+        return out[0]
+    return out
+
+
+def _check_flags(create_graph):
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True (building an eager-tape graph through the "
+            "result) is not supported: these transforms are jax functional "
+            "derivatives. Compose them instead — e.g. "
+            "jacobian(lambda x: jacobian(f, x), x) for higher order.")
+
+
+def _wrap(func: Callable, n_inputs: int):
+    """array fn(*arrays) -> array(s); user func runs on Tensors with the
+    eager tape suspended (jax traces the math)."""
+
+    def fn(*arrays):
+        with _engine.no_grad():
+            out = func(*_tensors(list(arrays), like=[]))
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        res = [o.data if isinstance(o, Tensor) else o for o in outs]
+        return res[0] if not isinstance(out, (list, tuple)) else tuple(res)
+
+    return fn
+
+
+def vjp(func, inputs, v=None, create_graph=False, allow_unused=False):
+    """(outputs, vjp_result): reference functional.py:87."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+    fn = _wrap(func, len(xs))
+    out, pullback = jax.vjp(fn, *xs)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        vs = _arrays(_as_list(v))
+        cot = vs[0] if not isinstance(out, tuple) else tuple(vs)
+    grads = pullback(cot)
+    return (_tensors(_as_list(out), like=out if isinstance(out, tuple) else None)
+            if isinstance(out, tuple) else Tensor(out),
+            _tensors(list(grads), like=inputs))
+
+
+def jvp(func, inputs, v=None, create_graph=False, allow_unused=False):
+    """(outputs, jvp_result): reference functional.py:174."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+    fn = _wrap(func, len(xs))
+    if v is None:
+        tangents = tuple(jnp.ones_like(x) for x in xs)
+    else:
+        tangents = tuple(_arrays(_as_list(v)))
+    out, tang_out = jax.jvp(fn, tuple(xs), tangents)
+    wrap_out = (_tensors(_as_list(out), like=out)
+                if isinstance(out, tuple) else Tensor(out))
+    wrap_t = (_tensors(_as_list(tang_out), like=tang_out)
+              if isinstance(tang_out, tuple) else Tensor(tang_out))
+    return wrap_out, wrap_t
+
+
+def jacobian(func, inputs, create_graph=False, allow_unused=False):
+    """Full Jacobian (reference functional.py:248): single input -> Tensor
+    [*out_shape, *in_shape]; multiple inputs -> tuple per input."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+    fn = _wrap(func, len(xs))
+    jac = jax.jacrev(fn, argnums=tuple(range(len(xs))))(*xs)
+    if not isinstance(inputs, (list, tuple)):
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(j)
+    return tuple(Tensor(j) for j in jac)
+
+
+def batch_jacobian(func, inputs, create_graph=False, allow_unused=False):
+    """Per-sample Jacobian over the leading batch dim (functional.py:390):
+    func maps [B, n] -> [B, m]; result [B, m, n] (tuple per input)."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+
+    def single(*rows):
+        fn = _wrap(func, len(rows))
+
+        def grow(*rs):
+            out = fn(*[r[None] for r in rs])
+            return (tuple(o[0] for o in out) if isinstance(out, tuple)
+                    else out[0])
+
+        return jax.jacrev(grow, argnums=tuple(range(len(rows))))(*rows)
+
+    jac = jax.vmap(single)(*xs)
+    if not isinstance(inputs, (list, tuple)):
+        j = jac[0] if isinstance(jac, tuple) else jac
+        return Tensor(j)
+    return tuple(Tensor(j) for j in jac)
+
+
+def hessian(func, inputs, create_graph=False, allow_unused=False):
+    """Hessian of a scalar-output func (functional.py:681)."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+    fn = _wrap(func, len(xs))
+
+    def scalar(*a):
+        out = fn(*a)
+        return jnp.reshape(out[0] if isinstance(out, tuple) else out, ())
+
+    hes = jax.hessian(scalar, argnums=tuple(range(len(xs))))(*xs)
+    if not isinstance(inputs, (list, tuple)):
+        h = hes[0][0] if isinstance(hes, tuple) else hes
+        return Tensor(h)
+    return tuple(tuple(Tensor(h) for h in row) for row in hes)
+
+
+def batch_hessian(func, inputs, create_graph=False, allow_unused=False):
+    """Per-sample Hessian (functional.py:536): func [B, n] -> scalar-per-
+    sample [B]; result [B, n, n] (tuple-of-tuples blocks per input pair for
+    multiple inputs, like hessian)."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+
+    def single(*rows):
+        fn = _wrap(func, len(rows))
+
+        def srow(*rs):
+            out = fn(*[r[None] for r in rs])
+            o = out[0] if isinstance(out, tuple) else out
+            return jnp.reshape(o, ())
+
+        return jax.hessian(srow, argnums=tuple(range(len(rows))))(*rows)
+
+    hes = jax.vmap(single)(*xs)
+    if not isinstance(inputs, (list, tuple)):
+        return Tensor(hes[0][0] if isinstance(hes, tuple) else hes)
+    return tuple(tuple(Tensor(h) for h in row) for row in hes)
+
+
+def vhp(func, inputs, v=None, create_graph=False, allow_unused=False):
+    """(func_output, vector-Hessian product) — functional.py:807."""
+    _check_flags(create_graph)
+    xs = _arrays(_as_list(inputs))
+    fn = _wrap(func, len(xs))
+
+    def scalar(*a):
+        out = fn(*a)
+        return jnp.reshape(out[0] if isinstance(out, tuple) else out, ())
+
+    if v is None:
+        vs = tuple(jnp.ones_like(x) for x in xs)
+    else:
+        vs = tuple(_arrays(_as_list(v)))
+    out = scalar(*xs)
+    _, vhp_val = jax.jvp(jax.grad(scalar, argnums=tuple(range(len(xs)))),
+                         tuple(xs), vs)
+    wrapped = _tensors(list(vhp_val), like=inputs)
+    return Tensor(out), wrapped

@@ -201,7 +201,6 @@ class HybridParallelOptimizer:
     def step(self):
         if self._gm_k > 1:
             self._gm_count += 1
-            self._gm_just_stepped = True
             if self._gm_count % self._gm_k:
                 return  # accumulate: grads keep summing on the tape
             if self._gm_avg:
@@ -211,16 +210,18 @@ class HybridParallelOptimizer:
         self._inner_opt.step()
 
     def clear_grad(self):
+        # inside an accumulation window clear_grad preserves grads and is
+        # idempotent (training loops may clear at both ends of an iteration);
+        # dropping a poisoned batch is the EXPLICIT discard_merge_window()
         if self._gm_k > 1 and self._gm_count % self._gm_k:
-            if getattr(self, "_gm_just_stepped", False):
-                # normal post-step clear inside an accumulation window:
-                # grads must survive until the k-th step
-                self._gm_just_stepped = False
-                return
-            # clear WITHOUT a step = the loop is dropping a bad batch:
-            # discard the whole window (count rewinds to the window start)
+            return
+        self._inner_opt.clear_grad()
+
+    def discard_merge_window(self):
+        """Drop the current gradient-merge accumulation window (bad batch /
+        scaler-skipped step): clears grads and rewinds to the window start."""
+        if self._gm_k > 1:
             self._gm_count -= self._gm_count % self._gm_k
-        self._gm_just_stepped = False
         self._inner_opt.clear_grad()
 
     def minimize(self, loss, startup_program=None, parameters=None,

@@ -76,12 +76,12 @@ void* PD_PredictorCreate(const char* model_prefix) {
 }
 
 // Run on one float32 input; copies the float32 output into out_buf.
-// Returns the number of output elements, or -1 on error (out_cap too small
-// included — call with out_cap=0 to query the size via a dry result).
+// Returns the number of output elements, or -1 on error. Size query: pass
+// out_buf=NULL (out_shape/out_ndim still fill, bounded by out_shape_cap).
 int64_t PD_PredictorRunFloat(void* handle, const float* data,
                              const int64_t* shape, int ndim, float* out_buf,
                              int64_t out_cap, int64_t* out_shape,
-                             int* out_ndim) {
+                             int out_shape_cap, int* out_ndim) {
   if (!handle) return -1;
   Gil gil;
   PdPredictor* p = static_cast<PdPredictor*>(handle);
@@ -106,7 +106,8 @@ int64_t PD_PredictorRunFloat(void* handle, const float* data,
   int odim = static_cast<int>(PyTuple_Size(out_shp));
   if (out_ndim) *out_ndim = odim;
   if (out_shape) {
-    for (int i = 0; i < odim; ++i)
+    int lim = odim < out_shape_cap ? odim : out_shape_cap;
+    for (int i = 0; i < lim; ++i)
       out_shape[i] = PyLong_AsLongLong(PyTuple_GetItem(out_shp, i));
   }
   if (out_buf && out_cap >= count) {

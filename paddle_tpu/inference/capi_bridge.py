@@ -32,15 +32,21 @@ def load_capi_lib():
     import sysconfig
 
     src_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "capi")
-    inc = subprocess.run(["python3-config", "--includes"],
-                         capture_output=True, text=True).stdout.split()
-    ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
-                        capture_output=True, text=True)
-    if ld.returncode == 0 and ld.stdout.strip():
-        ldflags = ld.stdout.split()
-    else:  # derive from the running interpreter
-        v = sysconfig.get_config_var
-        ldflags = [f"-L{v('LIBDIR')}", f"-lpython{v('LDVERSION')}"]
+    # header/lib flags from THE RUNNING interpreter (python3-config may be
+    # absent or belong to a different python)
+    v = sysconfig.get_config_var
+    inc = [f"-I{sysconfig.get_paths()['include']}"]
+    ldflags = [f"-L{v('LIBDIR')}", f"-lpython{v('LDVERSION')}"]
+    try:
+        ld = subprocess.run(["python3-config", "--ldflags", "--embed"],
+                            capture_output=True, text=True)
+        if ld.returncode == 0 and ld.stdout.strip():
+            ldflags = ld.stdout.split()
+    except OSError:
+        pass
+    build_dir = cpp_extension.get_build_directory()
+    os.makedirs(build_dir, exist_ok=True)
     return cpp_extension.load(
         "pd_capi", [os.path.join(src_dir, "pd_capi.cpp")],
-        build_directory=src_dir, extra_cxx_cflags=inc, extra_ldflags=ldflags)
+        build_directory=build_dir, extra_cxx_cflags=inc,
+        extra_ldflags=ldflags)

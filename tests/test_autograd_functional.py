@@ -1,0 +1,77 @@
+"""paddle.autograd functional transforms (reference autograd/functional.py):
+numeric parity with hand-computed derivatives."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import autograd as AG
+
+
+def _t(a):
+    return paddle.to_tensor(np.asarray(a, "float32"))
+
+
+def test_vjp_matches_manual():
+    x = _t([1.0, 2.0, 3.0])
+    out, (gx,) = AG.vjp(lambda t: (t * t).sum(), [x])
+    np.testing.assert_allclose(float(out), 14.0)
+    np.testing.assert_allclose(gx.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_vjp_with_cotangent():
+    x = _t([[1.0, 2.0], [3.0, 4.0]])
+    v = _t([[1.0, 0.0], [0.0, 1.0]])
+    out, (gx,) = AG.vjp(lambda t: t * 3.0, [x], v=[v])
+    np.testing.assert_allclose(gx.numpy(), [[3.0, 0.0], [0.0, 3.0]])
+
+
+def test_jvp_matches_directional_derivative():
+    x = _t([1.0, 2.0])
+    v = _t([1.0, 0.0])
+    out, tang = AG.jvp(lambda t: t ** 3, [x], v=[v])
+    np.testing.assert_allclose(tang.numpy(), [3.0, 0.0])
+
+
+def test_jacobian_full_matrix():
+    x = _t([1.0, 2.0])
+
+    def f(t):
+        return paddle.concat([t * 2.0, (t * t).sum().reshape([1])])
+
+    jac = AG.jacobian(f, x)
+    np.testing.assert_allclose(
+        jac.numpy(), [[2.0, 0.0], [0.0, 2.0], [2.0, 4.0]])
+
+
+def test_batch_jacobian():
+    x = _t([[1.0, 2.0], [3.0, 4.0]])
+    jac = AG.batch_jacobian(lambda t: t * t, x)
+    ref = np.zeros((2, 2, 2), "float32")
+    ref[0] = np.diag([2.0, 4.0])
+    ref[1] = np.diag([6.0, 8.0])
+    np.testing.assert_allclose(jac.numpy(), ref)
+
+
+def test_hessian_quadratic():
+    x = _t([1.0, 2.0])
+    A = np.array([[2.0, 1.0], [1.0, 4.0]], "float32")
+
+    def f(t):
+        return (t.reshape([1, 2]).matmul(_t(A)) * t.reshape([1, 2])).sum()
+
+    hes = AG.hessian(f, x)
+    np.testing.assert_allclose(hes.numpy(), A + A.T, rtol=1e-5)
+
+
+def test_batch_hessian():
+    x = _t([[1.0], [2.0]])
+    hes = AG.batch_hessian(lambda t: (t ** 3).sum(axis=-1), x)
+    np.testing.assert_allclose(np.squeeze(hes.numpy()), [6.0, 12.0])
+
+
+def test_vhp():
+    x = _t([1.0, 2.0])
+    v = _t([1.0, 1.0])
+    out, (hv,) = AG.vhp(lambda t: (t ** 3).sum(), [x], v=[v])
+    np.testing.assert_allclose(float(out), 9.0)
+    np.testing.assert_allclose(hv.numpy(), [6.0, 12.0])

@@ -495,11 +495,17 @@ def test_gradient_merge_drop_bad_batch():
         opt.SGD(learning_rate=0.1, parameters=net.parameters()))
     x = paddle.to_tensor(np.ones((2, 4), "float32"))
 
-    # poisoned batch: backward, then drop via clear_grad (no step)
+    # poisoned batch: backward, then drop the window explicitly
     (net(x) * 100.0).mean().backward()
-    o.clear_grad()
+    o.discard_merge_window()
     assert net.parameters()[0].grad is None or \
         float(np.abs(net.parameters()[0].grad.numpy()).max()) == 0.0
+    # clear_grad mid-window stays idempotent (double clears preserve grads)
+    (net(x)).mean().backward()
+    o.step()
+    o.clear_grad()
+    o.clear_grad()
+    assert net.parameters()[0].grad is not None
 
     # a full clean window of 2 microbatches then applies only their grads
     w0 = net.weight.numpy().copy()

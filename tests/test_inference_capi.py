@@ -34,7 +34,8 @@ def test_capi_roundtrip(saved_model):
         ctypes.c_void_p, ctypes.POINTER(ctypes.c_float),
         ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int)]
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int)]
     lib.PD_GetLastError.restype = ctypes.c_char_p
     lib.PD_PredictorGetInputNum.argtypes = [ctypes.c_void_p]
     lib.PD_PredictorDestroy.argtypes = [ctypes.c_void_p]
@@ -51,7 +52,7 @@ def test_capi_roundtrip(saved_model):
     n = lib.PD_PredictorRunFloat(
         h, data.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), shape, 2,
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
-        out_shape, ctypes.byref(out_ndim))
+        out_shape, 8, ctypes.byref(out_ndim))
     assert n == 8, lib.PD_GetLastError()
     assert out_ndim.value == 2 and list(out_shape[:2]) == [2, 4]
     np.testing.assert_allclose(out.reshape(2, 4), net(x).numpy(),
