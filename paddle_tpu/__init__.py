@@ -99,3 +99,122 @@ try:
     from .framework.io import save, load  # noqa: F401,E402
 except ImportError:
     pass
+
+# -- reference top-level long tail -------------------------------------------
+from .framework.place import CUDAPinnedPlace, NPUPlace  # noqa: F401,E402
+from .framework import dtype as dtype  # noqa: F401,E402  (paddle.dtype module-alias)
+from .distributed.parallel import DataParallel  # noqa: F401,E402
+from .ops.creation import create_parameter  # noqa: F401,E402
+
+
+def shape(x):
+    """Tensor of x's shape (reference layers.shape returns an int32 tensor)."""
+    import numpy as _np
+
+    return to_tensor(_np.asarray(x.shape, "int32"))
+
+
+def rank(x):
+    """0-d int32 tensor holding x's ndim (reference layers.rank)."""
+    import numpy as _np
+
+    return to_tensor(_np.asarray(len(x.shape), "int32"))
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as _np
+
+    return list(_np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def is_complex(x):
+    return "complex" in str(x.dtype)
+
+
+def is_floating_point(x):
+    return "float" in str(x.dtype) and "complex" not in str(x.dtype)
+
+
+def is_integer(x):
+    d = str(x.dtype)
+    return "int" in d and "uint" not in d or d.endswith("uint8")
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """numpy-backed print options (reference tensor print formatting)."""
+    import numpy as _np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    _np.set_printoptions(**kw)
+
+
+def disable_signal_handler():
+    """No-op: the reference installs C++ fatal-signal dumpers; XLA does not."""
+    return None
+
+
+def get_cuda_rng_state():
+    """API-compat: no CUDA generator exists on TPU builds (empty state)."""
+    return []
+
+
+def set_cuda_rng_state(state):
+    return None
+
+
+def check_shape(shape):
+    """Validate a shape argument the way reference layers.utils.check_shape
+    does (positive/-1 dims only)."""
+    for d in shape:
+        d = int(d)
+        if d < -1 or d == 0:
+            raise ValueError(f"invalid dim {d} in shape {list(shape)}")
+    return True
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Legacy reader decorator (reference paddle.batch): groups an iterable
+    sample reader into lists of batch_size samples."""
+
+    def batched():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batched
+
+
+def _module_inplace(name):
+    def fn(x, *args, **kwargs):
+        return getattr(x, name)(*args, **kwargs)
+
+    fn.__name__ = name
+    fn.__doc__ = f"Module-level alias of Tensor.{name} (inplace)."
+    return fn
+
+
+reshape_ = _module_inplace("reshape_")
+squeeze_ = _module_inplace("squeeze_")
+unsqueeze_ = _module_inplace("unsqueeze_")
+tanh_ = _module_inplace("tanh_")
+scatter_ = _module_inplace("scatter_")

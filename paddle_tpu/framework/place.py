@@ -102,3 +102,13 @@ def _get_expected_place() -> Place:
 
 def is_compiled_with_tpu() -> bool:
     return any(_kind_matches(d, "tpu") for d in jax.devices())
+
+
+class CUDAPinnedPlace(Place):  # API-compat: pinned host memory has no TPU role
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
+class NPUPlace(Place):  # API-compat alias for custom-device builds
+    def __init__(self, idx=0):
+        super().__init__("npu", idx)

@@ -35,7 +35,10 @@ def load_capi_lib():
     # header/lib flags from THE RUNNING interpreter (python3-config may be
     # absent or belong to a different python)
     v = sysconfig.get_config_var
-    inc = [f"-I{sysconfig.get_paths()['include']}"]
+    paths = sysconfig.get_paths()
+    inc = [f"-I{paths['include']}"]
+    if paths.get("platinclude") and paths["platinclude"] != paths["include"]:
+        inc.append(f"-I{paths['platinclude']}")
     ldflags = [f"-L{v('LIBDIR')}", f"-lpython{v('LDVERSION')}"]
     try:
         ld = subprocess.run(["python3-config", "--ldflags", "--embed"],

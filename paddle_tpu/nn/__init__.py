@@ -38,3 +38,13 @@ from .layer.transformer import (  # noqa: F401
 )
 from .clip import ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm  # noqa: F401
 
+from .layer.extension_r3 import (  # noqa: F401
+    Conv3D, Conv1DTranspose, Conv3DTranspose,
+    MaxPool1D, AvgPool1D, MaxPool3D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveMaxPool1D, AdaptiveAvgPool3D, AdaptiveMaxPool3D,
+    MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
+    Pad1D, Pad3D, Dropout3D, AlphaDropout, PairwiseDistance, Fold,
+    InstanceNorm1D, InstanceNorm3D, CTCLoss, HSigmoidLoss,
+    BeamSearchDecoder, dynamic_decode,
+    Unfold, ZeroPad2D, UpsamplingNearest2D, UpsamplingBilinear2D, SpectralNorm,
+)

@@ -11,3 +11,19 @@ from .vision import (  # noqa: F401
 )
 
 from ...ops.manipulation import one_hot  # noqa: F401
+from ...ops.manipulation import diag_embed  # noqa: F401,E402
+from .common import (  # noqa: F401,E402
+    max_pool1d, avg_pool1d, max_pool3d, avg_pool3d, max_unpool1d,
+    max_unpool2d, max_unpool3d, adaptive_avg_pool1d, adaptive_max_pool1d,
+    adaptive_avg_pool3d, adaptive_max_pool3d, conv3d, conv1d_transpose,
+    conv3d_transpose, dropout3d, alpha_dropout, local_response_norm,
+    bilinear, sequence_mask, zeropad2d, sparse_attention, relu_, softmax_,
+    tanh_,
+)
+from .loss import (  # noqa: F401,E402
+    ctc_loss, dice_loss, log_loss, label_smooth, hsigmoid_loss,
+    margin_cross_entropy, class_center_sample, npair_loss,
+    sigmoid_focal_loss,
+)
+from .vision import temporal_shift  # noqa: F401,E402
+from .activation import elu_, gather_tree  # noqa: F401,E402

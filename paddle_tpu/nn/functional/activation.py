@@ -201,3 +201,33 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     return _gumbel_softmax(
         x, random_mod.next_key(), temperature=float(temperature), hard=bool(hard), axis=int(axis)
     )
+
+
+def elu_(x, alpha=1.0, name=None):
+    """Inplace variant (reference elu_): rebinds x to the result."""
+    out = elu(x, alpha)
+    x._rebind(out)
+    return x
+
+
+@primitive("gather_tree_op", nondiff=True)
+def _gather_tree(ids, parents):
+    # ids/parents: [T, B, beam]; walk ancestry from the last step backwards
+    T = ids.shape[0]
+
+    def step(beams, t):
+        # beams: [B, beam] current beam index per output slot
+        tok = jnp.take_along_axis(ids[t], beams, axis=-1)
+        par = jnp.take_along_axis(parents[t], beams, axis=-1)
+        return par, tok
+
+    init = jnp.broadcast_to(jnp.arange(ids.shape[2]),
+                            ids.shape[1:]).astype(ids.dtype)
+    _, toks = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+    return jnp.flip(toks, axis=0)
+
+
+def gather_tree(ids, parents):
+    """Beam-search ancestry walk (reference gather_tree op): rebuild full
+    token paths from per-step ids + parent beam indices."""
+    return _gather_tree(ids, parents)

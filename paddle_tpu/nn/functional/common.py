@@ -357,7 +357,14 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                return_mask=False, data_format="NCHW", name=None):
     ks = _pair(kernel_size)
     st = _pair(stride) if stride is not None else ks
-    return _max_pool2d(x, ksize=ks, stride=st, padding=_pair(padding), nchw=data_format == "NCHW")
+    out = _max_pool2d(x, ksize=ks, stride=st, padding=_pair(padding),
+                      nchw=data_format == "NCHW")
+    if return_mask:
+        if data_format != "NCHW":
+            raise ValueError("max_pool2d return_mask requires NCHW")
+        return out, _max_pool_nd_mask(x, ksize=ks, stride=st,
+                                      padding=_pair(padding))
+    return out
 
 
 @primitive("avg_pool2d_op")
@@ -538,3 +545,429 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
     from ...ops import manipulation
 
     return manipulation.pad(x, pad, mode, value, data_format)
+
+
+# -- 1-D / 3-D pooling + conv family (round-3 API completion) ----------------
+# One generic N-spatial-dim reduce_window body serves every rank; the 2-D
+# code above predates it and stays as-is (hot path, already tuned).
+
+def _tuple_n(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),) * n
+
+
+@primitive("pool_nd_op")
+def _pool_nd(x, *, ksize, stride, padding, kind, count_include_pad):
+    nd = len(ksize)
+    window = (1, 1) + ksize
+    strides = (1, 1) + stride
+    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in padding)
+    if kind == "max":
+        return jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, window,
+                                     strides, pads)
+    summed = jax.lax.reduce_window(x, 0.0, jax.lax.add, window, strides, pads)
+    if count_include_pad or all(p == 0 for p in padding):
+        return summed / np.prod(ksize)
+    counts = jax.lax.reduce_window(jnp.ones_like(x), 0.0, jax.lax.add,
+                                   window, strides, pads)
+    return summed / counts
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    ks = _tuple_n(kernel_size, 1)
+    st = _tuple_n(stride, 1) if stride is not None else ks
+    out = _pool_nd(x, ksize=ks, stride=st, padding=_tuple_n(padding, 1),
+                   kind="max", count_include_pad=True)
+    if return_mask:
+        return out, _max_pool_nd_mask(x, ksize=ks, stride=st,
+                                      padding=_tuple_n(padding, 1))
+    return out
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    ks = _tuple_n(kernel_size, 1)
+    st = _tuple_n(stride, 1) if stride is not None else ks
+    return _pool_nd(x, ksize=ks, stride=st, padding=_tuple_n(padding, 1),
+                    kind="avg", count_include_pad=not exclusive)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    ks = _tuple_n(kernel_size, 3)
+    st = _tuple_n(stride, 3) if stride is not None else ks
+    out = _pool_nd(x, ksize=ks, stride=st, padding=_tuple_n(padding, 3),
+                   kind="max", count_include_pad=True)
+    if return_mask:
+        return out, _max_pool_nd_mask(x, ksize=ks, stride=st,
+                                      padding=_tuple_n(padding, 3))
+    return out
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW",
+               name=None):
+    ks = _tuple_n(kernel_size, 3)
+    st = _tuple_n(stride, 3) if stride is not None else ks
+    return _pool_nd(x, ksize=ks, stride=st, padding=_tuple_n(padding, 3),
+                    kind="avg", count_include_pad=not exclusive)
+
+
+@primitive("max_pool_nd_mask_op", nondiff=True)
+def _max_pool_nd_mask(x, *, ksize, stride, padding):
+    """Flattened spatial argmax index per window (paddle's unpool mask)."""
+    nd = len(ksize)
+    spatial = x.shape[2:]
+    flat_sizes = np.array(spatial)
+    # linear index of every input position
+    lin = jnp.arange(int(np.prod(spatial))).reshape(spatial)
+    lin = jnp.broadcast_to(lin, x.shape)
+    if any(padding):
+        padcfg = [(0, 0), (0, 0)] + [(p, p) for p in padding]
+        xp = jnp.pad(x, padcfg, constant_values=-jnp.inf)
+        linp = jnp.pad(lin, padcfg, constant_values=-1)
+    else:
+        xp, linp = x, lin
+    window = (1, 1) + tuple(ksize)
+    strides = (1, 1) + tuple(stride)
+    # argmax via reduce_window over (value, index) pairs
+    def sel(a, b):
+        av, ai = a
+        bv, bi = b
+        take_b = bv > av
+        return jnp.where(take_b, bv, av), jnp.where(take_b, bi, ai)
+    vals, idxs = jax.lax.reduce_window(
+        (xp, linp.astype(jnp.int32)), (-jnp.inf, jnp.int32(-1)), sel,
+        window, strides, [(0, 0)] * (nd + 2))
+    return idxs
+
+
+@primitive("max_unpool_nd_op")
+def _max_unpool_nd(x, indices, *, out_spatial):
+    n, c = x.shape[:2]
+    flat = int(np.prod(out_spatial))
+    xf = x.reshape(n, c, -1)
+    idx = indices.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, flat), x.dtype)
+    bi = jnp.arange(n)[:, None, None]
+    ci = jnp.arange(c)[None, :, None]
+    out = out.at[bi, ci, idx].set(xf)
+    return out.reshape((n, c) + out_spatial)
+
+
+def _unpool(x, indices, kernel_size, stride, padding, output_size, nd):
+    ks = _tuple_n(kernel_size, nd)
+    st = _tuple_n(stride, nd) if stride is not None else ks
+    if output_size is None:
+        out_spatial = tuple(
+            (s - 1) * st[i] + ks[i] - 2 * _tuple_n(padding, nd)[i]
+            for i, s in enumerate(x.shape[2:]))
+    else:
+        out_spatial = tuple(int(d) for d in output_size[-nd:])
+    return _max_unpool_nd(x, indices, out_spatial=out_spatial)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 1)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 2)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, kernel_size, stride, padding, output_size, 3)
+
+
+def _adaptive_pool_nd(x, out_sizes, reduce_fn):
+    spatial = x.shape[2:]
+    if all(s % o == 0 for s, o in zip(spatial, out_sizes)):
+        shape = list(x.shape[:2])
+        axes = []
+        for i, (s, o) in enumerate(zip(spatial, out_sizes)):
+            shape += [o, s // o]
+            axes.append(2 + 2 * i + 1)
+        return reduce_fn(x.reshape(shape), tuple(axes))
+    # general bins: recursive per-dim construction (rare path, small outputs)
+    def build(prefix_idx, t):
+        dim = len(prefix_idx)
+        if dim == len(out_sizes):
+            return reduce_fn(t, tuple(range(2, 2 + len(out_sizes))))
+        res = []
+        for a, b in _adaptive_bins(t.shape[2 + dim], out_sizes[dim]):
+            idx = [slice(None)] * t.ndim
+            idx[2 + dim] = slice(a, b)
+            res.append(build(prefix_idx + (0,), t[tuple(idx)]))
+        return jnp.stack(res, axis=2 + dim)
+    return build((), x)
+
+
+@primitive("adaptive_pool_nd_op")
+def _adaptive_pool_nd_prim(x, *, out_sizes, kind):
+    fn = {"avg": lambda v, ax: jnp.mean(v, axis=ax),
+          "max": lambda v, ax: jnp.max(v, axis=ax)}[kind]
+    return _adaptive_pool_nd(x, out_sizes, fn)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool_nd_prim(x, out_sizes=_tuple_n(output_size, 1),
+                                  kind="avg")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd_prim(x, out_sizes=_tuple_n(output_size, 1),
+                                 kind="max")
+    if return_mask:
+        raise ValueError("adaptive_max_pool1d return_mask: use "
+                         "adaptive_max_pool2d on an unsqueezed input")
+    return out
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool_nd_prim(x, out_sizes=_tuple_n(output_size, 3),
+                                  kind="avg")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    out = _adaptive_pool_nd_prim(x, out_sizes=_tuple_n(output_size, 3),
+                                 kind="max")
+    if return_mask:
+        raise ValueError("adaptive_max_pool3d return_mask is not provided; "
+                         "derive indices via max_pool3d(return_mask=True)")
+    return out
+
+
+@primitive("conv3d_op")
+def _conv3d(x, w, *, stride, padding, dilation, groups):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=stride, padding=[(p, p) for p in padding],
+        rhs_dilation=dilation, feature_group_count=groups,
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    out = _conv3d(x, weight, stride=_tuple_n(stride, 3),
+                  padding=_tuple_n(padding, 3),
+                  dilation=_tuple_n(dilation, 3), groups=int(groups))
+    if bias is not None:
+        from ...ops import manipulation
+
+        out = out + manipulation.reshape(bias, [1, -1, 1, 1, 1])
+    return out
+
+
+@primitive("conv_transpose_nd_op")
+def _conv_transpose_nd(x, w, *, stride, padding, dilation, out_pad, groups):
+    nd = len(stride)
+    g = groups
+    cin = w.shape[0]
+    cog = w.shape[1]
+    k = w.shape[2:]
+    w = jnp.flip(w, axis=tuple(range(2, 2 + nd)))
+    w = w.reshape((g, cin // g, cog) + k)
+    w = jnp.moveaxis(w, 2, 1).reshape((g * cog, cin // g) + k)
+    pads = [
+        (dilation[i] * (k[i] - 1) - padding[i],
+         dilation[i] * (k[i] - 1) - padding[i] + out_pad[i])
+        for i in range(nd)
+    ]
+    spec = "NC" + "DHW"[-nd:]
+    wspec = "OI" + "DHW"[-nd:]
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1,) * nd, padding=pads, lhs_dilation=stride,
+        rhs_dilation=dilation, feature_group_count=g,
+        dimension_numbers=(spec, wspec, spec))
+
+
+def _out_pad_from_size(x, weight, output_size, st, pd, dl, nd):
+    """Same conversion conv2d_transpose does: requested output size ->
+    output_padding, validated against the [min, min+stride) legal range."""
+    if isinstance(output_size, Tensor):
+        output_size = output_size.tolist()
+    osz = _tuple_n(output_size, nd)
+    ks = weight.shape[2:]
+    op = tuple(
+        osz[i] - ((x.shape[2 + i] - 1) * st[i] - 2 * pd[i]
+                  + dl[i] * (ks[i] - 1) + 1)
+        for i in range(nd))
+    for i in range(nd):
+        if not 0 <= op[i] < st[i]:
+            raise ValueError(
+                f"output_size[{i}]={osz[i]} is out of the legal range "
+                "[min, min+stride) for the given input/kernel/stride")
+    return op
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    st, pd, dl = _tuple_n(stride, 1), _tuple_n(padding, 1), _tuple_n(dilation, 1)
+    op = _tuple_n(output_padding, 1)
+    if output_size is not None:
+        if op != (0,):
+            raise ValueError("output_padding and output_size can not be both set")
+        op = _out_pad_from_size(x, weight, output_size, st, pd, dl, 1)
+    out = _conv_transpose_nd(
+        x, weight, stride=st, padding=pd, dilation=dl, out_pad=op,
+        groups=int(groups))
+    if bias is not None:
+        from ...ops import manipulation
+
+        out = out + manipulation.reshape(bias, [1, -1, 1])
+    return out
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    st, pd, dl = _tuple_n(stride, 3), _tuple_n(padding, 3), _tuple_n(dilation, 3)
+    op = _tuple_n(output_padding, 3)
+    if output_size is not None:
+        if op != (0, 0, 0):
+            raise ValueError("output_padding and output_size can not be both set")
+        op = _out_pad_from_size(x, weight, output_size, st, pd, dl, 3)
+    out = _conv_transpose_nd(
+        x, weight, stride=st, padding=pd, dilation=dl, out_pad=op,
+        groups=int(groups))
+    if bias is not None:
+        from ...ops import manipulation
+
+        out = out + manipulation.reshape(bias, [1, -1, 1, 1, 1])
+    return out
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    """Whole-channel dropout for 5-D inputs (reference dropout3d)."""
+    if not training or p == 0.0:
+        return x
+    from ...framework import random as random_mod
+    from ...ops import creation
+
+    keep = creation.rand([x.shape[0], x.shape[1], 1, 1, 1]) >= p
+    from ...ops import manipulation as _m
+
+    mask = _m.cast(keep, str(x.dtype)) / (1.0 - p)
+    return x * mask
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    """SELU-preserving dropout (reference alpha_dropout): keeps mean/var of
+    self-normalizing activations."""
+    if not training or p == 0.0:
+        return x
+    from ...ops import creation, manipulation as _m
+    import math as _math
+
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    keep = creation.rand(list(x.shape)) >= p
+    mask = _m.cast(keep, str(x.dtype))
+    a = (1.0 / _math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) \
+        if (1 - p) * (1 + p * alpha_p ** 2) > 0 else 1.0
+    b = -a * alpha_p * p
+    return a * (x * mask + alpha_p * (1.0 - mask)) + b
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    """AlexNet LRN across channels (reference local_response_norm)."""
+    sq = x * x
+    from ...ops import manipulation as _m
+
+    pad_lo = (size - 1) // 2
+    pad_hi = size - 1 - pad_lo
+    sq_sum = _lrn_sum(sq, pad_lo=pad_lo, pad_hi=pad_hi, size=size)
+    return x / (k + alpha * sq_sum) ** beta
+
+
+@primitive("lrn_sum_op")
+def _lrn_sum(sq, *, pad_lo, pad_hi, size):
+    padded = jnp.pad(sq, [(0, 0), (pad_lo, pad_hi)] +
+                     [(0, 0)] * (sq.ndim - 2))
+    return jax.lax.reduce_window(
+        padded, 0.0, jax.lax.add, (1, size) + (1,) * (sq.ndim - 2),
+        (1,) * sq.ndim, [(0, 0)] * sq.ndim)
+
+
+@primitive("bilinear_op")
+def _bilinear(x1, x2, w, b):
+    # w: [out, in1, in2] -> out[n,o] = x1[n,i] w[o,i,j] x2[n,j] + b
+    out = jnp.einsum("ni,oij,nj->no", x1, w, x2)
+    return out + b if b is not None else out
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    if bias is None:
+        from ...ops import creation
+
+        bias = creation.zeros([1, weight.shape[0]], str(weight.dtype))
+    return _bilinear(x1, x2, weight, bias)
+
+
+
+@primitive("sequence_mask_op", nondiff=True)
+def _sequence_mask(lengths, *, maxlen):
+    return (jnp.arange(maxlen)[None, :] <
+            lengths.reshape(-1, 1)).astype(jnp.int64).reshape(
+        tuple(lengths.shape) + (maxlen,))
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[..., L] 0/1 mask from lengths (reference sequence_mask op)."""
+    from ...ops import manipulation as _m
+
+    if maxlen is None:
+        import numpy as np
+
+        maxlen = int(np.asarray(x.numpy()).max())
+    out = _sequence_mask(x, maxlen=int(maxlen))
+    return _m.cast(out, dtype)
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0,
+               data_format=data_format)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Block-sparse attention (reference sparse_attention op, CUDA-only).
+
+    TPU stance: XLA has no CSR attention lowering; the supported sparse
+    pattern on TPU is blockwise flash attention (kernels/flash_attention) or
+    ring attention for long context. Raises with that pointer."""
+    raise ValueError(
+        "sparse_attention's CSR kernel is CUDA-specific; on TPU use "
+        "F.scaled_dot_product_attention (flash kernel) or "
+        "distributed.context_parallel ring/ulysses attention")
+
+
+def relu_(x, name=None):
+    from .activation import relu
+
+    out = relu(x)
+    x._rebind(out)
+    return x
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    from .activation import softmax
+
+    out = softmax(x, axis)
+    x._rebind(out)
+    return x
+
+
+def tanh_(x, name=None):
+    from ...ops import math as _math
+
+    out = _math.tanh(x)
+    x._rebind(out)
+    return x

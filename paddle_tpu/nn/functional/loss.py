@@ -6,6 +6,7 @@ fused primitive so XLA keeps it on-device in one kernel cluster.
 from __future__ import annotations
 
 import jax
+import numpy as np
 import jax.numpy as jnp
 
 from ...core.dispatch import primitive
@@ -272,3 +273,213 @@ def _square_error_cost(x, y):
 
 def square_error_cost(input, label):
     return _square_error_cost(input, label)
+
+
+# -- round-3 loss completion --------------------------------------------------
+
+@primitive("ctc_loss_op")
+def _ctc_loss(log_probs, labels, input_lengths, label_lengths, *, blank):
+    import optax
+
+    # paddle layout [T, B, K] -> optax [B, T, K]; optax uses blank=0 by
+    # default and paddle allows arbitrary blank: roll the class axis so the
+    # blank lands at position `blank` for optax's blank_id parameter
+    lp = jnp.transpose(log_probs, (1, 0, 2))
+    B, T = lp.shape[0], lp.shape[1]
+    logit_pad = (jnp.arange(T)[None, :] >= input_lengths[:, None]) \
+        .astype(lp.dtype)
+    L = labels.shape[1]
+    label_pad = (jnp.arange(L)[None, :] >= label_lengths[:, None]) \
+        .astype(lp.dtype)
+    return optax.ctc_loss(lp, logit_pad, labels, label_pad, blank_id=blank)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False, name=None):
+    """CTC (reference warpctc op, nn/functional/loss.py ctc_loss): forward
+    algorithm over the [T, B, K] log-prob lattice."""
+    per = _ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                    blank=int(blank))
+    if reduction == "mean":
+        from ...ops import manipulation as _m
+
+        ll = _m.cast(label_lengths, str(per.dtype))
+        return (per / ll).mean()
+    if reduction == "sum":
+        return per.sum()
+    return per
+
+
+@primitive("dice_loss_op")
+def _dice_loss(input, label, *, epsilon):
+    # input [N, ..., C] probabilities; label [N, ..., 1] class ids
+    lab = jax.nn.one_hot(label[..., 0], input.shape[-1], dtype=input.dtype)
+    reduce_dims = tuple(range(1, input.ndim))
+    inse = jnp.sum(input * lab, axis=reduce_dims)
+    dice_denom = jnp.sum(input, axis=reduce_dims) + jnp.sum(lab, axis=reduce_dims)
+    dice = 1.0 - 2.0 * inse / (dice_denom + epsilon)
+    return jnp.mean(dice)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    return _dice_loss(input, label, epsilon=float(epsilon))
+
+
+@primitive("log_loss_op")
+def _log_loss(input, label, *, epsilon):
+    return -label * jnp.log(input + epsilon) \
+        - (1.0 - label) * jnp.log(1.0 - input + epsilon)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return _log_loss(input, label, epsilon=float(epsilon))
+
+
+@primitive("label_smooth_op")
+def _label_smooth(label, *, epsilon):
+    return (1.0 - epsilon) * label + epsilon / label.shape[-1]
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    if prior_dist is not None:
+        eps = float(epsilon)
+        return (1.0 - eps) * label + eps * prior_dist
+    return _label_smooth(label, epsilon=float(epsilon))
+
+
+@primitive("hsigmoid_loss_op")
+def _hsigmoid_loss(x, labels, w, b, *, num_classes):
+    """Default complete-binary-tree hierarchical softmax (reference
+    hierarchical_sigmoid_op): class c's path follows the binary digits of
+    c + num_classes down from the root; internal node i uses w[i-1]."""
+    code_len = int(np.ceil(np.log2(num_classes)))
+    codes = labels + num_classes  # node path encoded in binary
+    loss = jnp.zeros(x.shape[0], x.dtype)
+    for d in range(code_len, 0, -1):
+        node = codes >> d  # ancestor at depth (from root)
+        bit = (codes >> (d - 1)) & 1  # which child we descend into
+        valid = node >= 1
+        widx = jnp.clip(node - 1, 0, num_classes - 2)
+        logits = jnp.sum(x * w[widx], axis=-1) + b[widx]
+        # bit==1 -> right child: target 0/1 convention follows the sign trick
+        t = bit.astype(x.dtype)
+        bce = jnp.maximum(logits, 0) - logits * t + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        loss = loss + jnp.where(valid, bce, 0.0)
+    return loss
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    if path_table is not None or path_code is not None:
+        raise ValueError(
+            "hsigmoid_loss custom path tables are not supported; the default "
+            "complete-binary-tree coding is")
+    if bias is None:
+        from ...ops import creation
+
+        bias = creation.zeros([num_classes - 1], str(input.dtype))
+    per = _hsigmoid_loss(input, label, weight, bias,
+                         num_classes=int(num_classes))
+    return per.mean()
+
+
+@primitive("margin_cross_entropy_op")
+def _margin_ce(logits, label, *, m1, m2, m3, s):
+    # logits are cosines; apply the combined ArcFace/CosFace margin to the
+    # target class then scale and softmax-CE
+    theta = jnp.arccos(jnp.clip(logits, -1.0 + 1e-7, 1.0 - 1e-7))
+    target = jax.nn.one_hot(label, logits.shape[-1], dtype=logits.dtype)
+    margin_cos = jnp.cos(m1 * theta + m2) - m3
+    adjusted = jnp.where(target > 0, margin_cos, logits) * s
+    logp = jax.nn.log_softmax(adjusted, axis=-1)
+    loss = -jnp.sum(target * logp, axis=-1)
+    return loss, jax.nn.softmax(adjusted, axis=-1)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace margin softmax (reference margin_cross_entropy op)."""
+    loss, softmax = _margin_ce(logits, label, m1=float(margin1),
+                               m2=float(margin2), m3=float(margin3),
+                               s=float(scale))
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, softmax
+    return loss
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample class centers: the positives plus random negatives up to
+    num_samples (reference class_center_sample op). Host-side sampling —
+    eager only, like the reference's CPU path."""
+    import numpy as np
+
+    from ...core.tensor import Tensor as _T
+    from ...ops import creation
+
+    lab = np.asarray(label.numpy() if hasattr(label, "numpy") else label)
+    pos = np.unique(lab)
+    if len(pos) > num_samples:
+        raise ValueError(
+            f"class_center_sample: num_samples={num_samples} is smaller than "
+            f"the {len(pos)} distinct positive classes in the batch; every "
+            "positive must be kept (reference contract)")
+    if len(pos) == num_samples:
+        sampled = pos
+    else:
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = np.random.choice(neg_pool, num_samples - len(pos),
+                                 replace=False)
+        sampled = np.concatenate([pos, extra])
+    remap = -np.ones(num_classes, "int64")
+    remap[sampled] = np.arange(len(sampled))
+    return (creation.to_tensor(remap[lab]),
+            creation.to_tensor(sampled.astype("int64")))
+
+
+@primitive("npair_loss_op")
+def _npair_loss(anchor, positive, labels, *, l2_reg):
+    batch = anchor.shape[0]
+    sim = jnp.matmul(anchor, positive.T)
+    lab = labels.reshape(-1)
+    target = (lab[:, None] == lab[None, :]).astype(anchor.dtype)
+    target = target / jnp.sum(target, axis=1, keepdims=True)
+    logp = jax.nn.log_softmax(sim, axis=1)
+    ce = -jnp.mean(jnp.sum(target * logp, axis=1))
+    reg = l2_reg * (jnp.mean(jnp.sum(anchor * anchor, 1))
+                    + jnp.mean(jnp.sum(positive * positive, 1))) * 0.25
+    return ce + reg
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (reference npair_loss)."""
+    return _npair_loss(anchor, positive, labels, l2_reg=float(l2_reg))
+
+
+@primitive("sigmoid_focal_loss_op")
+def _sigmoid_focal_loss(logit, label, norm, *, alpha, gamma, reduction):
+    p = jax.nn.sigmoid(logit)
+    ce = jnp.maximum(logit, 0) - logit * label + \
+        jnp.log1p(jnp.exp(-jnp.abs(logit)))
+    p_t = p * label + (1 - p) * (1 - label)
+    a_t = alpha * label + (1 - alpha) * (1 - label)
+    loss = a_t * ((1.0 - p_t) ** gamma) * ce
+    loss = loss / norm
+    return _reduce(loss, reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    """RetinaNet focal loss (reference sigmoid_focal_loss)."""
+    from ...ops import creation
+
+    if normalizer is None:
+        normalizer = creation.ones([1], str(logit.dtype))
+    return _sigmoid_focal_loss(logit, label, normalizer, alpha=float(alpha),
+                               gamma=float(gamma), reduction=reduction)

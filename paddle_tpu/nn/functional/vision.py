@@ -196,3 +196,25 @@ def _pairwise_distance(x, y, *, p, epsilon, keepdim):
 def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
     return _pairwise_distance(x, y, p=float(p), epsilon=float(epsilon),
                               keepdim=bool(keepdim))
+
+
+@primitive("temporal_shift_op")
+def _temporal_shift(x, *, seg_num, shift_ratio):
+    # x: [N*T, C, H, W] -> shift 1/r channels backward, 1/r forward in time
+    nt, c, h, w = x.shape
+    n = nt // seg_num
+    xt = x.reshape(n, seg_num, c, h, w)
+    fold = int(c * shift_ratio)
+    back = jnp.concatenate([xt[:, 1:, :fold], jnp.zeros_like(xt[:, :1, :fold])], 1)
+    fwd = jnp.concatenate([jnp.zeros_like(xt[:, :1, fold:2 * fold]),
+                           xt[:, :-1, fold:2 * fold]], 1)
+    rest = xt[:, :, 2 * fold:]
+    out = jnp.concatenate([back, fwd, rest], axis=2)
+    return out.reshape(nt, c, h, w)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, name=None,
+                   data_format="NCHW"):
+    """TSM temporal shift (reference temporal_shift_op)."""
+    return _temporal_shift(x, seg_num=int(seg_num),
+                           shift_ratio=float(shift_ratio))

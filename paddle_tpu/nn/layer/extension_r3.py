@@ -1,0 +1,476 @@
+"""Round-3 layer-zoo completion: the 1-D/3-D variants, unpooling, padding,
+alpha dropout, hierarchical-sigmoid/CTC losses, and beam-search decoding the
+reference exports from paddle.nn (python/paddle/nn/__init__.py) that were
+still missing. Thin Layer wrappers over nn.functional — the math lives there.
+"""
+from __future__ import annotations
+
+import math
+
+from .. import functional as F
+from .. import initializer as I
+from .layers import Layer
+from .conv import _ConvNd
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, weight_attr, bias_attr, 3)
+
+    def forward(self, x):
+        return F.conv3d(x, self.weight, self.bias, self._stride,
+                        self._padding, self._dilation, self._groups)
+
+
+class _ConvTransposeNd(Layer):
+    def __init__(self, in_channels, out_channels, kernel_size, stride,
+                 padding, output_padding, groups, dilation, weight_attr,
+                 bias_attr, ndim):
+        super().__init__()
+        if isinstance(kernel_size, int):
+            kernel_size = (kernel_size,) * ndim
+        self._stride = stride
+        self._padding = padding
+        self._output_padding = output_padding
+        self._groups = groups
+        self._dilation = dilation
+        fan_in = in_channels * int(math.prod(kernel_size))
+        self.weight = self.create_parameter(
+            [in_channels, out_channels // groups, *kernel_size],
+            attr=weight_attr, default_initializer=I.KaimingUniform(fan_in=fan_in))
+        if bias_attr is False:
+            self.bias = None
+            self._parameters["bias"] = None
+        else:
+            bound = 1.0 / math.sqrt(max(fan_in, 1))
+            self.bias = self.create_parameter(
+                [out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+
+
+class Conv1DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, groups, dilation,
+                         weight_attr, bias_attr, 1)
+
+    def forward(self, x, output_size=None):
+        return F.conv1d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation, output_size)
+
+
+class Conv3DTranspose(_ConvTransposeNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(in_channels, out_channels, kernel_size, stride,
+                         padding, output_padding, groups, dilation,
+                         weight_attr, bias_attr, 3)
+
+    def forward(self, x, output_size=None):
+        return F.conv3d_transpose(x, self.weight, self.bias, self._stride,
+                                  self._padding, self._output_padding,
+                                  self._groups, self._dilation, output_size)
+
+
+def _pool_layer(fname, ndims_kw=None):
+    class _Pool(Layer):
+        def __init__(self, kernel_size, stride=None, padding=0,
+                     ceil_mode=False, return_mask=False, exclusive=True,
+                     divisor_override=None, data_format=None, name=None):
+            super().__init__()
+            self.kernel_size = kernel_size
+            self.stride = stride
+            self.padding = padding
+            self.return_mask = return_mask
+            self.exclusive = exclusive
+            self._fn = getattr(F, fname)
+            self._is_max = fname.startswith("max")
+
+        def forward(self, x):
+            if self._is_max:
+                return self._fn(x, self.kernel_size, self.stride,
+                                self.padding, return_mask=self.return_mask)
+            return self._fn(x, self.kernel_size, self.stride, self.padding,
+                            exclusive=self.exclusive)
+
+    _Pool.__name__ = "".join(w.capitalize() for w in fname.split("_"))
+    return _Pool
+
+
+MaxPool1D = _pool_layer("max_pool1d")
+AvgPool1D = _pool_layer("avg_pool1d")
+MaxPool3D = _pool_layer("max_pool3d")
+AvgPool3D = _pool_layer("avg_pool3d")
+
+
+class _AdaptivePool(Layer):
+    def __init__(self, output_size, fname, return_mask=False):
+        super().__init__()
+        self.output_size = output_size
+        self.return_mask = return_mask
+        self._fn = getattr(F, fname)
+        self._is_max = "max" in fname
+
+    def forward(self, x):
+        if self._is_max:
+            return self._fn(x, self.output_size,
+                            return_mask=self.return_mask)
+        return self._fn(x, self.output_size)
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    def __init__(self, output_size, name=None):
+        super().__init__(output_size, "adaptive_avg_pool1d")
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "adaptive_max_pool1d", return_mask)
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__(output_size, "adaptive_avg_pool3d")
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__(output_size, "adaptive_max_pool3d", return_mask)
+
+
+class _MaxUnPool(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0, fname="",
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.output_size = output_size
+        self._fn = getattr(F, fname)
+
+    def forward(self, x, indices):
+        return self._fn(x, indices, self.kernel_size, self.stride,
+                        self.padding, output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, "max_unpool1d",
+                         output_size=output_size)
+
+
+class MaxUnPool2D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, "max_unpool2d",
+                         output_size=output_size)
+
+
+class MaxUnPool3D(_MaxUnPool):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(kernel_size, stride, padding, "max_unpool3d",
+                         output_size=output_size)
+
+
+class _PadNd(Layer):
+    def __init__(self, padding, mode, value, data_format):
+        super().__init__()
+        self._padding = padding
+        self._mode = mode
+        self._value = value
+        self._data_format = data_format
+
+    def forward(self, x):
+        return F.pad(x, self._padding, self._mode, self._value,
+                     self._data_format)
+
+
+class Pad1D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCL", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Pad3D(_PadNd):
+    def __init__(self, padding, mode="constant", value=0.0,
+                 data_format="NCDHW", name=None):
+        super().__init__(padding, mode, value, data_format)
+
+
+class Dropout3D(Layer):
+    def __init__(self, p=0.5, data_format="NCDHW", name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.dropout3d(x, self.p, training=self.training)
+
+
+class AlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.alpha_dropout(x, self.p, training=self.training)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Fold(Layer):
+    def __init__(self, output_sizes, kernel_sizes, strides=1, paddings=0,
+                 dilations=1, name=None):
+        super().__init__()
+        self._args = (output_sizes, kernel_sizes, strides, paddings,
+                      dilations)
+
+    def forward(self, x):
+        return F.fold(x, *self._args)
+
+
+class InstanceNorm1D(Layer):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCL",
+                 name=None):
+        super().__init__()
+        self._epsilon = epsilon
+        self.scale = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter([num_features], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x):
+        return F.instance_norm(x, weight=self.scale, bias=self.bias,
+                               eps=self._epsilon)
+
+
+class InstanceNorm3D(InstanceNorm1D):
+    def __init__(self, num_features, epsilon=1e-5, momentum=0.9,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(num_features, epsilon, momentum, weight_attr,
+                         bias_attr)
+
+
+class CTCLoss(Layer):
+    def __init__(self, blank=0, reduction="mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths):
+        return F.ctc_loss(log_probs, labels, input_lengths, label_lengths,
+                          self.blank, self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        if is_custom:
+            raise ValueError("HSigmoidLoss custom trees are not supported; "
+                             "the default complete-binary-tree coding is")
+        self.num_classes = num_classes
+        bound = 1.0 / math.sqrt(feature_size)
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr,
+            default_initializer=I.Uniform(-bound, bound))
+        self.bias = self.create_parameter([num_classes - 1], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, input, label):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias)
+
+
+class BeamSearchDecoder:
+    """Beam-search decoder over an RNN cell (reference nn/decode.py:77).
+
+    Greedy-expand beams each step using the cell; drive with the module-level
+    dynamic_decode below. Python-loop decoding (eager), matching the
+    reference's dynamic_decode while-op semantics at beam_size fan-out.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, inits, max_step_num=16):
+        """Returns (token ids [B, beam, T], scores [B, beam])."""
+        import numpy as np
+
+        from ...ops import creation
+
+        import paddle_tpu as paddle
+
+        # step 0: expand the start token into beam_size beams per row
+        inp = self._embed_ids(None, inits)
+        out, state = self.cell(inp, inits)
+        logits = self.output_fn(out) if self.output_fn else out
+        lp = np.asarray(
+            paddle.nn.functional.log_softmax(logits, axis=-1).numpy())
+        B = lp.shape[0]
+        top = np.argsort(-lp, axis=-1)[:, : self.beam_size]
+        # beams[b] = list of (tokens, score, state, finished)
+        beams = [[([int(top[b, k])], float(lp[b, top[b, k]]), state,
+                   int(top[b, k]) == self.end_token)
+                  for k in range(self.beam_size)] for b in range(B)]
+
+        for _ in range(1, max_step_num):
+            if all(fin for bs in beams for *_x, fin in bs):
+                break
+            # ONE batched cell call per beam slot: rows advance together
+            expansions = [[] for _ in range(B)]
+            for k in range(self.beam_size):
+                tokens = np.array([beams[b][k][0][-1] for b in range(B)],
+                                  "int64")
+                slot_state = beams[0][k][2]  # states are row-batched arrays
+                inp = self._embed_ids(tokens, inits)
+                out, st2 = self.cell(inp, slot_state)
+                logits = self.output_fn(out) if self.output_fn else out
+                lp = np.asarray(
+                    paddle.nn.functional.log_softmax(logits, axis=-1).numpy())
+                for b in range(B):
+                    toks, score, _st, fin = beams[b][k]
+                    if fin:
+                        expansions[b].append((toks, score, _st, True))
+                        continue
+                    for t in np.argsort(-lp[b])[: self.beam_size]:
+                        expansions[b].append(
+                            (toks + [int(t)], score + float(lp[b, t]), st2,
+                             int(t) == self.end_token))
+            for b in range(B):
+                expansions[b].sort(key=lambda c: -c[1])
+                beams[b] = expansions[b][: self.beam_size]
+
+        T = max(len(toks) for bs in beams for toks, *_x in bs)
+        ids = np.full((B, self.beam_size, T), self.end_token, "int64")
+        scores = np.zeros((B, self.beam_size), "float32")
+        for b in range(B):
+            for k, (toks, score, *_x) in enumerate(beams[b]):
+                ids[b, k, : len(toks)] = toks
+                scores[b, k] = score
+        return creation.to_tensor(ids), creation.to_tensor(scores)
+
+    def _embed_ids(self, tokens, inits):
+        """Batched embedding of one token per row (None = start token)."""
+        import numpy as np
+
+        from ...ops import creation
+
+        ref = inits[0] if isinstance(inits, (list, tuple)) else inits
+        batch = ref.shape[0]
+        if tokens is None:
+            tokens = np.full((batch,), self.start_token, "int64")
+        ids = creation.to_tensor(np.asarray(tokens, "int64"))
+        if self.embedding_fn is not None:
+            return self.embedding_fn(ids)
+        return ids
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=16, **kwargs):
+    """reference nn/decode.py dynamic_decode over a BeamSearchDecoder."""
+    return decoder.decode(inits, max_step_num=max_step_num)
+
+
+class Unfold(Layer):
+    def __init__(self, kernel_sizes, strides=1, paddings=0, dilations=1,
+                 name=None):
+        super().__init__()
+        self._args = (kernel_sizes, strides, paddings, dilations)
+
+    def forward(self, x):
+        return F.unfold(x, *self._args)
+
+
+class ZeroPad2D(_PadNd):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class UpsamplingNearest2D(Layer):
+    def __init__(self, size=None, scale_factor=None, data_format="NCHW",
+                 name=None):
+        super().__init__()
+        self.size, self.scale_factor = size, scale_factor
+
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "nearest")
+
+
+class UpsamplingBilinear2D(UpsamplingNearest2D):
+    def forward(self, x):
+        return F.interpolate(x, self.size, self.scale_factor, "bilinear",
+                             align_corners=True)
+
+
+class SpectralNorm(Layer):
+    """Standalone spectral-norm layer (reference nn/layer/norm.py
+    SpectralNorm): power-iterates on a held weight and returns the
+    normalized weight (the hook-based variant is nn.utils.spectral_norm)."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 name=None, dtype="float32"):
+        super().__init__()
+        self._dim = dim
+        self._power_iters = power_iters
+        self._eps = eps
+        import numpy as np
+
+        h = weight_shape[dim]
+        w = int(np.prod(weight_shape)) // h
+        self.weight_u = self.create_parameter(
+            [h], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_v = self.create_parameter(
+            [w], default_initializer=I.Normal(0.0, 1.0))
+        self.weight_u.stop_gradient = True
+        self.weight_v.stop_gradient = True
+
+    def forward(self, weight):
+        import paddle_tpu as paddle
+
+        dim = self._dim
+        mat = weight
+        if dim != 0:
+            perm = [dim] + [d for d in range(weight.ndim) if d != dim]
+            mat = paddle.transpose(mat, perm)
+        h = mat.shape[0]
+        mat2 = paddle.reshape(mat, [h, -1])
+        u, v = self.weight_u, self.weight_v
+        for _ in range(self._power_iters):
+            v_new = paddle.matmul(mat2, u, transpose_x=True)
+            v = v_new / (paddle.norm(v_new) + self._eps)
+            u_new = paddle.matmul(mat2, v)
+            u = u_new / (paddle.norm(u_new) + self._eps)
+        sigma = (u * paddle.matmul(mat2, v)).sum()
+        out = mat2 / sigma
+        out = paddle.reshape(out, list(mat.shape))
+        if dim != 0:
+            inv = [0] * weight.ndim
+            perm = [dim] + [d for d in range(weight.ndim) if d != dim]
+            for i, p in enumerate(perm):
+                inv[p] = i
+            out = paddle.transpose(out, inv)
+        return out

@@ -324,3 +324,44 @@ def multinomial(x, num_samples=1, replacement=False, name=None):
     return _multinomial(
         Tensor(logp), random_mod.next_key(), num_samples=int(num_samples), replacement=bool(replacement)
     )
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    """Random ints with x's shape (reference randint_like)."""
+    return randint(low, high, tuple(int(d) for d in x.shape),
+                   dtype=dtype or str(x.dtype))
+
+
+@primitive("poisson_op", nondiff=True)
+def _poisson(key, x):
+    return jax.random.poisson(key, x, dtype=jnp.int32).astype(x.dtype)
+
+
+def poisson(x, name=None):
+    """Element-wise Poisson draw with rate x (reference poisson op); the
+    PRNG key rides as a traced operand so repeated calls reuse one compile
+    (same pattern as _uniform above)."""
+    return _poisson(random_mod.next_key(), x)
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Free-standing Parameter (reference layers/tensor.py create_parameter)."""
+    from ..nn.layer.layers import Parameter
+    from ..framework import random as random_mod
+
+    if default_initializer is not None:
+        t = zeros(shape, dtype)
+        default_initializer(t)
+        data = t.data
+    elif is_bias:
+        data = jnp.zeros(tuple(int(s) for s in shape),
+                         dtype_mod.convert_dtype(dtype))
+    else:
+        import math as _m
+
+        fan_in = int(shape[0]) if shape else 1
+        bound = _m.sqrt(6.0 / max(fan_in, 1))
+        t = rand(shape, dtype)
+        data = (t.data * 2.0 - 1.0) * bound
+    return Parameter(data, name=name)

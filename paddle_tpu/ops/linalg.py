@@ -417,3 +417,48 @@ def tensordot(x, y, axes=2, name=None):
     else:
         axes = int(axes)
     return _tensordot(x, y, axes=axes)
+
+
+def eigvals(x, name=None):
+    """General eigenvalues (host-LAPACK eager op like eig — no XLA lowering
+    for the general case, results complex on the host CPU backend)."""
+    import numpy as np
+
+    from ..core.tensor import Tensor as _T
+
+    arr = np.asarray(x.data if isinstance(x, _T) else x)
+    cdtype = np.complex64 if arr.dtype in (np.float32, np.complex64) \
+        else np.complex128
+    vals = np.linalg.eigvals(arr)
+    cpu = jax.devices("cpu")[0]
+    return _T(jax.device_put(vals.astype(cdtype), cpu))
+
+
+@primitive("lu_unpack_op")
+def _lu_unpack(lu_data, perm, *, unpack_ludata, unpack_pivots):
+    n = lu_data.shape[-2]
+    m = lu_data.shape[-1]
+    k = min(n, m)
+    L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(n, k, dtype=lu_data.dtype)
+    U = jnp.triu(lu_data[..., :k, :])
+    # pivots -> permutation matrix (sequential row swaps, LAPACK ipiv style)
+    P = jnp.eye(n, dtype=lu_data.dtype)
+    def swap(P, i):
+        j = perm[i]
+        row_i, row_j = P[i], P[j]
+        P = P.at[i].set(row_j).at[j].set(row_i)
+        return P
+    for i in range(perm.shape[-1]):
+        P = swap(P, i)
+    return P.T, L, U
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """(P, L, U) from lu()'s packed output + pivots (reference lu_unpack).
+    2-D inputs only — the pivot-walk below is unbatched."""
+    if x.ndim != 2:
+        raise ValueError(
+            f"lu_unpack supports 2-D factors only (got ndim={x.ndim}); "
+            "vmap over the batch for batched unpacking")
+    return _lu_unpack(x, y, unpack_ludata=bool(unpack_ludata),
+                      unpack_pivots=bool(unpack_pivots))

@@ -748,3 +748,134 @@ def _index_put(x, value, *indices, accumulate):
 
 def index_put(x, indices, value, accumulate=False, name=None):
     return _index_put(x, value, *indices, accumulate=bool(accumulate))
+
+
+@primitive("diagonal_op")
+def _diagonal(x, *, offset, axis1, axis2):
+    return jnp.diagonal(x, offset=offset, axis1=axis1, axis2=axis2)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return _diagonal(x, offset=int(offset), axis1=int(axis1),
+                     axis2=int(axis2))
+
+
+@primitive("kthvalue_op")
+def _kthvalue(x, *, k, axis, keepdim):
+    vals = jnp.sort(x, axis=axis)
+    idxs = jnp.argsort(x, axis=axis)
+    v = jnp.take(vals, k - 1, axis=axis)
+    i = jnp.take(idxs, k - 1, axis=axis)
+    if keepdim:
+        v = jnp.expand_dims(v, axis)
+        i = jnp.expand_dims(i, axis)
+    return v, i.astype(jnp.int64)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    """k-th smallest value (+index) along axis (reference kthvalue_op)."""
+    return _kthvalue(x, k=int(k), axis=int(axis), keepdim=bool(keepdim))
+
+
+@primitive("mode_op")
+def _mode(x, *, axis, keepdim):
+    # most frequent value: sort, count equal runs via comparisons (static
+    # shapes, no data-dependent control flow)
+    sx = jnp.sort(x, axis=axis)
+    n = x.shape[axis]
+    sx_m = jnp.moveaxis(sx, axis, -1)
+    eq = sx_m[..., :, None] == sx_m[..., None, :]
+    counts = eq.sum(-1)  # for each sorted position: multiplicity
+    best = jnp.argmax(counts, axis=-1)
+    val = jnp.take_along_axis(sx_m, best[..., None], axis=-1)[..., 0]
+    # index: LAST occurrence in the original order (paddle contract)
+    xm = jnp.moveaxis(x, axis, -1)
+    match = xm == val[..., None]
+    pos = jnp.arange(n)
+    idx = jnp.max(jnp.where(match, pos, -1), axis=-1)
+    if keepdim:
+        val = val[..., None]
+        idx = idx[..., None]
+        val = jnp.moveaxis(val, -1, axis)
+        idx = jnp.moveaxis(idx, -1, axis)
+    return val, idx.astype(jnp.int64)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    return _mode(x, axis=int(axis), keepdim=bool(keepdim))
+
+
+@primitive("multiplex_op")
+def _multiplex(index, *inputs):
+    stacked = jnp.stack(inputs)  # [n, batch, ...]
+    rows = jnp.arange(inputs[0].shape[0])
+    return stacked[index.reshape(-1).astype(jnp.int32), rows]
+
+
+def multiplex(inputs, index, name=None):
+    """Row r of the output comes from inputs[index[r]][r] (reference
+    multiplex_op)."""
+    return _multiplex(index, *inputs)
+
+
+@primitive("scatter_nd_op")
+def _scatter_nd(index, updates, *, shape):
+    zeros = jnp.zeros(shape, updates.dtype)
+    idx = tuple(jnp.moveaxis(index, -1, 0))
+    return zeros.at[idx].add(updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    return _scatter_nd(index, updates, shape=tuple(int(d) for d in shape))
+
+
+@primitive("strided_slice_op")
+def _strided_slice(x, *, axes, starts, ends, strides):
+    sl = [builtins.slice(None)] * x.ndim
+    for ax, st, en, sr in zip(axes, starts, ends, strides):
+        sl[ax] = builtins.slice(st, en, sr)
+    return x[tuple(sl)]
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def _vals(v):
+        return tuple(int(e.item() if hasattr(e, "item") else e) for e in v)
+
+    return _strided_slice(x, axes=_vals(axes), starts=_vals(starts),
+                          ends=_vals(ends), strides=_vals(strides))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    """Split along axis into that many rank-reduced tensors."""
+    n = num or x.shape[axis]
+    outs = []
+    for i in range(n):
+        outs.append(squeeze(slice(x, [axis], [i], [i + 1]), [axis]))
+    return outs
+
+
+@primitive("crop_op")
+def _crop(x, *, offsets, lengths):
+    sl = tuple(builtins.slice(o, o + l) for o, l in zip(offsets, lengths))
+    return x[sl]
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Crop a sub-box (reference crop_tensor_op): shape = output lengths
+    (-1 = to the end), offsets default to 0."""
+    ndim = x.ndim
+    if offsets is None:
+        offsets = [0] * ndim
+    offsets = [int(o.item() if hasattr(o, "item") else o) for o in offsets]
+    if shape is None:
+        lengths = [int(d) - o for d, o in zip(x.shape, offsets)]
+    else:
+        lengths = [int(s.item() if hasattr(s, "item") else s) for s in shape]
+        lengths = [int(x.shape[i]) - offsets[i] if l == -1 else l
+                   for i, l in enumerate(lengths)]
+    return _crop(x, offsets=tuple(offsets), lengths=tuple(lengths))
+
+
+def reverse(x, axis, name=None):
+    """Deprecated paddle alias of flip."""
+    return flip(x, axis)

@@ -348,3 +348,26 @@ def _nan_to_num(x, *, nan, posinf, neginf):
 
 def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
     return _nan_to_num(x, nan=float(nan), posinf=posinf, neginf=neginf)
+
+
+def increment(x, value=1.0, name=None):
+    """x + value as a new tensor (reference increment_op; the reference
+    mutates in place — callers here rebind, matching the inplace-variant
+    convention of the dispatch layer)."""
+    return add(x, value)
+
+
+@primitive("renorm_op")
+def _renorm(x, *, p, axis, max_norm):
+    moved = jnp.moveaxis(x, axis, 0)
+    flat = moved.reshape(moved.shape[0], -1)
+    norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+    scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+    out = flat * scale[:, None]
+    return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Clamp each sub-tensor along `axis` to p-norm <= max_norm (reference
+    renorm_op)."""
+    return _renorm(x, p=float(p), axis=int(axis), max_norm=float(max_norm))

@@ -2,5 +2,5 @@
 from . import lr  # noqa: F401
 from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
-    LarsMomentum, Adafactor,
+    LarsMomentum, Adafactor, Adadelta,
 )

@@ -449,3 +449,28 @@ class Adafactor(Optimizer):
             hyper["pscale"] > 0,
             jnp.maximum(eps2, jnp.sqrt(jnp.mean(pf * pf))), 1.0)
         return (pf - lr * scale * u).astype(p.dtype), new
+
+
+class Adadelta(Optimizer):
+    """reference optimizer/adadelta.py: accumulated squared grads + squared
+    updates, rho-averaged."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._hyper_defaults = {"rho": float(rho), "eps": float(epsilon)}
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p),
+                "avg_squared_update": jnp.zeros_like(p)}
+
+    @staticmethod
+    def _rule(p, g, state, lr, step, hyper):
+        rho, eps = hyper["rho"], hyper["eps"]
+        g2 = rho * state["avg_squared_grad"] + (1 - rho) * g * g
+        update = -jnp.sqrt(state["avg_squared_update"] + eps) / \
+            jnp.sqrt(g2 + eps) * g
+        u2 = rho * state["avg_squared_update"] + (1 - rho) * update * update
+        return p + lr.astype(p.dtype) * update, {
+            "avg_squared_grad": g2, "avg_squared_update": u2}
