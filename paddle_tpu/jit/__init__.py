@@ -411,3 +411,61 @@ def not_to_static(fn=None):
 
 def ignore_module(modules):
     return None
+
+
+class ProgramTranslator:
+    """reference dygraph_to_static ProgramTranslator singleton: the
+    enable/disable switch for to_static conversion."""
+
+    _instance = None
+    _enabled = True
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    @classmethod
+    def get_instance(cls):
+        return cls()
+
+    def enable(self, enable_to_static: bool):
+        ProgramTranslator._enabled = bool(enable_to_static)
+
+
+class TracedLayer:
+    """reference dygraph/jit.py TracedLayer: trace-and-run wrapper. The
+    capture machinery is StaticLayer; this keeps the trace/save surface."""
+
+    def __init__(self, layer, inputs):
+        self._static = StaticLayer(layer)
+        self._layer = layer
+        self._inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        tl = TracedLayer(layer, inputs)
+        out = tl._static(*inputs)
+        return out, tl
+
+    def __call__(self, *args):
+        return self._static(*args)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        from . import save as _save
+
+        return _save(self._layer, path, input_spec=list(self._inputs))
+
+
+def set_code_level(level=100):
+    """reference dy2static debug knob: we have no transpiled-code printer;
+    stored for API compat."""
+    import os
+
+    os.environ["PT_DY2STATIC_CODE_LEVEL"] = str(level)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import os
+
+    os.environ["PT_DY2STATIC_VERBOSITY"] = str(level)

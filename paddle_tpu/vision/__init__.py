@@ -1,1 +1,18 @@
 from . import models, transforms, datasets, ops  # noqa: F401
+
+
+def image_load(path, backend=None):
+    """Load an image file (reference vision/image.py image_load): PIL when
+    available, else raw bytes via numpy for .npy."""
+    try:
+        from PIL import Image
+
+        return Image.open(path)
+    except ImportError:
+        import numpy as np
+
+        if str(path).endswith(".npy"):
+            return np.load(path)
+        raise RuntimeError(
+            "image_load needs Pillow for image formats (not in this image); "
+            ".npy arrays load without it")
