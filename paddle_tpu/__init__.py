@@ -137,7 +137,7 @@ def is_floating_point(x):
 
 def is_integer(x):
     d = str(x.dtype)
-    return "int" in d and "uint" not in d or d.endswith("uint8")
+    return "int" in d and "bool" not in d
 
 
 def tolist(x):

@@ -108,16 +108,21 @@ class GradScaler:
 
     def set_init_loss_scaling(self, v):
         self._scale = float(v)
+        self._state_version = getattr(self, "_state_version", 0) + 1
 
     def state_dict(self):
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
-                "decr_ratio": self._decr_ratio, "good_steps": self._good_steps,
-                "bad_steps": self._bad_steps}
+        # float()/int() also materializes lazy in-graph scale state mirrored
+        # here by ShardedTrainStep._sync_scaler
+        return {"scale": float(self._scale), "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "good_steps": int(self._good_steps),
+                "bad_steps": int(self._bad_steps)}
 
     def load_state_dict(self, sd):
         self._scale = sd["scale"]
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)
+        self._state_version = getattr(self, "_state_version", 0) + 1
 
     set_state_dict = load_state_dict
 

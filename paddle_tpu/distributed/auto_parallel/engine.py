@@ -44,8 +44,11 @@ def propose_mesh(n_devices: int, param_bytes: int, num_heads: int = 0,
             break
         if num_heads and num_heads % (mp * 2) != 0:
             break  # don't split heads unevenly
+        if n_devices % (mp * 2) != 0:
+            break  # mp must divide the device count (dp >= 1)
         mp *= 2
     dp = n_devices // mp
+    assert dp >= 1 and mp * dp <= n_devices
     axes = {}
     if mp > 1:
         axes["mp"] = mp

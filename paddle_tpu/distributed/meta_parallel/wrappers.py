@@ -86,9 +86,14 @@ class PipelineParallel(_MetaParallelBase):
     When a mesh with a 'pp' axis is live, train_batch compiles fwd+bwd+update
     into ONE pjit'ed executable whose middle is the ppermute microbatch
     pipeline (pp_layers.PipelineLayer builds that structure for any LayerDesc
-    model) — the compiled twin of the reference's 1F1B loop. Without a mesh
-    (or with a GradScaler, whose state machine is host-driven) it falls back
-    to the eager sequential schedule, numerically identical."""
+    model) — the compiled twin of the reference's 1F1B loop. A GradScaler's
+    loss-scale state machine and a strategy.gradient_merge window both run
+    IN-GRAPH on this path (ShardedTrainStep scaler/accum_steps), so AMP and
+    gradient merge keep the pipeline. Without a mesh it falls back to the
+    eager sequential schedule (identical for finite grads; on a non-finite
+    micro-step the compiled path zeroes that contribution and still applies
+    the window at the boundary, while the eager scaler extends the window —
+    both sound, but not bit-identical across paths)."""
 
     def __init__(self, layers, hcg, strategy=None):
         super().__init__(layers, hcg, strategy)
@@ -111,15 +116,27 @@ class PipelineParallel(_MetaParallelBase):
 
         x, y = data
         env = get_mesh_env()
-        if env is not None and scaler is None:
-            inner = getattr(optimizer, "_inner_opt", optimizer)
-            step = self._steps.get(id(inner))
+        inner = getattr(optimizer, "_inner_opt", optimizer)
+        gm_k = int(getattr(optimizer, "_gm_k", 1))
+        gm_avg = bool(getattr(optimizer, "_gm_avg", True))
+        sc = getattr(scaler, "_scaler", scaler)
+        # optimizer-state offload splits the step across host/device and
+        # can't host the in-graph scaler/accumulation state machine — keep
+        # the (numerically identical) eager schedule for that combination
+        offload_amp = bool(getattr(inner, "_offload", False)) and (
+            sc is not None or gm_k > 1)
+        if env is not None and not offload_amp:
+            key = (id(inner), id(sc) if sc is not None else 0, gm_k, gm_avg)
+            step = self._steps.get(key)
             if step is None:
                 from ..parallel import ShardedTrainStep
 
                 step = ShardedTrainStep(self._layers, self._loss_fn, inner,
-                                        env=env)
-                self._steps[id(inner)] = step
+                                        env=env, scaler=sc, accum_steps=gm_k,
+                                        accum_avg=gm_avg)
+                self._steps[key] = step
+                if hasattr(optimizer, "_attach_step"):
+                    optimizer._attach_step(step)
             loss = step(x, y)
             if lr_scheduler is not None:
                 lr_scheduler.step()
@@ -217,12 +234,23 @@ class HybridParallelOptimizer:
             return
         self._inner_opt.clear_grad()
 
+    def _attach_step(self, step):
+        """Register a compiled ShardedTrainStep whose in-graph accumulation
+        window this wrapper must be able to discard."""
+        if not hasattr(self, "_attached_steps"):
+            self._attached_steps = []
+        self._attached_steps.append(step)
+
     def discard_merge_window(self):
         """Drop the current gradient-merge accumulation window (bad batch /
-        scaler-skipped step): clears grads and rewinds to the window start."""
+        scaler-skipped step): clears grads and rewinds to the window start.
+        Covers both the eager tape window and any compiled in-graph window
+        (ShardedTrainStep fp32 accumulators)."""
         if self._gm_k > 1:
             self._gm_count -= self._gm_count % self._gm_k
         self._inner_opt.clear_grad()
+        for step in getattr(self, "_attached_steps", []):
+            step.discard_accum_window()
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):

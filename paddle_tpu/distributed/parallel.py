@@ -126,16 +126,41 @@ class ShardedTrainStep:
 
     batch_specs: PartitionSpec per batch input (default: shard dim0 over dp
     and sdp — ZeRO's data feeding — and cp if used by the caller's specs).
+
+    scaler: an amp.GradScaler whose loss-scale state machine runs IN-GRAPH
+    (scale/good/bad carried as compiled-step state; reference
+    dygraph/amp/loss_scaler.py:40 update_loss_scaling). This is what lets
+    AMP ride the compiled ppermute pipeline instead of falling back to the
+    eager schedule.
+
+    accum_steps: gradient-merge window k (reference
+    meta_optimizers/gradient_merge_optimizer.py role): grads accumulate in
+    fp32 carried buffers for k calls; the optimizer update applies only at
+    window boundaries (averaged when accum_avg). Non-finite micro-steps
+    (scaler live) contribute zero and are excluded from the average.
     """
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 batch_specs=None, env: Optional[MeshEnv] = None, donate=True):
+                 batch_specs=None, env: Optional[MeshEnv] = None, donate=True,
+                 scaler=None, accum_steps=1, accum_avg=True):
         self.env = env or require_mesh_env()
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.batch_specs = batch_specs
         self.donate = donate
+        # retain the original object even when disabled: callers key compiled
+        # steps by id(scaler), so the id must stay pinned to this object
+        self._scaler_ref = scaler
+        self.scaler = scaler if (scaler is not None
+                                 and getattr(scaler, "_enable", True)) else None
+        self.accum_steps = int(accum_steps)
+        self.accum_avg = bool(accum_avg)
+        self._amp_state = None   # (scale f32, good i32, bad i32)
+        self._upd_no = None      # applied-update counter (in-graph)
+        self._acc = None         # fp32 grad buffers (accum_steps > 1)
+        self._goodw = None       # finite micro-steps in current window
+        self._win_count = 0      # host-side call index within the window
         self._jitted = None
         inner = getattr(model, "_layers", model)
         self.target = model
@@ -159,6 +184,11 @@ class ShardedTrainStep:
         # param stays replicated)
         self.zero_stage = int(getattr(optimizer, "_zero_stage", 0))
         self.offload = bool(getattr(optimizer, "_offload", False))
+        if self.offload and (self.scaler is not None or self.accum_steps > 1):
+            raise NotImplementedError(
+                "ShardedTrainStep: in-graph GradScaler / gradient accumulation "
+                "is not supported together with optimizer-state offload; run "
+                "the scaler eagerly or drop offload for this step")
         if self.offload:
             # reference sharding_utils.py offload: master weights + optimizer
             # state pinned to host memory; see _build_offload
@@ -192,35 +222,100 @@ class ShardedTrainStep:
             return P()
         return P(tuple(data_axes))
 
-    def _build(self, batch_arrays):
-        env = self.env
+    def _make_updater(self):
+        """Per-param optimizer update math shared by every build variant:
+        grads (param dtype) + states -> (new_params, new_states)."""
         opt = self.optimizer
-        model, loss_fn = self.target, self.loss_fn
         rule = type(opt)._rule
         hyper = opt._hyper()
         wd = opt._weight_decay
         decoupled = opt._decoupled
+        wd_flags = tuple(
+            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
+            for p in self.train_params)
+
+        def apply(params, grads, states, lr, step_no):
+            new_p, new_s = [], []
+            for p, g, s, flag in zip(params, grads, states, wd_flags):
+                g = g.astype(p.dtype)
+                if wd and not decoupled and flag:
+                    g = g + wd * p
+                hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
+                np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+                if wd and decoupled and flag:
+                    np_ = np_ - (lr * wd * p).astype(p.dtype)
+                new_p.append(np_)
+                new_s.append(ns)
+            return new_p, new_s
+
+        return apply
+
+    def _make_grad_fn(self, scale_in_graph=False):
+        """value_and_grad closure over the bound model; returns
+        (loss f32, grads in param dtype). When scale_in_graph, the loss is
+        multiplied by a traced loss-scale before differentiation."""
+        model, loss_fn = self.target, self.loss_fn
+        train_params = self.train_params
+        frozen = self.frozen
+
+        from ..jit import _Binder
+
+        def grad_of(params, frozen_arrays, batch, scale=None):
+            def loss_of(param_arrays):
+                ts = train_params + frozen
+                with _Binder(ts) as b:
+                    b.bind(list(param_arrays) + list(frozen_arrays))
+                    with autograd.no_grad():
+                        loss = loss_fn(model, *[Tensor(a) for a in batch])
+                loss = loss.data.astype(jnp.float32)
+                return loss * scale if scale_in_graph else loss
+
+            return jax.value_and_grad(loss_of)(tuple(params))
+
+        return grad_of
+
+    def _sharding_plan(self, batch_arrays):
+        """Input/output placements shared by every build variant."""
+        env = self.env
+        opt = self.optimizer
+        param_sh = [param_sharding(p, env) for p in self.train_params]
+        state_sh = [
+            {k: (self._state_sharding(p) if v.shape == p.data.shape
+                 else env.replicated())
+             for k, v in opt._accumulators[id(p)].items()}
+            for p in self.train_params
+        ]
+        frozen_sh = [param_sharding(p, env) for p in self.frozen]
+        if self.batch_specs is not None:
+            batch_sh = [env.sharding_for(s) for s in self.batch_specs]
+        else:
+            batch_sh = [env.sharding_for(self._default_batch_spec(a))
+                        for a in batch_arrays]
+        return param_sh, state_sh, frozen_sh, batch_sh
+
+    def _zero2_plan(self):
+        """Per-grad reduce-scatter constraint specs (ZeRO-2), else None."""
+        if self.zero_stage < 2:
+            return None
+        return [
+            None if getattr(p, "dist_spec", None) is not None
+            else self._state_sharding(p)
+            for p in self.train_params
+        ]
+
+    def _build(self, batch_arrays):
+        env = self.env
+        opt = self.optimizer
         clip = opt._grad_clip
         train_params = self.train_params
         frozen = self.frozen
-        wd_flags = tuple(
-            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
-            for p in train_params)
-
-        from ..jit import _Binder
+        updater = self._make_updater()
+        grad_of = self._make_grad_fn()
 
         def step(params, states, frozen_arrays, lr, step_no, rngkey, *batch):
             random_mod.default_generator().set_trace_key(rngkey)
             try:
-                def loss_of(param_arrays):
-                    ts = train_params + frozen
-                    with _Binder(ts) as b:
-                        b.bind(list(param_arrays) + list(frozen_arrays))
-                        with autograd.no_grad():
-                            loss = loss_fn(model, *[Tensor(a) for a in batch])
-                    return loss.data.astype(jnp.float32)
-
-                loss_val, grads = jax.value_and_grad(loss_of)(tuple(params))
+                loss_val, grads = grad_of(params, frozen_arrays, batch)
                 grads = list(grads)
                 if zero2_shardings is not None:
                     # ZeRO-2: constrain each grad to the optimizer-state shard
@@ -230,45 +325,273 @@ class ShardedTrainStep:
                              for g, sh in zip(grads, zero2_shardings)]
                 if clip is not None:
                     grads = clip._apply_jax(grads)
-                new_p, new_s = [], []
-                for p, g, s, flag in zip(params, grads, states, wd_flags):
-                    g = g.astype(p.dtype)
-                    if wd and not decoupled and flag:
-                        g = g + wd * p
-                    hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
-                    np_, ns = rule(p, g, s, lr, step_no, hyper_i)
-                    if wd and decoupled and flag:
-                        np_ = np_ - (lr * wd * p).astype(p.dtype)
-                    new_p.append(np_)
-                    new_s.append(ns)
+                new_p, new_s = updater(params, grads, states, lr, step_no)
                 return loss_val, new_p, new_s
             finally:
                 random_mod.default_generator().clear_trace_key()
 
-        zero2_shardings = None
-        if self.zero_stage >= 2:
-            zero2_shardings = [
-                None if getattr(p, "dist_spec", None) is not None
-                else self._state_sharding(p)
-                for p in train_params
-            ]
-        param_sh = [param_sharding(p, env) for p in train_params]
-        state_sh = [
-            {k: (self._state_sharding(p) if v.shape == p.data.shape else env.replicated())
-             for k, v in opt._accumulators[id(p)].items()}
-            for p in train_params
-        ]
-        frozen_sh = [param_sharding(p, env) for p in frozen]
-        if self.batch_specs is not None:
-            batch_sh = [env.sharding_for(s) for s in self.batch_specs]
-        else:
-            batch_sh = [env.sharding_for(self._default_batch_spec(a)) for a in batch_arrays]
+        zero2_shardings = self._zero2_plan()
+        param_sh, state_sh, frozen_sh, batch_sh = self._sharding_plan(batch_arrays)
         repl = env.replicated()
         in_shardings = (param_sh, state_sh, frozen_sh, repl, repl, repl, *batch_sh)
         out_shardings = (repl, param_sh, state_sh)
         donate = (0, 1) if self.donate else ()
         return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
                        donate_argnums=donate)
+
+    # -- in-graph AMP / gradient accumulation --------------------------------
+    def _grad_shardings(self):
+        """Placement for fp32 grad/accumulator buffers: the ZeRO-2 state shard
+        when active, else the param placement."""
+        env = self.env
+        shs = []
+        for p in self.train_params:
+            if self.zero_stage >= 2 and getattr(p, "dist_spec", None) is None:
+                shs.append(self._state_sharding(p))
+            else:
+                shs.append(param_sharding(p, env))
+        return shs
+
+    def _amp_update(self, fin, amp):
+        """Dynamic loss-scale state machine, traced (reference
+        python/paddle/fluid/dygraph/amp/loss_scaler.py:40 + the
+        update_loss_scaling op). amp = (scale, good, bad)."""
+        sc = self.scaler
+        scale, good, bad = amp
+        if not getattr(sc, "_dynamic", True):
+            return (scale, good, bad)
+        good2 = jnp.where(fin, good + 1, 0)
+        bad2 = jnp.where(fin, 0, bad + 1)
+        incr = fin & (good2 >= sc._incr_every_n_steps)
+        decr = (~fin) & (bad2 >= sc._decr_every_n_nan_or_inf)
+        scale2 = jnp.where(incr, scale * sc._incr_ratio,
+                           jnp.where(decr,
+                                     jnp.maximum(scale * sc._decr_ratio, 1.0),
+                                     scale))
+        good3 = jnp.where(incr, 0, good2)
+        bad3 = jnp.where(decr, 0, bad2)
+        return (scale2, good3, bad3)
+
+    def _build_amp(self, batch_arrays, boundary):
+        """One compiled variant of the scaler/accumulation step.
+
+        boundary=False (accum only, k > 1): fwd+bwd, fold this call's grads
+        into the fp32 accumulators — no optimizer math in the executable.
+        boundary=True: fold, then apply the update from the window total
+        (guarded by found-any-finite when a scaler is live)."""
+        env = self.env
+        opt = self.optimizer
+        clip = opt._grad_clip
+        has_scaler = self.scaler is not None
+        k = self.accum_steps
+        avg = self.accum_avg
+        train_params = self.train_params
+        updater = self._make_updater()
+        grad_of = self._make_grad_fn(scale_in_graph=has_scaler)
+
+        zero2_shardings = self._zero2_plan()
+
+        def micro_grads(params, frozen_arrays, amp, batch):
+            """Shared fwd+bwd prefix: unscaled fp32 grads + finite flag."""
+            scale = amp[0]
+            loss_s, grads = grad_of(params, frozen_arrays, batch,
+                                    scale=scale if has_scaler else None)
+            grads = [g.astype(jnp.float32) for g in grads]
+            if has_scaler:
+                inv = 1.0 / scale
+                grads = [g * inv for g in grads]
+                loss_val = loss_s * inv
+            else:
+                loss_val = loss_s
+            if zero2_shardings is not None:
+                grads = [g if sh is None else jax.lax.with_sharding_constraint(g, sh)
+                         for g, sh in zip(grads, zero2_shardings)]
+            if has_scaler:
+                import functools
+
+                fin = functools.reduce(
+                    jnp.logical_and,
+                    [jnp.all(jnp.isfinite(g)) for g in grads])
+            else:
+                fin = jnp.asarray(True)
+            return loss_val, grads, fin
+
+        def step_accum(params, acc, goodw, amp, frozen_arrays, rngkey, *batch):
+            random_mod.default_generator().set_trace_key(rngkey)
+            try:
+                loss_val, grads, fin = micro_grads(params, frozen_arrays, amp,
+                                                   batch)
+                new_acc = [a + jnp.where(fin, g, 0.0)
+                           for a, g in zip(acc, grads)]
+                new_goodw = goodw + fin.astype(jnp.int32)
+                amp_out = self._amp_update(fin, amp) if has_scaler else amp
+                return loss_val, new_acc, new_goodw, amp_out
+            finally:
+                random_mod.default_generator().clear_trace_key()
+
+        def step_apply(params, states, acc, goodw, amp, frozen_arrays, lr,
+                       upd_no, rngkey, *batch):
+            # k == 1 callers pass acc=() and goodw is ignored. upd_no counts
+            # APPLIED updates (in-graph, so a fully-skipped scaler window
+            # leaves Adam's bias-correction step where it was — matching the
+            # eager scaler, which skips optimizer.step() entirely on inf)
+            random_mod.default_generator().set_trace_key(rngkey)
+            try:
+                loss_val, grads, fin = micro_grads(params, frozen_arrays, amp,
+                                                   batch)
+                if k > 1:
+                    total = [a + jnp.where(fin, g, 0.0)
+                             for a, g in zip(acc, grads)]
+                    ngood = goodw + fin.astype(jnp.int32)
+                else:
+                    total = grads
+                    ngood = fin.astype(jnp.int32)
+                step_no = (upd_no + 1).astype(jnp.int32)
+
+                def do_update(ops):
+                    params_, states_, g32 = ops
+                    g32 = list(g32)
+                    if avg and k > 1:
+                        denom = jnp.maximum(ngood, 1).astype(jnp.float32)
+                        g32 = [g / denom for g in g32]
+                    if clip is not None:
+                        g32 = clip._apply_jax(g32)
+                    new_p, new_s = updater(list(params_), g32, list(states_),
+                                           lr, step_no)
+                    return tuple(new_p), tuple(new_s)
+
+                def skip_update(ops):
+                    params_, states_, _ = ops
+                    return tuple(params_), tuple(states_)
+
+                operands = (tuple(params), tuple(states), tuple(total))
+                if has_scaler:
+                    applied = (ngood > 0).astype(jnp.int32)
+                    new_p, new_s = jax.lax.cond(ngood > 0, do_update,
+                                                skip_update, operands)
+                else:
+                    applied = jnp.int32(1)
+                    new_p, new_s = do_update(operands)
+                acc_out = [jnp.zeros_like(a) for a in acc]
+                goodw_out = jnp.zeros_like(goodw)
+                amp_out = self._amp_update(fin, amp) if has_scaler else amp
+                return loss_val, list(new_p), list(new_s), acc_out, \
+                    goodw_out, amp_out, upd_no + applied
+            finally:
+                random_mod.default_generator().clear_trace_key()
+
+        param_sh, state_sh, frozen_sh, batch_sh = self._sharding_plan(batch_arrays)
+        acc_sh = self._grad_shardings() if k > 1 else []
+        repl = env.replicated()
+        amp_sh = (repl, repl, repl)
+        if not boundary:
+            in_sh = (param_sh, acc_sh, repl, amp_sh, frozen_sh, repl, *batch_sh)
+            out_sh = (repl, acc_sh, repl, amp_sh)
+            return jax.jit(step_accum, in_shardings=in_sh, out_shardings=out_sh,
+                           donate_argnums=(1,) if self.donate else ())
+        in_sh = (param_sh, state_sh, acc_sh, repl, amp_sh, frozen_sh, repl,
+                 repl, repl, *batch_sh)
+        out_sh = (repl, param_sh, state_sh, acc_sh, repl, amp_sh, repl)
+        donate = (0, 1, 2) if self.donate else ()
+        return jax.jit(step_apply, in_shardings=in_sh, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def _init_amp_state(self):
+        repl = self.env.replicated()
+        sc = self.scaler
+        scale = float(getattr(sc, "_scale", 1.0)) if sc is not None else 1.0
+        self._amp_state = (
+            jax.device_put(jnp.float32(scale), repl),
+            jax.device_put(jnp.int32(int(getattr(sc, "_good_steps", 0) or 0)
+                                     if sc is not None else 0), repl),
+            jax.device_put(jnp.int32(int(getattr(sc, "_bad_steps", 0) or 0)
+                                     if sc is not None else 0), repl))
+        self._upd_no = jax.device_put(
+            jnp.int32(int(self.optimizer._global_step)), repl)
+        self._goodw = jax.device_put(jnp.int32(0), repl)
+        self._win_count = 0
+        self._host_versions = self._host_state_version()
+        if self.accum_steps > 1:
+            self._acc = [
+                jax.device_put(jnp.zeros(p.shape, jnp.float32), sh)
+                for p, sh in zip(self.train_params, self._grad_shardings())]
+        else:
+            self._acc = []
+
+    def _host_state_version(self):
+        return (int(getattr(self.optimizer, "_state_version", 0)),
+                int(getattr(self.scaler, "_state_version", 0) or 0)
+                if self.scaler is not None else 0)
+
+    def _call_amp(self, arrays):
+        opt = self.optimizer
+        k = self.accum_steps
+        if self._jitted is None:
+            accum = self._build_amp(arrays, boundary=False) if k > 1 else None
+            self._jitted = (accum, self._build_amp(arrays, boundary=True))
+            self._init_amp_state()
+        elif self._host_versions != self._host_state_version():
+            # optimizer.set_state_dict / scaler.load_state_dict happened
+            # since build: re-seed the in-graph state from the restored host
+            # values (discards any partial accumulation window)
+            self._init_amp_state()
+        jit_accum, jit_apply = self._jitted
+        params = [p.data for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        boundary = (self._win_count + 1) % k == 0
+        if not boundary:
+            loss, self._acc, self._goodw, self._amp_state = jit_accum(
+                params, self._acc, self._goodw, self._amp_state,
+                frozen_arrays, random_mod.next_key(), *arrays)
+            self._win_count += 1
+            self._sync_scaler()
+            return Tensor(loss)
+        states = [opt._accumulators[id(p)] for p in self.train_params]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        (loss, new_p, new_s, self._acc, self._goodw,
+         self._amp_state, self._upd_no) = jit_apply(
+            params, states, self._acc, self._goodw, self._amp_state,
+            frozen_arrays, lr, self._upd_no, random_mod.next_key(), *arrays)
+        for p, a in zip(self.train_params, new_p):
+            p.data = a
+        for p, s in zip(self.train_params, new_s):
+            opt._accumulators[id(p)] = s
+        # the authoritative applied-update count lives in-graph (a scaler may
+        # have skipped the window); hand the lazy scalar to the optimizer —
+        # int() contexts (state_dict, resume) materialize it without a
+        # per-step host sync here
+        opt._global_step = self._upd_no
+        self._win_count = 0
+        self._sync_scaler()
+        return Tensor(loss)
+
+    def _sync_scaler(self):
+        """Mirror the in-graph scale state onto the host GradScaler object
+        (lazy jax scalars, no sync) so state_dict()/checkpointing and any
+        later eager fall-through see the live scale."""
+        sc = self.scaler
+        if sc is None or self._amp_state is None:
+            return
+        sc._scale, sc._good_steps, sc._bad_steps = self._amp_state
+
+    def discard_accum_window(self):
+        """Drop the in-flight gradient-merge window (compiled-path twin of
+        HybridParallelOptimizer.discard_merge_window): zero the fp32
+        accumulators and rewind to the window start."""
+        if self._acc:
+            self._acc = [jnp.zeros_like(a) for a in self._acc]
+        if self._goodw is not None:
+            self._goodw = jnp.zeros_like(self._goodw)
+        self._win_count = 0
+
+    def amp_state(self):
+        """Materialize the in-graph scaler state (host sync): dict with
+        loss_scale / good_steps / bad_steps / updates, or None w/o scaler."""
+        if self.scaler is None or self._amp_state is None:
+            return None
+        scale, good, bad = self._amp_state
+        return {"loss_scale": float(scale), "good_steps": int(good),
+                "bad_steps": int(bad), "updates": int(self._upd_no)}
 
     def _build_offload(self, batch_arrays):
         """Two executables instead of one: fwd+bwd on the mesh, update on the
@@ -366,6 +689,8 @@ class ShardedTrainStep:
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         if self.offload:
             return self._call_offload(arrays)
+        if self.scaler is not None or self.accum_steps > 1:
+            return self._call_amp(arrays)
         if self._jitted is None:
             self._jitted = self._build(arrays)
         params = [p.data for p in self.train_params]

@@ -317,6 +317,16 @@ class BeamSearchDecoder:
         self.embedding_fn = embedding_fn
         self.output_fn = output_fn
 
+    @staticmethod
+    def _map_state(fn, *states):
+        """Apply fn leafwise over (possibly nested tuple/list) cell states."""
+        s0 = states[0]
+        if isinstance(s0, (list, tuple)):
+            return type(s0)(
+                BeamSearchDecoder._map_state(fn, *parts)
+                for parts in zip(*states))
+        return fn(*states)
+
     def decode(self, inits, max_step_num=16):
         """Returns (token ids [B, beam, T], scores [B, beam])."""
         import numpy as np
@@ -333,37 +343,57 @@ class BeamSearchDecoder:
             paddle.nn.functional.log_softmax(logits, axis=-1).numpy())
         B = lp.shape[0]
         top = np.argsort(-lp, axis=-1)[:, : self.beam_size]
-        # beams[b] = list of (tokens, score, state, finished)
-        beams = [[([int(top[b, k])], float(lp[b, top[b, k]]), state,
+        # beams[b] = list of (tokens, score, finished); cell states live in
+        # slot_states[k], row-batched: row b of slot_states[k] is the state of
+        # (row b, beam k). Step-0 state is parent-agnostic (all beams share it)
+        beams = [[([int(top[b, k])], float(lp[b, top[b, k]]),
                    int(top[b, k]) == self.end_token)
                   for k in range(self.beam_size)] for b in range(B)]
+        slot_states = [state] * self.beam_size
 
         for _ in range(1, max_step_num):
             if all(fin for bs in beams for *_x, fin in bs):
                 break
-            # ONE batched cell call per beam slot: rows advance together
+            # ONE batched cell call per beam slot: rows advance together.
+            # Expansions remember their parent slot so states can be re-
+            # gathered after per-row re-ranking (standard beam-search state
+            # reordering; reference nn/decode.py _beam_search_step gather).
             expansions = [[] for _ in range(B)]
+            stepped = []  # stepped[k] = cell state after advancing slot k
             for k in range(self.beam_size):
                 tokens = np.array([beams[b][k][0][-1] for b in range(B)],
                                   "int64")
-                slot_state = beams[0][k][2]  # states are row-batched arrays
                 inp = self._embed_ids(tokens, inits)
-                out, st2 = self.cell(inp, slot_state)
+                out, st2 = self.cell(inp, slot_states[k])
+                stepped.append(st2)
                 logits = self.output_fn(out) if self.output_fn else out
                 lp = np.asarray(
                     paddle.nn.functional.log_softmax(logits, axis=-1).numpy())
                 for b in range(B):
-                    toks, score, _st, fin = beams[b][k]
+                    toks, score, fin = beams[b][k]
                     if fin:
-                        expansions[b].append((toks, score, _st, True))
+                        expansions[b].append((toks, score, k, True))
                         continue
                     for t in np.argsort(-lp[b])[: self.beam_size]:
                         expansions[b].append(
-                            (toks + [int(t)], score + float(lp[b, t]), st2,
+                            (toks + [int(t)], score + float(lp[b, t]), k,
                              int(t) == self.end_token))
+            parent = np.zeros((B, self.beam_size), "int64")
             for b in range(B):
                 expansions[b].sort(key=lambda c: -c[1])
-                beams[b] = expansions[b][: self.beam_size]
+                sel = expansions[b][: self.beam_size]
+                beams[b] = [(toks, score, fin) for toks, score, _j, fin in sel]
+                parent[b] = [j for _t, _s, j, _f in sel]
+
+            def _gather(k, *leaves):
+                arrs = [np.asarray(l.numpy() if hasattr(l, "numpy") else l)
+                        for l in leaves]
+                stacked = np.stack(arrs)  # [beam, B, ...]
+                return creation.to_tensor(stacked[parent[:, k], np.arange(B)])
+
+            slot_states = [
+                self._map_state(lambda *ls, _k=k: _gather(_k, *ls), *stepped)
+                for k in range(self.beam_size)]
 
         T = max(len(toks) for bs in beams for toks, *_x in bs)
         ids = np.full((B, self.beam_size, T), self.end_token, "int64")

@@ -147,7 +147,7 @@ class Optimizer:
         return None, None
 
     def state_dict(self):
-        sd = {"global_step": self._global_step}
+        sd = {"global_step": int(self._global_step)}
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         for i, p in enumerate(self._parameter_list):
@@ -158,6 +158,9 @@ class Optimizer:
         return sd
 
     def set_state_dict(self, state_dict):
+        # signal compiled steps holding in-graph state (ShardedTrainStep AMP/
+        # accumulation path) to re-seed from the restored host values
+        self._state_version = getattr(self, "_state_version", 0) + 1
         self._global_step = int(state_dict.get("global_step", 0))
         if isinstance(self._learning_rate, LRScheduler) and "LR_Scheduler" in state_dict:
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])

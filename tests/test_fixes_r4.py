@@ -1,0 +1,403 @@
+"""Regression tests for round-3 advisor findings (ADVICE.md) + the in-graph
+AMP / gradient-merge compiled-step work (VERDICT r3 weak #2, next #4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def _mlp(seed=7):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+
+
+def _loss_fn(m, x, y):
+    return F.mse_loss(m(x), y)
+
+
+class TestIsInteger:
+    """ADVICE low: unsigned dtypes beyond uint8 must classify as integer."""
+
+    @pytest.mark.parametrize("dt", ["uint8", "int8", "int32", "int64"])
+    def test_integer_dtypes(self, dt):
+        assert paddle.is_integer(paddle.zeros([2], dtype=dt))
+
+    def test_unsigned_numpy_passthrough(self):
+        import jax.numpy as jnp
+        from paddle_tpu.core.tensor import Tensor
+
+        for dt in ("uint16", "uint32"):
+            t = Tensor(jnp.zeros((2,), dtype=dt))
+            assert paddle.is_integer(t), dt
+
+    def test_non_integer(self):
+        assert not paddle.is_integer(paddle.zeros([2], dtype="float32"))
+        assert not paddle.is_integer(paddle.zeros([2], dtype="bool"))
+
+
+class TestProposeMesh:
+    """ADVICE low: mp doubling must stay a divisor of n_devices."""
+
+    def test_non_power_of_two_devices(self):
+        from paddle_tpu.distributed.auto_parallel.engine import propose_mesh
+
+        axes = propose_mesh(6, param_bytes=int(20e9), num_heads=0,
+                            hbm_bytes=16e9)
+        total = 1
+        for d in axes.values():
+            total *= d
+        assert total <= 6
+        assert 6 % axes.get("mp", 1) == 0
+
+    def test_large_model_8dev(self):
+        from paddle_tpu.distributed.auto_parallel.engine import propose_mesh
+
+        axes = propose_mesh(8, param_bytes=int(14e9), num_heads=32)
+        total = 1
+        for d in axes.values():
+            total *= d
+        assert total <= 8 and axes.get("mp", 1) >= 2
+
+
+class TestBeamSearchStateReordering:
+    """ADVICE medium: a stateful cell must decode with the PARENT beam's
+    state after per-row re-ranking, for every row."""
+
+    def _naive_beam(self, cell_np, embed, start, end, beam, B, T, V):
+        """Per-row reference beam search carrying per-beam scalar state."""
+        out0, st0 = cell_np(np.full((B,), start, "int64"), np.zeros((B, 1)))
+        results = []
+        for b in range(B):
+            lp = out0[b]
+            order = np.argsort(-lp)[:beam]
+            beams = [([int(t)], float(lp[t]), st0[b:b + 1].copy(),
+                      int(t) == end) for t in order]
+            for _ in range(1, T):
+                if all(f for *_x, f in beams):
+                    break
+                exp = []
+                for toks, sc, st, fin in beams:
+                    if fin:
+                        exp.append((toks, sc, st, True))
+                        continue
+                    o, st2 = cell_np(np.array([toks[-1]], "int64"), st)
+                    for t in np.argsort(-o[0])[:beam]:
+                        exp.append((toks + [int(t)], sc + float(o[0, t]),
+                                    st2, int(t) == end))
+                exp.sort(key=lambda c: -c[1])
+                beams = exp[:beam]
+            results.append(beams)
+        return results
+
+    def test_stateful_cell_matches_naive(self):
+        from paddle_tpu.nn.layer.extension_r3 import (BeamSearchDecoder,
+                                                      dynamic_decode)
+
+        V, B, beam, T = 7, 3, 2, 5
+        rng = np.random.RandomState(0)
+        W = rng.randn(V, V).astype("float32") * 1.5
+        U = rng.randn(1, V).astype("float32")
+
+        def cell_np(tokens, state):
+            # logits depend on the token AND the accumulated state — a wrong
+            # parent state changes the distribution
+            logits = W[tokens] + state * U
+            logits = logits - logits.max(-1, keepdims=True)
+            lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+            return lp.astype("float32"), state + tokens[:, None].astype(
+                "float32")
+
+        def cell(inp, state):
+            toks = _np(inp).astype("int64")
+            st = _np(state).astype("float32")
+            lp, st2 = cell_np(toks, st)
+            return paddle.to_tensor(lp), paddle.to_tensor(st2)
+
+        dec = BeamSearchDecoder(cell, start_token=1, end_token=0,
+                                beam_size=beam)
+        inits = paddle.zeros([B, 1], dtype="float32")
+        ids, scores = dynamic_decode(dec, inits, max_step_num=T)
+        ref = self._naive_beam(cell_np, None, 1, 0, beam, B, T, V)
+        for b in range(B):
+            for k in range(beam):
+                toks, sc, *_x = ref[b][k]
+                got = [int(v) for v in _np(ids)[b, k][: len(toks)]]
+                assert got == toks, (b, k, got, toks)
+                np.testing.assert_allclose(_np(scores)[b, k], sc, rtol=1e-4)
+
+
+@pytest.mark.dist
+class TestInGraphScaler:
+    def test_finite_parity_with_eager_scaler(self):
+        from paddle_tpu.amp import GradScaler
+
+        net = _mlp()
+        snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+        o = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+        sc = GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=3)
+        dist.init_mesh(dp=8)
+        step = dist.ShardedTrainStep(net, _loss_fn, o, scaler=sc)
+        x = np.random.RandomState(0).rand(8, 16).astype("float32")
+        y = np.random.RandomState(1).rand(8, 16).astype("float32")
+        compiled = [float(step(paddle.to_tensor(x), paddle.to_tensor(y)))
+                    for _ in range(4)]
+        # dynamic scale grew once after 3 good steps
+        st = step.amp_state()
+        assert st["loss_scale"] == 2048.0
+        assert st["good_steps"] == 1
+
+        dist.reset_mesh()
+        net2 = _mlp()
+        net2.set_state_dict(snap)
+        o2 = opt.Adam(learning_rate=0.05, parameters=net2.parameters())
+        sc2 = GradScaler(init_loss_scaling=1024.0, incr_every_n_steps=3)
+        eager = []
+        for _ in range(4):
+            loss = _loss_fn(net2, paddle.to_tensor(x), paddle.to_tensor(y))
+            sc2.scale(loss).backward()
+            sc2.step(o2)
+            o2.clear_grad()
+            eager.append(float(loss))
+        np.testing.assert_allclose(compiled, eager, rtol=2e-4)
+
+    def test_skips_update_and_decays_scale_on_inf(self):
+        from paddle_tpu.amp import GradScaler
+
+        net = _mlp(3)
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        sc = GradScaler(init_loss_scaling=512.0, decr_every_n_nan_or_inf=1)
+        dist.init_mesh(dp=8)
+        try:
+            step = dist.ShardedTrainStep(net, _loss_fn, o, scaler=sc)
+            before = {k: v.numpy().copy()
+                      for k, v in net.state_dict().items()}
+            x = np.full((8, 16), np.inf, "float32")
+            y = np.zeros((8, 16), "float32")
+            step(paddle.to_tensor(x), paddle.to_tensor(y))
+            st = step.amp_state()
+            assert st["loss_scale"] == 256.0  # one bad step halves
+            for k, v in net.state_dict().items():
+                np.testing.assert_array_equal(v.numpy(), before[k])
+            # a good batch afterwards does update
+            xg = np.random.RandomState(2).rand(8, 16).astype("float32")
+            step(paddle.to_tensor(xg), paddle.to_tensor(y))
+            changed = any(
+                not np.array_equal(v.numpy(), before[k])
+                for k, v in net.state_dict().items())
+            assert changed
+        finally:
+            dist.reset_mesh()
+
+
+@pytest.mark.dist
+class TestInGraphAccumulation:
+    def test_accum2_matches_eager_gradient_merge(self):
+        net = _mlp(5)
+        snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+        o = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+        dist.init_mesh(dp=8)
+        step = dist.ShardedTrainStep(net, _loss_fn, o, accum_steps=2,
+                                     accum_avg=True)
+        rs = np.random.RandomState(9)
+        xs = [rs.rand(8, 16).astype("float32") for _ in range(4)]
+        ys = [rs.rand(8, 16).astype("float32") for _ in range(4)]
+        mid_before = None
+        for i in range(4):
+            if i == 1:
+                mid_before = {k: v.numpy().copy()
+                              for k, v in net.state_dict().items()}
+            step(paddle.to_tensor(xs[i]), paddle.to_tensor(ys[i]))
+            if i == 0:
+                # no update yet: params unchanged after the first micro-step
+                for k, v in net.state_dict().items():
+                    np.testing.assert_array_equal(v.numpy(), snap[k])
+        # an update happened at each window boundary
+        assert o._global_step == 2
+        after = {k: v.numpy() for k, v in net.state_dict().items()}
+        dist.reset_mesh()
+
+        # eager gradient merge: accumulate 2 micro-batch grads, average, step
+        net2 = _mlp(5)
+        net2.set_state_dict(snap)
+        o2 = opt.Adam(learning_rate=0.05, parameters=net2.parameters())
+        for w in range(2):
+            for i in range(2):
+                loss = _loss_fn(net2, paddle.to_tensor(xs[2 * w + i]),
+                                paddle.to_tensor(ys[2 * w + i]))
+                loss.backward()
+            for p in net2.parameters():
+                p.grad.data = p.grad.data / 2.0
+            o2.step()
+            o2.clear_grad()
+        for k, v in net2.state_dict().items():
+            np.testing.assert_allclose(after[k], v.numpy(), rtol=3e-4,
+                                       atol=1e-6)
+
+
+@pytest.mark.dist
+class TestPipelineWrapperPaths:
+    """ADVICE medium: gradient_merge must gate updates on the COMPILED
+    pipeline path, and a GradScaler must not knock train_batch off it."""
+
+    def _fleet_pipe(self, gm=False, use_scaler=False):
+        import paddle_tpu.distributed.fleet as fleet
+
+        strategy = fleet.DistributedStrategy()
+        if gm:
+            strategy.gradient_merge = True
+            strategy.gradient_merge_configs = {"k_steps": 2, "avg": True}
+        dist.init_mesh(dp=8)
+        net = _mlp(11)
+        o = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+        from paddle_tpu.distributed.meta_parallel.wrappers import (
+            HybridParallelOptimizer, PipelineParallel)
+
+        class _HCG:
+            mesh_env = None
+
+        hp_opt = HybridParallelOptimizer(o, strategy=strategy)
+        pipe = PipelineParallel(net, _HCG(), strategy)
+        return pipe, hp_opt, net
+
+    def test_gradient_merge_gates_compiled_updates(self):
+        pipe, hp_opt, net = self._fleet_pipe(gm=True)
+        try:
+            snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+            x = np.random.RandomState(1).rand(8, 16).astype("float32")
+            y = np.random.RandomState(2).rand(8, 16).astype("float32")
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt)
+            # first micro-step of the k=2 window: NO update applied
+            for k, v in net.state_dict().items():
+                np.testing.assert_array_equal(v.numpy(), snap[k])
+            (step,) = pipe._steps.values()
+            assert step.accum_steps == 2
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt)
+            changed = any(not np.array_equal(v.numpy(), snap[k])
+                          for k, v in net.state_dict().items())
+            assert changed
+        finally:
+            dist.reset_mesh()
+
+    def test_offload_plus_scaler_falls_back_to_eager(self):
+        """Offload can't host the in-graph scaler; train_batch must take the
+        eager schedule (not raise NotImplementedError)."""
+        from paddle_tpu.amp import GradScaler
+
+        pipe, hp_opt, net = self._fleet_pipe()
+        try:
+            hp_opt._inner_opt._offload = True
+            sc = GradScaler(init_loss_scaling=64.0)
+            x = np.random.RandomState(5).rand(8, 16).astype("float32")
+            y = np.random.RandomState(6).rand(8, 16).astype("float32")
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            loss = pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                                    hp_opt, scaler=sc)
+            assert np.isfinite(float(loss))
+            assert not pipe._steps  # eager path, no compiled step cached
+        finally:
+            dist.reset_mesh()
+
+    def test_scaler_state_syncs_to_host_object(self):
+        """Checkpointing reads scaler.state_dict(); the in-graph scale must
+        be mirrored there after compiled steps."""
+        from paddle_tpu.amp import GradScaler
+
+        pipe, hp_opt, net = self._fleet_pipe()
+        try:
+            sc = GradScaler(init_loss_scaling=128.0, incr_every_n_steps=2)
+            x = np.random.RandomState(7).rand(8, 16).astype("float32")
+            y = np.random.RandomState(8).rand(8, 16).astype("float32")
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            for _ in range(2):
+                pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                                 hp_opt, scaler=sc)
+            sd = sc.state_dict()
+            assert sd["scale"] == 256.0  # grew after 2 good steps
+            assert isinstance(sd["scale"], float)
+        finally:
+            dist.reset_mesh()
+
+    def test_discard_merge_window_reaches_compiled_accumulators(self):
+        pipe, hp_opt, net = self._fleet_pipe(gm=True)
+        try:
+            snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+            rs = np.random.RandomState(21)
+            xs = [rs.rand(8, 16).astype("float32") for _ in range(3)]
+            ys = [rs.rand(8, 16).astype("float32") for _ in range(3)]
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            pipe.train_batch((paddle.to_tensor(xs[0]), paddle.to_tensor(ys[0])),
+                             hp_opt)
+            hp_opt.discard_merge_window()  # poisoned batch: drop the window
+            pipe.train_batch((paddle.to_tensor(xs[1]), paddle.to_tensor(ys[1])),
+                             hp_opt)
+            # window restarted: still mid-window, no update applied
+            for k, v in net.state_dict().items():
+                np.testing.assert_array_equal(v.numpy(), snap[k])
+            pipe.train_batch((paddle.to_tensor(xs[2]), paddle.to_tensor(ys[2])),
+                             hp_opt)
+            after = {k: v.numpy() for k, v in net.state_dict().items()}
+            dist.reset_mesh()
+
+            # reference: ONE window of exactly batches 1+2 (batch 0 dropped)
+            net2 = _mlp(11)
+            net2.set_state_dict(snap)
+            o2 = opt.Adam(learning_rate=0.05, parameters=net2.parameters())
+            for i in (1, 2):
+                loss = F.mse_loss(net2(paddle.to_tensor(xs[i])),
+                                  paddle.to_tensor(ys[i]))
+                loss.backward()
+            for p in net2.parameters():
+                p.grad.data = p.grad.data / 2.0
+            o2.step()
+            for k, v in net2.state_dict().items():
+                np.testing.assert_allclose(after[k], v.numpy(), rtol=3e-4,
+                                           atol=1e-6)
+        finally:
+            dist.reset_mesh()
+
+    def test_scaler_load_state_dict_reseeds_compiled_state(self):
+        from paddle_tpu.amp import GradScaler
+
+        pipe, hp_opt, net = self._fleet_pipe()
+        try:
+            sc = GradScaler(init_loss_scaling=1024.0)
+            x = np.random.RandomState(22).rand(8, 16).astype("float32")
+            y = np.random.RandomState(23).rand(8, 16).astype("float32")
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt, scaler=sc)
+            sc.load_state_dict({"scale": 64.0})
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt, scaler=sc)
+            (step,) = pipe._steps.values()
+            assert step.amp_state()["loss_scale"] == 64.0
+        finally:
+            dist.reset_mesh()
+
+    def test_scaler_stays_on_compiled_path(self):
+        from paddle_tpu.amp import GradScaler
+
+        pipe, hp_opt, net = self._fleet_pipe()
+        try:
+            sc = GradScaler(init_loss_scaling=256.0)
+            x = np.random.RandomState(3).rand(8, 16).astype("float32")
+            y = np.random.RandomState(4).rand(8, 16).astype("float32")
+            pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt, scaler=sc)
+            (step,) = pipe._steps.values()
+            assert step.scaler is sc  # compiled, not the eager fallback
+            assert step.amp_state()["loss_scale"] == 256.0
+        finally:
+            dist.reset_mesh()
