@@ -90,6 +90,12 @@ def _batch_shard_degree(env) -> int:
 def choose_microbatches(batch: int, desired: int, env=None) -> int:
     """Largest M <= desired with batch % (M * d) == 0, so each microbatch
     spans every dp/sdp shard (keeps the pipeline handoff resharding-free).
+
+    This is NOT an extra TPU-side coupling: it is exactly the reference's
+    requirement that each dp rank's LOCAL batch split into M integral
+    micro-batches (pipeline_parallel.py micro_batch_size * accumulate_steps
+    == local batch) — batch % (M*d) == 0 <=> (batch/d) % M == 0. The minimal
+    global batch that keeps a desired M is therefore M * d rows.
     Falls back to the largest divisor of batch when nothing spans; warns
     whenever the answer differs from what the caller configured."""
     d = _batch_shard_degree(env)
@@ -106,11 +112,24 @@ def choose_microbatches(batch: int, desired: int, env=None) -> int:
     if chosen != desired:
         import warnings
 
+        e = env if env is not None else require_mesh_env()
+        pp = max(e.get_dim("pp"), 1)
         warnings.warn(
-            f"pipeline microbatches clamped {desired} -> {chosen} so batch "
-            f"{batch} divides into microbatches spanning all {d} data shards "
-            f"(larger pipeline bubble; raise the batch size to keep M)")
+            f"pipeline microbatches clamped {desired} -> {chosen}: each "
+            f"microbatch must hold >=1 row from every one of the {d} data "
+            f"shards (the same local-batch divisibility constraint as "
+            f"multi-process PP), which batch {batch} cannot satisfy for "
+            f"M={desired}. Bubble fraction "
+            f"{bubble_fraction(desired, pp):.0%} -> "
+            f"{bubble_fraction(chosen, pp):.0%}; use a global batch that "
+            f"is a multiple of {desired * d} to keep M={desired}")
     return chosen
+
+
+def bubble_fraction(num_microbatches: int, pp: int) -> float:
+    """Fill/drain idle fraction of the synchronous microbatch pipeline:
+    (pp-1)/(M+pp-1), same as the reference 1F1B schedule's bubble."""
+    return (pp - 1) / (num_microbatches + pp - 1)
 
 
 def microbatch(x, num_microbatches: int, env=None):

@@ -125,3 +125,38 @@ def test_stacked_run_matches_sequential_no_mesh():
     got.sum().backward()
     for _, p in run.named_parameters():
         assert p.grad is not None
+
+
+def test_bubble_fraction_formula():
+    from paddle_tpu.distributed.meta_parallel.pipeline import bubble_fraction
+
+    assert bubble_fraction(4, 2) == pytest.approx(1 / 5)
+    assert bubble_fraction(2, 2) == pytest.approx(1 / 3)
+    assert bubble_fraction(8, 1) == 0.0
+
+
+@pytest.mark.dist
+def test_microbatches_kept_when_batch_feasible():
+    """batch >= M*d must keep the configured M with NO clamp warning; the
+    dryrun pp2-dp4 config uses batch 16 for exactly this reason."""
+    import warnings
+
+    from paddle_tpu.distributed.meta_parallel.pipeline import (
+        bubble_fraction, choose_microbatches)
+
+    dist.reset_mesh()
+    dist.init_mesh(pp=2, dp=4)
+    try:
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # any clamp warning -> failure
+            m = choose_microbatches(16, 4)
+        assert m == 4
+        assert bubble_fraction(m, 2) == pytest.approx(1 / 5)
+        # infeasible batch still clamps, loudly, with the minimal batch named
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            m2 = choose_microbatches(8, 4)
+        assert m2 == 2
+        assert any("multiple of 16" in str(x.message) for x in w)
+    finally:
+        dist.reset_mesh()
