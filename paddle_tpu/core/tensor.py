@@ -133,6 +133,14 @@ class Tensor:
             raise TypeError("len() of a 0-d tensor")
         return self.data.shape[0]
 
+    def __iter__(self):
+        # without this, Python falls back to __getitem__(0,1,2,...) waiting
+        # for an IndexError that jnp's clamping indexing never raises — an
+        # eager `for row in tensor` would spin (and compile) forever
+        if not self.data.shape:
+            raise TypeError("iteration over a 0-d tensor")
+        return (self[i] for i in range(self.data.shape[0]))
+
     def __hash__(self):
         return id(self)
 

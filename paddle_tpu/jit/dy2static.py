@@ -10,6 +10,13 @@ TPU-native lite version: an ast pass rewrites the *simple* shapes —
   * `if t:` assigning plain names in each branch -> branch closures returning
     the assigned tuple, dispatched through __pt_if
   * `while t:` whose body assigns plain names    -> __pt_while carry loop
+  * `for i in range(...)` / `for x in tensor:`   -> __pt_for carry loop
+    (reference loop_transformer.py:486 for-to-while lowering)
+  * top-level `break` / `continue` (incl. `if c: break`) -> guard-flag carry
+    (reference break_continue_transformer.py's bool-flag rewrite)
+  * `and` / `or` / `not` inside converted tests  -> __pt_bool_* dispatch
+    (reference logical_transformer.py: logical_and/or ops under trace,
+    short-circuit Python semantics when the operands are concrete)
 into `paddle_tpu.static.nn.cond` / `while_loop`, which run plain Python when
 the predicate is concrete and lower to `lax.cond`/`lax.while_loop` when it is
 traced. Anything more complex is left untouched — tracing such code then hits
@@ -38,9 +45,171 @@ def _runtime_while(cond_fn, body_fn, loop_vars):
     return tuple(out)
 
 
+def _pred_data(x):
+    from ..core.tensor import Tensor
+
+    return x.data if isinstance(x, Tensor) else x
+
+
+def _is_traced(x):
+    import jax
+
+    return isinstance(_pred_data(x), jax.core.Tracer)
+
+
+def _np_bool(x):
+    import numpy as np
+
+    return bool(np.asarray(_pred_data(x)))
+
+
+def _runtime_bool_and(a, b_thunk):
+    """`a and b` — short-circuits when `a` is concrete, logical_and under
+    trace (both sides evaluated, like the reference's logical_and op)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not _is_traced(a):
+        return b_thunk() if _np_bool(a) else a
+    b = b_thunk()
+    return Tensor(jnp.logical_and(jnp.asarray(_pred_data(a)).astype(bool),
+                                  jnp.asarray(_pred_data(b)).astype(bool)))
+
+
+def _runtime_bool_or(a, b_thunk):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not _is_traced(a):
+        return a if _np_bool(a) else b_thunk()
+    b = b_thunk()
+    return Tensor(jnp.logical_or(jnp.asarray(_pred_data(a)).astype(bool),
+                                 jnp.asarray(_pred_data(b)).astype(bool)))
+
+
+def _runtime_bool_not(a):
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not _is_traced(a):
+        return not _np_bool(a)
+    return Tensor(jnp.logical_not(jnp.asarray(_pred_data(a)).astype(bool)))
+
+
+def _runtime_select(pred, new_thunk, old):
+    """Guarded assignment `x = new if live else x` (break/continue lowering).
+    The new value is a THUNK: on the concrete path a dead statement's RHS is
+    never evaluated (it may be the very thing the break was protecting, e.g.
+    `1.0/x` after `if x == 0: continue`). Structural over tuples so
+    `a, b = ...` targets stay convertible."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    if not _is_traced(pred):
+        return new_thunk() if _np_bool(pred) else old
+    new = new_thunk()
+    pd = jnp.asarray(_pred_data(pred)).astype(bool)
+
+    def sel(n, o):
+        nd = n.data if isinstance(n, Tensor) else n
+        od = o.data if isinstance(o, Tensor) else o
+        return Tensor(jnp.where(pd, nd, od))
+
+    if isinstance(new, (tuple, list)):
+        return type(new)(sel(n, o) for n, o in zip(new, old))
+    return sel(new, old)
+
+
+def _runtime_for_range(range_args, body_fn, loop_vars):
+    """`for i in range(...)` -> carry loop. Concrete bounds run the Python
+    loop; a traced stop lowers to a while carry over (i, *vars). The step
+    must be concrete (its sign decides the loop predicate)."""
+    import jax.numpy as jnp
+
+    from ..core.tensor import Tensor
+
+    vals = [_pred_data(a) for a in range_args]
+    if len(vals) == 1:
+        start, stop, step = 0, vals[0], 1
+    elif len(vals) == 2:
+        (start, stop), step = vals, 1
+    else:
+        start, stop, step = vals
+    if _is_traced(step):
+        raise ValueError(
+            "dy2static for-range: the step must be concrete (its sign "
+            "chooses the loop predicate); got a traced step")
+    step = int(step)
+    if step == 0:
+        raise ValueError("range() arg 3 must not be zero")
+    if not (_is_traced(start) or _is_traced(stop)):
+        vs = list(loop_vars)
+        for i in range(int(start), int(stop), step):
+            vs = list(body_fn(i, *vs))
+        return tuple(vs)
+
+    from ..static import nn as static_nn
+
+    def cond_fn(i, *vs):
+        d = _pred_data(i)
+        return Tensor(d < stop) if step > 0 else Tensor(d > stop)
+
+    def body(i, *vs):
+        out = body_fn(i, *vs)
+        return (Tensor(_pred_data(i) + step),) + tuple(out)
+
+    i0 = Tensor(jnp.asarray(start, jnp.int32))
+    res = static_nn.while_loop(cond_fn, body, [i0] + list(loop_vars))
+    return tuple(res[1:])
+
+
+_FOR_UNROLL_LIMIT = 32
+
+
+def _runtime_for_iter(xs, body_fn, loop_vars):
+    """`for x in xs` — Tensors iterate dim 0 (unrolled when short, a
+    dynamic-index while carry when long); other iterables run eagerly."""
+    from ..core.tensor import Tensor
+
+    if not isinstance(xs, Tensor):
+        vs = list(loop_vars)
+        for x in xs:
+            vs = list(body_fn(x, *vs))
+        return tuple(vs)
+    n = int(xs.shape[0])
+    if n <= _FOR_UNROLL_LIMIT:
+        vs = list(loop_vars)
+        for i in range(n):
+            vs = list(body_fn(xs[i], *vs))
+        return tuple(vs)
+    import jax.numpy as jnp
+
+    from ..static import nn as static_nn
+
+    def cond_fn(i, *vs):
+        return Tensor(_pred_data(i) < n)
+
+    def body(i, *vs):
+        out = body_fn(xs[i], *vs)
+        return (Tensor(_pred_data(i) + 1),) + tuple(out)
+
+    i0 = Tensor(jnp.asarray(0, jnp.int32))
+    res = static_nn.while_loop(cond_fn, body, [i0] + list(loop_vars))
+    return tuple(res[1:])
+
+
 def _assigned_names(stmts) -> Optional[List[str]]:
     """Plain Name targets assigned in stmts; None if anything else happens
-    (calls with side effects are fine — only the statement SHAPE matters)."""
+    (calls with side effects on the RHS of an assignment are fine — only the
+    statement SHAPE matters). Helper defs emitted by earlier conversions
+    (`__pt_*`) and docstring exprs are allowed and contribute no names; a
+    BARE call statement bails out (its side effect would run both-branch
+    under lax.cond / once under lax.while_loop)."""
     names = []
     for st in stmts:
         if isinstance(st, ast.Assign):
@@ -57,9 +226,16 @@ def _assigned_names(stmts) -> Optional[List[str]]:
                 names.append(st.target.id)
             else:
                 return None
+        elif isinstance(st, ast.FunctionDef) and st.name.startswith("__pt_"):
+            continue
+        elif isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+            continue  # docstrings; a call Expr may carry side effects that
+            # lax.cond/while (both-branch / once-only tracing) would distort
         else:
             return None
-    return names
+    # live/guard temps are re-derived at each iteration start, not carried
+    return [n for n in names
+            if not n.startswith(("__pt_live", "__pt_g_"))]
 
 
 def _read_before_write(stmts, extra_reads=()) -> set:
@@ -88,6 +264,143 @@ def _read_before_write(stmts, extra_reads=()) -> set:
                         assigned.update(e.id for e in t.elts
                                         if isinstance(e, ast.Name))
     return reads
+
+
+class _TestBoolOps(ast.NodeTransformer):
+    """Rewrite `and`/`or`/`not` inside a (to-be-converted) TEST expression
+    into __pt_bool_* dispatch. Right operands become thunks so Python's
+    short-circuit order is preserved on the concrete path; under trace both
+    sides evaluate and combine via logical ops (reference
+    logical_transformer.py)."""
+
+    def visit_Lambda(self, node):  # nested scopes keep their own semantics
+        return node
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        fn = "__pt_bool_and" if isinstance(node.op, ast.And) else \
+            "__pt_bool_or"
+        expr = node.values[0]
+        for v in node.values[1:]:
+            expr = ast.Call(func=ast.Name(id=fn, ctx=ast.Load()),
+                            args=[expr,
+                                  ast.Lambda(args=_no_args(), body=v)],
+                            keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            return ast.Call(func=ast.Name(id="__pt_bool_not", ctx=ast.Load()),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def _conv_test(expr):
+    return _TestBoolOps().visit(expr)
+
+
+def _name(n, store=False):
+    return ast.Name(id=n, ctx=ast.Store() if store else ast.Load())
+
+
+def _call(fn, *args):
+    return ast.Call(func=_name(fn), args=list(args), keywords=[])
+
+
+def _thunk(expr):
+    return ast.Lambda(args=_no_args(), body=expr)
+
+
+def _lower_breaks(body, uid: int, for_loop: bool = False):
+    """Rewrite top-level `break`/`continue` (bare, or the `if c: break` /
+    `if c: continue` shapes) into live/brk guard flags — the reference
+    break_continue_transformer.py bool-flag rewrite. Statements after the
+    first guard become __pt_sel-guarded assignments (targets must provably
+    pre-exist). Returns (new_body, has_break), or None when the body is out
+    of scope. Bodies with no break/continue come back unchanged."""
+    def _ctrl(st):
+        if isinstance(st, (ast.Break, ast.Continue)):
+            return ast.Constant(value=True), isinstance(st, ast.Break)
+        if (isinstance(st, ast.If) and len(st.body) == 1 and not st.orelse
+                and isinstance(st.body[0], (ast.Break, ast.Continue))):
+            return st.test, isinstance(st.body[0], ast.Break)
+        return None
+
+    if not any(_ctrl(st) for st in body):
+        return list(body), False
+
+    live = f"__pt_live_{uid}"
+    brk = f"__pt_brk_{uid}"
+    pre = _read_before_write(body)
+    has_break = any(_ctrl(st) and _ctrl(st)[1] for st in body)
+    # live starts as "not already broken": for `for` loops the trip count is
+    # fixed, so post-break iterations still run the (fully masked) body
+    init = _call("__pt_bool_not", _name(brk)) if has_break else \
+        ast.Constant(value=True)
+    new = [ast.Assign(targets=[_name(live, store=True)], value=init)]
+    # a for loop's trip count is fixed, so post-break iterations still enter
+    # the body: EVERY statement needs the live mask, not just post-guard ones
+    seen_guard = for_loop and has_break
+    gi = 0
+    for st in body:
+        ctrl = _ctrl(st)
+        if ctrl is not None:
+            guard_expr, is_break = ctrl
+            gi += 1
+            gname = f"__pt_g_{uid}_{gi}"
+            new.append(ast.Assign(targets=[_name(gname, store=True)],
+                                  value=_conv_test(guard_expr)))
+            if is_break:
+                hit = _call("__pt_bool_and", _name(live), _thunk(_name(gname)))
+                new.append(ast.Assign(
+                    targets=[_name(brk, store=True)],
+                    value=_call("__pt_bool_or", _name(brk), _thunk(hit))))
+            new.append(ast.Assign(
+                targets=[_name(live, store=True)],
+                value=_call("__pt_bool_and", _name(live),
+                            _thunk(_call("__pt_bool_not", _name(gname))))))
+            seen_guard = True
+            continue
+        if isinstance(st, (ast.Assign, ast.AugAssign)):
+            if not seen_guard:
+                new.append(st)
+                continue
+            if isinstance(st, ast.AugAssign):
+                if not isinstance(st.target, ast.Name):
+                    return None
+                targets = [st.target.id]
+                value = ast.BinOp(left=_name(st.target.id), op=st.op,
+                                  right=st.value)
+                store = _name(st.target.id, store=True)
+                old = _name(st.target.id)
+            else:
+                if len(st.targets) != 1:
+                    return None
+                t = st.targets[0]
+                if isinstance(t, ast.Name):
+                    targets = [t.id]
+                    old = _name(t.id)
+                elif isinstance(t, ast.Tuple) and all(
+                        isinstance(e, ast.Name) for e in t.elts):
+                    targets = [e.id for e in t.elts]
+                    old = ast.Tuple(elts=[_name(x) for x in targets],
+                                    ctx=ast.Load())
+                else:
+                    return None
+                store = t
+                value = st.value
+            if any(x not in pre for x in targets):
+                return None  # guarded target may not pre-exist: bail
+            new.append(ast.Assign(
+                targets=[store],
+                value=_call("__pt_sel", _name(live), _thunk(value), old)))
+            continue
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Constant):
+            new.append(st)  # docstrings only: a call Expr may have side
+            continue        # effects that guards/trace can't mask
+        return None  # anything else is out of scope
+    return new, has_break
 
 
 def _branch_fn(name: str, stmts, targets: List[str], params: List[str]):
@@ -133,7 +446,7 @@ class _CtrlFlow(ast.NodeTransformer):
             self.changed = True
             call = ast.Call(
                 func=ast.Name(id="__pt_if", ctx=ast.Load()),
-                args=[node.test,
+                args=[_conv_test(node.test),
                       ast.Lambda(args=_no_args(), body=node.body[0].value),
                       ast.Lambda(args=_no_args(), body=node.orelse[0].value)],
                 keywords=[])
@@ -167,7 +480,7 @@ class _CtrlFlow(ast.NodeTransformer):
                 elts=[ast.Name(id=t, ctx=ast.Store()) for t in targets],
                 ctx=ast.Store())],
             value=ast.Call(func=ast.Name(id="__pt_if", ctx=ast.Load()),
-                           args=[node.test,
+                           args=[_conv_test(node.test),
                                  ast.Name(id=tfn.name, ctx=ast.Load()),
                                  ast.Name(id=ffn.name, ctx=ast.Load())],
                            keywords=[]))
@@ -178,27 +491,41 @@ class _CtrlFlow(ast.NodeTransformer):
         self.generic_visit(node)
         if node.orelse:
             return node
-        carry = _assigned_names(node.body)
+        uid = self._uid()
+        lowered = _lower_breaks(node.body, uid)
+        if lowered is None:
+            return node
+        body, has_break = lowered
+        test = _conv_test(node.test)
+        prelude = []
+        if has_break:
+            brk = f"__pt_brk_{uid}"
+            # brk wins over the original predicate (evaluated first, so the
+            # original test may even rely on loop-var bounds kept by brk)
+            test = _call("__pt_bool_and",
+                         _call("__pt_bool_not", _name(brk)), _thunk(test))
+            prelude.append(ast.Assign(targets=[_name(brk, store=True)],
+                                      value=ast.Constant(value=False)))
+        carry = _assigned_names(body)
         if not carry:
             return node
         carry = sorted(set(carry))
         # every carried name must provably pre-exist (read before written in
         # test/body) — a loop-local temp would be unbound in the initial
         # carry list where the eager loop ran fine
-        pre = _read_before_write([ast.Expr(value=node.test)] + node.body)
+        pre = _read_before_write([ast.Expr(value=test)] + body)
         if any(c not in pre for c in carry):
             return node
-        uid = self._uid()
         cargs = ast.arguments(
             posonlyargs=[], args=[ast.arg(arg=c) for c in carry], vararg=None,
             kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
         cond_fn = ast.FunctionDef(
             name=f"__pt_cond_{uid}", args=cargs,
-            body=[ast.Return(value=node.test)], decorator_list=[],
+            body=[ast.Return(value=test)], decorator_list=[],
             returns=None)
         body_fn = ast.FunctionDef(
             name=f"__pt_body_{uid}", args=cargs,
-            body=list(node.body) + [ast.Return(value=ast.Tuple(
+            body=list(body) + [ast.Return(value=ast.Tuple(
                 elts=[ast.Name(id=c, ctx=ast.Load()) for c in carry],
                 ctx=ast.Load()))],
             decorator_list=[], returns=None)
@@ -215,7 +542,65 @@ class _CtrlFlow(ast.NodeTransformer):
                 keywords=[]))
         self.changed = True
         return [ast.copy_location(x, node)
-                for x in (cond_fn, body_fn, assign)]
+                for x in prelude + [cond_fn, body_fn, assign]]
+
+    def visit_For(self, node):
+        """`for i in range(...)` / `for x in xs:` -> __pt_for_* carry loop
+        (reference loop_transformer.py:486 for-to-while lowering)."""
+        self.generic_visit(node)
+        if node.orelse or not isinstance(node.target, ast.Name):
+            return node
+        uid = self._uid()
+        lowered = _lower_breaks(node.body, uid, for_loop=True)
+        if lowered is None:
+            return node
+        body, has_break = lowered
+        prelude = []
+        if has_break:
+            prelude.append(ast.Assign(
+                targets=[_name(f"__pt_brk_{uid}", store=True)],
+                value=ast.Constant(value=False)))
+        carry = _assigned_names(body)
+        if carry is None:
+            return node
+        carry = sorted(set(carry))
+        loop_var = node.target.id
+        if loop_var in carry or not carry:
+            return node  # reassigned loop var / pure-side-effect body: bail
+        pre = _read_before_write(body)
+        if any(c not in pre for c in carry):
+            return node
+        it = node.iter
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and not it.keywords
+                and 1 <= len(it.args) <= 3
+                and not any(isinstance(a, ast.Starred) for a in it.args)):
+            helper = "__pt_for_range"
+            iter_arg = ast.Tuple(elts=list(it.args), ctx=ast.Load())
+        else:
+            helper = "__pt_for_iter"
+            iter_arg = it
+        cargs = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=loop_var)] +
+            [ast.arg(arg=c) for c in carry], vararg=None,
+            kwonlyargs=[], kw_defaults=[], kwarg=None, defaults=[])
+        body_fn = ast.FunctionDef(
+            name=f"__pt_fbody_{uid}", args=cargs,
+            body=list(body) + [ast.Return(value=ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Load()) for c in carry],
+                ctx=ast.Load()))],
+            decorator_list=[], returns=None)
+        assign = ast.Assign(
+            targets=[ast.Tuple(
+                elts=[ast.Name(id=c, ctx=ast.Store()) for c in carry],
+                ctx=ast.Store())],
+            value=_call(helper, iter_arg,
+                        _name(body_fn.name),
+                        ast.List(elts=[_name(c) for c in carry],
+                                 ctx=ast.Load())))
+        self.changed = True
+        return [ast.copy_location(x, node)
+                for x in prelude + [body_fn, assign]]
 
 
 def _no_args():
@@ -284,6 +669,12 @@ def convert_to_static(fn):
     glb = dict(raw.__globals__)
     glb["__pt_if"] = _runtime_if
     glb["__pt_while"] = _runtime_while
+    glb["__pt_for_range"] = _runtime_for_range
+    glb["__pt_for_iter"] = _runtime_for_iter
+    glb["__pt_bool_and"] = _runtime_bool_and
+    glb["__pt_bool_or"] = _runtime_bool_or
+    glb["__pt_bool_not"] = _runtime_bool_not
+    glb["__pt_sel"] = _runtime_select
     loc: dict = {}
     try:
         exec(compile(tree, f"<dy2static:{raw.__name__}>", "exec"), glb, loc)

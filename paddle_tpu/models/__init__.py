@@ -1,7 +1,8 @@
 """Flagship model zoo (NLP side; vision lives in paddle_tpu.vision.models)."""
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaMoEConfig, LlamaModel, LlamaForCausalLM, LlamaDecoderLayer,
-    llama_param_count, llama_flops_per_token, apply_rotary_pos_emb,
+    llama_param_count, llama_flops_per_token, llama_moe_param_counts,
+    llama_moe_flops_per_token, apply_rotary_pos_emb,
 )
 from .gpt import (  # noqa: F401
     GPTConfig, GPTModel, GPTForCausalLM, GPTAttention, GPTForCausalLMPipe,

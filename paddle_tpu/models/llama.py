@@ -361,3 +361,29 @@ def llama_param_count(config: LlamaConfig) -> int:
     kvh = config.num_key_value_heads * (h // config.num_attention_heads)
     per_layer = h * h + 2 * h * kvh + h * h + 3 * h * i + 2 * h
     return L * per_layer + 2 * v * h + h
+
+
+def llama_moe_param_counts(config: "LlamaMoEConfig"):
+    """(total, activated-per-token) parameter counts for the MoE variant:
+    every token runs attention + embeddings + gate but only top_k of the
+    num_experts expert FFNs."""
+    h, v, L = (config.hidden_size, config.vocab_size,
+               config.num_hidden_layers)
+    i = config.moe_intermediate_size or config.intermediate_size
+    kvh = config.num_key_value_heads * (h // config.num_attention_heads)
+    attn_layer = h * h + 2 * h * kvh + h * h + 2 * h
+    expert = 3 * h * i
+    gate = h * config.num_experts
+    shared = L * (attn_layer + gate) + 2 * v * h + h
+    total = shared + L * config.num_experts * expert
+    activated = shared + L * config.top_k * expert
+    return total, activated
+
+
+def llama_moe_flops_per_token(config: "LlamaMoEConfig", seq_len: int) -> float:
+    """Model FLOPs per token for MFU on the MoE flagship: 6 * ACTIVATED
+    params + attention term (the standard sparse-model MFU convention —
+    capacity-factor overcompute counts as overhead, not useful flops)."""
+    _, activated = llama_moe_param_counts(config)
+    attn = 12 * config.num_hidden_layers * config.hidden_size * seq_len
+    return 6 * activated + attn

@@ -60,24 +60,118 @@ def drain_aux(bucket):
     return total
 
 
-@primitive("moe_mlp")
-def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor, ep_degree):
-    """Routed expert FFN: [b, s, h] -> ([b, s, h], aux_loss).
-
-    GShard dispatch: slot-major cumsum assigns each (token, choice) a position
-    in its expert's capacity buffer; overflow drops. Router math in fp32.
-    """
-    b, s, h = x.shape
-    n = b * s
+def _route(xt, wg, top_k):
+    """Router: fp32 softmax + renormalized top-k, and the Switch/GShard
+    load-balancing aux (e * sum(frac_tokens * frac_probs))."""
+    n, _ = xt.shape
     e = wg.shape[1]
-    cap = int(math.ceil(capacity_factor * top_k * n / e))
-    cap = max(cap, top_k)
-
-    xt = x.reshape(n, h)
     logits = jnp.matmul(xt.astype(jnp.float32), wg.astype(jnp.float32))
     probs = jax.nn.softmax(logits, axis=-1)  # [n, e]
     gate_v, gate_i = jax.lax.top_k(probs, top_k)  # [n, k]
     gate_v = gate_v / jnp.maximum(jnp.sum(gate_v, -1, keepdims=True), 1e-9)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(gate_i[:, 0], e, dtype=jnp.float32), axis=0)
+    aux = e * jnp.sum(me * ce)
+    return gate_v, gate_i, aux
+
+
+def _expert_ffn(expert_in, w_gate, w_up, w_down, ep_degree):
+    """Batched per-expert SwiGLU on [e, cap, h] buffers (one MXU matmul per
+    projection; gate/up separate so the silu(gate)*up multiply stays local
+    per mp shard)."""
+    expert_in = _ep_constraint(expert_in, ep_degree)
+    g = jnp.einsum("ech,ehi->eci", expert_in, w_gate)
+    u = jnp.einsum("ech,ehi->eci", expert_in, w_up)
+    act = jax.nn.silu(g) * u
+    expert_out = jnp.einsum("eci,eih->ech", act, w_down)
+    return _ep_constraint(expert_out, ep_degree)
+
+
+@primitive("moe_mlp")
+def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
+             ep_degree, dispatch="sort"):
+    """Routed expert FFN: [b, s, h] -> ([b, s, h], aux_loss).
+
+    Two dispatch strategies, same drop semantics (slot-major: every token's
+    1st choice outranks any 2nd choice for capacity):
+
+    - 'sort' (default): tokens are argsorted by expert id; each (token,
+      choice) takes the next position in its expert's capacity buffer via a
+      gather, and outputs scatter-add back per token. O(k*n*h) memory — the
+      TPU-native form of the reference's count-based global_scatter
+      (global_scatter_op.cc builds exactly these per-expert contiguous
+      buffers from counts).
+    - 'einsum': GShard one-hot dispatch/combine einsums. O(n*e*cap)
+      intermediates (quadratic in tokens at fixed capacity factor) — kept as
+      the oracle for parity tests and for comparison, via
+      FLAGS_moe_dispatch=einsum.
+
+    `dispatch` is a primitive ATTR (cache-key participant): the caller reads
+    the flag so a set_flags after the first call still takes effect.
+    """
+    impl = _moe_mlp_einsum if dispatch == "einsum" else _moe_mlp_sort
+    return impl(x, wg, w_gate, w_up, w_down, top_k=top_k,
+                capacity_factor=capacity_factor, ep_degree=ep_degree)
+
+
+def _moe_mlp_sort(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
+                  ep_degree):
+    """All [*, h]-row movement is GATHERS — TPU scatters of wide rows
+    serialize, so the two scatters here touch only int32 index vectors
+    (slot->source map and inverse permutation)."""
+    b, s, h = x.shape
+    n = b * s
+    e = wg.shape[1]
+    kn = top_k * n
+    cap = max(int(math.ceil(capacity_factor * top_k * n / e)), top_k)
+
+    xt = x.reshape(n, h)
+    gate_v, gate_i, aux = _route(xt, wg, top_k)
+
+    # slot-major flattening (all 1st choices before any 2nd choice), then a
+    # stable sort by expert groups tokens while preserving choice priority
+    flat_e = gate_i.T.reshape(kn)
+    flat_g = gate_v.T.reshape(kn)
+    order = jnp.argsort(flat_e, stable=True)
+    sorted_e = flat_e[order]
+    starts = jnp.searchsorted(sorted_e, jnp.arange(e))  # [e] group offsets
+    pos = jnp.arange(kn, dtype=jnp.int32) - starts[sorted_e]
+    keep = pos < cap
+    # dropped entries land on a scratch slot past the buffer
+    slot = jnp.where(keep, sorted_e * cap + pos, e * cap)
+    tok = order % n  # flat index j = choice*n + token
+
+    # dispatch: slot -> source token map (int scatter), then one row gather;
+    # unfilled slots point at a zero row
+    slot_src = jnp.full((e * cap + 1,), n, jnp.int32).at[slot].set(
+        tok.astype(jnp.int32), mode="drop")[:-1]
+    xt_pad = jnp.concatenate([xt, jnp.zeros((1, h), x.dtype)])
+    buf = xt_pad[slot_src]
+
+    expert_out = _expert_ffn(buf.reshape(e, cap, h), w_gate, w_up,
+                             w_down, ep_degree).reshape(e * cap, h)
+
+    # combine: gather each kept choice's output row, undo the sort with the
+    # inverse permutation (int scatter + gather), then sum the k choices
+    contrib = jnp.where(
+        keep[:, None],
+        expert_out[jnp.clip(slot, 0, e * cap - 1)],
+        jnp.zeros((), x.dtype)) * flat_g[order][:, None].astype(x.dtype)
+    inv = jnp.zeros((kn,), jnp.int32).at[order].set(
+        jnp.arange(kn, dtype=jnp.int32))
+    out = jnp.sum(contrib[inv].reshape(top_k, n, h), axis=0)
+    return out.reshape(b, s, h), aux
+
+
+def _moe_mlp_einsum(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
+                    ep_degree):
+    b, s, h = x.shape
+    n = b * s
+    e = wg.shape[1]
+    cap = max(int(math.ceil(capacity_factor * top_k * n / e)), top_k)
+
+    xt = x.reshape(n, h)
+    gate_v, gate_i, aux = _route(xt, wg, top_k)
 
     # slot-major one-hot so the 1st choice wins capacity over 2nd choices
     oh = jax.nn.one_hot(gate_i.T.reshape(top_k * n), e, dtype=jnp.float32)
@@ -92,21 +186,8 @@ def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor, ep_degree):
     disp = jnp.sum(disp, axis=1)  # [n, e, cap]
     combine = jnp.sum(combine, axis=1)
 
-    # aux load-balancing loss (Switch/GShard): e * sum(frac_tokens * frac_probs)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.mean(jax.nn.one_hot(gate_i[:, 0], e, dtype=jnp.float32), axis=0)
-    aux = e * jnp.sum(me * ce)
-
-    # expert compute: [e, cap, h] buffers, weights [e, h, i]/[e, i, h] on 'ep'
-    # (gate and up are separate params so each mp shard holds matching halves
-    # and the silu(gate)*up multiply stays local)
     expert_in = jnp.einsum("nec,nh->ech", disp.astype(x.dtype), xt)
-    expert_in = _ep_constraint(expert_in, ep_degree)
-    g = jnp.einsum("ech,ehi->eci", expert_in, w_gate)
-    u = jnp.einsum("ech,ehi->eci", expert_in, w_up)
-    act = jax.nn.silu(g) * u
-    expert_out = jnp.einsum("eci,eih->ech", act, w_down)
-    expert_out = _ep_constraint(expert_out, ep_degree)
+    expert_out = _expert_ffn(expert_in, w_gate, w_up, w_down, ep_degree)
     out = jnp.einsum("ech,nec->nh", expert_out, combine.astype(x.dtype))
     return out.reshape(b, s, h), aux
 
@@ -159,11 +240,14 @@ class MoELayer(Layer):
 
     def forward(self, x):
         from ...distributed.mesh import get_mesh_env
+        from ...framework import flags as flags_mod
 
         env = get_mesh_env()
         ep = env.get_dim("ep") if env is not None else 1
+        mode = flags_mod.get_flags("FLAGS_moe_dispatch")["FLAGS_moe_dispatch"]
         out, aux = _moe_mlp(x, self.gate_weight, self.experts.gate,
                             self.experts.up, self.experts.down, top_k=self.top_k,
-                            capacity_factor=self.capacity_factor, ep_degree=ep)
+                            capacity_factor=self.capacity_factor, ep_degree=ep,
+                            dispatch=mode)
         record_aux(aux)
         return out

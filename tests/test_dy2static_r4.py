@@ -1,0 +1,266 @@
+"""Round-4 dy2static breadth: for loops, break/continue, bool-op predicates.
+
+Patterns ported from the reference dygraph_to_static unittests
+(test_loop.py, test_break_continue.py, test_logical_operator.py shapes);
+each converted function must agree with its eager run.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _check(f, *inputs, rtol=1e-6):
+    st = paddle.jit.to_static(f)
+    for args in inputs:
+        args = [paddle.to_tensor(a) for a in args]
+        want = f(*args)
+        got = st(*args)
+        np.testing.assert_allclose(got.numpy(), want.numpy(), rtol=rtol)
+
+
+class TestForLoops:
+    def test_for_range_accumulate(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(5):
+                s = s + x * float(i)
+            return s
+
+        _check(f, ([1.0, 2.0],), ([-3.0, 0.5],))
+
+    def test_for_range_start_stop_step(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(1, 9, 2):
+                s = s + i
+            return s + x
+
+        _check(f, ([1.0],))
+
+    def test_for_range_tensor_bound(self):
+        """`for i in range(t)` with a TRACED bound lowers to a while carry."""
+        def f(x, n):
+            s = x * 0.0
+            for i in range(n):
+                s = s + x
+            return s
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor([2.0, 3.0])
+        out = st(x, paddle.to_tensor(4))
+        np.testing.assert_allclose(out.numpy(), [8.0, 12.0])
+        out = st(x, paddle.to_tensor(0))
+        np.testing.assert_allclose(out.numpy(), [0.0, 0.0])
+
+    def test_for_over_tensor_rows(self):
+        def f(x):
+            s = x[0] * 0.0
+            for v in x:
+                s = s + v * v
+            return s
+
+        _check(f, (np.arange(6, dtype="float32").reshape(3, 2),))
+
+    def test_for_with_augassign(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(4):
+                s += x
+            return s
+
+        _check(f, ([1.5, -2.0],))
+
+    def test_for_containing_convertible_if(self):
+        def f(x):
+            s = x.sum() * 0.0
+            for v in x:
+                if v > 0:
+                    s = s + v
+                else:
+                    s = s - v
+            return s
+
+        _check(f, ([1.0, -2.0, 3.0],), ([-1.0, -1.0, -1.0],))
+
+
+class TestBreakContinue:
+    def test_while_guarded_break(self):
+        def f(x):
+            i = x.sum() * 0 + 0.0
+            s = x.sum() * 0.0
+            while i < 10:
+                if s > 20:
+                    break
+                s = s + i
+                i = i + 1
+            return s
+
+        _check(f, ([1.0],))
+
+    def test_for_guarded_break(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(10):
+                if i >= 3:
+                    break
+                s = s + x
+            return s
+
+        _check(f, ([2.0, 4.0],))
+
+    def test_for_guarded_continue(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(6):
+                if i == 2:
+                    continue
+                s = s + x * float(1.0)
+            return s
+
+        _check(f, ([1.0, -1.0],))
+
+    def test_for_tensor_guard_continue(self):
+        """Guard on the loop DATA (traced even with concrete trip count)."""
+        def f(x):
+            s = x[0] * 0.0
+            for v in x:
+                if v.sum() < 0:
+                    continue
+                s = s + v
+            return s
+
+        _check(f, (np.array([[1.0], [-2.0], [3.0]], "float32"),))
+
+    def test_bare_break_after_work(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(5):
+                s = s + x
+                break
+            return s
+
+        _check(f, ([7.0],))
+
+    def test_while_break_on_tensor_state(self):
+        def f(x):
+            s = x.sum() * 0.0
+            i = s * 0.0
+            while i < 100:
+                s = s + x.sum()
+                i = i + 1.0
+                if s > 5:
+                    break
+            return s
+
+        _check(f, ([2.0],), ([0.5],))
+
+
+class TestBoolOps:
+    def test_if_and(self):
+        def f(x, y):
+            if x.sum() > 0 and y.sum() > 0:
+                r = x + y
+            else:
+                r = x - y
+            return r
+
+        _check(f, ([1.0], [2.0]), ([1.0], [-2.0]), ([-1.0], [2.0]))
+
+    def test_if_or_not(self):
+        def f(x, y):
+            if not (x.sum() > 0) or y.sum() > 0:
+                r = x * 2.0
+            else:
+                r = y * 3.0
+            return r
+
+        _check(f, ([1.0], [2.0]), ([1.0], [-2.0]), ([-1.0], [-2.0]))
+
+    def test_while_boolop_test(self):
+        def f(x):
+            s = x.sum() * 0.0
+            i = s * 0.0
+            while i < 10 and s < 6:
+                s = s + x.sum()
+                i = i + 1.0
+            return s
+
+        _check(f, ([2.0],), ([0.25],))
+
+    def test_break_guard_with_boolop(self):
+        def f(x):
+            s = x.sum() * 0.0
+            for i in range(8):
+                if s > 3 and i > 1:
+                    break
+                s = s + x.sum()
+            return s
+
+        _check(f, ([1.0],), ([5.0],))
+
+
+class TestConversionSafety:
+    def test_for_else_not_converted(self):
+        """for/else is out of scope: must stay Python (and still run eagerly)."""
+        def f(x):
+            s = x * 0.0
+            for i in range(3):
+                s = s + x
+            else:
+                s = s + 1.0
+            return s
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        assert convert_to_static(f) is f
+
+    def test_guarded_fresh_name_not_converted(self):
+        """An assignment after a guard whose target does NOT pre-exist can't
+        be select-guarded — the loop must stay unconverted."""
+        def f(x):
+            s = x * 0.0
+            for i in range(4):
+                if i > 1:
+                    continue
+                fresh = x * 2.0
+                s = s + fresh
+            return s
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        assert convert_to_static(f) is f
+
+    def test_loop_var_reassign_not_converted(self):
+        def f(x):
+            s = x * 0.0
+            for i in range(4):
+                i = i + 1
+                s = s + i
+            return s
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        assert convert_to_static(f) is f
+
+    def test_converted_runs_inside_trace(self):
+        """The converted loop must actually compile: run under jit tracing
+        where Python control flow on tensors would raise."""
+        import jax
+
+        def f(x):
+            s = x * 0.0
+            for i in range(6):
+                if s.sum() > 4:
+                    break
+                s = s + x
+            return s
+
+        st = paddle.jit.to_static(f)
+        from paddle_tpu.core.tensor import Tensor
+
+        def traced(a):
+            return st(Tensor(a)).data
+
+        out = jax.jit(traced)(np.array([1.0, 1.0], "float32"))
+        np.testing.assert_allclose(np.asarray(out), [3.0, 3.0])
