@@ -184,8 +184,17 @@ def _measure_moe(cfg, batch, seq, iters):
     mfu = tokens_per_sec * llama_moe_flops_per_token(cfg, seq) \
         / detect_peak() * 100.0
     total, activated = llama_moe_param_counts(cfg)
+    # executed MFU: counts the capacity-factor overcompute the chip actually
+    # performs (cf * expert param flops; the attention term is NOT scaled —
+    # only expert FFNs run at capacity)
+    i = cfg.moe_intermediate_size or cfg.intermediate_size
+    expert_act = cfg.num_hidden_layers * cfg.top_k * 3 * cfg.hidden_size * i
+    act_flops = llama_moe_flops_per_token(cfg, seq)
+    exec_flops = act_flops + 6 * (cfg.capacity_factor - 1.0) * expert_act
+    mfu_exec = tokens_per_sec * exec_flops / detect_peak() * 100.0
     return {
         "mfu_activated": round(mfu, 2),
+        "mfu_executed": round(mfu_exec, 2),
         "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
         "step_time_s": round(dt, 4),
         "loss": round(float(loss), 4),

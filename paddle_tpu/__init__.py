@@ -55,18 +55,26 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None, create_graph=Fal
     return results
 
 
-def disable_static(place=None):  # dygraph is the only mode; compat no-op
+def disable_static(place=None):
+    from .static import compat
+
+    compat.disable_static()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is dygraph-first; use paddle_tpu.jit.to_static for graph capture"
-    )
+    """Enter static-graph compat mode: ops record into the default Program
+    (replayed by static.Executor.run) while the build runs eagerly on
+    placeholder values. See static/compat.py."""
+    from .static import compat
+
+    compat.enable_static()
 
 
 def in_dynamic_mode():
-    return True
+    from .static import compat
+
+    return not compat.in_static_mode()
 
 
 in_dygraph_mode = in_dynamic_mode

@@ -263,6 +263,7 @@ def _check_nan_inf(op_name, outs):
 
 
 _HOT = None  # lazily-bound (amp_state, maybe_cast_inputs, flags, profiler, time)
+_static_recorder = [None]  # lazily-bound static.compat module (False = absent)
 
 
 def dispatch(prim, args, attrs):
@@ -295,6 +296,7 @@ def dispatch(prim, args, attrs):
         _HOT = (amp_state, maybe_cast_inputs, flags, profiler, time)
     amp_state, maybe_cast_inputs, _flags, _profiler, _time = _HOT
 
+    arrays_precast = arrays
     if amp_state()["enabled"]:
         arrays = maybe_cast_inputs(prim.name, arrays)
 
@@ -315,6 +317,23 @@ def dispatch(prim, args, attrs):
             _check_nan_inf(prim.name, outs_raw)
     if _prof:
         _profiler.record_op_span(prim.name, _t0)
+
+    # static-mode shim: record the SSA node into the default Program
+    # (reference: static append_op; see static/compat.py)
+    if _static_recorder[0] is None:
+        try:
+            from ..static import compat as _compat
+
+            _static_recorder[0] = _compat
+        except ImportError:  # mid-build partial package
+            _static_recorder[0] = False
+    _compat = _static_recorder[0]
+    if _compat and _compat.in_static_mode():
+        # record against the PRE-amp-cast arrays: a cast copy has a fresh id,
+        # which would sever feed placeholders from the replayed graph (the
+        # replay then runs un-cast, i.e. at full precision — fine)
+        _compat.record_dispatch(prim, attrs, arrays_precast, inputs,
+                                outs_raw, multi)
 
     record = any_grad and is_grad_enabled() and not prim.nondiff
     out_tensors = [Tensor(o, stop_gradient=not record) for o in outs_raw]

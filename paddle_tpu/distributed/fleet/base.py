@@ -23,9 +23,29 @@ class ParallelMode:
 class DistributedStrategy:
     """Typed config tree (distributed_strategy.proto role, SURVEY §5 config).
 
-    Attribute surface mirrors the reference's proto sections; only fields the
-    TPU stack consumes are live, the rest are stored for compatibility.
-    """
+    Attribute surface mirrors the reference's proto sections. Every settable
+    field is either CONSUMED by the TPU stack or warns loudly on assignment
+    — there are no silently-ignored knobs (asserted by
+    tests/test_fixes_r4.py::TestStrategyFlags)."""
+
+    # CUDA/NCCL-era optimizations with no TPU-stack counterpart: setting one
+    # warns that it cannot take effect (the fail-loud convention)
+    _UNSUPPORTED = {
+        "dgc": "deep-gradient-compression rewrites NCCL allreduce payloads; "
+               "the compiled step's dp reduction is an XLA collective",
+        "fp16_allreduce": "the compiled step already reduces in the model's "
+                          "dtype; cast-before-allreduce is a NCCL-era knob",
+        "a_sync": "parameter-server async mode lives in distributed.ps "
+                  "(AsyncCommunicator), not the collective strategy",
+    }
+    # accepted-for-compat fields whose job XLA already performs; warn when
+    # changed from the default so nobody expects a behavior change
+    _COMPAT_DEFAULTS = {
+        "find_unused_parameters": False,
+        "fuse_all_reduce_ops": True,
+        "fuse_grad_size_in_MB": 32,
+        "nccl_comm_num": 1,
+    }
 
     def __init__(self):
         self.hybrid_configs = {
@@ -47,7 +67,10 @@ class DistributedStrategy:
         self.lamb = False
         self.lars = False
         self.dgc = False
+        self.fp16_allreduce = False
+        self.a_sync = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 1, "begin_step": 1}
         self.find_unused_parameters = False
         self.fuse_all_reduce_ops = True
         self.fuse_grad_size_in_MB = 32
@@ -55,6 +78,19 @@ class DistributedStrategy:
         self.gradient_scale_configs = {"scale_strategy": "avg"}
 
     def __setattr__(self, k, v):
+        import warnings
+
+        if k in self._UNSUPPORTED and v:
+            warnings.warn(
+                f"DistributedStrategy.{k} has no effect on the TPU stack: "
+                f"{self._UNSUPPORTED[k]}", stacklevel=2)
+        elif k in self._COMPAT_DEFAULTS and k in self.__dict__ \
+                and v != self._COMPAT_DEFAULTS[k]:
+            warnings.warn(
+                f"DistributedStrategy.{k} is compat-only on the TPU stack "
+                f"(XLA fuses/schedules the dp reduction); changing it from "
+                f"{self._COMPAT_DEFAULTS[k]!r} does not alter execution",
+                stacklevel=2)
         object.__setattr__(self, k, v)
 
     def __repr__(self):

@@ -86,7 +86,8 @@ class PipelineLayer(Layer):
                 built.append(_FnLayer(d))
             else:
                 raise TypeError(f"bad pipeline element {d!r}")
-        self.segment_parts = self._segment(len(built), self._num_stages, seg_method)
+        self.segment_parts = self._segment(len(built), self._num_stages,
+                                           seg_method, layers=built)
         self._built = built
         self._pipeline_engaged = self._try_compile_pipeline(built)
         if not self._pipeline_engaged:
@@ -146,11 +147,38 @@ class PipelineLayer(Layer):
         return True
 
     @staticmethod
-    def _segment(n, stages, seg_method):
+    def _segment(n, stages, seg_method, layers=None):
         """_segment_network (reference :282): uniform split by layer count,
-        or 'layer:<Pattern>' balancing only matching layers."""
-        if isinstance(seg_method, str) and seg_method.startswith("layer:"):
-            return PipelineLayer._uniform(n, stages)  # pattern-balanced ~ uniform here
+        or 'layer:<Pattern>' balancing only layers whose CLASS NAME matches
+        the regex — heavy edge layers (embedding/head) then ride along with
+        their neighbor stage instead of skewing the split."""
+        if isinstance(seg_method, str) and seg_method.startswith("layer:") \
+                and layers is not None:
+            import re
+            import warnings
+
+            pat = seg_method[len("layer:"):]
+            weights = [1 if re.search(pat, type(l).__name__) else 0
+                       for l in layers]
+            total = sum(weights)
+            if total < stages:
+                warnings.warn(
+                    f"PipelineLayer seg_method={seg_method!r}: only {total} "
+                    f"layers match for {stages} stages; falling back to the "
+                    f"uniform layer-count split")
+                return PipelineLayer._uniform(n, stages)
+            parts = [0]
+            prefix = [0]
+            for w in weights:
+                prefix.append(prefix[-1] + w)
+            for s in range(1, stages):
+                want = round(s * total / stages)
+                idx = parts[-1] + 1  # stages must be non-empty
+                while idx < n - (stages - s - 1) and prefix[idx] < want:
+                    idx += 1
+                parts.append(idx)
+            parts.append(n)
+            return parts
         return PipelineLayer._uniform(n, stages)
 
     @staticmethod

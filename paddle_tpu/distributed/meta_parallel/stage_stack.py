@@ -28,6 +28,30 @@ from ..mesh import get_mesh_env
 
 _RUN_REGISTRY = {}
 
+# streamed-offload trace mode (jit.StreamedTrainStep): stacked params arrive
+# as TPU pinned-host arrays and the stack unrolls layer-by-layer H2D copies
+# instead of scanning device-resident weights
+_STREAM_MODE = [False]
+
+
+def _memory_sharding(kind: str):
+    """SingleDeviceSharding with a memory kind; None when the backend cannot
+    execute memory-space placement (the CPU test backend lists pinned_host
+    but has no annotate_device_placement kernel — and everything is host RAM
+    there anyway)."""
+    from jax.sharding import SingleDeviceSharding
+
+    dev = jax.devices()[0]
+    if dev.platform == "cpu":
+        return None
+    try:
+        kinds = {m.kind for m in dev.addressable_memories()}
+    except Exception:
+        kinds = set()
+    if kind not in kinds:
+        return None
+    return SingleDeviceSharding(dev, memory_kind=kind)
+
 
 def remat_wrap(fn):
     """jax.checkpoint with the policy chosen by FLAGS_remat_policy:
@@ -111,7 +135,8 @@ class StackedStageRun(Layer):
         stacked = [self._parameters[safe] for safe, _ in self._names]
         out, aux = _run_stack(hidden, *stacked, _run_id=id(self),
                               use_recompute=self.recompute and self.training,
-                              microbatches=self.num_microbatches or 0)
+                              microbatches=self.num_microbatches or 0,
+                              stream=_STREAM_MODE[0])
         from ...nn.layer import moe as moe_mod
 
         moe_mod.record_aux(aux)
@@ -119,7 +144,8 @@ class StackedStageRun(Layer):
 
 
 @primitive("pp_stage_stack")
-def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches):
+def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches,
+                  stream=False):
     from ...core import autograd
     from ...nn.layer import moe as moe_mod
 
@@ -142,6 +168,29 @@ def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches):
 
     env = get_mesh_env()
     pp = env.get_dim("pp") if env is not None else 1
+    if stream:
+        # streamed ZeRO-offload (reference sharding_stage3.py:50 offload +
+        # TaskFlow prefetch :737): the stacked weights live in TPU pinned
+        # host memory; each layer's slice is copied into HBM right before
+        # use (XLA emits async copy-start/done, overlapping the previous
+        # layer's compute), and index_in_dim's transpose keeps the stacked
+        # GRAD accumulator in host memory too. Unrolled — a scan would force
+        # one resident carry of the full stacked array.
+        if pp > 1:
+            raise ValueError("streamed offload is a single-chip capacity "
+                             "feature; it cannot combine with pp")
+        devm = _memory_sharding("device")
+        body_c = remat_wrap(body) if use_recompute else body
+        out = hidden
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(run.depth):
+            slices = []
+            for s in stacked:
+                sl = jax.lax.index_in_dim(s, i, keepdims=False)
+                slices.append(sl if devm is None else jax.device_put(sl, devm))
+            out, aux_i = body_c(out, tuple(slices))
+            aux_total = aux_total + aux_i
+        return out, aux_total
     if pp > 1:
         from .pipeline import (choose_microbatches, microbatch,
                                pipeline_shard_map, unmicrobatch)
@@ -167,9 +216,11 @@ def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches):
     return out, jnp.sum(aux)
 
 
-def _run_stack(hidden, *stacked, _run_id, use_recompute, microbatches):
+def _run_stack(hidden, *stacked, _run_id, use_recompute, microbatches,
+               stream=False):
     return _run_stack_fn(hidden, *stacked, _run_id=_run_id,
-                         use_recompute=use_recompute, microbatches=microbatches)
+                         use_recompute=use_recompute, microbatches=microbatches,
+                         stream=stream)
 
 
 def find_homogeneous_run(layers: List[Layer], min_len: int = 2):

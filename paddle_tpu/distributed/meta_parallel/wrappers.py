@@ -182,6 +182,70 @@ class HybridParallelOptimizer:
             self._gm_k = int(strategy.gradient_merge_configs.get("k_steps", 1))
             self._gm_avg = bool(strategy.gradient_merge_configs.get("avg",
                                                                     True))
+        # localsgd (reference meta_optimizers/localsgd_optimizer.py): local
+        # updates every step, parameters averaged across data-parallel
+        # workers every k_steps. Under single-controller SPMD the compiled
+        # step is already globally consistent, so the averaging only fires in
+        # eager MULTI-PROCESS mode — the one place local replicas diverge.
+        self._lsgd_k = 0
+        self._lsgd_begin = 1
+        self._lsgd_count = 0
+        if strategy is not None and getattr(strategy, "localsgd", False):
+            cfg = getattr(strategy, "localsgd_configs", {}) or {}
+            self._lsgd_k = max(int(cfg.get("k_steps", 1)), 1)
+            self._lsgd_begin = int(cfg.get("begin_step", 1))
+
+    def _maybe_localsgd_sync(self):
+        if not self._lsgd_k:
+            return
+        self._lsgd_count += 1
+        if self._lsgd_count < self._lsgd_begin or \
+                self._lsgd_count % self._lsgd_k:
+            return
+        from .. import collective as C
+
+        _, world = C._proc_rank_world()
+        if world <= 1:
+            return  # SPMD / single process: params already consistent
+        self._cross_process_param_average(world)
+
+    # localsgd sync tags on the TCPStore p2p channel
+    _LSGD_TAG_GATHER = 7701
+    _LSGD_TAG_BCAST = 7702
+
+    def _cross_process_param_average(self, world: int):
+        """Average parameters across eager multi-process workers over the
+        native TCPStore p2p channel (gather-to-0 + broadcast). Infrequent by
+        design — localsgd's entire point is paying communication every
+        k steps instead of every step."""
+        import jax.numpy as jnp
+
+        from .. import collective as C
+        from ...core.tensor import Tensor
+
+        prank, _ = C._proc_rank_world()
+        params = self._inner_opt._parameter_list
+        flat = jnp.concatenate(
+            [jnp.ravel(p.data).astype(jnp.float32) for p in params])
+        if prank == 0:
+            acc = flat
+            for r in range(1, world):
+                buf = Tensor(jnp.zeros_like(flat))
+                C.recv(buf, src=r, tag=self._LSGD_TAG_GATHER)
+                acc = acc + buf.data
+            avg = acc / float(world)
+            for r in range(1, world):
+                C.send(Tensor(avg), dst=r, tag=self._LSGD_TAG_BCAST)
+        else:
+            C.send(Tensor(flat), dst=0, tag=self._LSGD_TAG_GATHER)
+            buf = Tensor(jnp.zeros_like(flat))
+            C.recv(buf, src=0, tag=self._LSGD_TAG_BCAST)
+            avg = buf.data
+        off = 0
+        for p in params:
+            n = p.data.size
+            p.data = avg[off:off + n].reshape(p.data.shape).astype(p.data.dtype)
+            off += n
 
     @staticmethod
     def _maybe_swap_rule(optimizer, strategy):
@@ -225,6 +289,7 @@ class HybridParallelOptimizer:
                     if p.grad is not None:
                         p.grad.data = p.grad.data / self._gm_k
         self._inner_opt.step()
+        self._maybe_localsgd_sync()
 
     def clear_grad(self):
         # inside an accumulation window clear_grad preserves grads and is

@@ -630,22 +630,9 @@ class ShardedTrainStep:
             finally:
                 random_mod.default_generator().clear_trace_key()
 
-        def update(master, grads, states, lr, step_no):
-            grads = [g.astype(jnp.float32) for g in grads]
-            if clip is not None:
-                grads = clip._apply_jax(grads)
-            new_m, new_s, new_p = [], [], []
-            for p, g, s, flag, dt in zip(master, grads, states, wd_flags, dtypes):
-                if wd and not decoupled and flag:
-                    g = g + wd * p
-                hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
-                np_, ns = rule(p, g, s, lr, step_no, hyper_i)
-                if wd and decoupled and flag:
-                    np_ = np_ - lr * wd * p
-                new_m.append(np_)
-                new_s.append(ns)
-                new_p.append(np_.astype(dt))
-            return new_m, new_s, new_p
+        from ..optimizer.optimizer import make_master_update
+
+        update = make_master_update(opt, train_params, dtypes)
 
         param_sh = [param_sharding(p, env) for p in train_params]
         frozen_sh = [param_sharding(p, env) for p in frozen]

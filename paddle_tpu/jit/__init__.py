@@ -469,3 +469,6 @@ def set_verbosity(level=0, also_to_stdout=False):
     import os
 
     os.environ["PT_DY2STATIC_VERBOSITY"] = str(level)
+
+
+from .offload_stream import StreamedTrainStep, init_on_host  # noqa: E402,F401

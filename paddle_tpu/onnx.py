@@ -289,6 +289,70 @@ def _convert_eqn(g: _Graph, eqn, env: Dict[int, str]):
             raise ValueError(
                 f"onnx export: general gather {dn} has no ONNX mapping; "
                 "use paddle_tpu.jit.save for the StableHLO artifact")
+    elif name == "conv_general_dilated":
+        dn = eqn.params["dimension_numbers"]
+        nd = len(eqn.invars[0].aval.shape)
+        iota = tuple(range(nd))
+        if (tuple(dn.lhs_spec) != iota or tuple(dn.rhs_spec) != iota
+                or tuple(dn.out_spec) != iota):
+            raise ValueError(
+                f"onnx export: conv layout {dn} is not NC*/OI* "
+                "(channel-first); transpose to NCHW before export")
+        if eqn.params["batch_group_count"] != 1:
+            raise ValueError("onnx export: batch_group_count > 1 conv has "
+                             "no ONNX mapping")
+        if any(d != 1 for d in eqn.params["lhs_dilation"]):
+            raise ValueError(
+                "onnx export: lhs-dilated (transposed) conv is not mapped; "
+                "export the ConvTranspose layer form instead")
+        pads = [p[0] for p in eqn.params["padding"]] + \
+            [p[1] for p in eqn.params["padding"]]
+        set_out(g.add("Conv", [inp(0), inp(1)],
+                      strides=list(eqn.params["window_strides"]),
+                      pads=pads,
+                      dilations=list(eqn.params["rhs_dilation"]),
+                      group=int(eqn.params["feature_group_count"]),
+                      kernel_shape=list(eqn.invars[1].aval.shape[2:])))
+    elif name in ("reduce_window_max", "reduce_window_sum"):
+        wd = eqn.params["window_dimensions"]
+        ws = eqn.params["window_strides"]
+        pad = eqn.params["padding"]
+        if any(d != 1 for d in eqn.params["base_dilation"]) or \
+                any(d != 1 for d in eqn.params["window_dilation"]):
+            raise ValueError("onnx export: dilated pooling windows have no "
+                             "ONNX pooling mapping")
+        if wd[0] != 1 or wd[1] != 1 or pad[0] != (0, 0) or pad[1] != (0, 0):
+            raise ValueError(
+                f"onnx export: reduce_window over batch/channel dims "
+                f"(window {wd}) is not a spatial pooling; no mapping")
+        sp_wd = list(wd[2:])
+        sp_ws = list(ws[2:])
+        sp_pads = [p[0] for p in pad[2:]] + [p[1] for p in pad[2:]]
+        if name == "reduce_window_max":
+            set_out(g.add("MaxPool", [inp(0)], kernel_shape=sp_wd,
+                          strides=sp_ws, pads=sp_pads))
+        else:
+            # ONNX has no SumPool: AveragePool (counting padded cells, which
+            # reduce_window_sum's zero-padding matches) times window size
+            ap = g.add("AveragePool", [inp(0)], kernel_shape=sp_wd,
+                       strides=sp_ws, pads=sp_pads, count_include_pad=1)
+            k = 1
+            for d in sp_wd:
+                k *= int(d)
+            kc = g.const(np.asarray(
+                float(k), np.dtype(eqn.invars[0].aval.dtype)))
+            set_out(g.add("Mul", [ap, kc]))
+    elif name == "pad":
+        cfg = eqn.params["padding_config"]
+        if any(interior != 0 for _, _, interior in cfg):
+            raise ValueError("onnx export: interior (dilating) pad has no "
+                             "ONNX mapping")
+        if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+            raise ValueError("onnx export: negative pad (cropping) has no "
+                             "ONNX Pad mapping")
+        pads = [c[0] for c in cfg] + [c[1] for c in cfg]
+        set_out(g.add("Pad", [inp(0), g.const(np.asarray(pads, np.int64)),
+                              inp(1)], mode="constant"))
     elif name == "argmax":
         set_out(g.add("ArgMax", [inp(0)], axis=int(eqn.params["axes"][0]),
                       keepdims=0))

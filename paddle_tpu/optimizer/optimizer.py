@@ -30,7 +30,18 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None, **kwargs):
         if parameters is None:
-            raise ValueError("parameters must be provided (dygraph-style optimizer)")
+            # static-graph scripts construct optimizers parameter-less and
+            # let minimize(loss) collect the program's parameters (the
+            # reference's static Optimizer contract); dygraph still requires
+            # an explicit list at step() time
+            from ..static import compat as _static
+
+            if not _static.in_static_mode():
+                raise ValueError(
+                    "parameters must be provided (dygraph-style optimizer); "
+                    "parameter-less construction is only valid under "
+                    "paddle.enable_static() where minimize() collects them")
+            parameters = []
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -143,6 +154,14 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        from ..static import compat as _static
+
+        if _static.in_static_mode():
+            # static shim: mark the default program as a training program
+            # (the reference's append_backward + optimizer-ops role); the
+            # Executor then runs value_and_grad + this optimizer's update
+            _static.default_main_program().set_train(loss, self)
+            return None, None
         self.step()
         return None, None
 
@@ -477,3 +496,39 @@ class Adadelta(Optimizer):
         u2 = rho * state["avg_squared_update"] + (1 - rho) * update * update
         return p + lr.astype(p.dtype) * update, {
             "avg_squared_grad": g2, "avg_squared_update": u2}
+
+
+def make_master_update(opt, train_params, dtypes):
+    """fp32-master offload update shared by every host-offload step
+    (ShardedTrainStep optimizer-state offload and jit.StreamedTrainStep):
+    (master, grads, states, lr, step_no) -> (new_master, new_states,
+    new_params_cast_to_model_dtype). One definition so clip / coupled and
+    decoupled weight decay / per-param decay flags cannot drift between the
+    offload variants."""
+    rule = type(opt)._rule
+    hyper = opt._hyper()
+    wd = opt._weight_decay
+    decoupled = opt._decoupled
+    clip = opt._grad_clip
+    wd_flags = tuple(
+        1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
+        for p in train_params)
+
+    def update(master, grads, states, lr, step_no):
+        grads = [g.astype(jnp.float32) for g in grads]
+        if clip is not None:
+            grads = clip._apply_jax(grads)
+        new_m, new_s, new_p = [], [], []
+        for p, g, s, flag, dt in zip(master, grads, states, wd_flags, dtypes):
+            if wd and not decoupled and flag:
+                g = g + wd * p
+            hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
+            np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+            if wd and decoupled and flag:
+                np_ = np_ - lr * wd * p
+            new_m.append(np_)
+            new_s.append(ns)
+            new_p.append(np_.astype(dt))
+        return new_m, new_s, new_p
+
+    return update

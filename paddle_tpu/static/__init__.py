@@ -1,11 +1,15 @@
 """paddle.static compat surface (reference: python/paddle/static/).
 
-paddle_tpu is dygraph-first: graph capture is `paddle_tpu.jit.to_static`
-(tracing), not a ProgramDesc build. This module provides the pieces of the
-static API that carry over meaningfully: InputSpec (trace signatures),
-control-flow ops (lax.cond/while_loop backed), and save/load_inference_model
-(jax.export AOT artifacts). Program/Executor raise with pointers to the
-dygraph equivalents rather than emulating a second IR.
+paddle_tpu is dygraph-first; graph capture is tracing, not a ProgramDesc
+build. Two layers live here:
+
+- the meaningful carry-overs: InputSpec (trace signatures), control-flow ops
+  (lax.cond/while_loop backed), save/load_inference_model pointers;
+- a full STATIC-MODE COMPAT SHIM (compat.py): enable_static() +
+  static.data + program_guard + Executor.run(feed/fetch) implemented as
+  record-and-replay over the dygraph dispatch, so reference-era static
+  training scripts (the test_fit_a_line.py shape) run unmodified — without
+  rebuilding a second IR.
 """
 from __future__ import annotations
 
@@ -13,6 +17,10 @@ import numpy as np
 
 from . import nn  # noqa: F401
 from .input_spec import InputSpec  # noqa: F401
+from .compat import (  # noqa: F401
+    Executor, Program, data, default_main_program, default_startup_program,
+    program_guard,
+)
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
@@ -26,25 +34,3 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
 def load_inference_model(path_prefix, executor=None, **kwargs):
     raise NotImplementedError(
         "use paddle_tpu.jit.load(path) or paddle_tpu.inference.create_predictor")
-
-
-class Program:  # pragma: no cover - compat stub
-    def __init__(self):
-        raise NotImplementedError(
-            "paddle_tpu has no ProgramDesc IR; capture graphs with "
-            "paddle_tpu.jit.to_static (jaxpr/StableHLO is the program)")
-
-
-class Executor:  # pragma: no cover - compat stub
-    def __init__(self, place=None):
-        raise NotImplementedError(
-            "paddle_tpu has no static Executor; compiled execution is "
-            "paddle_tpu.jit.to_static / jit.TrainStep (XLA executables)")
-
-
-def default_main_program():  # pragma: no cover - compat stub
-    raise NotImplementedError("no ProgramDesc IR; see paddle_tpu.jit")
-
-
-def default_startup_program():  # pragma: no cover - compat stub
-    raise NotImplementedError("no ProgramDesc IR; see paddle_tpu.jit")

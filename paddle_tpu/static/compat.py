@@ -1,0 +1,271 @@
+"""Static-graph compat shim: record-and-replay over the dygraph dispatch.
+
+Reference: python/paddle/fluid/framework.py:4624 (Program), executor.py:1095
+(Executor.run feed/fetch), python/paddle/static/input.py (data). No
+ProgramDesc IR is rebuilt: under ``paddle.enable_static()`` every primitive
+dispatch RECORDS an SSA node into the default Program while still computing
+placeholder (dummy) values eagerly — Python build-phase control flow just
+works — and ``Executor.run`` replays the recorded graph against the real
+feed arrays. ``optimizer.minimize(loss)`` marks the program as a training
+program: the replay then runs under ``jax.value_and_grad`` over the live
+Parameters and applies the dygraph optimizer update, which is exactly the
+role split of the reference's append_backward + optimizer ops.
+
+Deliberate limits (documented, loud): the graph is shape-specialized per
+feed (placeholder None dims re-trace, like to_static), and ops must flow
+through the primitive dispatch (all of paddle_tpu's op corpus does).
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("prim", "attrs", "inputs", "out_ids", "multi")
+
+    def __init__(self, prim, attrs, inputs, out_ids, multi):
+        self.prim = prim
+        self.attrs = attrs
+        self.inputs = inputs  # list of ("value", aid) | ("param", Tensor)
+        #                       | ("const", array)
+        self.out_ids = out_ids
+        self.multi = multi
+
+
+class Program:
+    """Recorded op list + feed table (reference framework.py:4624 Program)."""
+
+    def __init__(self):
+        self.nodes: List[_Node] = []
+        self.feeds: Dict[str, tuple] = {}  # name -> (aid, dtype, shape)
+        self._values: Dict[int, Any] = {}  # id -> dummy array (keeps ids live)
+        self.train_spec = None  # (loss_aid, optimizer)
+
+    # -- build-time recording ------------------------------------------------
+    def _register_value(self, arr) -> int:
+        aid = id(arr)
+        self._values[aid] = arr
+        return aid
+
+    def add_feed(self, name, arr, dtype, shape):
+        if name in self.feeds:
+            raise ValueError(f"static.data: duplicate feed name '{name}'")
+        self.feeds[name] = (self._register_value(arr), dtype, shape)
+
+    def record(self, prim, attrs, arrays, tensors, outs_raw, multi):
+        from ..nn.layer.layers import Parameter
+
+        inputs = []
+        for arr, t in zip(arrays, tensors):
+            aid = id(arr)
+            if aid in self._values:
+                inputs.append(("value", aid))
+            elif isinstance(t, Parameter):
+                inputs.append(("param", t))  # live ref: replay reads t.data
+            else:
+                inputs.append(("const", arr))
+        out_ids = [self._register_value(o) for o in outs_raw]
+        self.nodes.append(_Node(prim, dict(attrs), inputs, out_ids, multi))
+
+    # -- introspection -------------------------------------------------------
+    def parameters(self):
+        seen, out = set(), []
+        for node in self.nodes:
+            for kind, payload in node.inputs:
+                if kind == "param" and id(payload) not in seen:
+                    seen.add(id(payload))
+                    if not payload.stop_gradient:
+                        out.append(payload)
+        return out
+
+    def set_train(self, loss, optimizer):
+        aid = id(loss.data)
+        if aid not in self._values:
+            raise ValueError(
+                "minimize(loss): the loss was not produced by this static "
+                "program (build it between enable_static() and run())")
+        self.train_spec = (aid, optimizer)
+        if not optimizer._parameter_list:
+            optimizer._parameter_list = self.parameters()
+
+    # -- replay --------------------------------------------------------------
+    def _replay(self, env: Dict[int, Any], param_override=None):
+        for node in self.nodes:
+            ins = []
+            for kind, payload in node.inputs:
+                if kind == "value":
+                    v = env.get(payload)
+                    if v is None:
+                        # produced outside the feed cone (a build-time value
+                        # that doesn't depend on feeds): use the dummy
+                        v = self._values[payload]
+                    ins.append(v)
+                elif kind == "param":
+                    if param_override is not None and id(payload) in param_override:
+                        ins.append(param_override[id(payload)])
+                    else:
+                        ins.append(payload.data)
+                else:
+                    ins.append(payload)
+            out = node.prim.fwd(node.attrs)(*ins)
+            outs = tuple(out) if node.multi else (out,)
+            for oid, o in zip(node.out_ids, outs):
+                env[oid] = o
+        return env
+
+    def global_block(self):  # minimal compat surface
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.feeds = dict(self.feeds)
+        p._values = self._values  # shared dummy table (ids must match)
+        p.train_spec = None if for_test else self.train_spec
+        return p
+
+
+_STATE = {"static": False}
+_DEFAULT = {"main": Program(), "startup": Program()}
+_GUARD_STACK: List[tuple] = []
+
+
+def enable_static():
+    _STATE["static"] = True
+    _DEFAULT["main"] = Program()
+    _DEFAULT["startup"] = Program()
+
+
+def disable_static():
+    _STATE["static"] = False
+
+
+def in_static_mode() -> bool:
+    return _STATE["static"]
+
+
+def default_main_program() -> Program:
+    return _DEFAULT["main"]
+
+
+def default_startup_program() -> Program:
+    return _DEFAULT["startup"]
+
+
+class program_guard:
+    """Swap the default (main, startup) programs (reference
+    framework.py program_guard)."""
+
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program or Program()
+
+    def __enter__(self):
+        _GUARD_STACK.append((_DEFAULT["main"], _DEFAULT["startup"]))
+        _DEFAULT["main"], _DEFAULT["startup"] = self.main, self.startup
+        return self
+
+    def __exit__(self, *exc):
+        _DEFAULT["main"], _DEFAULT["startup"] = _GUARD_STACK.pop()
+        return False
+
+
+def record_dispatch(prim, attrs, arrays, tensors, outs_raw, multi):
+    """Hook called from core.tensor.dispatch for every op in static mode."""
+    _DEFAULT["main"].record(prim, attrs, arrays, tensors, outs_raw, multi)
+
+
+def data(name: str, shape, dtype="float32", lod_level=0):
+    """Feed placeholder (reference static/input.py data): a dummy-valued
+    Tensor registered in the default program's feed table. None/-1 dims
+    materialize as 1 at build time and re-specialize per feed at run."""
+    from ..core.tensor import Tensor
+
+    if not in_static_mode():
+        raise RuntimeError("paddle.static.data requires enable_static()")
+    dummy_shape = tuple(1 if (d is None or (isinstance(d, int) and d < 0))
+                        else int(d) for d in shape)
+    arr = jnp.zeros(dummy_shape, dtype)
+    t = Tensor(arr, stop_gradient=True)
+    t.name = name
+    _DEFAULT["main"].add_feed(name, arr, dtype, tuple(shape))
+    return t
+
+
+class Executor:
+    """reference executor.py:1095. run(startup) is a no-op (parameters
+    initialize eagerly at build); run(main, feed, fetch_list) replays the
+    recorded graph — with the training extension when minimize() was
+    called: value_and_grad over the live Parameters + dygraph update."""
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True, **kwargs):
+        program = program if program is not None else _DEFAULT["main"]
+        if not isinstance(program, Program):
+            raise TypeError(f"Executor.run expects a Program, got "
+                            f"{type(program).__name__}")
+        if not program.nodes and not program.feeds:
+            return []  # startup program: nothing to execute
+        feed = feed or {}
+        missing = set(program.feeds) - set(feed)
+        if missing and program.nodes:
+            raise ValueError(f"Executor.run: missing feeds {sorted(missing)}")
+        env: Dict[int, Any] = {}
+        for name, (aid, dtype, _shape) in program.feeds.items():
+            if name in feed:
+                env[aid] = jnp.asarray(np.asarray(feed[name]), dtype)
+        fetch_list = fetch_list or []
+        fetch_ids = []
+        for f in fetch_list:
+            aid = id(f.data) if hasattr(f, "data") else id(f)
+            fetch_ids.append(aid)
+
+        if program.train_spec is not None:
+            outs = self._run_train(program, env, fetch_ids)
+        else:
+            env = program._replay(env)
+            outs = [env.get(aid, program._values.get(aid)) for aid in fetch_ids]
+        if return_numpy:
+            return [np.asarray(o) for o in outs]
+        from ..core.tensor import Tensor
+
+        return [Tensor(o) for o in outs]
+
+    def _run_train(self, program: Program, env, fetch_ids):
+        from ..core.tensor import Tensor
+
+        loss_aid, optimizer = program.train_spec
+        params = optimizer._parameter_list or program.parameters()
+        train_params = [p for p in params if not p.stop_gradient]
+
+        def loss_of(param_arrays):
+            override = {id(p): a for p, a in zip(train_params, param_arrays)}
+            e = program._replay(dict(env), param_override=override)
+            loss = e[loss_aid].astype(jnp.float32)
+            if loss.ndim:
+                loss = loss.mean()  # reference appends mean for vector losses
+            return loss, e
+
+        (loss, e), grads = jax.value_and_grad(
+            loss_of, has_aux=True)(tuple(p.data for p in train_params))
+        for p, g in zip(train_params, grads):
+            p.grad = Tensor(g.astype(p.dtype))
+        optimizer.step()
+        optimizer.clear_grad()
+        return [e.get(aid, program._values.get(aid)) for aid in fetch_ids]
+
+
+def save_inference_model_impl(path_prefix, feed_vars, fetch_vars):
+    raise NotImplementedError(
+        "static save_inference_model: use paddle_tpu.jit.save on a dygraph "
+        "layer — the static shim replays through the same jit machinery")

@@ -150,3 +150,46 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     sel = jnp.where(hit, dense, len(items))
     out = jax.lax.switch(sel, branches, None)
     return _to_tensor(out)
+
+
+def fc(x=None, size=None, num_flatten_dims=1, weight_attr=None,
+       bias_attr=None, activation=None, name=None, input=None):
+    """Fully-connected builder (reference static/nn/common.py fc): dims
+    [num_flatten_dims:] flatten into the feature axis (weight
+    [prod(trailing), size]) and the leading dims are restored on the output
+    — fc([2,3,4], size=5, num_flatten_dims=2) -> [2,3,5] with a [4,5]
+    weight; num_flatten_dims=1 -> [2,5] with a [12,5] weight. Build-time
+    parameter creation is eager (the startup program's role); the matmul
+    and activation record into the default program like any other op."""
+    from ... import nn as nn_mod
+    from ...nn import functional as F
+    from ...ops import manipulation
+
+    x = x if x is not None else input  # fluid-era keyword
+    if x is None or size is None:
+        raise ValueError("static.nn.fc requires x and size")
+    nfd = int(num_flatten_dims)
+    if not 0 < nfd < len(x.shape):
+        raise ValueError(
+            f"fc: num_flatten_dims={nfd} out of range for rank "
+            f"{len(x.shape)} input")
+    lead_shape = list(x.shape[:nfd])
+    in_features = 1
+    for d in x.shape[nfd:]:
+        in_features *= int(d)
+    if len(x.shape) > nfd + 1 or len(x.shape) == nfd:
+        x = manipulation.reshape(x, [-1] + [in_features])
+    layer = nn_mod.Linear(in_features, int(size), weight_attr=weight_attr,
+                          bias_attr=bias_attr)
+    out = layer(x)
+    if len(lead_shape) > 1:
+        # -1 for the batch dim: build-time placeholder shapes are dummies
+        # and the recorded reshape must respecialize per feed
+        out = manipulation.reshape(
+            out, [-1] + [int(d) for d in lead_shape[1:]] + [int(size)])
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+__all__ += ["fc"]

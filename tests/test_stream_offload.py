@@ -1,0 +1,60 @@
+"""Streamed parameter offload (VERDICT r3 next #3): the stacked decoder
+weights live in (pinned) host memory and stream through HBM layer by layer.
+On the CPU test backend memory kinds are inert, so these tests check the
+NUMERICS of the unrolled streaming path against the scan path; the capacity
+lift is proven on hardware by bench.py's hbm_envelope row."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu import jit
+
+
+def _run(streamed, steps=4):
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, hidden_size=64,
+                           intermediate_size=128, num_attention_heads=4,
+                           num_key_value_heads=4, vocab_size=128)
+    m = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    cls = jit.StreamedTrainStep if streamed else jit.TrainStep
+    step = cls(m, lambda mm, x, y: mm(x, labels=y), o)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16)).astype("int32"))
+    return [float(step(ids, ids)) for _ in range(steps)], m
+
+
+def test_streamed_matches_resident_training():
+    base, _ = _run(False)
+    st, _ = _run(True)
+    np.testing.assert_allclose(st, base, rtol=2e-4)
+    assert st[-1] < st[0]
+
+
+def test_streamed_requires_stacked_run():
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(4, 4))
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(ValueError, match="StackedStageRun"):
+        jit.StreamedTrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                              o)
+
+
+def test_streamed_rejects_pp_mesh():
+    """stream is a single-chip capacity feature; pp would fight it."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.meta_parallel import stage_stack
+
+    dist.reset_mesh()
+    dist.init_mesh(pp=2, dp=4)
+    try:
+        stage_stack._STREAM_MODE[0] = True
+        with pytest.raises(ValueError, match="single-chip"):
+            _run(False, steps=1)  # stack forward sees stream+pp
+    finally:
+        stage_stack._STREAM_MODE[0] = False
+        dist.reset_mesh()
