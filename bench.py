@@ -181,15 +181,14 @@ def _measure_moe(cfg, batch, seq, iters):
     ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
     dt, loss = _time_train_step(step, (ids, ids), iters)
     tokens_per_sec = batch * seq / dt
-    mfu = tokens_per_sec * llama_moe_flops_per_token(cfg, seq) \
-        / detect_peak() * 100.0
+    act_flops = llama_moe_flops_per_token(cfg, seq)
+    mfu = tokens_per_sec * act_flops / detect_peak() * 100.0
     total, activated = llama_moe_param_counts(cfg)
     # executed MFU: counts the capacity-factor overcompute the chip actually
     # performs (cf * expert param flops; the attention term is NOT scaled —
     # only expert FFNs run at capacity)
     i = cfg.moe_intermediate_size or cfg.intermediate_size
     expert_act = cfg.num_hidden_layers * cfg.top_k * 3 * cfg.hidden_size * i
-    act_flops = llama_moe_flops_per_token(cfg, seq)
     exec_flops = act_flops + 6 * (cfg.capacity_factor - 1.0) * expert_act
     mfu_exec = tokens_per_sec * exec_flops / detect_peak() * 100.0
     return {
@@ -246,6 +245,49 @@ def _measure_dit(cfg, batch, iters):
     }
 
 
+def _measure_stream(cfg, batch, seq, iters):
+    """Streamed-offload capacity row (VERDICT r3 next #3): stacked decoder
+    weights + optimizer state live in TPU pinned host memory and stream
+    through HBM layer by layer inside ONE compiled step — model sizes far
+    beyond the ~1.8B resident ceiling train on the 9.5GB chip. Throughput is
+    host-bandwidth-bound by design; the metric here is CAPACITY."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models import LlamaForCausalLM, llama_flops_per_token
+
+    paddle.seed(0)
+    with jit.init_on_host():
+        model = LlamaForCausalLM(cfg)
+    optimizer = opt.Adafactor(learning_rate=1e-2,
+                              parameters=model.parameters())
+    step = jit.StreamedTrainStep(model, lambda m, x, y: m(x, labels=y),
+                                 optimizer)
+    ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+    losses = [float(step(ids, ids))]  # compile + step 1
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, ids)
+        losses.append(float(loss))
+    dt = (time.perf_counter() - t0) / iters
+    from paddle_tpu.models import llama_param_count
+
+    n_params = llama_param_count(cfg)  # packed host slabs pad p.size
+    tokens_per_sec = batch * seq / dt
+    mfu = tokens_per_sec * llama_flops_per_token(cfg, seq) \
+        / detect_peak() * 100.0
+    return {
+        "params_b": round(n_params / 1e9, 3),
+        "step_time_s": round(dt, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "mfu": round(mfu, 2),
+        "loss_first": round(losses[0], 4),
+        "loss_last": round(losses[-1], 4),
+        "batch": batch, "seq": seq,
+        "mode": "streamed pinned-host offload (params+opt state)",
+    }
+
+
 def _configs():
     from paddle_tpu.models import LlamaConfig
 
@@ -290,8 +332,15 @@ def _configs():
     # DiT flagship (BASELINE config 4): the published DiT-XL/2 shape at the
     # ImageNet-256 latent (32x32x4, patch 2 -> 256 tokens)
     dit = DiTConfig.dit_xl_2(dtype="bfloat16")
+    # streamed-offload capacity demo: 4B params on the 9.5GB chip (stacked
+    # weights + optimizer state in pinned host memory, layerwise streaming)
+    stream_4b = LlamaConfig(
+        vocab_size=32000, hidden_size=3072, intermediate_size=8192,
+        num_hidden_layers=34, num_attention_heads=24, num_key_value_heads=24,
+        max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
     return {"big": big, "adafactor_1p8b": big_1p8, "long_seq_16k": long16k,
-            "compat_374m": compat, "moe": moe, "dit": dit}
+            "compat_374m": compat, "moe": moe, "dit": dit,
+            "stream_4b": stream_4b}
 
 
 def _run_one(name: str):
@@ -316,6 +365,8 @@ def _run_one(name: str):
             out["dispatch_probe_error"] = str(e)[:200]
     elif name == "dit":
         out = _measure_dit(cfg, batch=32, iters=8)
+    elif name == "stream_4b":
+        out = _measure_stream(cfg, batch=4, seq=2048, iters=3)
     else:
         out = _measure(cfg, batch=4, seq=2048, iters=8)
         try:
@@ -325,12 +376,12 @@ def _run_one(name: str):
     print("BENCH_RESULT " + json.dumps(out))
 
 
-def _spawn(name: str):
+def _spawn(name: str, timeout=1200):
     import subprocess
 
     r = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--config", name], capture_output=True, text=True,
-                       timeout=1200)
+                       timeout=timeout)
     for line in r.stdout.splitlines():
         if line.startswith("BENCH_RESULT "):
             return json.loads(line[len("BENCH_RESULT "):])
@@ -382,6 +433,18 @@ def main():
         detail["dit"] = _spawn("dit")
     except Exception as e:
         detail["dit_error"] = str(e)[:300]
+    try:
+        # host-side init of 4B params + the layerwise-streaming compile are
+        # slow by nature; give this capacity demo its own generous budget
+        detail["stream_4b"] = _spawn("stream_4b", timeout=3000)
+        detail["hbm_envelope"] = dict(
+            detail.get("hbm_envelope", {}),
+            streamed_max_params_b=detail["stream_4b"]["params_b"],
+            streamed_step_time_s=detail["stream_4b"]["step_time_s"],
+            note="resident ceiling 1.83B; streamed pinned-host offload "
+                 "trains 4B-class on the same chip")
+    except Exception as e:
+        detail["stream_4b_error"] = str(e)[:300]
     result = {
         "metric": "llama_pretrain_mfu",
         "value": big["mfu"],

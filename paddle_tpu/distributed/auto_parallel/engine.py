@@ -142,11 +142,19 @@ def propose_mesh(n_devices: int, param_bytes: int, num_heads: int = 0,
                                     hbm_bytes, zero, optimizer, act_bytes)
     assert cands, "propose_mesh: no candidates (n_devices < 1?)"
     if validate is not None:
+        tried = 0
         for i, (axes, _need, _ok) in enumerate(cands):
             if i >= 2 and not _ok:
                 break  # trial the top-2 plus any remaining feasible ones
+            tried += 1
             if validate(dict(axes)):
                 return axes
+        import warnings
+
+        warnings.warn(
+            f"propose_mesh: the validate hook rejected all {tried} trialed "
+            f"candidates; returning the top-ranked mesh UNVALIDATED — "
+            f"expect the same failure the trial saw")
     axes, need, ok = cands[0]
     if not ok:
         import warnings

@@ -601,17 +601,9 @@ class ShardedTrainStep:
         env = self.env
         opt = self.optimizer
         model, loss_fn = self.target, self.loss_fn
-        rule = type(opt)._rule
-        hyper = opt._hyper()
-        wd = opt._weight_decay
-        decoupled = opt._decoupled
-        clip = opt._grad_clip
         train_params = self.train_params
         frozen = self.frozen
         dtypes = [p.data.dtype for p in train_params]
-        wd_flags = tuple(
-            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
-            for p in train_params)
 
         from ..jit import _Binder
 

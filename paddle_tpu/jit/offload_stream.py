@@ -2,19 +2,30 @@
 
 Reference: python/paddle/distributed/fleet/meta_parallel/sharding/
 sharding_stage3.py:50 (param offload) + :737 (TaskFlow prefetch) — the
-reference streams each segment's params H2D ahead of use and keeps the fp32
-master + optimizer state on the host.
+reference streams each segment's params H2D ahead of use and keeps the
+optimizer state host-side.
 
-TPU-native mapping: the transformer stack's [L, ...] stacked parameters live
-in the TPU's PINNED HOST memory space; the compiled step copies one layer's
-slice into HBM right before its compute (XLA emits async copy-start/done —
-the prefetch), autodiff's transpose of those copies lands the stacked
-gradient accumulator back in host memory, and the fp32 master update runs on
-the host CPU backend. HBM holds only: edge params (embeddings/head/norms),
-1-2 layers' weights in flight, and remat boundary activations.
+TPU-native mapping, ONE compiled step end-to-end:
+- the transformer stack's [L, ...] stacked parameters (and their optimizer
+  state) live in the TPU's PINNED HOST memory space;
+- the forward copies one layer's slice into HBM right before its compute
+  (XLA emits async copy-start/done — the prefetch), and autodiff's transpose
+  of those copies lands the stacked gradient accumulator back in host memory;
+- the optimizer update then walks the layers again: slice param/grad/state
+  H2D, apply the functional rule on-device, and dynamic-update-slice the new
+  values straight back into the host buffers.
+Nothing ever crosses to another backend — every transfer is a TPU runtime
+DMA (the CPU-backend hop costs ~15 s/GB through the remote-chip tunnel).
+HBM holds only: edge params (embeddings/head/norms) + their state, one or
+two layers' tensors in flight, and remat boundary activations.
+
+Per-layer optimizer state is initialized per SLICE (factored optimizers see
+the true [d1, d2] layer shape, not the stacked [L, d1, d2]) — the same
+semantics as training the layers unstacked.
 """
 from __future__ import annotations
 
+import contextlib
 from typing import Callable, List
 
 import jax
@@ -25,9 +36,6 @@ from ..core import autograd
 from ..core.tensor import Tensor
 from ..framework import random as random_mod
 from ..nn.layer.layers import Layer
-
-
-import contextlib
 
 
 @contextlib.contextmanager
@@ -41,18 +49,62 @@ def init_on_host():
     The global rng key moves to the CPU backend for the duration: implicit
     cross-backend reads of an accelerator-resident key inside CPU-placed
     init ops are unreliable through the remote-chip tunnel."""
-    from ..framework import random as random_mod
-
     cpu = jax.devices("cpu")[0]
     gen = random_mod.default_generator()
     old_key = gen._key
-    gen._key = jax.device_put(np.asarray(jax.random.key_data(old_key)), cpu)
-    gen._key = jax.random.wrap_key_data(gen._key)
+    gen._key = jax.random.wrap_key_data(
+        jax.device_put(np.asarray(jax.random.key_data(old_key)), cpu))
     try:
         with jax.default_device(cpu):
             yield
     finally:
         gen._key = old_key
+
+
+# -- aligned host-slab packing ------------------------------------------------
+# The TPU compiler's async host dynamic-update-slice emitter requires the
+# written slab to be sublane/lane aligned (bf16: 16x128, f32: 8x128); 1-D or
+# oddly-shaped per-layer slices (norm scales, factored optimizer vectors)
+# crash it. Such buffers are stored host-side as [L, R, 128] zero-padded
+# slabs; the true shape is restored on-device after each slice copy.
+
+
+def _pack_dims(nelems: int, itemsize: int):
+    lanes = 128
+    sub = 16 if itemsize == 2 else 8
+    r = -(-nelems // lanes)
+    r = -(-r // sub) * sub
+    return r, lanes
+
+
+def _needs_pack(slice_shape, itemsize: int) -> bool:
+    if (len(slice_shape) >= 2 and slice_shape[-1] % 128 == 0
+            and slice_shape[-2] % (16 if itemsize == 2 else 8) == 0):
+        return False
+    return True
+
+
+def _pack_np(arr):
+    """[L, ...] numpy -> [L, R, 128] aligned slab."""
+    L = arr.shape[0]
+    flat = arr.reshape(L, -1)
+    r, lanes = _pack_dims(flat.shape[1], arr.dtype.itemsize)
+    out = np.zeros((L, r * lanes), arr.dtype)
+    out[:, :flat.shape[1]] = flat
+    return out.reshape(L, r, lanes)
+
+
+def _unpack_dev(x, true_shape):
+    n = 1
+    for d in true_shape:
+        n *= d
+    return x.reshape(-1)[:n].reshape(true_shape)
+
+
+def _pack_dev(x, packed_shape):
+    r, lanes = packed_shape
+    flat = x.reshape(-1)
+    return jnp.pad(flat, (0, r * lanes - flat.size)).reshape(r, lanes)
 
 
 def _find_runs(model: Layer):
@@ -73,8 +125,8 @@ def _find_runs(model: Layer):
 class StreamedTrainStep:
     """Single-chip capacity mode: jit.TrainStep's twin for models whose
     stacked decoder weights exceed HBM. Slower per step (every weight
-    crosses PCIe/host twice per step) but lifts the resident ceiling from
-    ~1.8B to 4B+ params on the 9.5GB chip."""
+    crosses the PCIe/host path twice) but lifts the resident ceiling from
+    ~1.8B toward the host-RAM bound."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer):
         from ..distributed.meta_parallel.stage_stack import _memory_sharding
@@ -82,6 +134,10 @@ class StreamedTrainStep:
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
+        if optimizer._grad_clip is not None:
+            raise NotImplementedError(
+                "StreamedTrainStep: global grad clip needs a norm pass over "
+                "host-resident grads; drop grad_clip for streamed training")
         runs = _find_runs(model)
         if not runs:
             raise ValueError(
@@ -105,43 +161,69 @@ class StreamedTrainStep:
             + [b for _, b in buffers]
         self._host_sh = _memory_sharding("pinned_host")
         self._dev_sh = _memory_sharding("device")
-        self._cpu = jax.devices("cpu")[0]
-        # fp32 master + optimizer state on the host CPU backend (the
-        # reference's offload destination). Read each param via plain D2H
-        # BEFORE parking it: the tunnel cannot np.asarray a pinned_host
-        # array (reads round-trip through HBM and can OOM)
-        def to_cpu(arr):
-            if self._on_cpu(arr):
-                return arr
-            return jax.device_put(np.asarray(arr), self._cpu)
+        dev = jax.devices()[0]
+        cpu = jax.devices("cpu")[0]
 
-        self._master = []
-        for p in self.train_params:
-            cpu_arr = to_cpu(p.data)
-            self._master.append(
-                jax.device_put(np.asarray(cpu_arr, np.float32), self._cpu))
+        def to_np(arr):
+            return np.asarray(arr)  # CPU-backend or device array: plain D2H
+
+        # true per-layer shapes for streamed params (packing metadata)
+        self._true_shape = {}
+        self._state_shape = {}
+        for r in runs:
+            for (safe, _), ts in zip(r._names, r._slice_shapes):
+                self._true_shape[id(r._parameters[safe])] = ts
+
+        # per-layer optimizer state, stacked [L, ...] and parked next to the
+        # params in pinned host memory; edge params/state live on device
+        for p in self.streamed:
+            L = p.data.shape[0]
             if id(p) not in opt._accumulators:
-                opt._accumulators[id(p)] = opt._init_state(cpu_arr)
+                with jax.default_device(cpu):
+                    per_layer = [opt._init_state(jnp.asarray(s))
+                                 for s in to_np(p.data)]
+                    stacked = {
+                        k: np.stack([np.asarray(st[k]) for st in per_layer])
+                        for k in per_layer[0]
+                    } if per_layer and per_layer[0] else {}
             else:
-                opt._accumulators[id(p)] = {
-                    k: jax.device_put(v, self._cpu)
-                    for k, v in opt._accumulators[id(p)].items()}
-            # place: streamed stacks -> pinned host; edge params -> HBM
-            # (init_on_host models arrive entirely on the CPU backend)
-            if id(p) in streamed_ids:
-                if self._host_sh is not None:
-                    parked = jax.device_put(
-                        np.asarray(cpu_arr).astype(
-                            str(p.data.dtype).replace("paddle.", ""))
-                        if self._on_cpu(p.data) else p.data,
-                        self._host_sh)
-                    p.data = parked
-            elif self._on_cpu(p.data):
-                p.data = jax.device_put(p.data, jax.devices()[0])
+                # pre-existing accumulators (resident steps ran first): park
+                # them too — leaving [L, ...] moments device-resident would
+                # defeat the offload. Requires per-layer-stacked leaves
+                # (elementwise optimizers); factored-over-stack state cannot
+                # be reinterpreted per layer
+                stacked = {}
+                for k, v in opt._accumulators[id(p)].items():
+                    if v.shape[:1] != (L,):
+                        raise ValueError(
+                            f"StreamedTrainStep: existing optimizer state "
+                            f"'{k}' for a streamed param has shape "
+                            f"{v.shape}, not per-layer [L={L}, ...]; reset "
+                            f"the optimizer before switching to streaming")
+                    stacked[k] = to_np(v)
+            self._state_shape[id(p)] = {
+                k: tuple(v.shape[1:]) for k, v in stacked.items()}
+            opt._accumulators[id(p)] = {
+                k: self._park(v) for k, v in stacked.items()}
+            np_data = to_np(p.data)
+            p.data = self._park(np_data)
+        for p in self.edge:
+            if self._on_cpu(p.data):
+                p.data = jax.device_put(to_np(p.data), dev)
+            if id(p) not in opt._accumulators:
+                opt._accumulators[id(p)] = opt._init_state(p.data)
         for t in self.frozen:
             if self._on_cpu(t.data):
-                t.data = jax.device_put(t.data, jax.devices()[0])
+                t.data = jax.device_put(to_np(t.data), dev)
         self._jitted = None
+
+    def _park(self, np_arr):
+        if self._host_sh is None:
+            return jnp.asarray(np_arr)
+        np_arr = np.asarray(np_arr)
+        if _needs_pack(np_arr.shape[1:], np_arr.dtype.itemsize):
+            np_arr = _pack_np(np_arr)
+        return jax.device_put(np_arr, self._host_sh)
 
     @staticmethod
     def _on_cpu(arr) -> bool:
@@ -150,16 +232,43 @@ class StreamedTrainStep:
         except Exception:
             return False
 
-    # -- compiled fwd+bwd -----------------------------------------------------
+    # -- the one compiled step ------------------------------------------------
     def _build(self, batch_arrays):
         from ..distributed.meta_parallel import stage_stack
         from . import _Binder
 
         model, loss_fn = self.model, self.loss_fn
         edge, streamed, frozen = self.edge, self.streamed, self.frozen
+        opt = self.optimizer
+        rule = type(opt)._rule
+        hyper = opt._hyper()
+        wd = opt._weight_decay
+        decoupled = opt._decoupled
+        host, devm = self._host_sh, self._dev_sh
 
-        def fwd_bwd(edge_arrays, streamed_arrays, frozen_arrays, rngkey,
-                    *batch):
+        def flag_of(p):
+            return 1.0 if (opt._decay_param_fn is None
+                           or opt._decay_param_fn(p)) else 0.0
+
+        def apply_rule(p_i, g_i, s_i, lr, step_no, flag):
+            g_i = g_i.astype(p_i.dtype)
+            if wd and not decoupled and flag:
+                g_i = g_i + wd * p_i
+            hyper_i = hyper if flag or "wd" not in hyper else \
+                dict(hyper, wd=0.0)
+            np_, ns = rule(p_i, g_i, s_i, lr, step_no, hyper_i)
+            if wd and decoupled and flag:
+                np_ = np_ - (lr * wd * p_i).astype(p_i.dtype)
+            return np_, ns
+
+        def d2h(x):
+            return x if host is None else jax.device_put(x, host)
+
+        def h2d(x):
+            return x if devm is None else jax.device_put(x, devm)
+
+        def step_fn(edge_arrays, streamed_arrays, edge_states, stream_states,
+                    frozen_arrays, lr, step_no, rngkey, *batch):
             random_mod.default_generator().set_trace_key(rngkey)
             stage_stack._STREAM_MODE[0] = True
             try:
@@ -175,67 +284,87 @@ class StreamedTrainStep:
                 loss_val, (ge, gs) = jax.value_and_grad(
                     loss_of, argnums=(0, 1))(tuple(edge_arrays),
                                              tuple(streamed_arrays))
-                return loss_val, list(ge), list(gs)
+
+                # edge update: plain on-device fused rule
+                new_edge, new_es = [], []
+                for p, a, g, s in zip(edge, edge_arrays, ge, edge_states):
+                    np_, ns = apply_rule(a, g, s, lr, step_no, flag_of(p))
+                    new_edge.append(np_)
+                    new_es.append(ns)
+
+                # streamed update: walk the layers — slice H2D (unpacking
+                # aligned slabs to the true shapes), rule on device, repack
+                # and dynamic-update-slice back into the host buffers
+                new_streamed, new_ss = [], []
+                for p, ph, gh, st in zip(streamed, streamed_arrays, gs,
+                                         stream_states):
+                    out_p = ph
+                    out_s = dict(st)
+                    flag = flag_of(p)
+                    p_ts = self._true_shape.get(id(p), tuple(ph.shape[1:]))
+                    packed = tuple(ph.shape[1:]) != tuple(p_ts)
+                    s_ts = self._state_shape.get(id(p), {})
+                    for i in range(ph.shape[0]):
+                        p_i = h2d(jax.lax.index_in_dim(ph, i, keepdims=False))
+                        g_i = h2d(jax.lax.index_in_dim(gh, i, keepdims=False))
+                        if packed:
+                            p_i = _unpack_dev(p_i, p_ts)
+                            g_i = _unpack_dev(g_i, p_ts)
+                        s_i = {}
+                        for k, v in st.items():
+                            sv = h2d(jax.lax.index_in_dim(v, i,
+                                                          keepdims=False))
+                            ts = s_ts.get(k, tuple(v.shape[1:]))
+                            if tuple(v.shape[1:]) != tuple(ts):
+                                sv = _unpack_dev(sv, ts)
+                            s_i[k] = sv
+                        np_, ns = apply_rule(p_i, g_i, s_i, lr, step_no, flag)
+                        if packed:
+                            np_ = _pack_dev(np_, tuple(ph.shape[1:]))
+                        out_p = jax.lax.dynamic_update_index_in_dim(
+                            out_p, d2h(np_[None]), i, 0)
+                        for k, v in ns.items():
+                            nv = v.astype(out_s[k].dtype)
+                            if tuple(st[k].shape[1:]) != tuple(
+                                    s_ts.get(k, tuple(st[k].shape[1:]))):
+                                nv = _pack_dev(nv, tuple(st[k].shape[1:]))
+                            out_s[k] = jax.lax.dynamic_update_index_in_dim(
+                                out_s[k], d2h(nv[None]), i, 0)
+                    new_streamed.append(out_p)
+                    new_ss.append(out_s)
+                return loss_val, new_edge, new_es, new_streamed, new_ss
             finally:
                 stage_stack._STREAM_MODE[0] = False
                 random_mod.default_generator().clear_trace_key()
 
-        if self._host_sh is None:  # CPU test backend without memory kinds
-            return jax.jit(fwd_bwd)
-        host, dev = self._host_sh, self._dev_sh
-        in_sh = ([dev] * len(edge), [host] * len(streamed),
-                 [dev] * len(frozen), dev)
-        out_sh = (dev, [dev] * len(edge), [host] * len(streamed))
-        return jax.jit(fwd_bwd, in_shardings=(*in_sh,) + (dev,) * len(batch_arrays),
-                       out_shardings=out_sh)
-
-    def _build_update(self):
-        """Host-side fp32 master update (one CPU-jitted fn; the reference's
-        offload optimizer step) — the loop itself is the shared
-        optimizer.make_master_update."""
-        from ..optimizer.optimizer import make_master_update
-
-        dtypes = [p.data.dtype for p in self.train_params]
-        update = make_master_update(self.optimizer, self.train_params, dtypes)
-        return jax.jit(update, donate_argnums=(0, 2))
+        if host is None:
+            return jax.jit(step_fn)
+        # outputs that end in host memory must SAY so (XLA rejects programs
+        # whose entry outputs were host-moved without a host output layout);
+        # prefix pytrees broadcast over the state dicts
+        out_sh = (devm, devm, devm, host, host)
+        return jax.jit(step_fn, out_shardings=out_sh)
 
     def __call__(self, *batch):
         opt = self.optimizer
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b)
                   for b in batch]
         if self._jitted is None:
-            self._jitted = (self._build(arrays), self._build_update())
-        jit_fb, jit_upd = self._jitted
-        loss, ge, gs = jit_fb([p.data for p in self.edge],
-                              [p.data for p in self.streamed],
-                              [t.data for t in self.frozen],
-                              random_mod.next_key(), *arrays)
-        # host-ward: edge grads cross D2H, streamed grads are already in
-        # host memory (cross-backend host->host copy)
-        grads_cpu = [jax.device_put(g, self._cpu) for g in ge + gs]
-        del ge, gs
-        ordered = self.edge + self.streamed
-        states = [opt._accumulators[id(p)] for p in ordered]
-        master = self._reorder_master(ordered)
-        lr = jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), self._cpu)
-        step_no = jax.device_put(jnp.asarray(opt._global_step + 1, jnp.int32),
-                                 self._cpu)
-        new_m, new_s, new_p = jit_upd(master, grads_cpu, states, lr, step_no)
-        for p, m, s in zip(ordered, new_m, new_s):
-            self._master_map[id(p)] = m
+            self._jitted = self._build(arrays)
+        loss, new_edge, new_es, new_streamed, new_ss = self._jitted(
+            [p.data for p in self.edge],
+            [p.data for p in self.streamed],
+            [opt._accumulators[id(p)] for p in self.edge],
+            [opt._accumulators[id(p)] for p in self.streamed],
+            [t.data for t in self.frozen],
+            jnp.asarray(opt.get_lr(), jnp.float32),
+            jnp.asarray(opt._global_step + 1, jnp.int32),
+            random_mod.next_key(), *arrays)
+        for p, a, s in zip(self.edge, new_edge, new_es):
+            p.data = a
             opt._accumulators[id(p)] = s
-        for p, a in zip(self.edge, new_p[:len(self.edge)]):
-            p.data = jax.device_put(a, self._dev_sh) if self._dev_sh is not None \
-                else jnp.asarray(np.asarray(a))
-        for p, a in zip(self.streamed, new_p[len(self.edge):]):
-            p.data = jax.device_put(a, self._host_sh) if self._host_sh is not None \
-                else jnp.asarray(np.asarray(a))
+        for p, a, s in zip(self.streamed, new_streamed, new_ss):
+            p.data = a
+            opt._accumulators[id(p)] = s
         opt._global_step += 1
         return Tensor(loss)
-
-    def _reorder_master(self, ordered):
-        if not hasattr(self, "_master_map"):
-            self._master_map = {id(p): m
-                                for p, m in zip(self.train_params,
-                                                self._master)}
-        return [self._master_map[id(p)] for p in ordered]

@@ -499,12 +499,13 @@ class Adadelta(Optimizer):
 
 
 def make_master_update(opt, train_params, dtypes):
-    """fp32-master offload update shared by every host-offload step
-    (ShardedTrainStep optimizer-state offload and jit.StreamedTrainStep):
-    (master, grads, states, lr, step_no) -> (new_master, new_states,
-    new_params_cast_to_model_dtype). One definition so clip / coupled and
-    decoupled weight decay / per-param decay flags cannot drift between the
-    offload variants."""
+    """fp32-master offload update used by ShardedTrainStep's optimizer-state
+    offload: (master, grads, states, lr, step_no) -> (new_master,
+    new_states, new_params_cast_to_model_dtype). jit.StreamedTrainStep
+    deliberately does NOT use this: it applies the rule in the model dtype
+    per layer slice (matching resident jit.TrainStep semantics — no fp32
+    master) and rejects grad_clip, so its update lives with its streaming
+    loop."""
     rule = type(opt)._rule
     hyper = opt._hyper()
     wd = opt._weight_decay

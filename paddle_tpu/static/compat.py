@@ -138,6 +138,9 @@ _GUARD_STACK: List[tuple] = []
 
 
 def enable_static():
+    if _STATE["static"]:
+        return  # idempotent, like the reference mode switch: a second call
+        #         must not wipe the program a script already built
     _STATE["static"] = True
     _DEFAULT["main"] = Program()
     _DEFAULT["startup"] = Program()
@@ -182,6 +185,17 @@ def record_dispatch(prim, attrs, arrays, tensors, outs_raw, multi):
     _DEFAULT["main"].record(prim, attrs, arrays, tensors, outs_raw, multi)
 
 
+def declared_shape(t) -> tuple:
+    """The as-declared placeholder shape (None dims preserved) for a
+    static.data Tensor, or None when `t` is not a feed placeholder. Builders
+    use this to reject dims that must be concrete (static.nn.fc)."""
+    aid = id(t.data) if hasattr(t, "data") else id(t)
+    for _name, (fid, _dt, shape) in _DEFAULT["main"].feeds.items():
+        if fid == aid:
+            return tuple(shape)
+    return None
+
+
 def data(name: str, shape, dtype="float32", lod_level=0):
     """Feed placeholder (reference static/input.py data): a dummy-valued
     Tensor registered in the default program's feed table. None/-1 dims
@@ -218,7 +232,7 @@ class Executor:
             return []  # startup program: nothing to execute
         feed = feed or {}
         missing = set(program.feeds) - set(feed)
-        if missing and program.nodes:
+        if missing:
             raise ValueError(f"Executor.run: missing feeds {sorted(missing)}")
         env: Dict[int, Any] = {}
         for name, (aid, dtype, _shape) in program.feeds.items():
@@ -242,27 +256,55 @@ class Executor:
         return [Tensor(o) for o in outs]
 
     def _run_train(self, program: Program, env, fetch_ids):
+        """One training iteration: grads via value_and_grad over the replay.
+        The replay + autodiff is jax.jit-compiled and CACHED per feed shape
+        (the to_static-style specialization the module docstring promises) —
+        the hot loop of a static script must not re-trace per step."""
         from ..core.tensor import Tensor
 
         loss_aid, optimizer = program.train_spec
         params = optimizer._parameter_list or program.parameters()
         train_params = [p for p in params if not p.stop_gradient]
+        feed_keys = sorted(env.keys())
+        cache_key = (
+            tuple((k, tuple(env[k].shape), str(env[k].dtype))
+                  for k in feed_keys),
+            tuple(fetch_ids),
+            tuple(id(p) for p in train_params),
+        )
+        cache = program.__dict__.setdefault("_train_jit", {})
+        jitted = cache.get(cache_key)
+        if jitted is None:
+            def train_fn(param_arrays, feed_vals):
+                base_env = dict(zip(feed_keys, feed_vals))
 
-        def loss_of(param_arrays):
-            override = {id(p): a for p, a in zip(train_params, param_arrays)}
-            e = program._replay(dict(env), param_override=override)
-            loss = e[loss_aid].astype(jnp.float32)
-            if loss.ndim:
-                loss = loss.mean()  # reference appends mean for vector losses
-            return loss, e
+                def loss_of(pa):
+                    override = {id(p): a
+                                for p, a in zip(train_params, pa)}
+                    e = program._replay(dict(base_env),
+                                        param_override=override)
+                    loss = e[loss_aid].astype(jnp.float32)
+                    if loss.ndim:
+                        loss = loss.mean()  # reference: mean vector losses
+                    fetches = tuple(
+                        e.get(aid, program._values.get(aid))
+                        for aid in fetch_ids)
+                    return loss, fetches
 
-        (loss, e), grads = jax.value_and_grad(
-            loss_of, has_aux=True)(tuple(p.data for p in train_params))
+                (loss, fetches), grads = jax.value_and_grad(
+                    loss_of, has_aux=True)(tuple(param_arrays))
+                return loss, fetches, grads
+
+            jitted = jax.jit(train_fn)
+            cache[cache_key] = jitted
+        _loss, fetches, grads = jitted(
+            tuple(p.data for p in train_params),
+            [env[k] for k in feed_keys])
         for p, g in zip(train_params, grads):
             p.grad = Tensor(g.astype(p.dtype))
         optimizer.step()
         optimizer.clear_grad()
-        return [e.get(aid, program._values.get(aid)) for aid in fetch_ids]
+        return list(fetches)
 
 
 def save_inference_model_impl(path_prefix, feed_vars, fetch_vars):

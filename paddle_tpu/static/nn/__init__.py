@@ -173,11 +173,26 @@ def fc(x=None, size=None, num_flatten_dims=1, weight_attr=None,
         raise ValueError(
             f"fc: num_flatten_dims={nfd} out of range for rank "
             f"{len(x.shape)} input")
+    from ..compat import declared_shape
+
+    declared = declared_shape(x)
+    if declared is not None:
+        # only the LEADING (batch) dim may be dynamic: trailing dims fold
+        # into the weight shape and non-batch lead dims bake into the
+        # recorded restore-reshape — a None there would silently build the
+        # wrong Linear from the build-time dummy
+        bad = [i for i, d in enumerate(declared)
+               if i > 0 and (d is None or (isinstance(d, int) and d < 0))]
+        if bad:
+            raise ValueError(
+                f"static.nn.fc: placeholder dims {bad} are dynamic but only "
+                f"dim 0 (batch) may be None — trailing/middle dims size the "
+                f"weight and the output reshape (declared {declared})")
     lead_shape = list(x.shape[:nfd])
     in_features = 1
     for d in x.shape[nfd:]:
         in_features *= int(d)
-    if len(x.shape) > nfd + 1 or len(x.shape) == nfd:
+    if len(x.shape) > nfd + 1:
         x = manipulation.reshape(x, [-1] + [in_features])
     layer = nn_mod.Linear(in_features, int(size), weight_attr=weight_attr,
                           bias_attr=bias_attr)

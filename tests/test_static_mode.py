@@ -85,10 +85,11 @@ def test_dygraph_untouched_after_disable():
     _ = paddle.static.data(name="x", shape=[2, 2], dtype="float32")
     paddle.disable_static()
     assert paddle.in_dynamic_mode()
+    before = len(paddle.static.default_main_program().nodes)
     t = paddle.ones([2, 2]) * 3.0
     np.testing.assert_allclose(t.numpy(), 3.0)
     # nothing recorded once back in dygraph
-    assert not paddle.static.default_main_program().nodes or True
+    assert len(paddle.static.default_main_program().nodes) == before
 
 
 def test_static_records_through_amp_autocast():

@@ -58,3 +58,29 @@ def test_streamed_rejects_pp_mesh():
     finally:
         stage_stack._STREAM_MODE[0] = False
         dist.reset_mesh()
+
+
+def test_pack_roundtrip():
+    """Aligned-slab packing: pack_np -> device unpack restores exactly."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.jit.offload_stream import (_needs_pack, _pack_dev,
+                                               _pack_np, _unpack_dev)
+
+    rng = np.random.RandomState(0)
+    for shape in [(2048,), (11,), (64, 3), (1,), (640, 128)]:
+        arr = rng.rand(4, *shape).astype("float32")
+        packed = _pack_np(arr)
+        assert packed.shape[2] == 128 and packed.shape[1] % 8 == 0
+        for i in range(4):
+            got = np.asarray(_unpack_dev(jnp.asarray(packed[i]), shape))
+            np.testing.assert_array_equal(got, arr[i])
+        # device-side pack matches numpy packing
+        repacked = np.asarray(_pack_dev(jnp.asarray(arr[2]),
+                                        packed.shape[1:]))
+        np.testing.assert_array_equal(repacked, packed[2])
+    # big matmul weights stay natural
+    assert not _needs_pack((2048, 5632), 2)
+    assert _needs_pack((2048,), 2)
+    assert _needs_pack((2048, 3), 2)
+    assert not _needs_pack((16, 128), 2)
