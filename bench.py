@@ -332,15 +332,19 @@ def _configs():
     # DiT flagship (BASELINE config 4): the published DiT-XL/2 shape at the
     # ImageNet-256 latent (32x32x4, patch 2 -> 256 tokens)
     dit = DiTConfig.dit_xl_2(dtype="bfloat16")
-    # streamed-offload capacity demo: 4B params on the 9.5GB chip (stacked
-    # weights + optimizer state in pinned host memory, layerwise streaming)
-    stream_4b = LlamaConfig(
-        vocab_size=32000, hidden_size=3072, intermediate_size=8192,
-        num_hidden_layers=34, num_attention_heads=24, num_key_value_heads=24,
+    # streamed-offload capacity demo: 2.5B params on the 9.5GB chip (stacked
+    # weights + optimizer state in pinned host memory, layerwise streaming).
+    # The resident ceiling is 1.83B and 2.0B OOMs outright; 4B-class currently
+    # stops in the TPU compiler's memory-space assignment (the dus chains for
+    # grads/updates get HBM-placed above ~3B — the design streams, the
+    # compiler pass doesn't yet cooperate at that size).
+    stream_25 = LlamaConfig(
+        vocab_size=32000, hidden_size=2560, intermediate_size=6912,
+        num_hidden_layers=30, num_attention_heads=20, num_key_value_heads=20,
         max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
     return {"big": big, "adafactor_1p8b": big_1p8, "long_seq_16k": long16k,
             "compat_374m": compat, "moe": moe, "dit": dit,
-            "stream_4b": stream_4b}
+            "stream_capacity": stream_25}
 
 
 def _run_one(name: str):
@@ -365,7 +369,7 @@ def _run_one(name: str):
             out["dispatch_probe_error"] = str(e)[:200]
     elif name == "dit":
         out = _measure_dit(cfg, batch=32, iters=8)
-    elif name == "stream_4b":
+    elif name == "stream_capacity":
         out = _measure_stream(cfg, batch=4, seq=2048, iters=3)
     else:
         out = _measure(cfg, batch=4, seq=2048, iters=8)
@@ -434,17 +438,19 @@ def main():
     except Exception as e:
         detail["dit_error"] = str(e)[:300]
     try:
-        # host-side init of 4B params + the layerwise-streaming compile are
-        # slow by nature; give this capacity demo its own generous budget
-        detail["stream_4b"] = _spawn("stream_4b", timeout=3000)
+        # host-side init + the layerwise-streaming compile are slow by
+        # nature; give this capacity demo its own generous budget
+        detail["stream_capacity"] = _spawn("stream_capacity", timeout=3000)
         detail["hbm_envelope"] = dict(
             detail.get("hbm_envelope", {}),
-            streamed_max_params_b=detail["stream_4b"]["params_b"],
-            streamed_step_time_s=detail["stream_4b"]["step_time_s"],
-            note="resident ceiling 1.83B; streamed pinned-host offload "
-                 "trains 4B-class on the same chip")
+            streamed_max_params_b=detail["stream_capacity"]["params_b"],
+            streamed_step_time_s=detail["stream_capacity"]["step_time_s"],
+            note="resident ceiling 1.83B (2.0B OOMs); streamed pinned-host "
+                 "offload trains 2.5B on the same chip; 4B blocked on the "
+                 "compiler's memory-space pass HBM-placing the grad/update "
+                 "chains at that size")
     except Exception as e:
-        detail["stream_4b_error"] = str(e)[:300]
+        detail["stream_capacity_error"] = str(e)[:300]
     result = {
         "metric": "llama_pretrain_mfu",
         "value": big["mfu"],

@@ -176,85 +176,37 @@ def _run_stack_fn(hidden, *stacked, _run_id, use_recompute, microbatches,
         # TaskFlow prefetch :737): the stacked weights live in TPU pinned
         # host memory; each layer's slice is copied into HBM right before
         # use (XLA emits async copy-start/done, overlapping the previous
-        # layer's compute). A CUSTOM VJP walks the layers in reverse,
-        # recomputing each layer from its saved boundary activation (the
-        # remat) and writing that layer's weight grads STRAIGHT into host
-        # slabs — without it, autodiff materializes the full [L, ...] grad
-        # accumulator in HBM, which is exactly what must not exist.
-        # Unrolled — a scan would carry the whole stacked array.
+        # layer's compute), and index_in_dim's transpose lands the stacked
+        # grad accumulator back in host memory. Plain autodiff + per-layer
+        # remat — a hand-written custom-VJP walk was tried and REGRESSED:
+        # the memory-space pass places dus chains built inside a custom_vjp
+        # bwd in HBM (27.8GB at 4B vs ~12.5GB here at 2.5B). Unrolled — a
+        # scan would carry the whole stacked array.
         if pp > 1:
             raise ValueError("streamed offload is a single-chip capacity "
                              "feature; it cannot combine with pp")
         devm = _memory_sharding("device")
-        host = _memory_sharding("pinned_host")
         shapes = getattr(run, "_slice_shapes", [None] * len(stacked))
-
-        def h2d(x):
-            return x if devm is None else jax.device_put(x, devm)
-
-        def d2h(x):
-            return x if host is None else jax.device_put(x, host)
-
-        def layer_weights(stacked_arrs, i):
+        body_c = remat_wrap(body) if use_recompute else body
+        out = hidden
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(run.depth):
             slices = []
-            for s, ts in zip(stacked_arrs, shapes):
-                sl = h2d(jax.lax.index_in_dim(s, i, keepdims=False))
+            for st, ts in zip(stacked, shapes):
+                sl = jax.lax.index_in_dim(st, i, keepdims=False)
+                if devm is not None:
+                    sl = jax.device_put(sl, devm)
                 if ts is not None and tuple(sl.shape) != tuple(ts):
-                    # host buffer is an aligned [R, 128] slab (see
-                    # offload_stream pack): trim + reshape on DEVICE
-                    n = 1
-                    for d in ts:
-                        n *= d
-                    sl = sl.reshape(-1)[:n].reshape(ts)
+                    # host buffer is an aligned [R, 128] slab: restore the
+                    # true shape on DEVICE (one unpack definition — the
+                    # packer's; lazy import, offload_stream imports us)
+                    from ...jit.offload_stream import _unpack_dev
+
+                    sl = _unpack_dev(sl, ts)
                 slices.append(sl)
-            return tuple(slices)
-
-        def fwd_core(hidden_a, stacked_arrs, save):
-            hs = []
-            out = hidden_a
-            aux_total = jnp.zeros((), jnp.float32)
-            for i in range(run.depth):
-                if save:
-                    hs.append(out)
-                out, aux_i = body(out, layer_weights(stacked_arrs, i))
-                aux_total = aux_total + aux_i
-            return out, aux_total, hs
-
-        @jax.custom_vjp
-        def run_stream(hidden_a, *stacked_arrs):
-            out, aux_total, _ = fwd_core(hidden_a, stacked_arrs, save=False)
-            return out, aux_total
-
-        def fwd_rule(hidden_a, *stacked_arrs):
-            out, aux_total, hs = fwd_core(hidden_a, stacked_arrs, save=True)
-            return (out, aux_total), (tuple(hs), stacked_arrs)
-
-        def bwd_rule(res, ct):
-            d_out, d_aux = ct
-            hs, stacked_arrs = res
-            # per-param host grad slabs, zero-initialized in host space
-            gbufs = [d2h(jnp.zeros(s.shape, s.dtype)) for s in stacked_arrs]
-            dh = d_out
-            for i in reversed(range(run.depth)):
-                w_i = layer_weights(stacked_arrs, i)
-                _, vjp = jax.vjp(lambda h, ws: body(h, ws), hs[i], w_i)
-                dh, dws = vjp((dh, d_aux))
-                for j, (dw, ts) in enumerate(zip(dws, shapes)):
-                    slab = tuple(stacked_arrs[j].shape[1:])
-                    if ts is not None and tuple(dw.shape) != slab:
-                        flat = dw.reshape(-1)
-                        pad = slab[0] * slab[1] - flat.size
-                        dw = jnp.pad(flat, (0, pad)).reshape(slab)
-                    gbufs[j] = jax.lax.dynamic_update_index_in_dim(
-                        gbufs[j], d2h(dw[None].astype(gbufs[j].dtype)), i, 0)
-                # serialize the walk: without the barrier XLA overlaps every
-                # layer's recompute + D2H grad copy, keeping all L layers'
-                # dw buffers live in HBM at once (observed 27GB at 4B)
-                dh, *gbufs = jax.lax.optimization_barrier((dh, *gbufs))
-            return (dh,) + tuple(gbufs)
-
-        run_stream.defvjp(fwd_rule, bwd_rule)
-        return run_stream(hidden, *stacked)
+            out, aux_i = body_c(out, tuple(slices))
+            aux_total = aux_total + aux_i
+        return out, aux_total
     if pp > 1:
         from .pipeline import (choose_microbatches, microbatch,
                                pipeline_shard_map, unmicrobatch)

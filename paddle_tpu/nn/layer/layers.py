@@ -25,7 +25,8 @@ class Parameter(Tensor):
     """
 
     __slots__ = ("optimize_attr", "regularizer", "do_model_average", "need_clip",
-                 "is_distributed", "dist_spec", "_stacked_into")
+                 "is_distributed", "dist_spec", "_stacked_into",
+                 "_stream_meta")
 
     def __init__(self, data, name=None, trainable=True):
         super().__init__(data, stop_gradient=not trainable, name=name)

@@ -74,13 +74,16 @@ class Program:
 
     # -- introspection -------------------------------------------------------
     def parameters(self):
+        return [p for p in self.param_tensors() if not p.stop_gradient]
+
+    def param_tensors(self):
+        """Every Parameter the recorded graph reads (trainable or not)."""
         seen, out = set(), []
         for node in self.nodes:
             for kind, payload in node.inputs:
                 if kind == "param" and id(payload) not in seen:
                     seen.add(id(payload))
-                    if not payload.stop_gradient:
-                        out.append(payload)
+                    out.append(payload)
         return out
 
     def set_train(self, loss, optimizer):
@@ -247,13 +250,38 @@ class Executor:
         if program.train_spec is not None:
             outs = self._run_train(program, env, fetch_ids)
         else:
-            env = program._replay(env)
-            outs = [env.get(aid, program._values.get(aid)) for aid in fetch_ids]
+            outs = self._run_infer(program, env, fetch_ids)
         if return_numpy:
             return [np.asarray(o) for o in outs]
         from ..core.tensor import Tensor
 
         return [Tensor(o) for o in outs]
+
+    def _run_infer(self, program: Program, env, fetch_ids):
+        """Inference replay, jit-compiled and cached per feed shape (same
+        specialization contract as the training path)."""
+        feed_keys = sorted(env.keys())
+        all_params = program.param_tensors()  # args, NOT baked constants:
+        #                                       training may update them
+        cache_key = (
+            tuple((k, tuple(env[k].shape), str(env[k].dtype))
+                  for k in feed_keys),
+            tuple(fetch_ids), None,
+        )
+        cache = program.__dict__.setdefault("_train_jit", {})
+        jitted = cache.get(cache_key)
+        if jitted is None:
+            def infer_fn(param_arrays, feed_vals):
+                override = {id(p): a for p, a in zip(all_params, param_arrays)}
+                e = program._replay(dict(zip(feed_keys, feed_vals)),
+                                    param_override=override)
+                return tuple(e.get(aid, program._values.get(aid))
+                             for aid in fetch_ids)
+
+            jitted = jax.jit(infer_fn)
+            cache[cache_key] = jitted
+        return list(jitted([p.data for p in all_params],
+                           [env[k] for k in feed_keys]))
 
     def _run_train(self, program: Program, env, fetch_ids):
         """One training iteration: grads via value_and_grad over the replay.
@@ -265,6 +293,9 @@ class Executor:
         loss_aid, optimizer = program.train_spec
         params = optimizer._parameter_list or program.parameters()
         train_params = [p for p in params if not p.stop_gradient]
+        train_ids = {id(p) for p in train_params}
+        frozen_params = [p for p in program.param_tensors()
+                         if id(p) not in train_ids]
         feed_keys = sorted(env.keys())
         cache_key = (
             tuple((k, tuple(env[k].shape), str(env[k].dtype))
@@ -275,12 +306,15 @@ class Executor:
         cache = program.__dict__.setdefault("_train_jit", {})
         jitted = cache.get(cache_key)
         if jitted is None:
-            def train_fn(param_arrays, feed_vals):
+            def train_fn(param_arrays, frozen_arrays, feed_vals):
                 base_env = dict(zip(feed_keys, feed_vals))
+                frozen_map = {id(p): a
+                              for p, a in zip(frozen_params, frozen_arrays)}
 
                 def loss_of(pa):
-                    override = {id(p): a
-                                for p, a in zip(train_params, pa)}
+                    override = dict(frozen_map)
+                    override.update({id(p): a
+                                     for p, a in zip(train_params, pa)})
                     e = program._replay(dict(base_env),
                                         param_override=override)
                     loss = e[loss_aid].astype(jnp.float32)
@@ -299,6 +333,7 @@ class Executor:
             cache[cache_key] = jitted
         _loss, fetches, grads = jitted(
             tuple(p.data for p in train_params),
+            [p.data for p in frozen_params],
             [env[k] for k in feed_keys])
         for p, g in zip(train_params, grads):
             p.grad = Tensor(g.astype(p.dtype))

@@ -120,3 +120,22 @@ def test_fc_flatten_semantics():
     f, k = exe.run(feed={"x": xv}, fetch_list=[flat, keep])
     assert f.shape == (2, 5), f.shape
     assert k.shape == (2, 3, 5), k.shape
+
+
+def test_infer_sees_updated_params_not_baked_constants():
+    """The jit-cached replay must take parameters as ARGUMENTS: after a
+    manual param update, a cached-shape run reflects the new values."""
+    paddle.enable_static()
+    x = paddle.static.data(name="x", shape=[None, 4], dtype="float32")
+    out = paddle.static.nn.fc(x, size=2)
+    exe = paddle.static.Executor()
+    xv = np.ones((3, 4), "float32")
+    (a,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    # mutate the fc weight and re-run the SAME shape (cached executable)
+    prog = paddle.static.default_main_program()
+    (w,) = [p for p in prog.param_tensors() if p.ndim == 2]
+    import jax.numpy as jnp
+
+    w.data = jnp.asarray(np.asarray(w.data) * 2.0)
+    (b,) = exe.run(feed={"x": xv}, fetch_list=[out])
+    assert not np.allclose(a, b), "cached replay baked stale params"

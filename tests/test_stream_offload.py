@@ -84,3 +84,25 @@ def test_pack_roundtrip():
     assert _needs_pack((2048,), 2)
     assert _needs_pack((2048, 3), 2)
     assert not _needs_pack((16, 128), 2)
+
+
+def test_streamed_reconstruction_is_safe():
+    """Building a second StreamedTrainStep on the same model/optimizer must
+    not re-pack already-parked buffers (which would corrupt slab state)."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, hidden_size=64,
+                           intermediate_size=128, num_attention_heads=4,
+                           num_key_value_heads=4, vocab_size=128)
+    m = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16)).astype("int32"))
+    s1 = jit.StreamedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+    a = float(s1(ids, ids))
+    s2 = jit.StreamedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+    b = float(s2(ids, ids))
+    c = float(s2(ids, ids))
+    assert np.isfinite([a, b, c]).all()
+    assert c < a  # training continued across reconstruction
