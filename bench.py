@@ -332,19 +332,19 @@ def _configs():
     # DiT flagship (BASELINE config 4): the published DiT-XL/2 shape at the
     # ImageNet-256 latent (32x32x4, patch 2 -> 256 tokens)
     dit = DiTConfig.dit_xl_2(dtype="bfloat16")
-    # streamed-offload capacity demo: 2.5B params on the 9.5GB chip (stacked
-    # weights + optimizer state in pinned host memory, layerwise streaming).
-    # The resident ceiling is 1.83B and 2.0B OOMs outright; 4B-class currently
-    # stops in the TPU compiler's memory-space assignment (the dus chains for
-    # grads/updates get HBM-placed above ~3B — the design streams, the
-    # compiler pass doesn't yet cooperate at that size).
-    stream_25 = LlamaConfig(
-        vocab_size=32000, hidden_size=2560, intermediate_size=6912,
-        num_hidden_layers=30, num_attention_heads=20, num_key_value_heads=20,
+    # streamed-offload capacity demo: 3.08B params on the 9.5GB chip
+    # (stacked weights + optimizer state in pinned host memory, layerwise
+    # streaming; batch 2 keeps the remat boundary activations under the
+    # compiler's HBM budget). The resident ceiling is 1.83B and 2.0B OOMs
+    # outright; ~3.1B is where the compiler's memory-space assignment runs
+    # out of headroom for the grad chains it HBM-places.
+    stream_31 = LlamaConfig(
+        vocab_size=32000, hidden_size=2816, intermediate_size=7680,
+        num_hidden_layers=30, num_attention_heads=22, num_key_value_heads=22,
         max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
     return {"big": big, "adafactor_1p8b": big_1p8, "long_seq_16k": long16k,
             "compat_374m": compat, "moe": moe, "dit": dit,
-            "stream_capacity": stream_25}
+            "stream_capacity": stream_31}
 
 
 def _run_one(name: str):
@@ -370,7 +370,7 @@ def _run_one(name: str):
     elif name == "dit":
         out = _measure_dit(cfg, batch=32, iters=8)
     elif name == "stream_capacity":
-        out = _measure_stream(cfg, batch=4, seq=2048, iters=3)
+        out = _measure_stream(cfg, batch=2, seq=2048, iters=3)
     else:
         out = _measure(cfg, batch=4, seq=2048, iters=8)
         try:
@@ -446,9 +446,9 @@ def main():
             streamed_max_params_b=detail["stream_capacity"]["params_b"],
             streamed_step_time_s=detail["stream_capacity"]["step_time_s"],
             note="resident ceiling 1.83B (2.0B OOMs); streamed pinned-host "
-                 "offload trains 2.5B on the same chip; 4B blocked on the "
-                 "compiler's memory-space pass HBM-placing the grad/update "
-                 "chains at that size")
+                 "offload trains 3.08B on the same chip; larger sizes stop "
+                 "in the compiler's memory-space pass, which HBM-places the "
+                 "grad chains (18.7G estimate at 4B)")
     except Exception as e:
         detail["stream_capacity_error"] = str(e)[:300]
     result = {

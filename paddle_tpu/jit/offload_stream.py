@@ -1,5 +1,5 @@
 """Streamed parameter offload: beyond-residence training on one chip
-(2.5B measured on the 9.5GB chip; the resident ceiling is 1.83B).
+(3.08B measured on the 9.5GB chip; the resident ceiling is 1.83B).
 
 Reference: python/paddle/distributed/fleet/meta_parallel/sharding/
 sharding_stage3.py:50 (param offload) + :737 (TaskFlow prefetch) — the
@@ -127,9 +127,9 @@ class StreamedTrainStep:
     """Single-chip capacity mode: jit.TrainStep's twin for models whose
     stacked decoder weights exceed HBM. Slower per step (every weight
     crosses the PCIe/host path twice) but lifts the resident ceiling from
-    ~1.8B toward the host-RAM bound (2.5B measured; 4B-class currently
-    stops in the TPU compiler's memory-space assignment, which HBM-places
-    the grad/update dus chains above ~3B)."""
+    ~1.8B toward the host-RAM bound (3.08B measured at batch 2; larger
+    sizes stop in the TPU compiler's memory-space assignment, which
+    HBM-places the grad chains)."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
                  donate_host: bool = False):
