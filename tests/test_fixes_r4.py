@@ -567,3 +567,71 @@ class TestStrategyFlags:
         w0 = np.load(tmp_path / "w.0.npy")
         w1 = np.load(tmp_path / "w.1.npy")
         np.testing.assert_allclose(w0, w1, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.dist
+class TestScalerPlusAccumulation:
+    """The in-graph scaler and gradient-merge window COMBINED in one
+    compiled step: non-finite micro-steps contribute zero and drop out of
+    the window average; the scale machine still updates every call."""
+
+    def test_inf_microstep_excluded_from_window(self):
+        from paddle_tpu.amp import GradScaler
+
+        net = _mlp(31)
+        snap = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        sc = GradScaler(init_loss_scaling=128.0, decr_every_n_nan_or_inf=1)
+        dist.init_mesh(dp=8)
+        try:
+            step = dist.ShardedTrainStep(net, _loss_fn, o, scaler=sc,
+                                         accum_steps=2, accum_avg=True)
+            rs = np.random.RandomState(41)
+            x_good = rs.rand(8, 16).astype("float32")
+            y = rs.rand(8, 16).astype("float32")
+            x_bad = np.full((8, 16), np.inf, "float32")
+            # window: [good, bad] -> update applies from the good step ONLY
+            step(paddle.to_tensor(x_good), paddle.to_tensor(y))
+            step(paddle.to_tensor(x_bad), paddle.to_tensor(y))
+            st = step.amp_state()
+            assert st["loss_scale"] == 64.0  # the bad micro-step halved it
+            assert st["updates"] == 1        # window still applied
+            after = {k: v.numpy() for k, v in net.state_dict().items()}
+            dist.reset_mesh()
+
+            # reference: one plain SGD step on the good batch's grads alone
+            net2 = _mlp(31)
+            net2.set_state_dict(snap)
+            o2 = opt.SGD(learning_rate=0.1, parameters=net2.parameters())
+            loss = _loss_fn(net2, paddle.to_tensor(x_good),
+                            paddle.to_tensor(y))
+            loss.backward()
+            o2.step()
+            for k, v in net2.state_dict().items():
+                np.testing.assert_allclose(after[k], v.numpy(), rtol=2e-4,
+                                           atol=1e-6, err_msg=k)
+        finally:
+            dist.reset_mesh()
+
+    def test_fully_poisoned_window_skips_update(self):
+        from paddle_tpu.amp import GradScaler
+
+        net = _mlp(32)
+        before = {k: v.numpy().copy() for k, v in net.state_dict().items()}
+        o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+        sc = GradScaler(init_loss_scaling=64.0, decr_every_n_nan_or_inf=1)
+        dist.init_mesh(dp=8)
+        try:
+            step = dist.ShardedTrainStep(net, _loss_fn, o, scaler=sc,
+                                         accum_steps=2)
+            x_bad = np.full((8, 16), np.inf, "float32")
+            y = np.zeros((8, 16), "float32")
+            for _ in range(2):
+                step(paddle.to_tensor(x_bad), paddle.to_tensor(y))
+            st = step.amp_state()
+            assert st["updates"] == 0  # nothing finite: no update applied
+            assert st["loss_scale"] == 16.0  # halved twice
+            for k, v in net.state_dict().items():
+                np.testing.assert_array_equal(v.numpy(), before[k])
+        finally:
+            dist.reset_mesh()
