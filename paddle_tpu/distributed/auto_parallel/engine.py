@@ -89,42 +89,96 @@ def _per_device_bytes(param_bytes, mp, dp, zero, opt_factor, act_bytes,
     return weights + state + acts
 
 
+# step-time model constants (documented rough v5e numbers — the model only
+# needs to rank meshes, not predict wall-clock):
+_PEAK_FLOPS = 197e12          # bf16 peak per chip
+_ICI_BYTES_PER_S = 9e10       # per-direction ring bandwidth
+_COLL_LATENCY_S = 1e-5        # per-collective launch/sync overhead
+_MP_COLLECTIVES = 100         # activation all-reduces per step under mp
+                              # (≈2/layer × layers, fwd+bwd)
+
+
+def _ring(n: int) -> float:
+    """Bytes-on-wire multiplier of a ring all-reduce: 2(n-1)/n."""
+    return 2.0 * (n - 1) / n if n > 1 else 0.0
+
+
+def estimate_step_time(axes: Dict[str, int], param_bytes: int,
+                       act_bytes: int = 0, flops_per_step: float = 0.0,
+                       peak_flops: float = _PEAK_FLOPS,
+                       ici_bytes_per_s: float = _ICI_BYTES_PER_S) -> float:
+    """Per-step seconds under a candidate mesh: compute + the two dominant
+    collective streams (the reference's measured cost_model.py:185 role,
+    done analytically from bytes-on-wire over ICI):
+
+    - mp: per-layer activation all-reduces, fwd AND bwd — traffic scales
+      with the activation footprint (divided by the data axes, which shard
+      the batch) and rides every microbatch, so it also pays a per-
+      collective latency charge.
+    - dp/sharding: one gradient reduce(-scatter) per step over this rank's
+      1/mp param shard.
+
+    When the caller has no activation estimate, param_bytes stands in
+    (typical batch sizes put per-step activation traffic on the order of
+    the weights)."""
+    mp = axes.get("mp", 1)
+    dp = axes.get("dp", 1) * axes.get("sharding", 1)
+    act_eff = act_bytes or param_bytes
+    t = flops_per_step / (max(mp * dp, 1) * peak_flops) if flops_per_step \
+        else 0.0
+    if mp > 1:
+        t += (2.0 * act_eff / max(dp, 1)) * _ring(mp) / ici_bytes_per_s
+        t += _MP_COLLECTIVES * _COLL_LATENCY_S
+    if dp > 1:
+        t += (param_bytes / mp) * _ring(dp) / ici_bytes_per_s
+        t += _COLL_LATENCY_S
+    return t
+
+
+def _divisors(n: int):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
 def propose_mesh_candidates(n_devices: int, param_bytes: int,
                             num_heads: int = 0, hbm_bytes: float = None,
                             zero: bool = True, optimizer: str = "adamw",
-                            act_bytes: int = 0):
+                            act_bytes: int = 0, flops_per_step: float = 0.0):
     """Ranked (axes, predicted_bytes, feasible) candidates — the planner /
-    cost-model role (reference planner.py + cost_model.py). Feasible
-    candidates first, smallest mp first (mp costs the most communication);
-    infeasible ones stay ranked by predicted bytes so a caller can still
-    pick the least-bad mesh."""
+    cost-model role (reference planner.py + cost_model.py). Every divisor
+    factorization of n_devices is considered (mp=3 on 6 devices is a valid
+    mesh), gated by head divisibility. Feasible candidates are ranked by
+    the estimated step time (estimate_step_time: compute + collective
+    bytes over ICI — NOT just smallest-mp); infeasible ones stay ranked by
+    predicted bytes so a caller can still pick the least-bad mesh."""
     budget = (hbm_bytes or usable_hbm_bytes()) * 0.9  # 10% workspace
     opt_factor = _OPT_STATE_FACTOR.get(optimizer.lower(), 4.0)
     cands = []
-    mp = 1
-    while mp <= n_devices:
-        if n_devices % mp == 0 and (not num_heads or num_heads % mp == 0):
-            dp = n_devices // mp
-            need = _per_device_bytes(param_bytes, mp, dp, zero, opt_factor,
-                                     act_bytes)
-            axes = {}
-            if mp > 1:
-                axes["mp"] = mp
-            if dp > 1:
-                axes["sharding" if zero else "dp"] = dp
-            if not axes:
-                axes["dp"] = n_devices
-            cands.append((axes, need, need <= budget))
-        mp *= 2
-    cands.sort(key=lambda c: (not c[2], c[1] if not c[2] else 0.0,
-                              c[0].get("mp", 1)))
+    for mp in _divisors(n_devices):
+        if num_heads and num_heads % mp != 0:
+            continue
+        dp = n_devices // mp
+        need = _per_device_bytes(param_bytes, mp, dp, zero, opt_factor,
+                                 act_bytes)
+        axes = {}
+        if mp > 1:
+            axes["mp"] = mp
+        if dp > 1:
+            axes["sharding" if zero else "dp"] = dp
+        if not axes:
+            axes["dp"] = n_devices
+        cands.append((axes, need, need <= budget))
+    cands.sort(key=lambda c: (
+        not c[2],
+        c[1] if not c[2] else estimate_step_time(
+            c[0], param_bytes, act_bytes, flops_per_step),
+        c[0].get("mp", 1)))
     return cands
 
 
 def propose_mesh(n_devices: int, param_bytes: int, num_heads: int = 0,
                  hbm_bytes: float = None, zero: bool = True,
                  optimizer: str = "adamw", act_bytes: int = 0,
-                 validate=None) -> Dict[str, int]:
+                 flops_per_step: float = 0.0, validate=None) -> Dict[str, int]:
     """Choose mesh axis degrees (the planner/cost-model role, planner.py).
 
     Memory model per device: params + grads + optimizer state (divided by
@@ -139,7 +193,8 @@ def propose_mesh(n_devices: int, param_bytes: int, num_heads: int = 0,
     blocking a run that rematerialization might still save.
     """
     cands = propose_mesh_candidates(n_devices, param_bytes, num_heads,
-                                    hbm_bytes, zero, optimizer, act_bytes)
+                                    hbm_bytes, zero, optimizer, act_bytes,
+                                    flops_per_step)
     assert cands, "propose_mesh: no candidates (n_devices < 1?)"
     if validate is not None:
         tried = 0

@@ -94,6 +94,8 @@ define_flag("flash_block_k", 0,
 define_flag("remat_policy", "",
             "recompute policy for scanned stacks: ''=full remat, 'dots'=save "
             "non-batch matmul outputs, 'dots_all'=save all matmul outputs")
-define_flag("moe_dispatch", "sort",
-            "MoE token dispatch: 'sort' (argsort capacity routing, O(k*n) "
-            "memory) or 'einsum' (GShard one-hot dispatch einsums, oracle)")
+define_flag("moe_dispatch", "index",
+            "MoE token dispatch: 'index' (cumsum capacity routing, default), "
+            "'sort' (argsort capacity routing), 'gmm' (dropless grouped "
+            "matmul, single-device experts) or 'einsum' (GShard one-hot "
+            "dispatch einsums, oracle)")

@@ -16,6 +16,7 @@ FFNs run as one batched MXU matmul. No ragged shapes, no host round-trips.
 from __future__ import annotations
 
 import contextlib
+import functools
 import math
 
 import jax
@@ -89,29 +90,155 @@ def _expert_ffn(expert_in, w_gate, w_up, w_down, ep_degree):
 
 @primitive("moe_mlp")
 def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
-             ep_degree, dispatch="sort"):
+             ep_degree, dispatch="index"):
     """Routed expert FFN: [b, s, h] -> ([b, s, h], aux_loss).
 
-    Two dispatch strategies, same drop semantics (slot-major: every token's
-    1st choice outranks any 2nd choice for capacity):
+    Four dispatch strategies; the capacity modes share drop semantics
+    (slot-major: every token's 1st choice outranks any 2nd choice):
 
-    - 'sort' (default): tokens are argsorted by expert id; each (token,
-      choice) takes the next position in its expert's capacity buffer via a
-      gather, and outputs scatter-add back per token. O(k*n*h) memory — the
+    - 'index' (default): capacity slots assigned by a cumsum over the
+      [k*n, e] expert one-hot — no argsort, no inverse permutation (the
+      choice-major flat order IS the combine order), all row movement plain
+      gathers. v5e at the bench shape: 19% faster fwd+bwd than 'sort'.
+    - 'sort': tokens argsorted by expert id; each (token, choice) takes the
+      next position in its expert's capacity buffer via a gather. The
       TPU-native form of the reference's count-based global_scatter
       (global_scatter_op.cc builds exactly these per-expert contiguous
       buffers from counts).
+    - 'gmm': DROPLESS grouped matmul (kernels/grouped_matmul.py, megablox
+      Pallas kernel on TPU) — rows sorted by expert, per-expert ragged row
+      blocks walked back-to-back on the MXU; no capacity, no padding waste,
+      capacity_factor ignored. Single-device experts only (falls back to
+      'index' when ep_degree > 1 — ragged row counts can't cross a GSPMD
+      all_to_all with static shapes).
     - 'einsum': GShard one-hot dispatch/combine einsums. O(n*e*cap)
-      intermediates (quadratic in tokens at fixed capacity factor) — kept as
-      the oracle for parity tests and for comparison, via
-      FLAGS_moe_dispatch=einsum.
+      intermediates — kept as the oracle for parity tests.
 
     `dispatch` is a primitive ATTR (cache-key participant): the caller reads
     the flag so a set_flags after the first call still takes effect.
     """
-    impl = _moe_mlp_einsum if dispatch == "einsum" else _moe_mlp_sort
+    if dispatch == "gmm" and ep_degree <= 1:
+        return _moe_mlp_gmm(x, wg, w_gate, w_up, w_down, top_k=top_k)
+    impl = {"einsum": _moe_mlp_einsum, "sort": _moe_mlp_sort}.get(
+        dispatch, _moe_mlp_index)
     return impl(x, wg, w_gate, w_up, w_down, top_k=top_k,
                 capacity_factor=capacity_factor, ep_degree=ep_degree)
+
+
+def _moe_mlp_index(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
+                   ep_degree):
+    """Capacity dispatch without the sort: positions come from a cumsum over
+    the [k*n, e] one-hot (GShard's position_in_expert), so there is no
+    argsort, no searchsorted, and — because the flat order is choice-major
+    by construction — no inverse permutation at combine time. Row movement
+    is two gathers; only int32 index vectors are ever scattered."""
+    b, s, h = x.shape
+    n = b * s
+    e = wg.shape[1]
+    kn = top_k * n
+    cap = max(int(math.ceil(capacity_factor * top_k * n / e)), top_k)
+
+    xt = x.reshape(n, h)
+    gate_v, gate_i, aux = _route(xt, wg, top_k)
+
+    # choice-major flattening: all 1st choices precede any 2nd choice, so
+    # the running count gives 1st choices capacity priority
+    flat_e = gate_i.T.reshape(kn)
+    flat_g = gate_v.T.reshape(kn)
+    oh = flat_e[:, None] == jnp.arange(e, dtype=flat_e.dtype)[None, :]
+    pos = jnp.cumsum(oh.astype(jnp.int32), axis=0) - 1
+    pos_in_e = jnp.sum(jnp.where(oh, pos, 0), axis=1)
+    keep = pos_in_e < cap
+    # dropped entries land on a scratch slot past the buffer
+    slot = jnp.where(keep, flat_e * cap + pos_in_e, e * cap)
+    tok = jnp.tile(jnp.arange(n, dtype=jnp.int32), top_k)
+
+    slot_src = jnp.full((e * cap + 1,), n, jnp.int32).at[slot].set(
+        tok, mode="drop")[:-1]
+    xt_pad = jnp.concatenate([xt, jnp.zeros((1, h), x.dtype)])
+    buf = xt_pad[slot_src]
+
+    expert_out = _expert_ffn(buf.reshape(e, cap, h), w_gate, w_up,
+                             w_down, ep_degree).reshape(e * cap, h)
+
+    contrib = jnp.where(
+        keep[:, None],
+        expert_out[jnp.clip(slot, 0, e * cap - 1)],
+        jnp.zeros((), x.dtype)) * flat_g[:, None].astype(x.dtype)
+    out = jnp.sum(contrib.reshape(top_k, n, h), axis=0)
+    return out.reshape(b, s, h), aux
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gmm_disp_gather(xt, order, inv, top_k):
+    """xs[i] = xt[order[i] // top_k] with a gather-only backward: the
+    cotangent is unsorted by `inv` (a gather, not the scatter XLA would
+    emit for this op's transpose) and summed over the k choice copies."""
+    return jnp.take(xt, order // top_k, axis=0)
+
+
+def _gmm_disp_fwd(xt, order, inv, top_k):
+    return jnp.take(xt, order // top_k, axis=0), (inv, xt.shape[0])
+
+
+def _gmm_disp_bwd(top_k, res, g):
+    inv, n = res
+    gt = jnp.take(g, inv, axis=0).reshape(n, top_k, -1).sum(axis=1)
+    return gt, None, None
+
+
+_gmm_disp_gather.defvjp(_gmm_disp_fwd, _gmm_disp_bwd)
+
+
+@jax.custom_vjp
+def _perm_rows(x, perm, inv_perm):
+    """x[perm] for a permutation, with the backward expressed as the inverse
+    gather instead of XLA's scatter transpose."""
+    return jnp.take(x, perm, axis=0)
+
+
+def _perm_rows_fwd(x, perm, inv_perm):
+    return jnp.take(x, perm, axis=0), (inv_perm,)
+
+
+def _perm_rows_bwd(res, g):
+    (inv_perm,) = res
+    return jnp.take(g, inv_perm, axis=0), None, None
+
+
+_perm_rows.defvjp(_perm_rows_fwd, _perm_rows_bwd)
+
+
+def _moe_mlp_gmm(x, wg, w_gate, w_up, w_down, *, top_k):
+    """Dropless expert FFN: sort the k*n (token, choice) rows by expert and
+    run the ragged per-expert blocks through one grouped matmul per
+    projection (kernels/grouped_matmul.py). Executed FLOPs == activated
+    FLOPs — no capacity padding, no drops."""
+    from ...kernels.grouped_matmul import grouped_matmul
+
+    b, s, h = x.shape
+    n = b * s
+    e = wg.shape[1]
+    kn = top_k * n
+
+    xt = x.reshape(n, h)
+    gate_v, gate_i, aux = _route(xt, wg, top_k)
+
+    flat_e = gate_i.reshape(kn)  # token-major: row t*k+c = choice c of t
+    order = jnp.argsort(flat_e, stable=True)
+    inv = jnp.zeros((kn,), jnp.int32).at[order].set(
+        jnp.arange(kn, dtype=jnp.int32))  # int scatter, not a second sort
+    group_sizes = jnp.bincount(flat_e, length=e)
+
+    xs = _gmm_disp_gather(xt, order, inv, top_k)  # [kn, h] expert-grouped
+    g_proj = grouped_matmul(xs, w_gate, group_sizes)
+    u_proj = grouped_matmul(xs, w_up, group_sizes)
+    act = jax.nn.silu(g_proj) * u_proj
+    ys = grouped_matmul(act, w_down, group_sizes)  # [kn, h]
+
+    y_tok = _perm_rows(ys, inv, order).reshape(n, top_k, h)
+    out = jnp.sum(y_tok * gate_v[:, :, None].astype(x.dtype), axis=1)
+    return out.reshape(b, s, h), aux
 
 
 def _moe_mlp_sort(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,

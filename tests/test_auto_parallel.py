@@ -158,11 +158,11 @@ class TestPlannerV2:
 
         def trial(axes):
             tried.append(dict(axes))
-            return axes.get("mp", 1) == 2  # pretend only mp2 compiles
+            return axes.get("mp", 1) == 4  # pretend only mp4 compiles
 
         axes = propose_mesh(8, param_bytes=int(1e9), num_heads=8,
                             validate=trial)
-        assert axes.get("mp", 1) == 2
+        assert axes.get("mp", 1) == 4
         assert tried[0] != axes  # ranked-first candidate was tried and failed
 
     def test_activation_bytes_estimator(self):
@@ -177,3 +177,58 @@ class TestPlannerV2:
 
         est = estimate_activation_bytes(f, jnp.zeros((8, 8), jnp.float32))
         assert est >= 2 * 8 * 8 * 4  # at least the two [8,8] intermediates
+
+
+class TestPlannerV3:
+    """VERDICT r4 next #7: divisor meshes + a step-time term in ranking."""
+
+    def test_non_power_of_2_mesh_reachable(self):
+        # 6 devices, 12 heads, 20B params: power-of-2 doubling never tried
+        # mp=3 or mp=6 and warned infeasible; divisor enumeration finds the
+        # feasible mp=6 (and proposes it without a warning)
+        import warnings
+
+        from paddle_tpu.distributed.auto_parallel.engine import (
+            propose_mesh, propose_mesh_candidates)
+
+        cands = propose_mesh_candidates(6, int(20e9), num_heads=12,
+                                        optimizer="adafactor")
+        mps = [a.get("mp", 1) for a, _, _ in cands]
+        assert 3 in mps and 6 in mps, mps
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            axes = propose_mesh(6, int(20e9), num_heads=12,
+                                optimizer="adafactor")
+        assert axes.get("mp", 1) in (3, 6), axes
+
+    def test_time_ranking_flips_on_comm_character(self):
+        # same device count, both meshes feasible (huge hbm decouples the
+        # memory gate): grad-reduce-dominated prefers big mp (grads shard
+        # over it), activation-allreduce-dominated prefers pure data axes
+        from paddle_tpu.distributed.auto_parallel.engine import (
+            propose_mesh_candidates)
+
+        param_heavy = propose_mesh_candidates(
+            8, int(40e9), num_heads=8, act_bytes=int(1e8), hbm_bytes=1e12)
+        act_heavy = propose_mesh_candidates(
+            8, int(1e8), num_heads=8, act_bytes=int(40e9), hbm_bytes=1e12)
+        assert param_heavy[0][0].get("mp", 1) > 1, param_heavy[0]
+        assert act_heavy[0][0].get("mp", 1) == 1, act_heavy[0]
+
+    def test_step_time_estimator_monotone_in_bytes(self):
+        from paddle_tpu.distributed.auto_parallel.engine import (
+            estimate_step_time)
+
+        lo = estimate_step_time({"mp": 2, "sharding": 4}, int(1e9),
+                                act_bytes=int(1e9))
+        hi = estimate_step_time({"mp": 2, "sharding": 4}, int(1e10),
+                                act_bytes=int(1e10))
+        assert hi > lo > 0.0
+        # compute term: flops raise the estimate; at compute-dominated
+        # scale, fewer devices means a slower step
+        plain = estimate_step_time({"sharding": 8}, int(1e9))
+        base = estimate_step_time({"sharding": 8}, int(1e9),
+                                  flops_per_step=1e17)
+        fewer = estimate_step_time({"sharding": 4}, int(1e9),
+                                   flops_per_step=1e17)
+        assert base > plain and fewer > base

@@ -517,36 +517,55 @@ def test_gradient_merge_drop_bad_batch():
     dist.reset_mesh()
 
 
-def test_moe_sort_dispatch_matches_einsum_oracle():
-    """The default argsort capacity routing must reproduce the GShard one-hot
-    einsum dispatch exactly — same drops (slot-major priority), same combine
-    weights — forward AND backward."""
+def _moe_run(layer, x):
+    out = layer(x)
+    loss = (out * out).mean()
+    loss.backward()
+    grads = {n: p.grad.numpy().copy() for n, p in layer.named_parameters()}
+    for p in layer.parameters():
+        p.clear_grad()
+    return out.numpy(), grads
+
+
+def _moe_dispatch_vs_oracle(capacity_factor, mode):
+    """Run one MoE layer under `mode` and under the GShard einsum oracle at
+    the same capacity; assert identical outputs and grads."""
     from paddle_tpu.framework import flags
     from paddle_tpu.nn.layer.moe import MoELayer
 
     dist.reset_mesh()
     paddle.seed(5)
     layer = MoELayer(d_model=32, num_experts=4, intermediate_size=64,
-                     top_k=2, capacity_factor=1.1)  # tight cap: forces drops
+                     top_k=2, capacity_factor=capacity_factor)
     x = paddle.randn([2, 24, 32])
-
-    def run():
-        out = layer(x)
-        loss = (out * out).mean()
-        loss.backward()
-        grads = {n: p.grad.numpy().copy()
-                 for n, p in layer.named_parameters()}
-        for p in layer.parameters():
-            p.clear_grad()
-        return out.numpy(), grads
-
     try:
         flags.set_flags({"FLAGS_moe_dispatch": "einsum"})
-        ref_out, ref_g = run()
+        ref_out, ref_g = _moe_run(layer, x)
+        flags.set_flags({"FLAGS_moe_dispatch": mode})
+        got_out, got_g = _moe_run(layer, x)
     finally:
-        flags.set_flags({"FLAGS_moe_dispatch": "sort"})
-    got_out, got_g = run()
+        flags.set_flags({"FLAGS_moe_dispatch": "index"})
     np.testing.assert_allclose(got_out, ref_out, rtol=1e-5, atol=1e-6)
     for n in ref_g:
         np.testing.assert_allclose(got_g[n], ref_g[n], rtol=1e-4,
                                    atol=1e-6, err_msg=n)
+
+
+def test_moe_sort_dispatch_matches_einsum_oracle():
+    """argsort capacity routing must reproduce the GShard one-hot einsum
+    dispatch exactly — same drops (slot-major priority), same combine
+    weights — forward AND backward. Tight cap forces drops."""
+    _moe_dispatch_vs_oracle(1.1, "sort")
+
+
+def test_moe_index_dispatch_matches_einsum_oracle():
+    """The default cumsum-position routing: same slot-major drop semantics
+    as the oracle, fwd AND bwd, under a drop-forcing capacity."""
+    _moe_dispatch_vs_oracle(1.1, "index")
+
+
+def test_moe_gmm_dropless_matches_undropped_oracle():
+    """The grouped-matmul dropless path must equal the einsum oracle when
+    the oracle's capacity is large enough that nothing drops (cf = e/k
+    guarantees cap >= n)."""
+    _moe_dispatch_vs_oracle(2.0, "gmm")
