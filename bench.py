@@ -341,6 +341,147 @@ def _measure_stream(cfg, batch, seq, iters):
     }
 
 
+def _surrogate_cifar(n, seed=0):
+    """Deterministic CIFAR-10 stand-in: the sealed image has no real CIFAR
+    download, so the parity harness uses 10 fixed class prototypes +
+    Gaussian noise — identical bytes on every backend (BASELINE config 1
+    demands loss parity vs a single-device CPU reference; the surrogate is
+    clearly labeled in the bench row)."""
+    rng = np.random.RandomState(seed)
+    protos = rng.randn(10, 3, 32, 32).astype("float32")
+    ys = rng.randint(0, 10, n).astype("int64")
+    xs = (protos[ys] + 0.7 * rng.randn(n, 3, 32, 32)).astype("float32")
+    return xs, ys
+
+
+def _resnet_cifar_losses(steps=12, batch=32, seed=7):
+    """Same-seed resnet18 training losses over the deterministic surrogate:
+    run on the TPU and on the CPU backend, the curves must match (threefry
+    init is backend-independent; divergence measures numerics only)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.vision.models import resnet18
+
+    paddle.seed(seed)
+    net = resnet18(num_classes=10)
+    optim = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=net.parameters())
+    step = jit.TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                         optim)
+    xs, ys = _surrogate_cifar(steps * batch)
+    losses = []
+    for i in range(steps):
+        xb = paddle.to_tensor(xs[i * batch:(i + 1) * batch])
+        yb = paddle.to_tensor(ys[i * batch:(i + 1) * batch])
+        losses.append(round(float(step(xb, yb)), 5))
+    return losses
+
+
+def _measure_resnet_cifar():
+    """BASELINE config 1: loss parity vs the CPU reference (grand-child
+    process pinned to the CPU backend) + TPU images/sec at batch 128."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.vision.models import resnet18
+
+    losses_tpu = _resnet_cifar_losses()
+    ref = _spawn("resnet_cifar_cpuref", timeout=2400)
+    deltas = [abs(a - b) for a, b in zip(losses_tpu, ref["losses"])]
+
+    paddle.seed(7)
+    batch = 128
+    net = resnet18(num_classes=10)
+    optim = opt.Momentum(learning_rate=0.05, momentum=0.9,
+                         parameters=net.parameters())
+    step = jit.TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                         optim)
+    xs, ys = _surrogate_cifar(batch, seed=1)
+    xb, yb = paddle.to_tensor(xs), paddle.to_tensor(ys)
+    dt, loss = _time_train_step(step, (xb, yb), iters=16)
+    return {
+        "images_per_sec": round(batch / dt, 1),
+        "step_time_s": round(dt, 5), "batch": batch,
+        "loss_parity": {
+            "data": "deterministic surrogate CIFAR (no real CIFAR in the "
+                    "sealed image)",
+            "steps": len(losses_tpu),
+            "max_abs_delta": round(max(deltas), 5),
+            "final_tpu": losses_tpu[-1], "final_cpu": ref["losses"][-1],
+            "losses_tpu": losses_tpu, "losses_cpu": ref["losses"]},
+    }
+
+
+def _surrogate_sst2(n, seq=128, vocab=30522, seed=0):
+    """Deterministic SST-2-shaped binary task: 3 class-marker tokens planted
+    per sentence (disjoint marker sets) — learnable to high accuracy, so a
+    finetune that works reaches it and a broken one cannot."""
+    rng = np.random.RandomState(seed)
+    markers = rng.choice(np.arange(1000, vocab), 80, replace=False)
+    pos, neg = markers[:40], markers[40:]
+    ids = rng.randint(1000, vocab, (n, seq)).astype("int64")
+    ys = rng.randint(0, 2, n).astype("int64")
+    cols = rng.randint(1, seq, (n, 3))
+    for i in range(n):
+        src = pos if ys[i] else neg
+        ids[i, cols[i]] = rng.choice(src, 3)
+    return ids, ys
+
+
+def _measure_bert_finetune(steps=150, batch=32, seq=128):
+    """BASELINE config 2: BERT-base finetune on the SST-2-shaped task —
+    held-out accuracy + sequences/sec."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.core import autograd
+    from paddle_tpu.models import BertConfig, BertForSequenceClassification
+
+    paddle.seed(11)
+    cfg = BertConfig.bert_base(dtype="bfloat16")
+    model = BertForSequenceClassification(cfg, num_classes=2)
+    optim = opt.AdamW(learning_rate=1e-4, parameters=model.parameters(),
+                      weight_decay=0.01)
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y), optim)
+
+    ids, ys = _surrogate_sst2(steps * batch + 256)
+    train_ids, train_ys = ids[:steps * batch], ys[:steps * batch]
+    test_ids, test_ys = ids[steps * batch:], ys[steps * batch:]
+    t_train = 0.0
+    loss = None
+    for i in range(steps):
+        xb = paddle.to_tensor(train_ids[i * batch:(i + 1) * batch])
+        yb = paddle.to_tensor(train_ys[i * batch:(i + 1) * batch])
+        t0 = time.perf_counter()
+        loss = step(xb, yb)
+        loss = float(loss)
+        if i >= 2:  # skip compile steps
+            t_train += time.perf_counter() - t0
+    seq_per_sec = (steps - 2) * batch / t_train
+
+    model.eval()
+    correct = 0
+    with autograd.no_grad():
+        for i in range(0, len(test_ys), batch):
+            logits = model(paddle.to_tensor(test_ids[i:i + batch]))
+            pred = np.argmax(np.asarray(logits.numpy(), dtype="float32"),
+                             axis=-1)
+            correct += int((pred == test_ys[i:i + batch]).sum())
+    acc = correct / len(test_ys)
+    return {
+        "heldout_accuracy": round(acc, 4),
+        "sequences_per_sec": round(seq_per_sec, 1),
+        "final_loss": round(loss, 4),
+        "steps": steps, "batch": batch, "seq": seq,
+        "data": "deterministic SST-2-shaped marker task (no GLUE download "
+                "in the sealed image)",
+        "params_m": 109.5,
+    }
+
+
 def _configs():
     from paddle_tpu.models import LlamaConfig
 
@@ -407,6 +548,19 @@ def _configs():
 def _run_one(name: str):
     """Child-process entry: one config per process so each gets the whole
     HBM (a prior config's live executables would otherwise OOM the next)."""
+    if name == "resnet_cifar_cpuref":
+        # the single-device CPU reference of BASELINE config 1 — pin the
+        # backend BEFORE any jax device use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print("BENCH_RESULT " + json.dumps({"losses": _resnet_cifar_losses()}))
+        return
+    if name in ("resnet_cifar", "bert_finetune"):
+        out = (_measure_resnet_cifar() if name == "resnet_cifar"
+               else _measure_bert_finetune())
+        print("BENCH_RESULT " + json.dumps(out))
+        return
     import paddle_tpu.optimizer as opt_mod
 
     cfg = _configs()[name]
@@ -503,6 +657,16 @@ def main():
         detail["dit"] = _spawn("dit")
     except Exception as e:
         detail["dit_error"] = str(e)[:300]
+    try:
+        # BASELINE config 1: parity (the child spawns the CPU-ref
+        # grandchild, which trains on 1 CPU core — generous budget)
+        detail["resnet_cifar"] = _spawn("resnet_cifar", timeout=3600)
+    except Exception as e:
+        detail["resnet_cifar_error"] = str(e)[:300]
+    try:
+        detail["bert_finetune"] = _spawn("bert_finetune", timeout=2400)
+    except Exception as e:
+        detail["bert_finetune_error"] = str(e)[:300]
     try:
         # host-side init + the layerwise-streaming compile are slow by
         # nature; give this capacity demo its own generous budget

@@ -70,6 +70,12 @@ def remat_wrap(fn):
         policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     elif pol == "dots_all":
         policy = jax.checkpoint_policies.dots_saveable
+    elif pol == "flash":
+        # save the flash-attention outputs (o + lse, named in
+        # kernels/flash_attention.py) so the backward recompute skips the
+        # forward Pallas kernel — ~50MB/layer for the fwd kernel's time
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "flash_o", "flash_lse")
     return jax.checkpoint(fn, policy=policy)
 
 

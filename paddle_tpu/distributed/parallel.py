@@ -156,7 +156,7 @@ class ShardedTrainStep:
                                  and getattr(scaler, "_enable", True)) else None
         self.accum_steps = int(accum_steps)
         self.accum_avg = bool(accum_avg)
-        self._amp_state = None   # (scale f32, good i32, bad i32)
+        self._amp_state = None   # (scale f32, good i32, bad i32, fin b1)
         self._upd_no = None      # applied-update counter (in-graph)
         self._acc = None         # fp32 grad buffers (accum_steps > 1)
         self._goodw = None       # finite micro-steps in current window
@@ -355,11 +355,13 @@ class ShardedTrainStep:
     def _amp_update(self, fin, amp):
         """Dynamic loss-scale state machine, traced (reference
         python/paddle/fluid/dygraph/amp/loss_scaler.py:40 + the
-        update_loss_scaling op). amp = (scale, good, bad)."""
+        update_loss_scaling op). amp = (scale, good, bad, last_fin); the
+        trailing flag records whether the LAST step's grads were finite so
+        the host GradScaler._found_inf can mirror it (advisor r4)."""
         sc = self.scaler
-        scale, good, bad = amp
+        scale, good, bad = amp[:3]
         if not getattr(sc, "_dynamic", True):
-            return (scale, good, bad)
+            return (scale, good, bad, fin)
         good2 = jnp.where(fin, good + 1, 0)
         bad2 = jnp.where(fin, 0, bad + 1)
         incr = fin & (good2 >= sc._incr_every_n_steps)
@@ -370,7 +372,7 @@ class ShardedTrainStep:
                                      scale))
         good3 = jnp.where(incr, 0, good2)
         bad3 = jnp.where(decr, 0, bad2)
-        return (scale2, good3, bad3)
+        return (scale2, good3, bad3, fin)
 
     def _build_amp(self, batch_arrays, boundary):
         """One compiled variant of the scaler/accumulation step.
@@ -483,7 +485,7 @@ class ShardedTrainStep:
         param_sh, state_sh, frozen_sh, batch_sh = self._sharding_plan(batch_arrays)
         acc_sh = self._grad_shardings() if k > 1 else []
         repl = env.replicated()
-        amp_sh = (repl, repl, repl)
+        amp_sh = (repl, repl, repl, repl)
         if not boundary:
             in_sh = (param_sh, acc_sh, repl, amp_sh, frozen_sh, repl, *batch_sh)
             out_sh = (repl, acc_sh, repl, amp_sh)
@@ -505,7 +507,9 @@ class ShardedTrainStep:
             jax.device_put(jnp.int32(int(getattr(sc, "_good_steps", 0) or 0)
                                      if sc is not None else 0), repl),
             jax.device_put(jnp.int32(int(getattr(sc, "_bad_steps", 0) or 0)
-                                     if sc is not None else 0), repl))
+                                     if sc is not None else 0), repl),
+            jax.device_put(jnp.bool_(not getattr(sc, "_found_inf", False)
+                                     if sc is not None else True), repl))
         self._upd_no = jax.device_put(
             jnp.int32(int(self.optimizer._global_step)), repl)
         self._goodw = jax.device_put(jnp.int32(0), repl)
@@ -572,7 +576,12 @@ class ShardedTrainStep:
         sc = self.scaler
         if sc is None or self._amp_state is None:
             return
-        sc._scale, sc._good_steps, sc._bad_steps = self._amp_state
+        sc._scale, sc._good_steps, sc._bad_steps = self._amp_state[:3]
+        # found-inf mirrors the last step's finite flag LAZILY (a jax bool;
+        # truthiness materializes it) — code inspecting scaler._found_inf
+        # after a compiled train_batch sees live state, not the eager-era
+        # stale False (advisor r4)
+        sc._found_inf = self._amp_state[3] == False  # noqa: E712 (lazy not)
 
     def discard_accum_window(self):
         """Drop the in-flight gradient-merge window (compiled-path twin of
@@ -589,9 +598,10 @@ class ShardedTrainStep:
         loss_scale / good_steps / bad_steps / updates, or None w/o scaler."""
         if self.scaler is None or self._amp_state is None:
             return None
-        scale, good, bad = self._amp_state
+        scale, good, bad, fin = self._amp_state
         return {"loss_scale": float(scale), "good_steps": int(good),
-                "bad_steps": int(bad), "updates": int(self._upd_no)}
+                "bad_steps": int(bad), "found_inf": not bool(fin),
+                "updates": int(self._upd_no)}
 
     def _build_offload(self, batch_arrays):
         """Two executables instead of one: fwd+bwd on the mesh, update on the

@@ -93,7 +93,9 @@ define_flag("flash_block_k", 0,
             "flash-attention k block size (0 = kernel default 512)")
 define_flag("remat_policy", "",
             "recompute policy for scanned stacks: ''=full remat, 'dots'=save "
-            "non-batch matmul outputs, 'dots_all'=save all matmul outputs")
+            "non-batch matmul outputs, 'dots_all'=save all matmul outputs, "
+            "'flash'=save flash-attention o+lse (skips the fwd kernel in "
+            "the backward recompute)")
 define_flag("moe_dispatch", "index",
             "MoE token dispatch: 'index' (cumsum capacity routing, default), "
             "'sort' (argsort capacity routing), 'gmm' (dropless grouped "

@@ -125,10 +125,22 @@ def _runtime_select(pred, new_thunk, old):
     return sel(new, old)
 
 
-def _runtime_for_range(range_args, body_fn, loop_vars):
+def _brk_hit(vs, brk_idx) -> bool:
+    """True when the carried break flag is concretely set (the concrete
+    paths below exit early instead of running masked dead iterations —
+    plain Python `for` semantics, and guards after the break never run)."""
+    if brk_idx is None:
+        return False
+    flag = vs[brk_idx]
+    return not _is_traced(flag) and _np_bool(flag)
+
+
+def _runtime_for_range(range_args, body_fn, loop_vars, brk_idx=None):
     """`for i in range(...)` -> carry loop. Concrete bounds run the Python
     loop; a traced stop lowers to a while carry over (i, *vars). The step
-    must be concrete (its sign decides the loop predicate)."""
+    must be concrete (its sign decides the loop predicate). `brk_idx`
+    points at the lowered break flag in the carry, so the concrete path
+    exits as soon as it trips."""
     import jax.numpy as jnp
 
     from ..core.tensor import Tensor
@@ -151,6 +163,8 @@ def _runtime_for_range(range_args, body_fn, loop_vars):
         vs = list(loop_vars)
         for i in range(int(start), int(stop), step):
             vs = list(body_fn(i, *vs))
+            if _brk_hit(vs, brk_idx):
+                break
         return tuple(vs)
 
     from ..static import nn as static_nn
@@ -171,7 +185,7 @@ def _runtime_for_range(range_args, body_fn, loop_vars):
 _FOR_UNROLL_LIMIT = 32
 
 
-def _runtime_for_iter(xs, body_fn, loop_vars):
+def _runtime_for_iter(xs, body_fn, loop_vars, brk_idx=None):
     """`for x in xs` — Tensors iterate dim 0 (unrolled when short, a
     dynamic-index while carry when long); other iterables run eagerly."""
     from ..core.tensor import Tensor
@@ -180,12 +194,16 @@ def _runtime_for_iter(xs, body_fn, loop_vars):
         vs = list(loop_vars)
         for x in xs:
             vs = list(body_fn(x, *vs))
+            if _brk_hit(vs, brk_idx):
+                break
         return tuple(vs)
     n = int(xs.shape[0])
     if n <= _FOR_UNROLL_LIMIT:
         vs = list(loop_vars)
         for i in range(n):
             vs = list(body_fn(xs[i], *vs))
+            if _brk_hit(vs, brk_idx):
+                break
         return tuple(vs)
     import jax.numpy as jnp
 
@@ -201,6 +219,72 @@ def _runtime_for_iter(xs, body_fn, loop_vars):
     i0 = Tensor(jnp.asarray(0, jnp.int32))
     res = static_nn.while_loop(cond_fn, body, [i0] + list(loop_vars))
     return tuple(res[1:])
+
+
+# -- call-graph conversion (reference call_transformer.py:25) -----------------
+# Every call site in a converted function is wrapped in
+# __pt_convert_call(f): user-defined plain-Python functions/methods get the
+# same AST conversion (recursively, cached); builtins, stdlib, framework and
+# third-party callables pass through untouched.
+
+import weakref
+
+# weak keys: per-call-created functions/lambdas routed through
+# __pt_convert_call must not be pinned forever (module-level functions stay
+# alive, so their conversions persist)
+_CONVERT_CACHE: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+_SKIP_MODULE_ROOTS = {
+    "paddle_tpu", "jax", "jaxlib", "numpy", "torch", "flax", "optax",
+    "einops", "chex", "builtins",
+}
+
+
+def _runtime_convert_call(f):
+    import sys
+
+    if not callable(f):
+        return f
+    target = f.__func__ if isinstance(f, types.MethodType) else f
+    if not isinstance(target, types.FunctionType):
+        return f  # builtins / C functions / classes / callable objects
+    root = (getattr(target, "__module__", "") or "").split(".")[0]
+    if root in _SKIP_MODULE_ROOTS or root in sys.stdlib_module_names:
+        return f
+    # "unchanged" is cached as None: a WeakKeyDictionary holds values
+    # strongly, so storing the function as its own value would pin the key
+    sentinel = object()
+    converted = _CONVERT_CACHE.get(target, sentinel)
+    if converted is sentinel:
+        _CONVERT_CACHE[target] = None  # recursion guard: use the original
+        converted = convert_to_static(target)
+        _CONVERT_CACHE[target] = None if converted is target else converted
+    if converted is None or converted is target:
+        return f
+    if isinstance(f, types.MethodType):
+        return types.MethodType(converted, f.__self__)
+    return converted
+
+
+class _WrapCalls(ast.NodeTransformer):
+    """fn(args) -> __pt_convert_call(fn)(args) for every call site whose
+    callee isn't a conversion helper (the reference transpiles the call
+    graph; we dispatch per call and decide at runtime)."""
+
+    def __init__(self):
+        self.changed = False
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Name) and (f.id.startswith("__pt_")
+                                        or f.id == "super"):
+            return node
+        self.changed = True
+        node.func = ast.copy_location(
+            ast.Call(func=_name("__pt_convert_call"), args=[f], keywords=[]),
+            f)
+        return node
 
 
 def _assigned_names(stmts) -> Optional[List[str]]:
@@ -349,8 +433,14 @@ def _lower_breaks(body, uid: int, for_loop: bool = False):
             guard_expr, is_break = ctrl
             gi += 1
             gname = f"__pt_g_{uid}_{gi}"
-            new.append(ast.Assign(targets=[_name(gname, store=True)],
-                                  value=_conv_test(guard_expr)))
+            # the guard TEST is masked by live like every other statement:
+            # concretely-dead iterations never evaluate it (it may only be
+            # safe pre-break, e.g. an index bound the break protects), and
+            # under trace a poisoned dead-lane test can't flip the flags
+            new.append(ast.Assign(
+                targets=[_name(gname, store=True)],
+                value=_call("__pt_bool_and", _name(live),
+                            _thunk(_conv_test(guard_expr)))))
             if is_break:
                 hit = _call("__pt_bool_and", _name(live), _thunk(_name(gname)))
                 new.append(ast.Assign(
@@ -416,10 +506,81 @@ def _branch_fn(name: str, stmts, targets: List[str], params: List[str]):
                            decorator_list=[], returns=None)
 
 
+class _ListAppend(ast.NodeTransformer):
+    """`xs.append(e)` statement -> `xs = xs + [e]` inside a loop body about
+    to be converted (reference list_transformer.py:28's list-to-tensor-array
+    rewrite): the functional form makes the list a loop CARRY, so the
+    concrete-trip path threads it like any other variable. Dynamic trip
+    counts still fail loudly — a growing list cannot be a lax carry.
+
+    Rebinding is only semantics-preserving for lists the function CREATED
+    itself, so the rewrite fires only for `allowed` names (locally assigned
+    a list literal, never a parameter): a caller-supplied list must keep
+    its in-place mutation, and a deque/array receiver must keep its own
+    append."""
+
+    def __init__(self, allowed):
+        self.changed = False
+        self.allowed = set(allowed)
+
+    def visit_FunctionDef(self, node):
+        return node
+
+    def visit_Lambda(self, node):
+        return node
+
+    def visit_Expr(self, node):
+        v = node.value
+        if (isinstance(v, ast.Call) and isinstance(v.func, ast.Attribute)
+                and v.func.attr == "append" and len(v.args) == 1
+                and not v.keywords and isinstance(v.func.value, ast.Name)
+                and v.func.value.id in self.allowed):
+            self.changed = True
+            name = v.func.value.id
+            return ast.copy_location(ast.Assign(
+                targets=[_name(name, store=True)],
+                value=ast.BinOp(
+                    left=_name(name), op=ast.Add(),
+                    right=ast.List(elts=[v.args[0]], ctx=ast.Load()))), node)
+        return node
+
+
+def _local_list_names(fdef) -> set:
+    """Names that are provably locally-created plain lists: every Assign to
+    the name is a list literal, and the name is not a parameter."""
+    params = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args +
+                              fdef.args.kwonlyargs)}
+    for a in (fdef.args.vararg, fdef.args.kwarg):
+        if a is not None:
+            params.add(a.arg)
+    lit, non_lit = set(), set()
+    for node in ast.walk(fdef):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    (lit if isinstance(node.value, ast.List)
+                     else non_lit).add(t.id)
+        elif isinstance(node, ast.AugAssign) and isinstance(node.target,
+                                                            ast.Name):
+            non_lit.add(node.target.id)
+    return lit - non_lit - params
+
+
 class _CtrlFlow(ast.NodeTransformer):
-    def __init__(self):
+    def __init__(self, list_names=()):
         self.changed = False
         self.n = 0
+        self.list_names = set(list_names)
+
+    def _lower_appends(self, body):
+        """Apply the list-append rewrite to a COPY of the loop body (a later
+        bail must leave the original statements untouched)."""
+        import copy
+
+        if not self.list_names:
+            return list(body)
+        la = _ListAppend(self.list_names)
+        return [la.visit(copy.deepcopy(st)) for st in body]
 
     def _uid(self):
         self.n += 1
@@ -492,7 +653,7 @@ class _CtrlFlow(ast.NodeTransformer):
         if node.orelse:
             return node
         uid = self._uid()
-        lowered = _lower_breaks(node.body, uid)
+        lowered = _lower_breaks(self._lower_appends(node.body), uid)
         if lowered is None:
             return node
         body, has_break = lowered
@@ -551,7 +712,8 @@ class _CtrlFlow(ast.NodeTransformer):
         if node.orelse or not isinstance(node.target, ast.Name):
             return node
         uid = self._uid()
-        lowered = _lower_breaks(node.body, uid, for_loop=True)
+        lowered = _lower_breaks(self._lower_appends(node.body), uid,
+                                for_loop=True)
         if lowered is None:
             return node
         body, has_break = lowered
@@ -590,14 +752,19 @@ class _CtrlFlow(ast.NodeTransformer):
                 elts=[ast.Name(id=c, ctx=ast.Load()) for c in carry],
                 ctx=ast.Load()))],
             decorator_list=[], returns=None)
+        call = _call(helper, iter_arg, _name(body_fn.name),
+                     ast.List(elts=[_name(c) for c in carry],
+                              ctx=ast.Load()))
+        if has_break:
+            # tell the runtime which carry slot is the break flag so the
+            # concrete path exits early (plain Python `for` semantics)
+            call.args.append(ast.Constant(
+                value=carry.index(f"__pt_brk_{uid}")))
         assign = ast.Assign(
             targets=[ast.Tuple(
                 elts=[ast.Name(id=c, ctx=ast.Store()) for c in carry],
                 ctx=ast.Store())],
-            value=_call(helper, iter_arg,
-                        _name(body_fn.name),
-                        ast.List(elts=[_name(c) for c in carry],
-                                 ctx=ast.Load())))
+            value=call)
         self.changed = True
         return [ast.copy_location(x, node)
                 for x in prelude + [body_fn, assign]]
@@ -656,14 +823,21 @@ def convert_to_static(fn):
     fdef.decorator_list = [d for d in fdef.decorator_list
                            if not _is_to_static(d)]
     _normalize_fallthrough(fdef)
-    tr = _CtrlFlow()
+    tr = _CtrlFlow(list_names=_local_list_names(fdef))
     # transform only the top-level function's body (nested defs keep scope)
     new_body = []
     for st in fdef.body:
         out = tr.visit(st)
         new_body.extend(out if isinstance(out, list) else [out])
     fdef.body = new_body
-    if not tr.changed:
+    # call-graph conversion (reference call_transformer.py:25), AFTER the
+    # control-flow pass so its `range(...)`/helper patterns see the original
+    # spellings: every remaining call site dispatches through
+    # __pt_convert_call, so user helpers with tensor control flow convert
+    # too instead of silently tracing one branch
+    wc = _WrapCalls()
+    fdef.body = [wc.visit(st) for st in fdef.body]
+    if not (tr.changed or wc.changed):
         return fn
     ast.fix_missing_locations(tree)
     glb = dict(raw.__globals__)
@@ -675,6 +849,7 @@ def convert_to_static(fn):
     glb["__pt_bool_or"] = _runtime_bool_or
     glb["__pt_bool_not"] = _runtime_bool_not
     glb["__pt_sel"] = _runtime_select
+    glb["__pt_convert_call"] = _runtime_convert_call
     loc: dict = {}
     try:
         exec(compile(tree, f"<dy2static:{raw.__name__}>", "exec"), glb, loc)

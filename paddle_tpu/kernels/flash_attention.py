@@ -359,9 +359,15 @@ def flash_attention_with_lse(q, k, v, offset=0, causal=False, scale=None,
     dq_, dk_ = _default_blocks()
     block_q = dq_ if block_q is None else block_q
     block_k = dk_ if block_k is None else block_k
-    return _flash_lse_bhsd(q, k, v, jnp.asarray(offset, jnp.int32),
-                           bool(causal), float(scale), int(block_q),
-                           int(block_k))
+    o, lse = _flash_lse_bhsd(q, k, v, jnp.asarray(offset, jnp.int32),
+                             bool(causal), float(scale), int(block_q),
+                             int(block_k))
+    # named for selective remat (FLAGS_remat_policy='flash'): saving o+lse
+    # lets jax.checkpoint DCE the forward Pallas kernel from the backward
+    # recompute (its custom-vjp residuals become available without it)
+    from jax.ad_checkpoint import checkpoint_name
+
+    return checkpoint_name(o, "flash_o"), checkpoint_name(lse, "flash_lse")
 
 
 def flash_attention(q, k, v, causal: bool = False, scale: float = None,

@@ -202,7 +202,9 @@ class TestBoolOps:
 
 class TestConversionSafety:
     def test_for_else_not_converted(self):
-        """for/else is out of scope: must stay Python (and still run eagerly)."""
+        """for/else is out of scope: the loop must stay Python (call sites
+        may still be wrapped for call-graph conversion, so identity is not
+        guaranteed — assert no loop machinery and same result)."""
         def f(x):
             s = x * 0.0
             for i in range(3):
@@ -213,7 +215,10 @@ class TestConversionSafety:
 
         from paddle_tpu.jit.dy2static import convert_to_static
 
-        assert convert_to_static(f) is f
+        f2 = convert_to_static(f)
+        assert "__pt_for_range" not in f2.__code__.co_names
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(f2(x).numpy(), f(x).numpy())
 
     def test_guarded_fresh_name_not_converted(self):
         """An assignment after a guard whose target does NOT pre-exist can't
@@ -229,7 +234,10 @@ class TestConversionSafety:
 
         from paddle_tpu.jit.dy2static import convert_to_static
 
-        assert convert_to_static(f) is f
+        f2 = convert_to_static(f)
+        assert "__pt_for_range" not in f2.__code__.co_names
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(f2(x).numpy(), f(x).numpy())
 
     def test_loop_var_reassign_not_converted(self):
         def f(x):
@@ -241,7 +249,10 @@ class TestConversionSafety:
 
         from paddle_tpu.jit.dy2static import convert_to_static
 
-        assert convert_to_static(f) is f
+        f2 = convert_to_static(f)
+        assert "__pt_for_range" not in f2.__code__.co_names
+        x = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(f2(x).numpy(), f(x).numpy())
 
     def test_converted_runs_inside_trace(self):
         """The converted loop must actually compile: run under jit tracing

@@ -1,0 +1,206 @@
+"""Round-5 dy2static: call-graph conversion, tensor-list lowering, and
+break-guard safety (reference call_transformer.py:25, list_transformer.py:28,
+break_continue_transformer.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _helper_tensor_if(t):
+    # tensor-dependent `if` in a HELPER (not the decorated function): the
+    # call-graph pass must convert it, else tracing hits Tensor.__bool__
+    if t.sum() > 0:
+        return t * 2.0
+    return t - 1.0
+
+
+class _HelperObj:
+    def scale(self, t):
+        if t.sum() > 0:
+            return t * 3.0
+        return t * 0.5
+
+
+_OBJ = _HelperObj()
+
+
+def _entry_calls_helper(x):
+    return _helper_tensor_if(x) + 1.0
+
+
+def _entry_calls_method(x):
+    return _OBJ.scale(x) + 1.0
+
+
+class TestCallGraphConversion:
+    def test_helper_function_converts(self):
+        st = paddle.jit.to_static(_entry_calls_helper)
+        pos = paddle.to_tensor([1.0, 2.0])
+        neg = paddle.to_tensor([-1.0, -2.0])
+        np.testing.assert_allclose(st(pos).numpy(), [3.0, 5.0])
+        np.testing.assert_allclose(st(neg).numpy(), [-1.0, -2.0])
+
+    def test_method_helper_converts(self):
+        st = paddle.jit.to_static(_entry_calls_method)
+        pos = paddle.to_tensor([1.0, 2.0])
+        neg = paddle.to_tensor([-2.0, -4.0])
+        np.testing.assert_allclose(st(pos).numpy(), [4.0, 7.0])
+        np.testing.assert_allclose(st(neg).numpy(), [0.0, -1.0])
+
+    def test_framework_calls_pass_through(self):
+        from paddle_tpu.jit.dy2static import _runtime_convert_call
+
+        assert _runtime_convert_call(len) is len
+        assert _runtime_convert_call(np.sum) is np.sum
+        assert _runtime_convert_call(paddle.concat) is paddle.concat
+        assert _runtime_convert_call(3) == 3
+
+    def test_recursive_helper_does_not_loop(self):
+        from paddle_tpu.jit.dy2static import _runtime_convert_call
+
+        def fact(n):
+            return 1 if n <= 1 else n * fact(n - 1)
+
+        conv = _runtime_convert_call(fact)
+        assert conv(5) == 120
+
+
+class TestTensorList:
+    def test_append_in_for_loop(self):
+        def f(x):
+            lst = []
+            for i in range(4):
+                lst.append(x * float(i))
+            return paddle.concat(lst)
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor([1.0, 2.0])
+        out = st(x)
+        exp = np.concatenate([np.array([1.0, 2.0]) * i for i in range(4)])
+        np.testing.assert_allclose(out.numpy(), exp)
+        # the loop itself converted (append became a carried assignment)
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        assert "__pt_for_range" in convert_to_static(f).__code__.co_names
+
+    def test_append_in_while_loop(self):
+        def f(x):
+            lst = []
+            i = 0
+            while i < 3:
+                lst.append(x + float(i))
+                i = i + 1
+            return paddle.stack(lst)
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor([0.5])
+        out = st(x)
+        np.testing.assert_allclose(out.numpy(),
+                                   [[0.5], [1.5], [2.5]])
+
+
+class TestListRewriteSafety:
+    def test_param_list_keeps_caller_visible_mutation(self):
+        """Appending to a CALLER-supplied list must stay in-place mutation:
+        the loop is left unconverted rather than silently rebinding."""
+        def f(x, out):
+            for i in range(3):
+                out.append(float(i))
+            return x
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        f2 = convert_to_static(f)
+        sink = []
+        f2(paddle.to_tensor([1.0]), sink)
+        assert sink == [0.0, 1.0, 2.0]
+
+    def test_non_list_receiver_keeps_own_append(self):
+        """A deque's append must not become list concatenation."""
+        import collections
+
+        def f(x):
+            dq = collections.deque()
+            for i in range(3):
+                dq.append(float(i))
+            return x * float(len(dq))
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        f2 = convert_to_static(f)
+        np.testing.assert_allclose(f2(paddle.to_tensor([2.0])).numpy(),
+                                   [6.0])
+
+    def test_convert_cache_does_not_pin_lambdas(self):
+        """Per-call-created functions must be collectible (weak cache)."""
+        import gc
+        import weakref
+
+        from paddle_tpu.jit.dy2static import _runtime_convert_call
+
+        def make():
+            def local_fn(t):
+                return t + 1.0
+            return local_fn
+
+        f = make()
+        _runtime_convert_call(f)
+        ref = weakref.ref(f)
+        del f
+        gc.collect()
+        assert ref() is None
+
+
+class TestBreakGuardSafety:
+    def test_concrete_break_exits_early(self):
+        """Post-break guard expressions must never evaluate on the concrete
+        path: lst[i] past the break would raise IndexError (the advisor's
+        only-safe-before-break case)."""
+        def f(x, lst):
+            s = x * 0.0
+            for i in range(5):
+                if lst[i] == 0:
+                    break
+                s = s + x * float(lst[i])
+            return s
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        f2 = convert_to_static(f)
+        assert "__pt_for_range" in f2.__code__.co_names
+        x = paddle.to_tensor([1.0])
+        out = f2(x, [3, 0])  # len 2 < range(5): old lowering raised
+        np.testing.assert_allclose(out.numpy(), [3.0])
+
+    def test_runtime_for_range_break_stops_iterating(self):
+        """brk_idx carry: the concrete loop must stop calling the body once
+        the flag is concretely true (not run masked dead iterations)."""
+        from paddle_tpu.jit.dy2static import _runtime_for_range
+
+        calls = []
+
+        def body(i, s, brk):
+            calls.append(i)
+            return s + 1, brk or i >= 2
+
+        s, brk = _runtime_for_range((10,), body, [0, False], brk_idx=1)
+        assert calls == [0, 1, 2]
+        assert s == 3 and brk
+
+    def test_traced_break_masks_dead_lanes(self):
+        """Under trace, statements and guards after the break must not
+        contribute (1/0 on a dead lane would poison the sum without the
+        live mask)."""
+        def f(x):
+            s = x.sum() * 0.0
+            for i in range(4):
+                if x[i] < 0:
+                    break
+                s = s + 1.0 / x[i]
+            return s
+
+        st = paddle.jit.to_static(f)
+        x = paddle.to_tensor([1.0, 2.0, -1.0, 0.0])
+        out = float(st(x))
+        np.testing.assert_allclose(out, 1.5, rtol=1e-6)

@@ -1,0 +1,61 @@
+"""Round-5 advisor fixes (ADVICE.md r4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+
+
+def _mlp(seed):
+    paddle.seed(seed)
+    return nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 16))
+
+
+class TestScalerFoundInfMirrors:
+    """advisor r4 #3: scaler._found_inf must reflect the compiled step's
+    last finite flag, not the eager era's stale False."""
+
+    def _pipe(self):
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.meta_parallel.wrappers import (
+            HybridParallelOptimizer, PipelineParallel)
+
+        strategy = fleet.DistributedStrategy()
+        dist.init_mesh(dp=8)
+        net = _mlp(31)
+
+        class _HCG:
+            mesh_env = None
+
+        o = opt.Adam(learning_rate=0.05, parameters=net.parameters())
+        hp_opt = HybridParallelOptimizer(o, strategy=strategy)
+        pipe = PipelineParallel(net, _HCG(), strategy)
+        pipe._loss_fn = lambda m, a, b: F.mse_loss(m(a), b)
+        return pipe, hp_opt, net
+
+    def test_found_inf_true_after_inf_batch_and_false_after_clean(self):
+        from paddle_tpu.amp import GradScaler
+
+        pipe, hp_opt, net = self._pipe()
+        try:
+            sc = GradScaler(init_loss_scaling=64.0)
+            rng = np.random.RandomState(5)
+            x = rng.rand(8, 16).astype("float32")
+            y = rng.rand(8, 16).astype("float32")
+            bad_x = x.copy()
+            bad_x[0, 0] = np.inf
+            pipe.train_batch((paddle.to_tensor(bad_x), paddle.to_tensor(y)),
+                             hp_opt, scaler=sc)
+            assert bool(sc._found_inf) is True
+            st = list(pipe._steps.values())[0].amp_state()
+            assert st["found_inf"] is True
+            pipe.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)),
+                             hp_opt, scaler=sc)
+            assert bool(sc._found_inf) is False
+            st = list(pipe._steps.values())[0].amp_state()
+            assert st["found_inf"] is False
+        finally:
+            dist.reset_mesh()
