@@ -32,6 +32,10 @@ _RUN_REGISTRY = {}
 # as TPU pinned-host arrays and the stack unrolls layer-by-layer H2D copies
 # instead of scanning device-resident weights
 _STREAM_MODE = [False]
+# segmented-offload hook (jit/offload_stream.SegmentedTrainStep): when set,
+# StackedStageRun.forward delegates to handler(run, hidden) so the step can
+# hand-schedule the per-layer forward/backward walk
+_SEG_HANDLER = [None]
 
 
 def _memory_sharding(kind: str):
@@ -141,6 +145,12 @@ class StackedStageRun(Layer):
         _RUN_REGISTRY[id(self)] = self
 
     def forward(self, hidden):
+        if _SEG_HANDLER[0] is not None:
+            from ...core.tensor import Tensor
+
+            out = _SEG_HANDLER[0](self, hidden.data
+                                  if isinstance(hidden, Tensor) else hidden)
+            return Tensor(out) if not isinstance(out, Tensor) else out
         stacked = [self._parameters[safe] for safe, _ in self._names]
         out, aux = _run_stack(hidden, *stacked, _run_id=id(self),
                               use_recompute=self.recompute and self.training,

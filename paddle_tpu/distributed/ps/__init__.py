@@ -20,6 +20,7 @@ rendezvous/wire is the native TCPStore daemon (store/store.cpp).
 from __future__ import annotations
 
 import io
+import os
 import queue
 import threading
 from typing import Dict, List, Optional
@@ -84,13 +85,36 @@ class ParameterServer:
         return f"ps/s{self.server_id}"
 
     def create_table(self, name: str, shape, lr: float = 0.1, init_std=0.01,
-                     seed: int = 0):
+                     seed: int = 0, hot_bytes: Optional[int] = None,
+                     spill_dir: Optional[str] = None, accessor=None):
         """Sparse table: this server materializes rows r % n_servers == id.
         All servers draw from the same seed so the sharded init equals the
         single-server init row-for-row; rows are drawn in bounded blocks so
         peak memory is O(block), not O(full table) — the point of sharding
-        giant tables."""
+        giant tables.
+
+        `hot_bytes` switches the shard to a disk-spill two-tier store
+        (reference ssd_sparse_table.h role, spill_table.SpillSparseTable):
+        only ~hot_bytes of rows stay in RAM, the rest live in a memmap under
+        `spill_dir`; `accessor` plugs a CTR-style per-row update policy."""
         rows, dim = int(shape[0]), int(shape[1])
+        if hot_bytes is not None:
+            from .spill_table import SpillSparseTable
+
+            path = os.path.join(spill_dir or ".", f"ps_{name}_"
+                                f"s{self.server_id}.bin")
+            table = SpillSparseTable(rows, dim, hot_bytes, path,
+                                     init_std=init_std, seed=seed,
+                                     server_id=self.server_id,
+                                     n_servers=self.n_servers,
+                                     accessor=accessor)
+            with self._mu:
+                self.tables[name] = table
+                self.lr[name] = float(lr)
+            self.store.set(f"ps/{name}/meta",
+                           _dumps(np.asarray([rows, dim, self.n_servers],
+                                             "int64")))
+            return self
         rng = np.random.RandomState(seed)
         n_own = len(range(self.server_id, rows, self.n_servers))
         shard = np.empty((n_own, dim), "float32")
@@ -170,7 +194,9 @@ class ParameterServer:
         def h_pull(name, k):
             table = self.tables[name]
             ids = _loads(self.store.get(f"{self._pfx}/{name}/pull/{k}/ids"))
-            rows = table[ids // self.n_servers]  # ids are GLOBAL row numbers
+            local = ids // self.n_servers  # ids are GLOBAL row numbers
+            rows = (table.gather(local) if hasattr(table, "gather")
+                    else table[local])
             self.store.set(f"{self._pfx}/{name}/pull/{k}/rows", _dumps(rows))
             self.store.delete_key(f"{self._pfx}/{name}/pull/{k}/ids")
 
@@ -178,8 +204,11 @@ class ParameterServer:
             table = self.tables[name]
             ids = _loads(self.store.get(f"{self._pfx}/{name}/push/{k}/ids"))
             grads = _loads(self.store.get(f"{self._pfx}/{name}/push/{k}/grads"))
-            np.subtract.at(table, ids // self.n_servers,
-                           self.lr[name] * grads)
+            local = ids // self.n_servers
+            if hasattr(table, "scatter_sub"):  # disk-spill tier + accessor
+                table.scatter_sub(local, grads, self.lr[name])
+            else:
+                np.subtract.at(table, local, self.lr[name] * grads)
             self.store.set(f"{self._pfx}/{name}/push/{k}/done", b"1")
             self.store.delete_key(f"{self._pfx}/{name}/push/{k}/ids")
             self.store.delete_key(f"{self._pfx}/{name}/push/{k}/grads")

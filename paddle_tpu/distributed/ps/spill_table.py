@@ -1,0 +1,159 @@
+"""Disk-spill sparse table + pluggable accessor seam.
+
+Reference: paddle/fluid/distributed/ps/table/ssd_sparse_table.h:21 (two-tier
+memory+SSD sparse table with eviction) and ctr_accessor.cc (per-row slot
+metadata + update policy). This is the same architecture at laptop scale:
+
+- hot tier: LRU dict of dirty/recent rows, bounded by a byte budget;
+- cold tier: a np.memmap file holding EVERY row (written block-wise at
+  create with the same RNG stream as the in-RAM table, so sharded init is
+  byte-identical to `ParameterServer.create_table`);
+- accessor: a per-row policy hook owning the extra metadata slots and the
+  update rule — `SGDAccessor` is the plain table, `CtrAccessor` keeps
+  show/click counters per row (the reference's CTR feature-value layout).
+
+The table serves the same gather/scatter surface the ParameterServer's
+pull/push handlers need; rows beyond the hot budget spill to disk instead
+of growing the process.
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+class SGDAccessor:
+    """Plain rows, SGD update (the default/dense accessor role)."""
+
+    slots = 0  # extra metadata columns per row
+
+    def init_slots(self, n_rows):
+        return None
+
+    def on_push(self, rows, meta, grads, lr, counts=None, clicks=None):
+        rows -= lr * grads
+        return rows, meta
+
+
+class CtrAccessor(SGDAccessor):
+    """CTR-style accessor (reference ctr_accessor.cc): per-row [show,
+    click] counters updated on every push; the embedding update is scaled
+    by a frequency-aware factor (rows that were never shown learn at full
+    rate, heavily-shown rows stabilize)."""
+
+    slots = 2  # show, click
+
+    def __init__(self, click_weight: float = 1.0):
+        self.click_weight = float(click_weight)
+
+    def init_slots(self, n_rows):
+        return np.zeros((n_rows, self.slots), "float32")
+
+    def on_push(self, rows, meta, grads, lr, counts=None, clicks=None):
+        meta[:, 0] += 1.0 if counts is None else np.asarray(counts, "f4")
+        if clicks is not None:
+            meta[:, 1] += np.asarray(clicks, "float32")
+        damp = 1.0 / np.sqrt(1.0 + meta[:, 0:1])
+        rows -= lr * damp * grads
+        return rows, meta
+
+
+class SpillSparseTable:
+    """Two-tier [rows_own, dim] row store: LRU hot dict over a memmap."""
+
+    def __init__(self, rows: int, dim: int, hot_bytes: int,
+                 path: str, init_std: float = 0.01, seed: int = 0,
+                 server_id: int = 0, n_servers: int = 1, accessor=None):
+        self.dim = int(dim)
+        self.accessor = accessor or SGDAccessor()
+        self.n_own = len(range(server_id, rows, n_servers))
+        row_bytes = self.dim * 4 + self.accessor.slots * 4
+        self.hot_budget_rows = max(int(hot_bytes) // max(row_bytes, 1), 1)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._mm = np.memmap(path, dtype="float32", mode="w+",
+                             shape=(self.n_own, self.dim))
+        # identical init stream to ParameterServer.create_table: block-wise
+        # full-table draw, this server keeps rows r % n == id
+        rng = np.random.RandomState(seed)
+        block = max(1, min(rows, (1 << 22) // max(dim, 1)))
+        out = 0
+        for start in range(0, rows, block):
+            stop = min(start + block, rows)
+            chunk = (rng.randn(stop - start, dim) * init_std).astype(
+                "float32")
+            mine = chunk[(server_id - start) % n_servers::n_servers]
+            self._mm[out:out + len(mine)] = mine
+            out += len(mine)
+        self._mm.flush()
+        self._meta_mm: Optional[np.memmap] = None
+        if self.accessor.slots:
+            self._meta_mm = np.memmap(path + ".slots", dtype="float32",
+                                      mode="w+",
+                                      shape=(self.n_own,
+                                             self.accessor.slots))
+        self._hot: "OrderedDict[int, tuple]" = OrderedDict()  # rid -> (row, meta)
+        self.spills = 0  # eviction write-backs (observability/testing)
+
+    # -- tiering -------------------------------------------------------------
+    def _load(self, rid: int):
+        ent = self._hot.get(rid)
+        if ent is not None:
+            self._hot.move_to_end(rid)
+            return ent
+        row = np.array(self._mm[rid])
+        meta = (np.array(self._meta_mm[rid])
+                if self._meta_mm is not None else None)
+        self._hot[rid] = (row, meta)
+        self._evict()
+        return self._hot[rid]
+
+    def _evict(self):
+        while len(self._hot) > self.hot_budget_rows:
+            rid, (row, meta) = self._hot.popitem(last=False)  # LRU
+            self._mm[rid] = row
+            if meta is not None:
+                self._meta_mm[rid] = meta
+            self.spills += 1
+
+    def flush(self):
+        for rid, (row, meta) in self._hot.items():
+            self._mm[rid] = row
+            if meta is not None:
+                self._meta_mm[rid] = meta
+        self._mm.flush()
+        if self._meta_mm is not None:
+            self._meta_mm.flush()
+
+    # -- the pull/push surface ----------------------------------------------
+    def gather(self, local_ids) -> np.ndarray:
+        return np.stack([self._load(int(r))[0] for r in local_ids])
+
+    def scatter_sub(self, local_ids, grads, lr: float, clicks=None):
+        """Duplicate ids accumulate (the np.subtract.at contract of the
+        in-RAM table): grads/clicks are summed per unique row before the
+        accessor applies them once."""
+        local_ids = np.asarray(local_ids)
+        grads = np.asarray(grads, "float32")
+        uniq, inv, counts = np.unique(local_ids, return_inverse=True,
+                                      return_counts=True)
+        gsum = np.zeros((len(uniq), grads.shape[1]), "float32")
+        np.add.at(gsum, inv, grads)
+        csum = None
+        if clicks is not None:
+            csum = np.zeros((len(uniq),), "float32")
+            np.add.at(csum, inv, np.asarray(clicks, "float32"))
+        rows = self.gather(uniq)
+        metas = None
+        if self.accessor.slots:
+            metas = np.stack([self._load(int(r))[1] for r in uniq])
+        rows, metas = self.accessor.on_push(
+            rows, metas, gsum, float(lr),
+            counts=counts.astype("float32"), clicks=csum)
+        for i, r in enumerate(uniq):
+            self._hot[int(r)] = (rows[i],
+                                 metas[i] if metas is not None else None)
+            self._hot.move_to_end(int(r))
+        self._evict()

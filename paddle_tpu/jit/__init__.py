@@ -471,4 +471,6 @@ def set_verbosity(level=0, also_to_stdout=False):
     os.environ["PT_DY2STATIC_VERBOSITY"] = str(level)
 
 
-from .offload_stream import StreamedTrainStep, init_on_host  # noqa: E402,F401
+from .offload_stream import (  # noqa: E402,F401
+    SegmentedTrainStep, StreamedTrainStep, init_on_host,
+)

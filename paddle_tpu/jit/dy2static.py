@@ -546,14 +546,54 @@ class _ListAppend(ast.NodeTransformer):
 
 
 def _local_list_names(fdef) -> set:
-    """Names that are provably locally-created plain lists: every Assign to
-    the name is a list literal, and the name is not a parameter."""
+    """Names safe for the append->rebind rewrite: every Assign to the name
+    is a list literal, the name is not a parameter, and it does not ESCAPE
+    before its append loops end — a Load that isn't an append receiver
+    (alias = lst, f(lst), (lst, …)) occurring before/inside the loop would
+    see the original object while the rewrite rebinds, silently dropping
+    appends. Loads strictly after every append-carrying loop (the normal
+    consumption: paddle.concat(lst)) are fine."""
     params = {a.arg for a in (fdef.args.posonlyargs + fdef.args.args +
                               fdef.args.kwonlyargs)}
     for a in (fdef.args.vararg, fdef.args.kwarg):
         if a is not None:
             params.add(a.arg)
-    lit, non_lit = set(), set()
+
+    order: dict = {}
+    # ctx/operator nodes are interned singletons shared across the tree —
+    # numbering them would smear positions; only real syntax nodes count
+    _skip = (ast.expr_context, ast.operator, ast.boolop, ast.unaryop,
+             ast.cmpop)
+
+    def number(node, counter=[0]):
+        if not isinstance(node, _skip):
+            order[id(node)] = counter[0]
+            counter[0] += 1
+        for child in ast.iter_child_nodes(node):
+            number(child)
+
+    number(fdef)
+
+    def span_end(node):
+        return max(order[id(n)] for n in ast.walk(node)
+                   if not isinstance(n, _skip))
+
+    append_receivers = set()  # id of the Name node in `name.append(e)`
+    appends_in_loop: dict = {}  # name -> max end-position of its loops
+    for node in ast.walk(fdef):
+        if isinstance(node, (ast.For, ast.While)):
+            end = span_end(node)
+            for sub in ast.walk(node):
+                if (isinstance(sub, ast.Call)
+                        and isinstance(sub.func, ast.Attribute)
+                        and sub.func.attr == "append"
+                        and isinstance(sub.func.value, ast.Name)):
+                    nm = sub.func.value.id
+                    append_receivers.add(id(sub.func.value))
+                    appends_in_loop[nm] = max(appends_in_loop.get(nm, 0),
+                                              end)
+
+    lit, non_lit, escapes = set(), set(), {}
     for node in ast.walk(fdef):
         if isinstance(node, ast.Assign):
             for t in node.targets:
@@ -563,7 +603,19 @@ def _local_list_names(fdef) -> set:
         elif isinstance(node, ast.AugAssign) and isinstance(node.target,
                                                             ast.Name):
             non_lit.add(node.target.id)
-    return lit - non_lit - params
+        elif (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and id(node) not in append_receivers):
+            escapes[node.id] = min(escapes.get(node.id, order[id(node)]),
+                                   order[id(node)])
+    out = set()
+    for nm in lit - non_lit - params:
+        loop_end = appends_in_loop.get(nm)
+        if loop_end is None:
+            continue  # no append-in-loop: nothing to rewrite
+        if nm in escapes and escapes[nm] <= loop_end:
+            continue  # aliased/escaped before the loop finished
+        out.add(nm)
+    return out
 
 
 class _CtrlFlow(ast.NodeTransformer):
@@ -840,7 +892,12 @@ def convert_to_static(fn):
     if not (tr.changed or wc.changed):
         return fn
     ast.fix_missing_locations(tree)
-    glb = dict(raw.__globals__)
+    # exec against the LIVE module globals (plus the __pt_* helpers): a
+    # converted function must see later rebinding of module-level names
+    # (monkeypatching, lazy globals) exactly like the original — a snapshot
+    # dict would pin every callee at conversion time. Only __pt_-prefixed
+    # names are added to the module namespace.
+    glb = raw.__globals__
     glb["__pt_if"] = _runtime_if
     glb["__pt_while"] = _runtime_while
     glb["__pt_for_range"] = _runtime_for_range

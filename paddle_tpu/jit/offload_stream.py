@@ -108,6 +108,17 @@ def _pack_dev(x, packed_shape):
     return jnp.pad(flat, (0, r * lanes - flat.size)).reshape(r, lanes)
 
 
+def _host_available_bytes():
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    return None
+
+
 def _find_runs(model: Layer):
     from ..distributed.meta_parallel.stage_stack import StackedStageRun
 
@@ -132,20 +143,26 @@ class StreamedTrainStep:
     HBM-places the grad chains)."""
 
     def __init__(self, model: Layer, loss_fn: Callable, optimizer,
-                 donate_host: bool = False):
+                 donate_host: bool | str = "auto"):
         from ..distributed.meta_parallel.stage_stack import _memory_sharding
+        from ..nn.clip import ClipGradByGlobalNorm
 
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         # donate_host halves the pinned-pool peak (params/state updated in
         # place) but DOUBLES step time through the remote tunnel (measured
-        # 27.7 -> 54.2 s/step at 2.5B): enable only when host RAM binds
-        self.donate_host = bool(donate_host)
-        if optimizer._grad_clip is not None:
+        # 27.7 -> 54.2 s/step at 2.5B). 'auto' (default) donates only when
+        # host RAM could not hold two copies of the parked buffers.
+        self._donate_auto = donate_host == "auto"
+        self.donate_host = bool(donate_host) and not self._donate_auto
+        clip = optimizer._grad_clip
+        if clip is not None and not isinstance(clip, ClipGradByGlobalNorm):
             raise NotImplementedError(
-                "StreamedTrainStep: global grad clip needs a norm pass over "
-                "host-resident grads; drop grad_clip for streamed training")
+                "StreamedTrainStep: only ClipGradByGlobalNorm is supported "
+                "for streamed params (other clips are per-tensor — apply "
+                "them in the loss or drop grad_clip)")
+        self._clip_norm = float(clip.clip_norm) if clip is not None else None
         runs = _find_runs(model)
         if not runs:
             raise ValueError(
@@ -231,6 +248,21 @@ class StreamedTrainStep:
         for t in self.frozen:
             if self._on_cpu(t.data):
                 t.data = jax.device_put(to_np(t.data), dev)
+        if self._donate_auto:
+            parked = sum(int(p.data.nbytes) for p in self.streamed) + sum(
+                int(v.nbytes)
+                for p in self.streamed
+                for v in opt._accumulators[id(p)].values())
+            # no donation needs a second transient copy of the parked pool;
+            # donate only when the host could not hold ~1.2x MORE than what
+            # is already allocated (the pool itself was parked above, so
+            # MemAvailable already excludes one copy) — donation is 2x step
+            # time through the tunnel. CAVEAT: through a remote-chip tunnel
+            # /proc/meminfo describes THIS client, not the TPU host — pass
+            # an explicit bool when they differ.
+            avail = _host_available_bytes()
+            self.donate_host = bool(avail is not None
+                                    and avail < 1.2 * parked)
         self._jitted = None
 
     def _park(self, np_arr):
@@ -301,10 +333,36 @@ class StreamedTrainStep:
                     loss_of, argnums=(0, 1))(tuple(edge_arrays),
                                              tuple(streamed_arrays))
 
+                # global-norm clip: one extra per-layer pass over the
+                # host-resident grads (slice H2D, square, accumulate) BEFORE
+                # any update consumes them — same semantics as
+                # ClipGradByGlobalNorm over the unstacked grads. Slab
+                # padding is zeros and contributes nothing to the norm.
+                coef = None
+                if self._clip_norm is not None:
+                    sq = jnp.float32(0.0)
+                    for g in ge:
+                        sq = sq + jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for gh in gs:
+                        for i in range(gh.shape[0]):
+                            g_i = h2d(jax.lax.index_in_dim(
+                                gh, i, keepdims=False))
+                            sq = sq + jnp.sum(
+                                jnp.square(g_i.astype(jnp.float32)))
+                    gnorm = jnp.sqrt(sq)
+                    coef = jnp.minimum(
+                        self._clip_norm / jnp.maximum(gnorm, 1e-12), 1.0)
+
+                def clipped(g):
+                    if coef is None:
+                        return g
+                    return (g.astype(jnp.float32) * coef).astype(g.dtype)
+
                 # edge update: plain on-device fused rule
                 new_edge, new_es = [], []
                 for p, a, g, s in zip(edge, edge_arrays, ge, edge_states):
-                    np_, ns = apply_rule(a, g, s, lr, step_no, flag_of(p))
+                    np_, ns = apply_rule(a, clipped(g), s, lr, step_no,
+                                         flag_of(p))
                     new_edge.append(np_)
                     new_es.append(ns)
 
@@ -326,6 +384,7 @@ class StreamedTrainStep:
                         if packed:
                             p_i = _unpack_dev(p_i, p_ts)
                             g_i = _unpack_dev(g_i, p_ts)
+                        g_i = clipped(g_i)
                         s_i = {}
                         for k, v in st.items():
                             sv = h2d(jax.lax.index_in_dim(v, i,
@@ -383,5 +442,311 @@ class StreamedTrainStep:
         for p, a, s in zip(self.streamed, new_streamed, new_ss):
             p.data = a
             opt._accumulators[id(p)] = s
+        opt._global_step += 1
+        return Tensor(loss)
+
+
+class _EarlyExit(Exception):
+    """Carries the run input captured during an embed-only prefix trace."""
+
+    def __init__(self, value):
+        self.value = value
+
+
+class SegmentedTrainStep:
+    """Beyond-StreamedTrainStep capacity: a hand-segmented backward in ONE
+    compiled step, with NO stacked [L, ...] gradient accumulator anywhere.
+
+    Reference sharding_stage3.py:50 + :737 streams per-SEGMENT params and
+    accumulates grads host-side; the TPU-native mapping here:
+
+    - every layer's params + optimizer state live as SEPARATE per-layer
+      pinned-host arrays (no [L, ...] stacks, so XLA's memory-space pass
+      has no whole-stack gradient chain to HBM-place — the 3.08B wall of
+      StreamedTrainStep);
+    - forward: unrolled per-layer walk, each boundary activation copied to
+      pinned host right after use;
+    - head/embedding gradients: plain jax AD around an independent
+      run-output variable (the run is snipped out of the autodiff graph);
+    - backward: a manual reverse walk — slice params H2D, jax.vjp of ONE
+      layer (recompute-from-boundary == remat), apply the optimizer rule
+      immediately, write the updated params/state back to host. A layer's
+      gradients die before the next layer's exist.
+
+    Single StackedStageRun models only (the streamed flagship shape); MoE
+    aux-loss stacks are not supported on this path.
+    """
+
+    def __init__(self, model: Layer, loss_fn: Callable, optimizer,
+                 donate_host: bool = False):
+        from ..distributed.meta_parallel.stage_stack import _memory_sharding
+
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        # donation halves the pinned peak (no second copy at the step
+        # boundary) at a measured ~2x step-time cost through the remote
+        # tunnel; off by default — this box holds both copies
+        self.donate_host = bool(donate_host)
+        if optimizer._grad_clip is not None:
+            raise NotImplementedError(
+                "SegmentedTrainStep: grad clip needs the norm before any "
+                "update; use StreamedTrainStep for clipped streaming")
+        runs = _find_runs(model)
+        if len(runs) != 1:
+            raise ValueError(
+                "SegmentedTrainStep supports exactly one StackedStageRun "
+                f"(got {len(runs)}); use StreamedTrainStep/TrainStep")
+        self.run = runs[0]
+        opt = optimizer
+        self.train_params = [p for p in opt._parameter_list
+                             if not p.stop_gradient]
+        run_param_ids = {id(p) for p in self.run._parameters.values()}
+        self.edge = [p for p in self.train_params
+                     if id(p) not in run_param_ids]
+        named = dict(model.named_parameters())
+        train_ids = {id(p) for p in self.train_params}
+        buffers = list(getattr(model, "named_buffers", lambda: [])())
+        self.frozen = [p for p in named.values()
+                       if id(p) not in train_ids
+                       and id(p) not in run_param_ids] + \
+            [b for _, b in buffers]
+        self._host_sh = _memory_sharding("pinned_host")
+        self._dev_sh = _memory_sharding("device")
+        dev = jax.devices()[0]
+        cpu = jax.devices("cpu")[0]
+
+        # split each stacked run param into per-layer HOST arrays + state
+        self.depth = self.run.depth
+        self._pnames = [safe for safe, _ in self.run._names]
+        self._layer_params: List[List] = []   # [L][P] host arrays
+        self._layer_states: List[List[dict]] = []
+        self._decay_flags: List[float] = []
+        stacked_params = [self.run._parameters[s] for s in self._pnames]
+        for p in stacked_params:
+            if p.stop_gradient:
+                raise NotImplementedError(
+                    "SegmentedTrainStep: frozen stacked params unsupported")
+            self._decay_flags.append(
+                1.0 if (opt._decay_param_fn is None
+                        or opt._decay_param_fn(p)) else 0.0)
+        for i in range(self.depth):
+            row, srow = [], []
+            for p in stacked_params:
+                sl = np.asarray(p.data[i]) if not self._on_cpu(p.data) \
+                    else np.asarray(p.data)[i]
+                row.append(self._park(sl))
+                with jax.default_device(cpu):
+                    st = opt._init_state(jnp.asarray(sl))
+                srow.append({k: self._park(np.asarray(v))
+                             for k, v in st.items()})
+            self._layer_params.append(row)
+            self._layer_states.append(srow)
+        # drop the stacked copies: this step owns the canonical weights now.
+        # model.state_dict() is wrapped so ordinary checkpointing still sees
+        # the REAL weights (reassembled from the per-layer buffers) instead
+        # of silently saving the freed placeholders.
+        split_ids = {id(p) for p in stacked_params}
+        for p in stacked_params:
+            p.data = jnp.zeros((0,), p.data.dtype)
+        name_of = {id(p): n for n, p in model.named_parameters()
+                   if id(p) in split_ids}
+        orig_state_dict = model.state_dict
+        pname_index = {s: j for j, s in enumerate(self._pnames)}
+
+        def state_dict_with_segments(*a, **k):
+            sd = orig_state_dict(*a, **k)
+            arrs = self.state_dict_arrays()
+            for pid, name in name_of.items():
+                safe = name.rsplit(".", 1)[-1]
+                j = pname_index.get(safe)
+                if j is not None and name in sd:
+                    sd[name] = Tensor(jnp.asarray(arrs[self._pnames[j]]))
+            return sd
+
+        model.state_dict = state_dict_with_segments
+        for p in self.edge:
+            if self._on_cpu(p.data):
+                p.data = jax.device_put(np.asarray(p.data), dev)
+            if id(p) not in opt._accumulators:
+                opt._accumulators[id(p)] = opt._init_state(p.data)
+        for t in self.frozen:
+            if self._on_cpu(t.data):
+                t.data = jax.device_put(np.asarray(t.data), dev)
+        self._jitted = None
+
+    _park = StreamedTrainStep._park
+    _on_cpu = staticmethod(StreamedTrainStep._on_cpu)
+
+    def state_dict_arrays(self):
+        """Reassembled stacked host arrays (checkpointing hook)."""
+        return {n: np.stack([np.asarray(self._layer_params[i][j])
+                             for i in range(self.depth)])
+                for j, n in enumerate(self._pnames)}
+
+    def _build(self, batch_arrays):
+        from ..distributed.meta_parallel import stage_stack
+        from . import _Binder
+
+        model, loss_fn = self.model, self.loss_fn
+        run, opt = self.run, self.optimizer
+        edge, frozen = self.edge, self.frozen
+        rule = type(opt)._rule
+        hyper = opt._hyper()
+        wd = opt._weight_decay
+        decoupled = opt._decoupled
+        host, devm = self._host_sh, self._dev_sh
+        depth, pnames = self.depth, self._pnames
+        template = run._template[0]
+        tparams = [dict(template.named_parameters())[orig]
+                   for _, orig in run._names]
+        flags = self._decay_flags
+
+        def h2d(x):
+            return x if devm is None else jax.device_put(x, devm)
+
+        def d2h(x):
+            return x if host is None else jax.device_put(x, host)
+
+        def layer_fwd(params_dev, hidden):
+            saved = [p.data for p in tparams]
+            try:
+                for p, a in zip(tparams, params_dev):
+                    p.data = a
+                with autograd.no_grad():
+                    return template(Tensor(hidden)).data
+            finally:
+                for p, a in zip(tparams, saved):
+                    p.data = a
+
+        def apply_rule(p_i, g_i, s_i, lr, step_no, flag):
+            g_i = g_i.astype(p_i.dtype)
+            if wd and not decoupled and flag:
+                g_i = g_i + wd * p_i
+            hyper_i = hyper if flag or "wd" not in hyper else \
+                dict(hyper, wd=0.0)
+            np_, ns = rule(p_i, g_i, s_i, lr, step_no, hyper_i)
+            if wd and decoupled and flag:
+                np_ = np_ - (lr * wd * p_i).astype(p_i.dtype)
+            return np_, ns
+
+        def step_fn(edge_arrays, layer_params, layer_states, edge_states,
+                    frozen_arrays, lr, step_no, rngkey, *batch):
+            random_mod.default_generator().set_trace_key(rngkey)
+            try:
+                boundaries: List = []
+                captured: dict = {}
+
+                def bind_and_run(edge_t, handler):
+                    ts = edge + frozen
+                    stage_stack._SEG_HANDLER[0] = handler
+                    try:
+                        with _Binder(ts) as b:
+                            b.bind(list(edge_t) + list(frozen_arrays))
+                            with autograd.no_grad():
+                                loss = loss_fn(model,
+                                               *[Tensor(a) for a in batch])
+                        return loss.data.astype(jnp.float32)
+                    finally:
+                        stage_stack._SEG_HANDLER[0] = None
+
+                # 1) forward walk: real layer compute, boundaries to host
+                def fwd_handler(_run, hidden):
+                    h = hidden
+                    for i in range(depth):
+                        boundaries.append(d2h(h))
+                        params_dev = [h2d(a) for a in layer_params[i]]
+                        h = layer_fwd(params_dev, h)
+                    captured["h_out"] = h
+                    return h
+
+                bind_and_run(tuple(edge_arrays), fwd_handler)
+                h_out = captured["h_out"]
+
+                # 2) head/embedding AD around an independent run output
+                def loss_of(edge_t, hv):
+                    def const_handler(_run, hidden):
+                        captured["h_in"] = hidden
+                        return hv
+                    return bind_and_run(edge_t, const_handler)
+
+                (loss_val, (g_edge, dh)) = jax.value_and_grad(
+                    loss_of, argnums=(0, 1))(tuple(edge_arrays), h_out)
+
+                # 3) reverse walk: per-layer vjp + immediate update
+                new_layer_params, new_layer_states = [], []
+                for i in range(depth - 1, -1, -1):
+                    h_i = h2d(boundaries[i])
+                    params_dev = [h2d(a) for a in layer_params[i]]
+                    _, vjp = jax.vjp(layer_fwd, params_dev, h_i)
+                    dparams, dh = vjp(dh)
+                    new_row, new_srow = [], []
+                    for a, g, st, flag in zip(params_dev, dparams,
+                                              layer_states[i], flags):
+                        st_dev = {k: h2d(v) for k, v in st.items()}
+                        np_, ns = apply_rule(a, g, st_dev, lr, step_no,
+                                             flag)
+                        new_row.append(d2h(np_))
+                        new_srow.append({k: d2h(v.astype(st[k].dtype))
+                                         for k, v in ns.items()})
+                    new_layer_params.append(new_row)
+                    new_layer_states.append(new_srow)
+                new_layer_params.reverse()
+                new_layer_states.reverse()
+
+                # 4) embedding-path edge grads: vjp through the captured
+                # run INPUT (loss_of's head path never saw it)
+                def h_in_of(edge_t):
+                    def early_handler(_run, hidden):
+                        raise _EarlyExit(hidden)
+                    try:
+                        bind_and_run(edge_t, early_handler)
+                    except _EarlyExit as e:
+                        return e.value
+                    raise RuntimeError("run was never reached by loss_fn")
+
+                _, vjp_embed = jax.vjp(h_in_of, tuple(edge_arrays))
+                (g_embed,) = vjp_embed(dh)
+                g_edge = [a + b for a, b in zip(g_edge, g_embed)]
+
+                new_edge, new_es = [], []
+                for p, a, g, s in zip(edge, edge_arrays, g_edge,
+                                      edge_states):
+                    flag = 1.0 if (opt._decay_param_fn is None
+                                   or opt._decay_param_fn(p)) else 0.0
+                    np_, ns = apply_rule(a, g, s, lr, step_no, flag)
+                    new_edge.append(np_)
+                    new_es.append(ns)
+                return (loss_val, new_edge, new_es, new_layer_params,
+                        new_layer_states)
+            finally:
+                random_mod.default_generator().clear_trace_key()
+
+        donate = (1, 2) if self.donate_host else ()
+        if host is None:
+            return jax.jit(step_fn, donate_argnums=donate)
+        out_sh = (devm, devm, devm, host, host)
+        return jax.jit(step_fn, out_shardings=out_sh,
+                       donate_argnums=donate)
+
+    def __call__(self, *batch):
+        opt = self.optimizer
+        arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        if self._jitted is None:
+            self._jitted = self._build(arrays)
+        (loss, new_edge, new_es, new_lp, new_ls) = self._jitted(
+            [p.data for p in self.edge],
+            self._layer_params, self._layer_states,
+            [opt._accumulators[id(p)] for p in self.edge],
+            [t.data for t in self.frozen],
+            jnp.asarray(opt.get_lr(), jnp.float32),
+            jnp.asarray(opt._global_step + 1, jnp.int32),
+            random_mod.next_key(), *arrays)
+        for p, a, s in zip(self.edge, new_edge, new_es):
+            p.data = a
+            opt._accumulators[id(p)] = s
+        self._layer_params = new_lp
+        self._layer_states = new_ls
         opt._global_step += 1
         return Tensor(loss)

@@ -25,12 +25,19 @@ from .compat import (  # noqa: F401
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
                          program=None, **kwargs):
-    """Maps to jit.save of the traced layer (reference static/io.py)."""
-    raise NotImplementedError(
-        "use paddle_tpu.jit.save(layer, path, input_spec=[...]) — tracing "
-        "replaces Program capture on this framework")
+    """Serialize the recorded static Program as a servable StableHLO
+    artifact (reference static/io.py:433): jit.load- and
+    inference.create_predictor-compatible .pdmodel/.pdiparams/.pdmeta."""
+    from .compat import save_inference_model_impl
+
+    return save_inference_model_impl(path_prefix, feed_vars, fetch_vars,
+                                     program=program)
 
 
 def load_inference_model(path_prefix, executor=None, **kwargs):
-    raise NotImplementedError(
-        "use paddle_tpu.jit.load(path) or paddle_tpu.inference.create_predictor")
+    """reference static/io.py load_inference_model: returns
+    [inference_program, feed_target_names, fetch_targets]; the program is
+    Executor.run-able with feed dicts + the returned fetch targets."""
+    from .compat import load_inference_model_impl
+
+    return load_inference_model_impl(path_prefix)

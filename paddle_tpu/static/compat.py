@@ -45,6 +45,9 @@ class Program:
         self.feeds: Dict[str, tuple] = {}  # name -> (aid, dtype, shape)
         self._values: Dict[int, Any] = {}  # id -> dummy array (keeps ids live)
         self.train_spec = None  # (loss_aid, optimizer)
+        self._frozen = False  # set at first Executor.run: the build phase is
+        # over, later eager ops (metrics on fetched results…) must not
+        # append junk nodes that the next re-specialization would replay
 
     # -- build-time recording ------------------------------------------------
     def _register_value(self, arr) -> int:
@@ -60,6 +63,9 @@ class Program:
     def record(self, prim, attrs, arrays, tensors, outs_raw, multi):
         from ..nn.layer.layers import Parameter
 
+        if self._frozen:
+            return  # run phase: eager ops between Executor.run calls are
+            #         not part of the program (reference build/run split)
         inputs = []
         for arr, t in zip(arrays, tensors):
             aid = id(arr)
@@ -228,6 +234,8 @@ class Executor:
     def run(self, program=None, feed=None, fetch_list=None,
             return_numpy=True, **kwargs):
         program = program if program is not None else _DEFAULT["main"]
+        if isinstance(program, LoadedProgram):
+            return program._run(feed or {}, fetch_list, return_numpy)
         if not isinstance(program, Program):
             raise TypeError(f"Executor.run expects a Program, got "
                             f"{type(program).__name__}")
@@ -245,7 +253,19 @@ class Executor:
         fetch_ids = []
         for f in fetch_list:
             aid = id(f.data) if hasattr(f, "data") else id(f)
+            if aid not in program._values:
+                # silent alternative: a per-step cache miss + full re-trace
+                # (advisor r4) — make the mistake loud instead
+                raise ValueError(
+                    "Executor.run: a fetch target was not produced by this "
+                    "program's build phase (fetch the SAME Tensor objects "
+                    "the build created — a freshly-computed tensor gets a "
+                    "new id every step and would silently re-trace)")
             fetch_ids.append(aid)
+        # feeds/fetches validated: the build phase is over (advisor r4 —
+        # eager ops between runs must not grow the program). Freezing only
+        # AFTER validation keeps a typo'd first run recoverable.
+        program._frozen = True
 
         if program.train_spec is not None:
             outs = self._run_train(program, env, fetch_ids)
@@ -342,7 +362,159 @@ class Executor:
         return list(fetches)
 
 
-def save_inference_model_impl(path_prefix, feed_vars, fetch_vars):
-    raise NotImplementedError(
-        "static save_inference_model: use paddle_tpu.jit.save on a dygraph "
-        "layer — the static shim replays through the same jit machinery")
+def save_inference_model_impl(path_prefix, feed_vars, fetch_vars,
+                              program=None):
+    """Serialize the recorded Program as a servable artifact (reference
+    static/io.py:433 save_inference_model).
+
+    The inference replay fn(param_arrays, *feed_arrays) -> fetches is
+    AOT-exported through the SAME StableHLO pipeline as jit.save, so the
+    artifact triple (.pdmodel/.pdiparams/.pdmeta) is jit.load- and
+    inference.create_predictor-compatible; static-specific keys (feed
+    names, fetch count) ride along in the meta for load_inference_model."""
+    import json
+
+    from jax import export as jexport
+
+    program = (program if program is not None
+               else _DEFAULT["main"]).clone(for_test=True)
+    feed_vars = list(feed_vars if isinstance(feed_vars, (list, tuple))
+                     else [feed_vars])
+    fetch_vars = list(fetch_vars if isinstance(fetch_vars, (list, tuple))
+                      else [fetch_vars])
+    by_aid = {aid: (name, dtype, shape)
+              for name, (aid, dtype, shape) in program.feeds.items()}
+    feed_aids, feed_names, arg_structs = [], [], []
+    from ..jit import _as_shape_struct
+    from .input_spec import InputSpec
+
+    for i, v in enumerate(feed_vars):
+        aid = id(v.data) if hasattr(v, "data") else id(v)
+        if aid not in by_aid:
+            raise ValueError(
+                "save_inference_model: every feed_var must be a "
+                "static.data placeholder of this program")
+        name, dtype, shape = by_aid[aid]
+        feed_aids.append(aid)
+        feed_names.append(name)
+        arg_structs.append(_as_shape_struct(
+            InputSpec(shape=list(shape), dtype=dtype), poly_suffix=str(i)))
+    fetch_aids = []
+    for v in fetch_vars:
+        aid = id(v.data) if hasattr(v, "data") else id(v)
+        if aid not in program._values:
+            raise ValueError(
+                "save_inference_model: every fetch_var must be produced by "
+                "this program's build phase")
+        fetch_aids.append(aid)
+    # the fetch cone must be fully covered by feed_vars: a placeholder the
+    # cone reads but the artifact doesn't feed would silently bake its
+    # build-time dummy zeros into the servable
+    needed = set(fetch_aids)
+    for node in reversed(program.nodes):
+        if any(oid in needed for oid in node.out_ids):
+            needed.update(aid for kind, aid in node.inputs
+                          if kind == "value")
+    for name, (aid, _dt, _sh) in program.feeds.items():
+        if aid in needed and aid not in feed_aids:
+            raise ValueError(
+                f"save_inference_model: fetch depends on placeholder "
+                f"'{name}' which is not in feed_vars — the artifact would "
+                f"serve its build-time dummy instead")
+
+    params = program.param_tensors()
+    param_structs = [jax.ShapeDtypeStruct(tuple(p.data.shape), p.data.dtype)
+                     for p in params]
+
+    def run(param_arrays, *feed_arrays):
+        override = {id(p): a for p, a in zip(params, param_arrays)}
+        env = dict(zip(feed_aids, feed_arrays))
+        e = program._replay(env, param_override=override)
+        return tuple(e.get(aid, program._values.get(aid))
+                     for aid in fetch_aids)
+
+    exp = jexport.export(jax.jit(run), platforms=("cpu", "tpu"))(
+        param_structs, *arg_structs)
+    keys, seen = [], set()
+    for i, p in enumerate(params):
+        k = getattr(p, "name", None) or f"static_param_{i}"
+        if k in seen:
+            k = f"{k}_{i}"
+        seen.add(k)
+        keys.append(k)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exp.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        np.savez(f, **{f"p{i}": np.asarray(p.data)
+                       for i, p in enumerate(params)})
+    with open(path_prefix + ".pdmeta", "w") as f:
+        json.dump({"param_keys": keys,
+                   "num_inputs": len(arg_structs),
+                   "input_specs": [
+                       {"shape": [d if isinstance(d, int) else None
+                                  for d in s.shape],
+                        "dtype": str(s.dtype)} for s in arg_structs],
+                   "static": {"feed_names": feed_names,
+                              "num_fetch": len(fetch_aids)}}, f)
+
+
+class _FetchTarget:
+    """Opaque fetch handle returned by load_inference_model (plays the
+    reference's fetch Variable role for the loaded program)."""
+
+    __slots__ = ("index",)
+
+    def __init__(self, index):
+        self.index = index
+
+
+class LoadedProgram:
+    """The 'inference_program' returned by load_inference_model: wraps the
+    jit.load'ed AOT executable so the reference idiom
+
+        prog, feed_names, fetch_targets = static.load_inference_model(p, exe)
+        exe.run(prog, feed={...}, fetch_list=fetch_targets)
+
+    runs unchanged."""
+
+    def __init__(self, layer, feed_names, num_fetch):
+        self._layer = layer
+        self.feed_names = list(feed_names)
+        self._num_fetch = num_fetch
+
+    def _run(self, feed, fetch_list, return_numpy):
+        missing = set(self.feed_names) - set(feed)
+        if missing:
+            raise ValueError(f"Executor.run: missing feeds {sorted(missing)}")
+        outs = self._layer(*[jnp.asarray(np.asarray(feed[n]))
+                             for n in self.feed_names])
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        if fetch_list:
+            idx = [f.index if isinstance(f, _FetchTarget) else int(f)
+                   for f in fetch_list]
+            outs = [outs[i] for i in idx]
+        vals = [o.data if hasattr(o, "data") else o for o in outs]
+        if return_numpy:
+            return [np.asarray(v) for v in vals]
+        from ..core.tensor import Tensor
+
+        return [Tensor(v) for v in vals]
+
+
+def load_inference_model_impl(path_prefix):
+    """reference static/io.py load_inference_model: returns
+    [inference_program, feed_target_names, fetch_targets]."""
+    import json
+
+    from .. import jit as jit_mod
+
+    layer = jit_mod.load(path_prefix)
+    with open(path_prefix + ".pdmeta") as f:
+        meta = json.load(f)
+    st = meta.get("static") or {}
+    feed_names = st.get("feed_names",
+                        [f"x{i}" for i in range(meta["num_inputs"])])
+    num_fetch = st.get("num_fetch", 1)
+    prog = LoadedProgram(layer, feed_names, num_fetch)
+    return [prog, list(feed_names), [_FetchTarget(i)
+                                     for i in range(num_fetch)]]

@@ -132,6 +132,38 @@ class TestListRewriteSafety:
         np.testing.assert_allclose(f2(paddle.to_tensor([2.0])).numpy(),
                                    [6.0])
 
+    def test_aliased_list_keeps_mutation(self):
+        """An alias taken before the loop must see the appends: the rewrite
+        is skipped for escaped lists (the loop stays eager Python)."""
+        def f(x):
+            lst = []
+            alias = lst
+            for i in range(3):
+                lst.append(float(i))
+            return x * float(len(alias))
+
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        f2 = convert_to_static(f)
+        np.testing.assert_allclose(f2(paddle.to_tensor([2.0])).numpy(),
+                                   [6.0])
+
+    def test_converted_function_sees_global_rebinding(self):
+        """Converted code executes against the LIVE module globals: a later
+        monkeypatch of a module-level helper must take effect."""
+        from paddle_tpu.jit.dy2static import convert_to_static
+
+        f2 = convert_to_static(_entry_calls_helper)
+        pos = paddle.to_tensor([1.0, 2.0])
+        np.testing.assert_allclose(f2(pos).numpy(), [3.0, 5.0])
+        g = _entry_calls_helper.__globals__
+        orig = g["_helper_tensor_if"]
+        try:
+            g["_helper_tensor_if"] = lambda t: t * 10.0
+            np.testing.assert_allclose(f2(pos).numpy(), [11.0, 21.0])
+        finally:
+            g["_helper_tensor_if"] = orig
+
     def test_convert_cache_does_not_pin_lambdas(self):
         """Per-call-created functions must be collectible (weak cache)."""
         import gc

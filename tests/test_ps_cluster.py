@@ -188,3 +188,75 @@ def test_two_server_two_trainer_parity(tmp_path):
 
     np.testing.assert_allclose(got["w"], w, rtol=1e-4)
     np.testing.assert_allclose(got["loss"], ref_loss, rtol=1e-4)
+
+
+class TestSpillTable:
+    """VERDICT r4 next #9: disk-spill sparse table + accessor seam
+    (reference ssd_sparse_table.h:21, ctr_accessor.cc)."""
+
+    def test_spill_matches_in_ram_table(self, store, tmp_path):
+        """Same seed, table larger than the hot tier: pulls and pushes must
+        be byte-identical to the all-RAM table, and rows must actually
+        spill to disk."""
+        rows, dim = 400, 8
+        sv_ram = ParameterServer(store, server_id=0, n_servers=1) \
+            .create_table("ram", (rows, dim), lr=0.5, seed=9).run()
+        # hot tier fits ~32 rows of a 400-row table
+        sv_sp = ParameterServer(store, server_id=0, n_servers=1) \
+            .create_table("sp", (rows, dim), lr=0.5, seed=9,
+                          hot_bytes=32 * dim * 4,
+                          spill_dir=str(tmp_path)).run()
+        tr = PsTrainer(store, n_servers=1)
+        rng = np.random.RandomState(0)
+        for it in range(6):
+            ids = rng.randint(0, rows, 64)
+            g = rng.randn(64, dim).astype("float32")
+            tr.push("ram", ids, g, wait=True)
+            tr.push("sp", ids, g, wait=True)
+        probe = rng.randint(0, rows, 128)
+        np.testing.assert_allclose(tr.pull("sp", probe),
+                                   tr.pull("ram", probe), rtol=1e-6)
+        spill = sv_sp.tables["sp"]
+        assert spill.spills > 0  # the cold tier was exercised
+        assert len(spill._hot) <= spill.hot_budget_rows
+        sv_ram.stop()
+        sv_sp.stop()
+
+    def test_ctr_accessor_slots_and_damping(self, store, tmp_path):
+        from paddle_tpu.distributed.ps.spill_table import CtrAccessor
+
+        rows, dim = 50, 4
+        sv = ParameterServer(store, server_id=0, n_servers=1) \
+            .create_table("ctr", (rows, dim), lr=1.0, init_std=0.0,
+                          hot_bytes=1 << 20, spill_dir=str(tmp_path),
+                          accessor=CtrAccessor()).run()
+        tr = PsTrainer(store, n_servers=1)
+        ids = np.array([3, 3, 7])  # duplicate id: shows accumulate
+        g = np.ones((3, dim), "float32")
+        tr.push("ctr", ids, g, wait=True)
+        table = sv.tables["ctr"]
+        meta3 = table._load(3)[1]
+        meta7 = table._load(7)[1]
+        assert meta3[0] == 2.0 and meta7[0] == 1.0  # show counts
+        # damped update: -lr * 2g / sqrt(1+2) for row 3
+        np.testing.assert_allclose(table.gather([3])[0],
+                                   -2.0 / np.sqrt(3.0), rtol=1e-6)
+        np.testing.assert_allclose(table.gather([7])[0],
+                                   -1.0 / np.sqrt(2.0), rtol=1e-6)
+        sv.stop()
+
+    def test_spill_flush_persists_to_disk(self, store, tmp_path):
+        rows, dim = 64, 4
+        sv = ParameterServer(store, server_id=0, n_servers=1) \
+            .create_table("f", (rows, dim), lr=1.0, init_std=0.0,
+                          hot_bytes=8 * dim * 4,
+                          spill_dir=str(tmp_path)).run()
+        tr = PsTrainer(store, n_servers=1)
+        tr.push("f", np.arange(32), np.ones((32, dim), "f4"), wait=True)
+        table = sv.tables["f"]
+        table.flush()
+        mm = np.memmap(str(tmp_path / "ps_f_s0.bin"), dtype="float32",
+                       mode="r", shape=(rows, dim))
+        np.testing.assert_allclose(mm[:32], -1.0)
+        np.testing.assert_allclose(mm[32:], 0.0)
+        sv.stop()

@@ -11,7 +11,7 @@ import paddle_tpu.optimizer as opt
 from paddle_tpu import jit
 
 
-def _run(streamed, steps=4):
+def _run(streamed, steps=4, grad_clip=None):
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
 
     paddle.seed(0)
@@ -19,7 +19,8 @@ def _run(streamed, steps=4):
                            intermediate_size=128, num_attention_heads=4,
                            num_key_value_heads=4, vocab_size=128)
     m = LlamaForCausalLM(cfg)
-    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters(),
+                  grad_clip=grad_clip)
     cls = jit.StreamedTrainStep if streamed else jit.TrainStep
     step = cls(m, lambda mm, x, y: mm(x, labels=y), o)
     ids = paddle.to_tensor(
@@ -106,3 +107,70 @@ def test_streamed_reconstruction_is_safe():
     c = float(s2(ids, ids))
     assert np.isfinite([a, b, c]).all()
     assert c < a  # training continued across reconstruction
+
+
+def test_streamed_global_norm_clip_matches_resident():
+    """VERDICT r4 next #10: ClipGradByGlobalNorm on the streamed path — one
+    extra norm pass over the host grads — must equal resident clipping.
+    A tiny clip_norm makes the coefficient bite every step."""
+    import paddle_tpu.nn as nn
+
+    clip = nn.ClipGradByGlobalNorm(0.05)
+    base, _ = _run(False, grad_clip=clip)
+    st, _ = _run(True, grad_clip=clip)
+    np.testing.assert_allclose(st, base, rtol=2e-4)
+    assert st[-1] < st[0]
+
+
+def test_streamed_rejects_per_tensor_clip():
+    import paddle_tpu.nn as nn
+
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=2)
+    m = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters(),
+                  grad_clip=nn.ClipGradByNorm(1.0))
+    with pytest.raises(NotImplementedError, match="ClipGradByGlobalNorm"):
+        jit.StreamedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+
+
+def test_segmented_matches_resident_training():
+    """VERDICT r4 next #4: the hand-segmented backward (per-layer host
+    buffers, no stacked grad accumulator, per-layer vjp + immediate update)
+    must reproduce resident training step-for-step."""
+    base, _ = _run(False)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, hidden_size=64,
+                           intermediate_size=128, num_attention_heads=4,
+                           num_key_value_heads=4, vocab_size=128)
+    m = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = jit.SegmentedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (4, 16)).astype("int32"))
+    seg = [float(step(ids, ids)) for _ in range(4)]
+    np.testing.assert_allclose(seg, base, rtol=2e-4)
+    # checkpoint hook: stacked reassembly matches the trained per-layer rows
+    arrs = step.state_dict_arrays()
+    assert all(a.shape[0] == 4 for a in arrs.values())
+    # ordinary checkpointing must see REAL weights, not freed placeholders
+    sd = m.state_dict()
+    stacked = [v for k, v in sd.items() if getattr(v, "ndim", 0) >= 1
+               and v.shape and v.shape[0] == 4]
+    assert stacked, "segmented state_dict lost the decoder stacks"
+    assert all(float(np.abs(np.asarray(v.numpy(), dtype="float32")).sum()) > 0
+               for v in stacked)
+
+
+def test_segmented_requires_single_run():
+    import paddle_tpu.nn as nn
+
+    net = nn.Sequential(nn.Linear(4, 4))
+    o = opt.SGD(learning_rate=0.1, parameters=net.parameters())
+    with pytest.raises(ValueError, match="StackedStageRun"):
+        jit.SegmentedTrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
+                               o)
