@@ -498,6 +498,29 @@ class SegmentedTrainStep:
                 "SegmentedTrainStep supports exactly one StackedStageRun "
                 f"(got {len(runs)}); use StreamedTrainStep/TrainStep")
         self.run = runs[0]
+        if getattr(self.run, "_segmented_owned", False):
+            raise ValueError(
+                "SegmentedTrainStep: this model's stacked weights were "
+                "already split into a previous SegmentedTrainStep (they "
+                "live in that step's per-layer buffers — keep using it, or "
+                "rebuild the model from model.state_dict())")
+        # the step runs loss_fn in FOUR traced passes (fwd walk, head AD,
+        # per-layer vjp recompute, embed vjp); a stochastic template would
+        # draw different rng per pass and silently break the chain rule
+        from ..nn.layer.common import Dropout
+        from ..nn.layer.moe import MoELayer
+
+        for sub in self.run._template[0].sublayers(include_self=True):
+            if isinstance(sub, Dropout) and getattr(sub, "p", 0.0) > 0.0:
+                raise NotImplementedError(
+                    "SegmentedTrainStep: dropout in the stacked template "
+                    "would resample per traced pass (inconsistent "
+                    "gradients); use StreamedTrainStep or p=0")
+            if isinstance(sub, MoELayer):
+                raise NotImplementedError(
+                    "SegmentedTrainStep: MoE aux losses cannot cross the "
+                    "segmented boundary; use StreamedTrainStep")
+        self.run._segmented_owned = True
         opt = optimizer
         self.train_params = [p for p in opt._parameter_list
                              if not p.stop_gradient]

@@ -11,6 +11,7 @@ from dataclasses import dataclass
 
 from .. import nn
 from ..nn import functional as F
+from ..nn import initializer as I
 from ..ops import creation, manipulation
 from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -30,6 +31,7 @@ class BertConfig:
     layer_norm_eps: float = 1e-12
     hidden_dropout_prob: float = 0.1
     attention_probs_dropout_prob: float = 0.1
+    initializer_range: float = 0.02
     use_recompute: bool = False
     dtype: str = "float32"
 
@@ -57,12 +59,22 @@ class BertConfig:
 class BertEmbeddings(nn.Layer):
     def __init__(self, config: BertConfig):
         super().__init__()
-        self.word_embeddings = VocabParallelEmbedding(config.vocab_size,
-                                                      config.hidden_size)
-        self.position_embeddings = nn.Embedding(config.max_position_embeddings,
-                                                config.hidden_size)
-        self.token_type_embeddings = nn.Embedding(config.type_vocab_size,
-                                                  config.hidden_size)
+        # ALL THREE tables share truncated-normal(initializer_range) — the
+        # reference BERT recipe. Mixing scales breaks training at real
+        # vocab sizes: Xavier over [30522, h] is std≈0.008 while default
+        # Embedding init is N(0,1), so the word-identity signal drowns
+        # ~125x under position noise and the summed embedding is
+        # content-blind (round-5 regression found at vocab=30522).
+        emb_init = nn.ParamAttr(initializer=I.TruncatedNormal(
+            0.0, config.initializer_range))
+        self.word_embeddings = VocabParallelEmbedding(
+            config.vocab_size, config.hidden_size, weight_attr=emb_init)
+        self.position_embeddings = nn.Embedding(
+            config.max_position_embeddings, config.hidden_size,
+            weight_attr=emb_init)
+        self.token_type_embeddings = nn.Embedding(
+            config.type_vocab_size, config.hidden_size,
+            weight_attr=emb_init)
         self.layer_norm = nn.LayerNorm(config.hidden_size, config.layer_norm_eps)
         self.dropout = nn.Dropout(config.hidden_dropout_prob)
 
