@@ -505,22 +505,34 @@ class SegmentedTrainStep:
                 "live in that step's per-layer buffers — keep using it, or "
                 "rebuild the model from model.state_dict())")
         # the step runs loss_fn in FOUR traced passes (fwd walk, head AD,
-        # per-layer vjp recompute, embed vjp); a stochastic template would
-        # draw different rng per pass and silently break the chain rule
-        from ..nn.layer.common import Dropout
+        # per-layer vjp recompute, embed vjp); stochasticity ANYWHERE in
+        # the model (not just the stacked template — embeddings/pooler too)
+        # would draw different rng per pass and silently break the chain
+        # rule. Checked: Dropout-family layers with p>0 and float
+        # *dropout*_p attrs driving functional dropout.
+        from ..nn.layer.common import Dropout, Dropout2D
         from ..nn.layer.moe import MoELayer
 
-        for sub in self.run._template[0].sublayers(include_self=True):
-            if isinstance(sub, Dropout) and getattr(sub, "p", 0.0) > 0.0:
+        scan = list(model.sublayers(include_self=True)) + \
+            list(self.run._template[0].sublayers(include_self=True))
+        for sub in scan:
+            if (isinstance(sub, (Dropout, Dropout2D))
+                    and getattr(sub, "p", 0.0) > 0.0):
                 raise NotImplementedError(
-                    "SegmentedTrainStep: dropout in the stacked template "
-                    "would resample per traced pass (inconsistent "
-                    "gradients); use StreamedTrainStep or p=0")
+                    "SegmentedTrainStep: dropout in the model would "
+                    "resample per traced pass (inconsistent gradients); "
+                    "use StreamedTrainStep or p=0")
+            for attr, val in vars(sub).items():
+                if (attr.endswith("dropout_p") and isinstance(val, float)
+                        and val > 0.0):
+                    raise NotImplementedError(
+                        f"SegmentedTrainStep: {type(sub).__name__}.{attr}="
+                        f"{val} drives functional dropout — inconsistent "
+                        f"across traced passes; use StreamedTrainStep")
             if isinstance(sub, MoELayer):
                 raise NotImplementedError(
                     "SegmentedTrainStep: MoE aux losses cannot cross the "
                     "segmented boundary; use StreamedTrainStep")
-        self.run._segmented_owned = True
         opt = optimizer
         self.train_params = [p for p in opt._parameter_list
                              if not p.stop_gradient]
@@ -565,6 +577,9 @@ class SegmentedTrainStep:
                              for k, v in st.items()})
             self._layer_params.append(row)
             self._layer_states.append(srow)
+        # split complete — only NOW mark ownership (an earlier validation
+        # failure must leave the run reusable)
+        self.run._segmented_owned = True
         # drop the stacked copies: this step owns the canonical weights now.
         # model.state_dict() is wrapped so ordinary checkpointing still sees
         # the REAL weights (reassembled from the per-layer buffers) instead
