@@ -1,0 +1,57 @@
+"""`python -m paddle_tpu.distributed.run` — the controller-generation
+launcher (reference: python/paddle/distributed/run/__main__.py:17 +
+context/ arg parsing).
+
+Differences from the older `distributed.launch` CLI (kept for compat):
+  - a master KV (the native TCPStore) rendezvouses nodes — start node 0
+    with no --master and it prints the command for the rest (auto mode);
+  - controllers: collective (default) and ps (--mode ps / --servers N);
+  - --elastic wires the fleet ElasticController for in-place gang restart.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .controllers import ControleMode, Controller
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.run",
+        description="Launch a distributed job via the controller generation")
+    p.add_argument("--master", default=None,
+                   help="master KV endpoint ip:port; omit on node 0 to "
+                        "auto-start one (it prints the peers' command)")
+    p.add_argument("--mode", default=ControleMode.COLLECTIVE,
+                   choices=[ControleMode.COLLECTIVE, ControleMode.PS])
+    p.add_argument("--id", dest="job_id", default="default",
+                   help="job id namespacing the rendezvous keys")
+    p.add_argument("--nnodes", type=int, default=1)
+    p.add_argument("--rank", type=int, default=None,
+                   help="this node's rank; omit for arrival-order election")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="local worker processes (TPU SPMD normally uses 1)")
+    p.add_argument("--servers", type=int, default=0,
+                   help="PS mode: local server process count")
+    p.add_argument("--trainers", type=int, default=0,
+                   help="PS mode: local trainer process count")
+    p.add_argument("--elastic", action="store_true",
+                   help="restart the surviving gang on worker failure")
+    p.add_argument("--elastic_min", type=int, default=None,
+                   help="minimum world size to continue at (default np-1)")
+    p.add_argument("--max_restarts", type=int, default=3)
+    p.add_argument("--log_dir", default=None)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    args = p.parse_args(argv)
+    if args.mode == ControleMode.PS and args.servers <= 0:
+        args.servers = 1
+    if args.mode == ControleMode.PS and args.trainers <= 0:
+        args.trainers = 1
+    return args
+
+
+def main(argv=None):
+    args = parse_args(list(sys.argv[1:] if argv is None else argv))
+    sys.exit(Controller.factory(args).run())

@@ -1,0 +1,180 @@
+"""Controllers for `python -m paddle_tpu.distributed.run`.
+
+Reference: python/paddle/distributed/run/controllers/controller.py:33
+(ControllerBase: build job/pod, deploy, watch) + collective.py:23
+(CollectiveController: sync peers via the master, wire trainer env, spawn
+one container per device) + ps.py (PSController: server + trainer pods).
+
+TPU-native collapse: one process drives all local chips (single-controller
+SPMD), so a "pod" is normally ONE worker process per host wired with the
+jax.distributed coordinator env; `--nproc_per_node` >1 covers the non-SPMD
+roles (PS gangs, CPU-mesh emulation). Failure detection is the gang watch
+(ProcessContext.poll); `--elastic` delegates restart policy to the fleet
+ElasticController over the same TCPStore the rendezvous used.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import List, Optional
+
+from ..launch.process import ProcessContext
+from .master import Master, free_port, node_payload
+
+
+class ControleMode:  # sic — the reference's spelling, kept for parity
+    COLLECTIVE = "collective"
+    PS = "ps"
+
+
+class Controller:
+    """build → deploy → watch (reference controller.py:48-62)."""
+
+    def __init__(self, args):
+        self.args = args
+        self.master: Optional[Master] = None
+        self.ctx: Optional[ProcessContext] = None
+
+    # -- build ---------------------------------------------------------------
+    def _rendezvous(self) -> tuple:
+        """Returns (peer payloads, node rank). Single node: trivial."""
+        nnodes = self.args.nnodes
+        if nnodes <= 1:
+            return [node_payload(self.args.nproc_per_node)], 0
+        self.master = Master(self.args.master)
+        payload = node_payload(self.args.nproc_per_node)
+        peers, rank = self.master.sync_peers(
+            f"/{self.args.job_id}/rendezvous", payload, nnodes,
+            self.args.rank if self.args.rank is not None else -1)
+        return peers, rank
+
+    def worker_envs(self, peers: List[str], node_rank: int,
+                    local_rank: int) -> dict:
+        raise NotImplementedError
+
+    def n_local_procs(self) -> int:
+        return self.args.nproc_per_node
+
+    # -- deploy + watch ------------------------------------------------------
+    def run(self) -> int:
+        peers, node_rank = self._rendezvous()
+        cmd = [sys.executable, self.args.script] + self.args.script_args
+        if self.args.elastic:
+            if self.args.nnodes > 1:
+                # a node-loss restart changes the world size, which needs a
+                # fresh rendezvous generation (new ranks + coordinator) —
+                # the single-store ElasticController can't re-elect peers.
+                raise NotImplementedError(
+                    "--elastic is single-node (local gang restart); "
+                    "multi-node elasticity needs re-rendezvous — run one "
+                    "controller per node without --elastic and restart the "
+                    "failed node's controller instead")
+            from ..fleet.elastic import ElasticController
+
+            np = self.n_local_procs()
+            # ElasticController stamps PADDLE_TRAINER_ID (per local rank)
+            # and PADDLE_TRAINERS_NUM (the surviving world) itself
+            env = {k: v for k, v in
+                   self.worker_envs(peers, node_rank, 0).items()
+                   if k not in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
+                                "PADDLE_LOCAL_RANK")}
+            ec = ElasticController(
+                cmd, np=np, min_np=self.args.elastic_min or max(1, np - 1),
+                log_dir=self.args.log_dir, extra_env=env)
+            status = ec.run(max_restarts=self.args.max_restarts)
+            self._stop()
+            return 0 if getattr(status, "name", str(status)) in (
+                "COMPLETED", "0") else 1
+
+        self.ctx = ProcessContext.start(
+            cmd, self.n_local_procs(), log_dir=self.args.log_dir,
+            extra_env_fn=lambda r: self.worker_envs(peers, node_rank, r))
+        rc = self.ctx.wait()
+        if rc != 0:
+            # surface the failed container's log tail (controller.py:66-73)
+            logs = self.ctx.logs()
+            for r, text in sorted(logs.items()):
+                tail = text.strip().splitlines()[-12:]
+                if tail:
+                    print(f"--- workerlog.{r} (tail) ---", file=sys.stderr)
+                    print("\n".join(tail), file=sys.stderr)
+        self._stop()
+        return rc
+
+    def _stop(self):
+        if self.master is not None:
+            self.master.stop()
+
+    @classmethod
+    def factory(cls, args) -> "Controller":
+        if args.mode == ControleMode.PS or args.servers > 0:
+            return PSController(args)
+        return CollectiveController(args)
+
+
+class CollectiveController(Controller):
+    """reference collective.py:23. Worker env wires the jax.distributed
+    coordinator (rank-0 node's advertised ip:port) + global trainer ranks;
+    launch.init_from_env() in the worker completes the bootstrap."""
+
+    def worker_envs(self, peers, node_rank, local_rank):
+        infos = [json.loads(p) for p in peers]
+        nproc = self.args.nproc_per_node
+        world = sum(i["nproc"] for i in infos)
+        env = {
+            "PADDLE_TRAINER_ID": str(node_rank * nproc + local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_JOB_ID": self.args.job_id,
+        }
+        if len(infos) > 1:
+            coord = f"{infos[0]['ip']}:{infos[0]['coord_port']}"
+            env["PADDLE_MASTER"] = coord
+            # p2p/PS control plane rides the rendezvous store's host on the
+            # next port (same convention as launch/__init__.py:90-92)
+            if self.master is not None:
+                mhost, mport = self.master.endpoint.rsplit(":", 1)
+                env["PADDLE_P2P_ENDPOINT"] = f"{mhost}:{int(mport) + 1}"
+        return env
+
+
+class PSController(Controller):
+    """reference ps.py: a server pod + a trainer pod per node. The PS gang
+    shares ONE TCPStore across all nodes (servers poll it, trainers
+    push/pull through it — distributed/ps/__init__.py): the rank-0 node's
+    advertised ps_port hosts it, server/trainer ids are globally offset by
+    node rank (homogeneous per-node counts, the reference's convention)."""
+
+    def __init__(self, args):
+        super().__init__(args)
+        self._ps_port = free_port()  # single-node fallback endpoint
+
+    def n_local_procs(self) -> int:
+        return self.args.servers + self.args.trainers
+
+    def worker_envs(self, peers, node_rank, local_rank):
+        ns, nt = self.args.servers, self.args.trainers
+        nnodes = max(len(peers), 1)
+        if peers:
+            infos = [json.loads(p) for p in peers]
+            host = infos[0].get("ip", "127.0.0.1")
+            port = infos[0].get("ps_port", self._ps_port)
+        else:
+            host, port = "127.0.0.1", self._ps_port
+        is_server = local_rank < ns
+        env = {
+            "TRAINING_ROLE": "PSERVER" if is_server else "TRAINER",
+            "PADDLE_PS_ENDPOINT": f"{host}:{port}",
+            "PADDLE_SERVERS_NUM": str(ns * nnodes),
+            "PADDLE_TRAINERS_NUM": str(nt * nnodes),
+            "PADDLE_JOB_ID": self.args.job_id,
+        }
+        if is_server:
+            gid = node_rank * ns + local_rank
+            env["PADDLE_SERVER_ID"] = str(gid)
+            # global server 0 hosts the store daemon
+            env["PADDLE_PS_IS_MASTER"] = "1" if gid == 0 else "0"
+        else:
+            env["PADDLE_TRAINER_ID"] = str(node_rank * nt + local_rank - ns)
+        return env

@@ -1,0 +1,137 @@
+"""Master KV + peer rendezvous for the `distributed.run` controller
+generation.
+
+Reference: python/paddle/distributed/run/controllers/master.py:28 (Master
+over a KV server: HTTPMaster binds the endpoint to self-elect MAIN, peers
+sync via put + get_prefix polling) and utils/kv_server.py. TPU-native
+mapping: the KV daemon is the repo's native TCPStore (distributed/store/
+store.cpp) instead of a python http.server — one control-plane store serves
+rendezvous, elastic heartbeats, and PS traffic alike.
+
+sync_peers uses the store's atomic counter instead of get_prefix scans:
+arrival order assigns ranks in auto mode (rank=-1), explicit ranks are
+honored otherwise; everyone blocks until all `size` values are present.
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+from typing import List, Optional, Tuple
+
+from ..store import TCPStore
+
+
+def _local_ip() -> str:
+    try:
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect(("10.255.255.255", 1))
+            return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class Master:
+    """One node is MAIN (hosts the TCPStore daemon), the rest PARTICIPANT —
+    decided by a bind race on the master endpoint exactly like the
+    reference's HTTPMaster.lazy_init (master.py:56-79)."""
+
+    MAIN = "main"
+    PARTICIPANT = "participant"
+
+    def __init__(self, endpoint: Optional[str] = None, print_hint=True):
+        self.role = Master.PARTICIPANT
+        self.store: Optional[TCPStore] = None
+        if endpoint is None:
+            # auto mode: become MAIN on a free port and tell the operator
+            # what to run on the other nodes (reference master.py:84-93)
+            port = free_port()
+            self.endpoint = f"{_local_ip()}:{port}"
+            self.store = TCPStore("0.0.0.0", port, is_master=True)
+            self.role = Master.MAIN
+            if print_hint:
+                print("Copy the following command to other nodes to run.")
+                cmd = [os.path.basename(sys.executable), "-m",
+                       "paddle_tpu.distributed.run", "--master",
+                       self.endpoint] + sys.argv[1:]
+                print("-" * 72)
+                print(" ".join(cmd))
+                print("-" * 72)
+            return
+        self.endpoint = endpoint
+        host, port = endpoint.rsplit(":", 1)
+        if host in ("127.0.0.1", "localhost", _local_ip()):
+            try:
+                self.store = TCPStore("0.0.0.0", int(port), is_master=True)
+                self.role = Master.MAIN
+            except RuntimeError:
+                pass  # another local controller won the race: participate
+        if self.store is None:
+            self.store = TCPStore(host, int(port), is_master=False)
+
+    def sync_peers(self, prefix: str, value: str, size: int,
+                   rank: int = -1, timeout: float = 300.0,
+                   ) -> Tuple[List[str], int]:
+        """Block until `size` peers registered under `prefix`; return
+        (ordered peer values, my rank). rank=-1 -> arrival order, with the
+        MAIN node pinned to rank 0 (the reference's 'aaaaaa' trick)."""
+        if size < 2:
+            return [value], 0
+        st = self.store
+        if rank < 0:
+            if self.role == Master.MAIN:
+                rank = 0
+                st.set(f"{prefix}/main_taken", b"1")
+            else:
+                st.wait([f"{prefix}/main_taken"])
+                rank = st.add(f"{prefix}/arrival", 1)  # 1..size-1
+        st.set(f"{prefix}/{rank}", value.encode())
+        n = st.add(f"{prefix}/n", 1)
+        if n > size:
+            raise RuntimeError(
+                f"sync_peers: {n} peers joined '{prefix}' but size={size} — "
+                f"duplicate rank or stale prefix (pass a fresh job id)")
+        st.wait([f"{prefix}/{r}" for r in range(size)])
+        deadline = time.time() + timeout
+        while st.add(f"{prefix}/n", 0) < size:  # all joins acknowledged
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"sync_peers: only {st.add(f'{prefix}/n', 0)}/{size} "
+                    f"peers joined '{prefix}' within {timeout}s")
+            time.sleep(0.05)
+        peers = [st.get(f"{prefix}/{r}").decode() for r in range(size)]
+        return peers, rank
+
+    def put(self, key: str, value: str):
+        self.store.set(key, value.encode())
+
+    def get(self, key: str) -> str:
+        return self.store.get(key).decode()
+
+    def stop(self):
+        if self.store is not None:
+            try:
+                self.store.close()
+            except Exception:
+                pass
+            self.store = None
+
+
+def node_payload(nproc: int, coordinator_port: Optional[int] = None) -> str:
+    """What each node advertises at rendezvous: its ip, local proc count,
+    and pre-reserved ports the node COULD serve on — jax.distributed
+    coordination and the PS store (only rank 0's are used)."""
+    return json.dumps({
+        "ip": _local_ip(),
+        "nproc": nproc,
+        "coord_port": coordinator_port or free_port(),
+        "ps_port": free_port(),
+    })

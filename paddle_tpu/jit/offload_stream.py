@@ -508,26 +508,30 @@ class SegmentedTrainStep:
         # per-layer vjp recompute, embed vjp); stochasticity ANYWHERE in
         # the model (not just the stacked template — embeddings/pooler too)
         # would draw different rng per pass and silently break the chain
-        # rule. Checked: Dropout-family layers with p>0 and float
-        # *dropout*_p attrs driving functional dropout.
+        # rule. Checked: Dropout-family layers (incl. 3D/Alpha) with p>0
+        # and ANY float attr whose name mentions dropout — MHA.dropout,
+        # RNN.dropout, DiT LabelEmbedding.dropout_prob, functional
+        # *dropout_p all drive rng draws.
         from ..nn.layer.common import Dropout, Dropout2D
+        from ..nn.layer.extension_r3 import AlphaDropout, Dropout3D
         from ..nn.layer.moe import MoELayer
 
         scan = list(model.sublayers(include_self=True)) + \
             list(self.run._template[0].sublayers(include_self=True))
         for sub in scan:
-            if (isinstance(sub, (Dropout, Dropout2D))
+            if (isinstance(sub, (Dropout, Dropout2D, Dropout3D,
+                                 AlphaDropout))
                     and getattr(sub, "p", 0.0) > 0.0):
                 raise NotImplementedError(
                     "SegmentedTrainStep: dropout in the model would "
                     "resample per traced pass (inconsistent gradients); "
                     "use StreamedTrainStep or p=0")
             for attr, val in vars(sub).items():
-                if (attr.endswith("dropout_p") and isinstance(val, float)
+                if ("dropout" in attr and isinstance(val, float)
                         and val > 0.0):
                     raise NotImplementedError(
                         f"SegmentedTrainStep: {type(sub).__name__}.{attr}="
-                        f"{val} drives functional dropout — inconsistent "
+                        f"{val} drives stochastic masking — inconsistent "
                         f"across traced passes; use StreamedTrainStep")
             if isinstance(sub, MoELayer):
                 raise NotImplementedError(

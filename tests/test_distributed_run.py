@@ -1,0 +1,171 @@
+"""Controller-generation launcher (distributed.run): master rendezvous,
+collective env wiring, PS pod split, gang failure surfacing.
+
+Reference roles: python/paddle/distributed/run/controllers/master.py
+(sync_peers), collective.py (trainer env), ps.py (server/trainer pods).
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from paddle_tpu.distributed.run import parse_args
+from paddle_tpu.distributed.run.controllers import (
+    CollectiveController, Controller, PSController)
+from paddle_tpu.distributed.run.master import Master, free_port, node_payload
+
+
+def test_master_sync_peers_arrival_order():
+    port = free_port()
+    main = Master(f"127.0.0.1:{port}")
+    assert main.role == Master.MAIN
+    results = {}
+
+    def participant(i):
+        m = Master(f"127.0.0.1:{port}")
+        assert m.role == Master.PARTICIPANT
+        peers, rank = m.sync_peers("/t/rdv", f"peer{i}", 3)
+        results[i] = (peers, rank)
+
+    threads = [threading.Thread(target=participant, args=(i,))
+               for i in (1, 2)]
+    for t in threads:
+        t.start()
+    peers, rank = main.sync_peers("/t/rdv", "peer0", 3)
+    for t in threads:
+        t.join(timeout=30)
+    assert rank == 0  # MAIN is pinned to rank 0
+    assert peers[0] == "peer0"
+    assert sorted(peers) == ["peer0", "peer1", "peer2"]
+    for i, (ppeers, prank) in results.items():
+        assert ppeers == peers and ppeers[prank] == f"peer{i}"
+    main.stop()
+
+
+def test_master_sync_peers_explicit_ranks():
+    port = free_port()
+    main = Master(f"127.0.0.1:{port}")
+    out = {}
+
+    def participant():
+        m = Master(f"127.0.0.1:{port}")
+        out["p"] = m.sync_peers("/t/expl", "b", 2, rank=0)
+
+    t = threading.Thread(target=participant)
+    t.start()
+    peers, rank = main.sync_peers("/t/expl", "a", 2, rank=1)
+    t.join(timeout=30)
+    assert peers == ["b", "a"] and rank == 1
+    assert out["p"][0] == ["b", "a"] and out["p"][1] == 0
+    main.stop()
+
+
+def test_collective_env_single_node():
+    args = parse_args(["--nproc_per_node", "2", "train.py"])
+    c = Controller.factory(args)
+    assert isinstance(c, CollectiveController)
+    peers = [node_payload(2)]
+    env0 = c.worker_envs(peers, 0, 0)
+    env1 = c.worker_envs(peers, 0, 1)
+    assert env0["PADDLE_TRAINER_ID"] == "0"
+    assert env1["PADDLE_TRAINER_ID"] == "1"
+    assert env0["PADDLE_TRAINERS_NUM"] == "2"
+    assert "PADDLE_MASTER" not in env0  # single node: no coordinator
+
+
+def test_collective_env_multi_node():
+    args = parse_args(["--nnodes", "2", "--nproc_per_node", "1",
+                       "--master", "127.0.0.1:12345", "train.py"])
+    c = CollectiveController(args)
+    p0 = json.dumps({"ip": "10.0.0.1", "nproc": 1, "coord_port": 7000})
+    p1 = json.dumps({"ip": "10.0.0.2", "nproc": 1, "coord_port": 7001})
+    env = c.worker_envs([p0, p1], 1, 0)
+    assert env["PADDLE_TRAINER_ID"] == "1"
+    assert env["PADDLE_TRAINERS_NUM"] == "2"
+    # coordinator is rank-0 node's advertised endpoint
+    assert env["PADDLE_MASTER"] == "10.0.0.1:7000"
+
+
+def test_ps_env_split():
+    args = parse_args(["--mode", "ps", "--servers", "2", "--trainers", "2",
+                       "train.py"])
+    c = Controller.factory(args)
+    assert isinstance(c, PSController)
+    assert c.n_local_procs() == 4
+    envs = [c.worker_envs([], 0, r) for r in range(4)]
+    assert [e["TRAINING_ROLE"] for e in envs] == \
+        ["PSERVER", "PSERVER", "TRAINER", "TRAINER"]
+    assert envs[0]["PADDLE_PS_IS_MASTER"] == "1"
+    assert envs[1]["PADDLE_PS_IS_MASTER"] == "0"
+    assert envs[2]["PADDLE_TRAINER_ID"] == "0"
+    assert envs[3]["PADDLE_TRAINER_ID"] == "1"
+    # every role shares one store endpoint
+    assert len({e["PADDLE_PS_ENDPOINT"] for e in envs}) == 1
+
+
+def test_ps_env_multi_node_shares_one_store():
+    args = parse_args(["--mode", "ps", "--servers", "1", "--trainers", "1",
+                       "--nnodes", "2", "--master", "127.0.0.1:12346",
+                       "train.py"])
+    c = PSController(args)
+    p0 = json.dumps({"ip": "10.0.0.1", "nproc": 2, "coord_port": 7000,
+                     "ps_port": 7100})
+    p1 = json.dumps({"ip": "10.0.0.2", "nproc": 2, "coord_port": 7001,
+                     "ps_port": 7101})
+    envs = [c.worker_envs([p0, p1], nr, lr)
+            for nr in (0, 1) for lr in (0, 1)]
+    # one global store: rank-0 node's advertised ps endpoint everywhere
+    assert {e["PADDLE_PS_ENDPOINT"] for e in envs} == {"10.0.0.1:7100"}
+    assert [e["TRAINING_ROLE"] for e in envs] == \
+        ["PSERVER", "TRAINER", "PSERVER", "TRAINER"]
+    assert envs[0]["PADDLE_SERVER_ID"] == "0"
+    assert envs[2]["PADDLE_SERVER_ID"] == "1"
+    assert envs[0]["PADDLE_PS_IS_MASTER"] == "1"
+    assert envs[2]["PADDLE_PS_IS_MASTER"] == "0"
+    assert envs[1]["PADDLE_TRAINER_ID"] == "0"
+    assert envs[3]["PADDLE_TRAINER_ID"] == "1"
+    assert envs[0]["PADDLE_SERVERS_NUM"] == "2"  # global count
+
+
+def test_elastic_multi_node_rejected():
+    args = parse_args(["--nnodes", "2", "--master", "127.0.0.1:12347",
+                       "--elastic", "train.py"])
+    c = CollectiveController(args)
+    c._rendezvous = lambda: ([node_payload(1), node_payload(1)], 0)
+    with pytest.raises(NotImplementedError, match="single-node"):
+        c.run()
+
+
+def test_run_end_to_end_gang(tmp_path):
+    """`-m paddle_tpu.distributed.run --nproc_per_node 2` runs a script
+    that asserts its wired env; non-zero exit propagates with a log tail."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        "rank = os.environ['PADDLE_TRAINER_ID']\n"
+        "assert os.environ['PADDLE_TRAINERS_NUM'] == '2'\n"
+        "print('worker', rank, 'ok')\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.run",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs"),
+         str(script)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 0, r.stderr
+    logs = sorted((tmp_path / "logs").glob("workerlog.*"))
+    assert len(logs) == 2
+    assert "ok" in logs[0].read_text()
+
+    bad = tmp_path / "bad.py"
+    bad.write_text("import sys; print('about to fail'); sys.exit(3)\n")
+    r = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.run",
+         "--nproc_per_node", "2", "--log_dir", str(tmp_path / "logs2"),
+         str(bad)],
+        capture_output=True, text=True, timeout=120,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    assert r.returncode == 3
+    assert "about to fail" in r.stderr  # failed container's tail surfaced
