@@ -80,6 +80,19 @@ def remat_wrap(fn):
         # forward Pallas kernel — ~50MB/layer for the fwd kernel's time
         policy = jax.checkpoint_policies.save_only_these_names(
             "flash_o", "flash_lse")
+    elif pol == "moe":
+        # MoE-selective: pin the expert capacity buffer + expert outputs
+        # (named in nn/layer/moe.py) and the flash residuals; the backward
+        # recompute then rebuilds only the g/u projections from the saved
+        # buffer instead of re-running routing + dispatch + down-proj
+        policy = jax.checkpoint_policies.save_only_these_names(
+            "flash_o", "flash_lse", "moe_buf", "moe_out", "moe_route")
+    elif pol == "route":
+        # pin ONLY the routing decisions (slot/keep/src maps + gates,
+        # ~1MB/layer): the backward recompute replays the expert matmuls
+        # but skips the router matmul/softmax/top_k/cumsum/int-scatter
+        # chain — near-zero memory for the routing chain's time
+        policy = jax.checkpoint_policies.save_only_these_names("moe_route")
     return jax.checkpoint(fn, policy=policy)
 
 

@@ -95,7 +95,9 @@ define_flag("remat_policy", "",
             "recompute policy for scanned stacks: ''=full remat, 'dots'=save "
             "non-batch matmul outputs, 'dots_all'=save all matmul outputs, "
             "'flash'=save flash-attention o+lse (skips the fwd kernel in "
-            "the backward recompute)")
+            "the backward recompute), 'moe'=also pin the MoE capacity "
+            "buffer/expert outputs/routing maps, 'route'=pin only the MoE "
+            "routing decisions (~1MB/layer)")
 define_flag("moe_dispatch", "index",
             "MoE token dispatch: 'index' (cumsum capacity routing, default), "
             "'sort' (argsort capacity routing), 'gmm' (dropless grouped "

@@ -518,10 +518,15 @@ def test_gradient_merge_drop_bad_batch():
 
 
 def _moe_run(layer, x):
-    out = layer(x)
+    # fresh non-leaf input each run so x.grad exercises the dispatch
+    # backward (d_xt of _idx_dispatch), not just parameter grads
+    xin = x * 1.0
+    xin.stop_gradient = False
+    out = layer(xin)
     loss = (out * out).mean()
     loss.backward()
     grads = {n: p.grad.numpy().copy() for n, p in layer.named_parameters()}
+    grads["__x__"] = xin.grad.numpy().copy()
     for p in layer.parameters():
         p.clear_grad()
     return out.numpy(), grads
