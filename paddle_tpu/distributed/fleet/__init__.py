@@ -15,3 +15,7 @@ from ..meta_parallel import (  # noqa: F401
 from ..utils_recompute import recompute  # noqa: F401
 from . import elastic  # noqa: F401,E402
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401,E402
+from . import data_generator  # noqa: F401,E402
+from .data_generator import (  # noqa: F401,E402
+    DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,
+    SlotDataset)

@@ -1,0 +1,231 @@
+"""fleet.data_generator — the PS data pipeline's user-side parser.
+
+Reference: python/paddle/distributed/fleet/data_generator/data_generator.py:21
+(DataGenerator base: generate_sample/generate_batch closures, run_from_stdin
+for the Dataset pipe protocol, run_from_memory for debugging) and :239/:283
+(MultiSlotStringDataGenerator / MultiSlotDataGenerator emitting the
+MultiSlotDataFeed text format "len id id ... len id ...").
+
+TPU-native collapse: the reference pipes this text into a C++ DataFeed that
+fills LoDTensors for PS trainers; here the same emit format is parsed back
+by SlotDataset (the InMemoryDataset role) into numpy slot arrays that the
+ordinary io.DataLoader batches for the PS trainer (distributed/ps) —
+sparse ids stay ragged lists, the embedding pull pads per batch.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["DataGenerator", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator", "parse_multi_slot", "SlotDataset"]
+
+
+class DataGenerator:
+    """Inherit and override generate_sample(line) (and optionally
+    generate_batch(samples)); run_from_stdin() streams the slot text format
+    to stdout for the PS data pipeline (reference data_generator.py:21)."""
+
+    def __init__(self):
+        self._proto_info = None
+        self.batch_size_ = 32
+
+    def set_batch(self, batch_size):
+        self.batch_size_ = int(batch_size)
+
+    # -- user hooks ----------------------------------------------------------
+    def generate_sample(self, line):
+        """Return a no-arg iterator yielding [(slot_name, values), ...] per
+        sample parsed from `line` (reference :153)."""
+        raise NotImplementedError(
+            "DataGenerator: override generate_sample(line) to yield "
+            "[(slot_name, [values...]), ...] per sample")
+
+    def generate_batch(self, samples):
+        """Batch-level hook (reference :194): default yields samples
+        unchanged, one per line."""
+        def local_iter():
+            for s in samples:
+                yield s
+        return local_iter
+
+    # -- drivers -------------------------------------------------------------
+    def run_from_stdin(self):
+        """One output line per sample, the Dataset pipe protocol
+        (reference :96)."""
+        batch_samples = []
+        for line in sys.stdin:
+            line_iter = self.generate_sample(line)
+            for parsed in line_iter():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        sys.stdout.write(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                sys.stdout.write(self._gen_str(sample))
+
+    def run_from_memory(self, lines: Optional[Iterable] = None) -> List[str]:
+        """Debug/bench driver (reference :61): collect the emitted lines
+        instead of writing stdout. `lines` feeds generate_sample; None
+        mirrors the reference's single None-line call."""
+        out = []
+        batch_samples = []
+        for line in (lines if lines is not None else [None]):
+            for parsed in self.generate_sample(line)():
+                if parsed is None:
+                    continue
+                batch_samples.append(parsed)
+                if len(batch_samples) == self.batch_size_:
+                    for sample in self.generate_batch(batch_samples)():
+                        out.append(self._gen_str(sample))
+                    batch_samples = []
+        if batch_samples:
+            for sample in self.generate_batch(batch_samples)():
+                out.append(self._gen_str(sample))
+        return out
+
+    def _gen_str(self, line):
+        raise NotImplementedError(
+            "use MultiSlotDataGenerator or MultiSlotStringDataGenerator "
+            "(they define the slot text format), or override _gen_str")
+
+
+def _check_slots(line):
+    if not isinstance(line, (list, tuple)):
+        raise ValueError(
+            "the output of generate_sample must be a list/tuple of "
+            "(slot_name, values) pairs, e.g. "
+            "[('words', [1926, 8, 17]), ('label', [1])]")
+
+
+class MultiSlotStringDataGenerator(DataGenerator):
+    """Emit 'len v1 v2 ... len v1 ...' with values passed through as
+    strings (reference :239)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        _check_slots(line)
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            parts.extend(str(e) for e in elements)
+        return " ".join(parts) + "\n"
+
+
+class MultiSlotDataGenerator(DataGenerator):
+    """Same format with typed values: the first batch fixes each slot's
+    name/order and dtype (int stays int, any float promotes the slot —
+    the reference's proto_info consistency contract, :283)."""
+
+    def _gen_str(self, line):
+        if isinstance(line, zip):
+            line = list(line)
+        _check_slots(line)
+        if self._proto_info is None:
+            self._proto_info = []
+            for name, elements in line:
+                dtype = "uint64"
+                if any(isinstance(e, float) for e in elements):
+                    dtype = "float"
+                self._proto_info.append((name, dtype))
+        else:
+            if len(line) != len(self._proto_info):
+                raise ValueError(
+                    f"the number of slots must stay {len(self._proto_info)}, "
+                    f"got {len(line)}")
+            for i, (name, elements) in enumerate(line):
+                if name != self._proto_info[i][0]:
+                    raise ValueError(
+                        f"slot {i} must stay '{self._proto_info[i][0]}', "
+                        f"got '{name}'")
+                if self._proto_info[i][1] == "uint64" and any(
+                        isinstance(e, float) for e in elements):
+                    self._proto_info[i] = (name, "float")
+        parts = []
+        for _name, elements in line:
+            parts.append(str(len(elements)))
+            for e in elements:
+                if not isinstance(e, (int, float)):
+                    raise ValueError(
+                        f"slot '{_name}' values must be int/float, "
+                        f"got {type(e).__name__}")
+                parts.append(str(e))
+        return " ".join(parts) + "\n"
+
+
+def parse_multi_slot(line: str, n_slots: int) -> List[List[float]]:
+    """Parse one 'len v... len v...' line back into per-slot value lists —
+    the MultiSlotDataFeed's reader half (reference C++ data_feed.cc role)."""
+    toks = line.split()
+    out = []
+    i = 0
+    for _ in range(n_slots):
+        if i >= len(toks):
+            raise ValueError(
+                f"slot line ended early: expected {n_slots} slots in "
+                f"{line!r}")
+        n = int(toks[i])
+        i += 1
+        vals = [float(t) if ("." in t or "e" in t or "E" in t) else int(t)
+                for t in toks[i:i + n]]
+        if len(vals) != n:
+            raise ValueError(
+                f"slot declared {n} values but line has {len(vals)}: "
+                f"{line!r}")
+        i += n
+        out.append(vals)
+    if i != len(toks):
+        raise ValueError(
+            f"trailing tokens after {n_slots} slots in {line!r}")
+    return out
+
+
+class SlotDataset:
+    """The InMemoryDataset role at library scale: load slot-format lines
+    (from data_generator output files or run_from_memory), expose
+    per-sample slot lists for io.DataLoader. Ragged sparse slots stay
+    Python lists; `pad_to` produces fixed [n] int arrays for jit paths."""
+
+    def __init__(self, slot_names: Sequence[str], pad_to: int = 0,
+                 pad_value: int = 0):
+        self.slot_names = list(slot_names)
+        self.pad_to = int(pad_to)
+        self.pad_value = pad_value
+        self._samples: List[List] = []
+
+    def load_lines(self, lines: Iterable[str]) -> "SlotDataset":
+        for line in lines:
+            if not line.strip():
+                continue
+            self._samples.append(
+                parse_multi_slot(line, len(self.slot_names)))
+        return self
+
+    def load_files(self, paths: Sequence[str]) -> "SlotDataset":
+        for p in paths:
+            with open(p) as f:
+                self.load_lines(f)
+        return self
+
+    def __len__(self):
+        return len(self._samples)
+
+    def __getitem__(self, idx):
+        slots = self._samples[idx]
+        if not self.pad_to:
+            return tuple(np.asarray(s) for s in slots)
+        out = []
+        for s in slots:
+            a = np.full((self.pad_to,), self.pad_value,
+                        dtype=np.int64 if all(
+                            isinstance(v, int) for v in s) else np.float32)
+            a[:min(len(s), self.pad_to)] = s[:self.pad_to]
+            out.append(a)
+        return tuple(out)

@@ -62,7 +62,10 @@ def remat_wrap(fn):
     '' = full remat (save inputs only, recompute everything — min memory),
     'dots' = save dot/matmul outputs without batch dims (skip re-running the
     MXU work in backward at the cost of activation HBM — the reference's
-    selective-recompute tier)."""
+    selective-recompute tier), 'dots_all' = save every matmul output,
+    'flash' = pin flash-attention o+lse, 'moe'/'route' = pin the named MoE
+    buffers/routing maps (names exist only on the default 'index' dispatch
+    path — under sort/einsum/gmm these two degrade to full remat)."""
     try:
         from ...framework import flags as flags_mod
 

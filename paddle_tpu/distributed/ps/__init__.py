@@ -28,9 +28,10 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from ..store import TCPStore
+from .graph_table import GraphTable  # noqa: F401
 
 __all__ = ["ParameterServer", "PsTrainer", "SparseEmbedding",
-           "AsyncCommunicator"]
+           "AsyncCommunicator", "GraphTable"]
 
 
 def _dumps(arr: np.ndarray) -> bytes:

@@ -1,0 +1,174 @@
+"""In-memory GNN graph table — the PS graph-storage tier at library scale.
+
+Reference: paddle/fluid/distributed/ps/table/common_graph_table.h:355
+(GraphTable: add_graph_node, random_sample_neighbors, random_sample_nodes,
+pull_graph_list, get/set_node_feat over sharded adjacency lists with
+optional weighted sampling). This keeps the same surface on a CSR-backed
+numpy store: edges accumulate in python lists, `build()` freezes them into
+CSR arrays for O(1) slicing, and samplers run vectorized numpy — the
+sampling results feed the jit'ed GNN compute path as ordinary arrays
+(data-dependent shapes stay OUTSIDE jit by design, like every io path)."""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class GraphTable:
+    """One homogeneous edge type (the reference instantiates one table per
+    edge type); directed edges src -> dst."""
+
+    def __init__(self, seed: int = 0):
+        self._src: List[np.ndarray] = []
+        self._dst: List[np.ndarray] = []
+        self._wgt: List[np.ndarray] = []
+        self._feat: Dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._csr: Optional[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  Optional[np.ndarray]]] = None
+
+    # -- construction (add_graph_node / add edges role) ----------------------
+    def add_edges(self, src, dst, weights=None):
+        src = np.asarray(src, np.int64).reshape(-1)
+        dst = np.asarray(dst, np.int64).reshape(-1)
+        if src.shape != dst.shape:
+            raise ValueError(f"add_edges: src/dst length mismatch "
+                             f"({src.size} vs {dst.size})")
+        self._src.append(src)
+        self._dst.append(dst)
+        self._wgt.append(
+            np.ones(src.size, np.float32) if weights is None
+            else np.asarray(weights, np.float32).reshape(-1))
+        self._csr = None
+
+    def set_node_feat(self, ids, feats):
+        feats = np.asarray(feats)
+        for i, nid in enumerate(np.asarray(ids, np.int64).reshape(-1)):
+            self._feat[int(nid)] = feats[i]
+
+    def get_node_feat(self, ids, dim: Optional[int] = None) -> np.ndarray:
+        rows = []
+        for nid in np.asarray(ids, np.int64).reshape(-1):
+            f = self._feat.get(int(nid))
+            if f is None:
+                if dim is None:
+                    raise KeyError(
+                        f"get_node_feat: node {int(nid)} has no features "
+                        f"(pass dim= for a zero default)")
+                f = np.zeros((dim,), np.float32)
+            rows.append(f)
+        return np.stack(rows) if rows else np.zeros((0, dim or 0), np.float32)
+
+    # -- freeze --------------------------------------------------------------
+    def build(self):
+        """Freeze accumulated edges into CSR over the dense id range
+        [0, max_id] (the reference shards by id; one shard here)."""
+        if not self._src:
+            raise ValueError("GraphTable.build: no edges added")
+        src = np.concatenate(self._src)
+        dst = np.concatenate(self._dst)
+        wgt = np.concatenate(self._wgt)
+        n = int(max(src.max(), dst.max())) + 1
+        order = np.argsort(src, kind="stable")
+        src, dst, wgt = src[order], dst[order], wgt[order]
+        indptr = np.zeros(n + 1, np.int64)
+        np.add.at(indptr, src + 1, 1)
+        indptr = np.cumsum(indptr)
+        uniform = bool(np.all(wgt == wgt[0]))
+        self._csr = (indptr, dst, wgt, None if uniform else wgt)
+        return self
+
+    def _require_csr(self):
+        if self._csr is None:
+            self.build()
+        return self._csr
+
+    @property
+    def num_nodes(self) -> int:
+        return self._require_csr()[0].size - 1
+
+    @property
+    def num_edges(self) -> int:
+        return self._require_csr()[1].size
+
+    def neighbors(self, nid: int) -> np.ndarray:
+        indptr, dst, _, _ = self._require_csr()
+        return dst[indptr[nid]:indptr[nid + 1]]
+
+    # -- serving surface (reference :359-372) --------------------------------
+    def pull_graph_list(self, start: int, size: int) -> np.ndarray:
+        """Node ids [start, start+size) that have at least one out-edge."""
+        indptr, _, _, _ = self._require_csr()
+        deg = np.diff(indptr)
+        ids = np.nonzero(deg > 0)[0]
+        return ids[start:start + size]
+
+    def random_sample_nodes(self, sample_size: int) -> np.ndarray:
+        ids = self.pull_graph_list(0, self.num_nodes)
+        if ids.size == 0:
+            return ids
+        return self._rng.choice(ids, size=min(sample_size, ids.size),
+                                replace=False)
+
+    def random_sample_neighbors(self, ids, sample_size: int,
+                                ) -> Tuple[np.ndarray, np.ndarray]:
+        """[n, sample_size] neighbor ids + bool mask (False = padded slot:
+        fewer neighbors than requested). Weighted when edge weights were
+        non-uniform, matching the reference's WeightedSampler."""
+        indptr, dst, wgt, weighted = self._require_csr()
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        out = np.zeros((ids.size, sample_size), np.int64)
+        mask = np.zeros((ids.size, sample_size), bool)
+        for r, nid in enumerate(ids):
+            lo, hi = int(indptr[nid]), int(indptr[nid + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            k = min(sample_size, deg)
+            if weighted is None:
+                idx = self._rng.choice(deg, size=k, replace=False)
+            else:
+                p = weighted[lo:hi] / weighted[lo:hi].sum()
+                idx = self._rng.choice(deg, size=k, replace=False, p=p)
+            out[r, :k] = dst[lo + idx]
+            mask[r, :k] = True
+        return out, mask
+
+    def clear_nodes(self):
+        self._src, self._dst, self._wgt = [], [], []
+        self._feat.clear()
+        self._csr = None
+
+    # -- persistence (reference :406 save) -----------------------------------
+    def save(self, path: str):
+        indptr, dst, wgt, _ = self._require_csr()
+        feat_ids = np.asarray(sorted(self._feat), np.int64)
+        feats = (np.stack([self._feat[int(i)] for i in feat_ids])
+                 if feat_ids.size else np.zeros((0, 0), np.float32))
+        np.savez(path, indptr=indptr, dst=dst, wgt=wgt,
+                 feat_ids=feat_ids, feats=feats)
+
+    @classmethod
+    def load(cls, path: str, seed: int = 0) -> "GraphTable":
+        z = np.load(path if path.endswith(".npz") else path + ".npz")
+        t = cls(seed=seed)
+        wgt = z["wgt"]
+        uniform = bool(wgt.size == 0 or np.all(wgt == wgt[0]))
+        t._csr = (z["indptr"], z["dst"], wgt, None if uniform else wgt)
+        for i, nid in enumerate(z["feat_ids"]):
+            t._feat[int(nid)] = z["feats"][i]
+        return t
+
+    def to_csc(self) -> Tuple[np.ndarray, np.ndarray]:
+        """(row, colptr) of the CSC form — the layout
+        incubate.graph_khop_sampler consumes (reference
+        graph_khop_sampler.py:23 takes CSC row/colptr)."""
+        indptr, dst, _, _ = self._require_csr()
+        n = indptr.size - 1
+        src = np.repeat(np.arange(n, dtype=np.int64), np.diff(indptr))
+        order = np.argsort(dst, kind="stable")
+        row = src[order]
+        colptr = np.zeros(n + 1, np.int64)
+        np.add.at(colptr, dst + 1, 1)
+        return row, np.cumsum(colptr)

@@ -91,13 +91,20 @@ define_flag("flash_block_q", 0,
             "flash-attention q block size (0 = kernel default 256)")
 define_flag("flash_block_k", 0,
             "flash-attention k block size (0 = kernel default 512)")
+define_flag("flash_bwd_block_q", 0,
+            "flash-attention BACKWARD q block size (0 = same as forward); "
+            "the bwd kernels hold more f32 VMEM operands so smaller blocks "
+            "can pipeline better")
+define_flag("flash_bwd_block_k", 0,
+            "flash-attention BACKWARD k block size (0 = same as forward)")
 define_flag("remat_policy", "",
             "recompute policy for scanned stacks: ''=full remat, 'dots'=save "
             "non-batch matmul outputs, 'dots_all'=save all matmul outputs, "
             "'flash'=save flash-attention o+lse (skips the fwd kernel in "
             "the backward recompute), 'moe'=also pin the MoE capacity "
             "buffer/expert outputs/routing maps, 'route'=pin only the MoE "
-            "routing decisions (~1MB/layer)")
+            "routing decisions (~1MB/layer); 'moe'/'route' names exist "
+            "only on the default index dispatch path")
 define_flag("moe_dispatch", "index",
             "MoE token dispatch: 'index' (cumsum capacity routing, default), "
             "'sort' (argsort capacity routing), 'gmm' (dropless grouped "

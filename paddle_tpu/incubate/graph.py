@@ -1,0 +1,98 @@
+"""Graph-learning sampling ops (paddle.incubate.graph_khop_sampler role).
+
+Reference: python/paddle/incubate/operators/graph_khop_sampler.py:23 and the
+graph_khop_sampler op (k-hop neighbor sampling over a CSC graph with a
+subgraph-reindex step). Data-dependent output shapes keep this OUTSIDE jit
+by design (it is an io/data-prep op, like the reference's CPU kernel); the
+returned reindexed arrays are static-shaped per call and feed jit'ed GNN
+compute directly.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+from ..core.tensor import Tensor
+
+__all__ = ["graph_khop_sampler"]
+
+
+def _np(x):
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    return np.asarray(x)
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None,
+                       seed: int = 0):
+    """K-hop sampling with subgraph reindex (reference
+    graph_khop_sampler.py:23 contract):
+
+    - `row`/`colptr`: CSC of the graph (row = src ids of in-edges per dst).
+    - per layer l, sample `sample_sizes[l]` in-neighbors of the frontier
+      (without replacement when the degree allows);
+    - returns (edge_src, edge_dst, sample_index, reindex_nodes[, eids]):
+      `sample_index` is the unique node list (inputs first, then newly
+      sampled, in discovery order), edges are REINDEXED into positions in
+      `sample_index`, and `reindex_nodes` is where each input node landed
+      (= arange(len(input_nodes)) by construction, kept for API parity).
+    """
+    row = _np(row).reshape(-1).astype(np.int64)
+    colptr = _np(colptr).reshape(-1).astype(np.int64)
+    nodes = _np(input_nodes).reshape(-1).astype(np.int64)
+    eids = None if sorted_eids is None else _np(sorted_eids).reshape(-1)
+    if return_eids and eids is None:
+        raise ValueError(
+            "graph_khop_sampler: return_eids=True needs sorted_eids")
+    rng = np.random.default_rng(seed)
+
+    # discovery-ordered unique table: original id -> compact position
+    index_of = {}
+    sample_index: List[int] = []
+
+    def register(nid: int) -> int:
+        pos = index_of.get(nid)
+        if pos is None:
+            pos = len(sample_index)
+            index_of[nid] = pos
+            sample_index.append(nid)
+        return pos
+
+    for nid in nodes:
+        register(int(nid))
+
+    src_out: List[int] = []
+    dst_out: List[int] = []
+    eid_out: List[int] = []
+    frontier = [int(x) for x in dict.fromkeys(nodes.tolist())]
+    for k in sample_sizes:
+        next_frontier: List[int] = []
+        for dst in frontier:
+            lo, hi = int(colptr[dst]), int(colptr[dst + 1])
+            deg = hi - lo
+            if deg == 0:
+                continue
+            take = min(int(k), deg)
+            sel = rng.choice(deg, size=take, replace=False)
+            for off in sel:
+                src = int(row[lo + off])
+                if src not in index_of:
+                    next_frontier.append(src)
+                src_out.append(register(src))
+                dst_out.append(index_of[dst])
+                if eids is not None:
+                    eid_out.append(int(eids[lo + off]))
+        frontier = next_frontier
+        if not frontier:
+            break
+
+    i64 = np.int64
+    outs = (Tensor(np.asarray(src_out, i64)),
+            Tensor(np.asarray(dst_out, i64)),
+            Tensor(np.asarray(sample_index, i64)),
+            Tensor(np.arange(nodes.size, dtype=i64)))
+    if return_eids:
+        return outs + (Tensor(np.asarray(eid_out, i64)),)
+    return outs

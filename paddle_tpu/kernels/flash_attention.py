@@ -316,21 +316,24 @@ def _flash_bwd(q, k, v, o, lse, do, dlse, offset, causal, scale,
 
 # -- differentiable wrapper (bh, s, d layout) ---------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash_lse_bhsd(q, k, v, offset, causal, scale, block_q, block_k):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9))
+def _flash_lse_bhsd(q, k, v, offset, causal, scale, block_q, block_k,
+                    bwd_block_q, bwd_block_k):
     return _flash_fwd(q, k, v, offset, causal, scale, block_q, block_k)
 
 
-def _flash_lse_fwd(q, k, v, offset, causal, scale, block_q, block_k):
+def _flash_lse_fwd(q, k, v, offset, causal, scale, block_q, block_k,
+                   bwd_block_q, bwd_block_k):
     o, lse = _flash_fwd(q, k, v, offset, causal, scale, block_q, block_k)
     return (o, lse), (q, k, v, o, lse, offset)
 
 
-def _flash_lse_bwd(causal, scale, block_q, block_k, res, cts):
+def _flash_lse_bwd(causal, scale, block_q, block_k, bwd_block_q, bwd_block_k,
+                   res, cts):
     q, k, v, o, lse, offset = res
     do, dlse = cts
     dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, dlse, offset, causal, scale,
-                            block_q, block_k)
+                            bwd_block_q or block_q, bwd_block_k or block_k)
     return dq, dk, dv, None
 
 
@@ -339,15 +342,23 @@ _flash_lse_bhsd.defvjp(_flash_lse_fwd, _flash_lse_bwd)
 
 def _default_blocks():
     """Tunable via FLAGS_flash_block_q / FLAGS_flash_block_k (live-read so a
-    bench sweep or user config changes take effect without re-import)."""
+    bench sweep or user config changes take effect without re-import).
+    FLAGS_flash_bwd_block_q/k override the BACKWARD kernels' tiling
+    separately (0 = same as forward): the dkv/dq kernels keep more f32
+    operands live in VMEM than the forward, so their best block shape is
+    smaller."""
     try:
         from ..framework import flags as flags_mod
 
-        f = flags_mod.get_flags(["FLAGS_flash_block_q", "FLAGS_flash_block_k"])
+        f = flags_mod.get_flags(["FLAGS_flash_block_q", "FLAGS_flash_block_k",
+                                 "FLAGS_flash_bwd_block_q",
+                                 "FLAGS_flash_bwd_block_k"])
         return (int(f.get("FLAGS_flash_block_q") or DEFAULT_BLOCK_Q),
-                int(f.get("FLAGS_flash_block_k") or DEFAULT_BLOCK_K))
+                int(f.get("FLAGS_flash_block_k") or DEFAULT_BLOCK_K),
+                int(f.get("FLAGS_flash_bwd_block_q") or 0),
+                int(f.get("FLAGS_flash_bwd_block_k") or 0))
     except Exception:
-        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K
+        return DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K, 0, 0
 
 
 def flash_attention_with_lse(q, k, v, offset=0, causal=False, scale=None,
@@ -356,12 +367,12 @@ def flash_attention_with_lse(q, k, v, offset=0, causal=False, scale=None,
     `offset` shifts q's global positions for the causal mask (ring attention)."""
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    dq_, dk_ = _default_blocks()
+    dq_, dk_, bbq, bbk = _default_blocks()
     block_q = dq_ if block_q is None else block_q
     block_k = dk_ if block_k is None else block_k
     o, lse = _flash_lse_bhsd(q, k, v, jnp.asarray(offset, jnp.int32),
                              bool(causal), float(scale), int(block_q),
-                             int(block_k))
+                             int(block_k), int(bbq), int(bbk))
     # named for selective remat (FLAGS_remat_policy='flash'): saving o+lse
     # lets jax.checkpoint DCE the forward Pallas kernel from the backward
     # recompute (its custom-vjp residuals become available without it)

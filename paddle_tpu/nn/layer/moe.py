@@ -233,13 +233,13 @@ def _moe_mlp_index(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
     keep = pos_in_e < cap
     # dropped entries land on a scratch slot past the buffer
     slot = jnp.where(keep, flat_e * cap + pos_in_e, e * cap)
-    tok = jnp.tile(jnp.arange(n, dtype=jnp.int32), top_k)
 
-    slot_src = jnp.full((e * cap + 1,), n, jnp.int32).at[slot].set(
-        tok, mode="drop")[:-1]
-    # slot -> flat (choice-major) row, for the combine backward
+    # slot -> flat (choice-major) row: the ONE int32 scatter; the token
+    # map follows arithmetically (row = k*n + t, so token = row % n with
+    # the empty-slot sentinel mapped to n for the zero pad row)
     slot_rowsrc = jnp.full((e * cap + 1,), kn, jnp.int32).at[slot].set(
         jnp.arange(kn, dtype=jnp.int32), mode="drop")[:-1]
+    slot_src = jnp.where(slot_rowsrc < kn, slot_rowsrc % n, n)
     # name the routing decisions (~1MB total) so FLAGS_remat_policy='route'
     # pins them across the remat boundary: the backward recompute then
     # skips the router matmul + softmax + top_k + cumsum + int scatters

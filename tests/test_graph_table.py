@@ -1,0 +1,105 @@
+"""GNN graph storage + k-hop sampling.
+
+Reference roles: paddle/fluid/distributed/ps/table/common_graph_table.h:355
+(GraphTable serving surface) and python/paddle/incubate/operators/
+graph_khop_sampler.py:23 (CSC k-hop sampling with subgraph reindex)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.ps import GraphTable
+from paddle_tpu.incubate import graph_khop_sampler
+
+
+def _toy():
+    # 0->1, 0->2, 1->2, 2->0, 3->1 (and node 4 isolated via features only)
+    t = GraphTable(seed=3)
+    t.add_edges([0, 0, 1, 2, 3], [1, 2, 2, 0, 1])
+    return t.build()
+
+
+def test_graph_build_and_neighbors():
+    t = _toy()
+    assert t.num_nodes == 4 and t.num_edges == 5
+    assert sorted(t.neighbors(0).tolist()) == [1, 2]
+    assert t.neighbors(2).tolist() == [0]
+    assert t.pull_graph_list(0, 10).tolist() == [0, 1, 2, 3]
+
+
+def test_sample_neighbors_mask_and_degree():
+    t = _toy()
+    nbrs, mask = t.random_sample_neighbors([0, 2, 1], 2)
+    assert nbrs.shape == (3, 2)
+    assert mask[0].all()                      # deg(0)=2
+    assert mask[1].tolist() == [True, False]  # deg(2)=1 -> padded
+    assert set(nbrs[0].tolist()) == {1, 2}
+    assert nbrs[1, 0] == 0
+
+
+def test_weighted_sampling_biases():
+    t = GraphTable(seed=0)
+    # node 0 has 2 neighbors, weight 99:1 -> single samples should
+    # overwhelmingly pick neighbor 1
+    t.add_edges([0, 0], [1, 2], weights=[99.0, 1.0])
+    t.build()
+    hits = sum(int(t.random_sample_neighbors([0], 1)[0][0, 0] == 1)
+               for _ in range(50))
+    assert hits >= 40
+
+
+def test_node_feat_roundtrip_and_save_load(tmp_path):
+    t = _toy()
+    t.set_node_feat([1, 4], np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    np.testing.assert_array_equal(
+        t.get_node_feat([4, 1]),
+        np.array([[3.0, 4.0], [1.0, 2.0]], np.float32))
+    # missing node: explicit dim gives zeros, otherwise raises
+    assert t.get_node_feat([0], dim=2).tolist() == [[0.0, 0.0]]
+    with pytest.raises(KeyError):
+        t.get_node_feat([0])
+    p = str(tmp_path / "g.npz")
+    t.save(p)
+    t2 = GraphTable.load(p)
+    assert t2.num_nodes == 4 and t2.num_edges == 5
+    assert sorted(t2.neighbors(0).tolist()) == [1, 2]
+    np.testing.assert_array_equal(t2.get_node_feat([1]),
+                                  [[1.0, 2.0]])
+
+
+def test_random_sample_nodes():
+    t = _toy()
+    ids = t.random_sample_nodes(3)
+    assert len(set(ids.tolist())) == 3
+    assert all(0 <= i <= 3 for i in ids)
+
+
+def test_khop_sampler_reference_contract():
+    t = _toy()
+    row, colptr = t.to_csc()
+    # CSC sanity: in-neighbors of node 1 are {0, 3}
+    assert sorted(row[colptr[1]:colptr[2]].tolist()) == [0, 3]
+
+    src, dst, sample_index, reindex = graph_khop_sampler(
+        row, colptr, [1, 2], [2, 2], seed=0)
+    si = sample_index.numpy().tolist()
+    # inputs come first in the unique table; reindex is their positions
+    assert si[:2] == [1, 2]
+    assert reindex.numpy().tolist() == [0, 1]
+    s, d = src.numpy(), dst.numpy()
+    assert s.shape == d.shape and s.size >= 2
+    # every edge is reindexed and exists in the original graph
+    for a, b in zip(s, d):
+        orig_src, orig_dst = si[a], si[b]
+        lo, hi = colptr[orig_dst], colptr[orig_dst + 1]
+        assert orig_src in row[lo:hi].tolist()
+
+
+def test_khop_sampler_eids_and_errors():
+    t = _toy()
+    row, colptr = t.to_csc()
+    eids = np.arange(row.size, dtype=np.int64)
+    out = graph_khop_sampler(row, colptr, [0], [1], sorted_eids=eids,
+                             return_eids=True, seed=1)
+    assert len(out) == 5
+    assert out[4].numpy().size == out[0].numpy().size
+    with pytest.raises(ValueError, match="sorted_eids"):
+        graph_khop_sampler(row, colptr, [0], [1], return_eids=True)
