@@ -679,10 +679,19 @@ def _configs():
         vocab_size=32000, hidden_size=3328, intermediate_size=8960,
         num_hidden_layers=32, num_attention_heads=26, num_key_value_heads=26,
         max_position_embeddings=2048, dtype="bfloat16", use_recompute=True)
+    # BASELINE config 3 shape on ONE chip: the published Llama-2-7B
+    # architecture (6.74B params) through the segmented path — per-layer
+    # host buffers (~404MB/layer), boundary activations spilled, edge
+    # params resident. Capacity evidence, not throughput (host-link bound).
+    llama7b = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=32, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=4096, dtype="bfloat16", use_recompute=True)
     return {"big": big, "adafactor_1p8b": big_1p8, "long_seq_16k": long16k,
             "compat_374m": compat, "moe": moe, "moe_cf1": moe_cf1,
             "dit": dit,
-            "stream_capacity": stream_31, "seg_capacity": seg_45}
+            "stream_capacity": stream_31, "seg_capacity": seg_45,
+            "llama7b_seg": llama7b}
 
 
 def _run_one(name: str):
@@ -729,6 +738,8 @@ def _run_one(name: str):
         out = _measure_stream(cfg, batch=2, seq=2048, iters=3)
     elif name == "seg_capacity":
         out = _measure_segmented(cfg, batch=2, seq=2048, iters=2)
+    elif name == "llama7b_seg":
+        out = _measure_segmented(cfg, batch=2, seq=2048, iters=1)
     else:
         out = _measure(cfg, batch=4, seq=2048, iters=8)
         try:
@@ -815,6 +826,13 @@ def main():
             detail["seg_capacity"]["params_b"]
     except Exception as e:
         detail["seg_capacity_error"] = str(e)[:300]
+    try:
+        # BASELINE config 3 architecture (Llama-2-7B) as a single-chip
+        # capacity row — slow by nature (host-link bound), own budget
+        detail["llama7b_seg"] = _spawn("llama7b_seg", timeout=5400)
+        detail.setdefault("hbm_envelope", {})["segmented_llama7b"] = True
+    except Exception as e:
+        detail["llama7b_seg_error"] = str(e)[:300]
     try:
         # host-side init + the layerwise-streaming compile are slow by
         # nature; give this capacity demo its own generous budget

@@ -52,43 +52,35 @@ class DataGenerator:
         return local_iter
 
     # -- drivers -------------------------------------------------------------
-    def run_from_stdin(self):
-        """One output line per sample, the Dataset pipe protocol
-        (reference :96)."""
+    def _emit(self, lines: Iterable):
+        """Shared batching loop: parse every line, flush through
+        generate_batch at batch_size_ (and once at end), yield formatted
+        slot strings."""
         batch_samples = []
-        for line in sys.stdin:
-            line_iter = self.generate_sample(line)
-            for parsed in line_iter():
-                if parsed is None:
-                    continue
-                batch_samples.append(parsed)
-                if len(batch_samples) == self.batch_size_:
-                    for sample in self.generate_batch(batch_samples)():
-                        sys.stdout.write(self._gen_str(sample))
-                    batch_samples = []
-        if batch_samples:
-            for sample in self.generate_batch(batch_samples)():
-                sys.stdout.write(self._gen_str(sample))
-
-    def run_from_memory(self, lines: Optional[Iterable] = None) -> List[str]:
-        """Debug/bench driver (reference :61): collect the emitted lines
-        instead of writing stdout. `lines` feeds generate_sample; None
-        mirrors the reference's single None-line call."""
-        out = []
-        batch_samples = []
-        for line in (lines if lines is not None else [None]):
+        for line in lines:
             for parsed in self.generate_sample(line)():
                 if parsed is None:
                     continue
                 batch_samples.append(parsed)
                 if len(batch_samples) == self.batch_size_:
                     for sample in self.generate_batch(batch_samples)():
-                        out.append(self._gen_str(sample))
+                        yield self._gen_str(sample)
                     batch_samples = []
         if batch_samples:
             for sample in self.generate_batch(batch_samples)():
-                out.append(self._gen_str(sample))
-        return out
+                yield self._gen_str(sample)
+
+    def run_from_stdin(self):
+        """One output line per sample, the Dataset pipe protocol
+        (reference :96)."""
+        for s in self._emit(sys.stdin):
+            sys.stdout.write(s)
+
+    def run_from_memory(self, lines: Optional[Iterable] = None) -> List[str]:
+        """Debug/bench driver (reference :61): collect the emitted lines
+        instead of writing stdout. `lines` feeds generate_sample; None
+        mirrors the reference's single None-line call."""
+        return list(self._emit(lines if lines is not None else [None]))
 
     def _gen_str(self, line):
         raise NotImplementedError(
@@ -199,13 +191,20 @@ class SlotDataset:
         self.pad_to = int(pad_to)
         self.pad_value = pad_value
         self._samples: List[List] = []
+        # per-SLOT dtype, fixed at load: a slot is float if ANY loaded
+        # sample has a float value in it — per-sample dtypes would make
+        # DataLoader stacks (and jit consumers) unstable
+        self._slot_float = [False] * len(self.slot_names)
 
     def load_lines(self, lines: Iterable[str]) -> "SlotDataset":
         for line in lines:
             if not line.strip():
                 continue
-            self._samples.append(
-                parse_multi_slot(line, len(self.slot_names)))
+            slots = parse_multi_slot(line, len(self.slot_names))
+            for i, s in enumerate(slots):
+                if any(isinstance(v, float) for v in s):
+                    self._slot_float[i] = True
+            self._samples.append(slots)
         return self
 
     def load_files(self, paths: Sequence[str]) -> "SlotDataset":
@@ -219,13 +218,12 @@ class SlotDataset:
 
     def __getitem__(self, idx):
         slots = self._samples[idx]
+        dtypes = [np.float32 if f else np.int64 for f in self._slot_float]
         if not self.pad_to:
-            return tuple(np.asarray(s) for s in slots)
+            return tuple(np.asarray(s, dt) for s, dt in zip(slots, dtypes))
         out = []
-        for s in slots:
-            a = np.full((self.pad_to,), self.pad_value,
-                        dtype=np.int64 if all(
-                            isinstance(v, int) for v in s) else np.float32)
+        for s, dt in zip(slots, dtypes):
+            a = np.full((self.pad_to,), self.pad_value, dtype=dt)
             a[:min(len(s), self.pad_to)] = s[:self.pad_to]
             out.append(a)
         return tuple(out)

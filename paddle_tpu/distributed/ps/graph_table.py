@@ -35,11 +35,14 @@ class GraphTable:
         if src.shape != dst.shape:
             raise ValueError(f"add_edges: src/dst length mismatch "
                              f"({src.size} vs {dst.size})")
+        wgt = np.ones(src.size, np.float32) if weights is None \
+            else np.asarray(weights, np.float32).reshape(-1)
+        if wgt.size != src.size:
+            raise ValueError(f"add_edges: weights length {wgt.size} != "
+                             f"edge count {src.size}")
         self._src.append(src)
         self._dst.append(dst)
-        self._wgt.append(
-            np.ones(src.size, np.float32) if weights is None
-            else np.asarray(weights, np.float32).reshape(-1))
+        self._wgt.append(wgt)
         self._csr = None
 
     def set_node_feat(self, ids, feats):
@@ -154,8 +157,15 @@ class GraphTable:
         z = np.load(path if path.endswith(".npz") else path + ".npz")
         t = cls(seed=seed)
         wgt = z["wgt"]
+        indptr, dst = z["indptr"], z["dst"]
         uniform = bool(wgt.size == 0 or np.all(wgt == wgt[0]))
-        t._csr = (z["indptr"], z["dst"], wgt, None if uniform else wgt)
+        t._csr = (indptr, dst, wgt, None if uniform else wgt)
+        # also repopulate the edge lists so a later add_edges() composes
+        # with the loaded graph instead of silently replacing it at the
+        # next build()
+        src = np.repeat(np.arange(indptr.size - 1, dtype=np.int64),
+                        np.diff(indptr))
+        t._src, t._dst, t._wgt = [src], [dst.copy()], [wgt.copy()]
         for i, nid in enumerate(z["feat_ids"]):
             t._feat[int(nid)] = z["feats"][i]
         return t

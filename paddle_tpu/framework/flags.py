@@ -35,13 +35,26 @@ def define_flag(name: str, default, doc: str = ""):
     return name
 
 
+_ON_SET: Dict[str, Any] = {}
+
+
+def on_set(name: str, hook):
+    """Register a side-effect hook fired when `name` is set (the role of
+    the reference's flag callbacks in global_value_getter_setter.cc)."""
+    _ON_SET[name] = hook
+
+
 def set_flags(flags: Dict[str, Any]):
     """paddle.set_flags: update registered flags (global_value_getter_setter.cc)."""
     for k, v in flags.items():
         name = k[6:] if k.startswith("FLAGS_") else k
         if name not in _DEFS:
             raise ValueError(f"unknown flag {k!r}; known: {sorted(_DEFS)}")
-        _VALUES[name] = _parse(v, _DEFS[name]["type"]) if isinstance(v, str) else _DEFS[name]["type"](v)
+        val = _parse(v, _DEFS[name]["type"]) if isinstance(v, str) \
+            else _DEFS[name]["type"](v)
+        if name in _ON_SET:
+            _ON_SET[name](val)  # hooks validate BEFORE the value is stored
+        _VALUES[name] = val
 
 
 def get_flags(flags: Union[str, Iterable[str], None] = None) -> Dict[str, Any]:
@@ -110,3 +123,24 @@ define_flag("moe_dispatch", "index",
             "'sort' (argsort capacity routing), 'gmm' (dropless grouped "
             "matmul, single-device experts) or 'einsum' (GShard one-hot "
             "dispatch einsums, oracle)")
+define_flag("matmul_precision", "default",
+            "XLA matmul/conv precision: 'default' (bf16 mantissas on the "
+            "MXU), 'high', or 'highest' (full f32 — use for parity "
+            "comparisons against CPU references)")
+
+
+def _apply_matmul_precision(value: str):
+    import jax
+
+    if value not in ("default", "high", "highest"):
+        raise ValueError(
+            f"FLAGS_matmul_precision must be default/high/highest, "
+            f"got {value!r}")
+    jax.config.update("jax_default_matmul_precision",
+                      None if value == "default" else value)
+
+
+on_set("matmul_precision", _apply_matmul_precision)
+# env-var initialization fires the hook too (define_flag only stores)
+if _VALUES.get("matmul_precision", "default") != "default":
+    _apply_matmul_precision(_VALUES["matmul_precision"])

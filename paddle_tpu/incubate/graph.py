@@ -92,7 +92,9 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     outs = (Tensor(np.asarray(src_out, i64)),
             Tensor(np.asarray(dst_out, i64)),
             Tensor(np.asarray(sample_index, i64)),
-            Tensor(np.arange(nodes.size, dtype=i64)))
+            # duplicate input nodes dedup into one sample_index slot, so
+            # positions come from the table, not arange
+            Tensor(np.asarray([index_of[int(n)] for n in nodes], i64)))
     if return_eids:
         return outs + (Tensor(np.asarray(eid_out, i64)),)
     return outs

@@ -91,6 +91,17 @@ def test_parse_roundtrip_and_errors():
         parse_multi_slot("1 1 1 1 99", 2)
 
 
+def test_slot_dataset_stable_slot_dtype():
+    """A slot with mixed int/float lines keeps ONE dtype across samples."""
+    ds = SlotDataset(["score"], pad_to=2)
+    ds.load_lines(["1 1", "1 0.5"])
+    a0, = ds[0]
+    a1, = ds[1]
+    assert a0.dtype == a1.dtype == np.float32
+    ints = SlotDataset(["ids"]).load_lines(["2 7 8"])
+    assert ints[0][0].dtype == np.int64
+
+
 def test_slot_dataset_dataloader_to_ps_trainer():
     """End-to-end PS data path: generator lines -> SlotDataset (padded) ->
     io.DataLoader batches -> sparse pull/push through the PS tables."""

@@ -93,6 +93,34 @@ def test_khop_sampler_reference_contract():
         assert orig_src in row[lo:hi].tolist()
 
 
+def test_khop_sampler_duplicate_inputs_reindex():
+    t = _toy()
+    row, colptr = t.to_csc()
+    src, dst, si, ri = graph_khop_sampler(row, colptr, [1, 1, 2], [1],
+                                          seed=0)
+    sil = si.numpy().tolist()
+    # duplicates dedup in sample_index; reindex points both at that slot
+    assert sil[:2] == [1, 2]
+    assert ri.numpy().tolist() == [0, 0, 1]
+
+
+def test_load_then_add_edges_composes(tmp_path):
+    t = _toy()
+    p = str(tmp_path / "g.npz")
+    t.save(p)
+    t2 = GraphTable.load(p)
+    t2.add_edges([0], [3])
+    assert t2.num_edges == 6          # loaded 5 + 1 new
+    assert 3 in t2.neighbors(0).tolist()
+    assert t2.neighbors(2).tolist() == [0]  # loaded edges survive
+
+
+def test_add_edges_weight_length_checked():
+    t = GraphTable()
+    with pytest.raises(ValueError, match="weights length"):
+        t.add_edges([0, 1], [1, 0], weights=[1.0])
+
+
 def test_khop_sampler_eids_and_errors():
     t = _toy()
     row, colptr = t.to_csc()
