@@ -36,8 +36,9 @@ def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
     - returns (edge_src, edge_dst, sample_index, reindex_nodes[, eids]):
       `sample_index` is the unique node list (inputs first, then newly
       sampled, in discovery order), edges are REINDEXED into positions in
-      `sample_index`, and `reindex_nodes` is where each input node landed
-      (= arange(len(input_nodes)) by construction, kept for API parity).
+      `sample_index`, and `reindex_nodes[i]` is where input_nodes[i]
+      landed — duplicate inputs dedup to one slot, so always gather
+      through this array rather than assuming arange.
     """
     row = _np(row).reshape(-1).astype(np.int64)
     colptr = _np(colptr).reshape(-1).astype(np.int64)

@@ -574,10 +574,10 @@ class SegmentedTrainStep:
             for p in stacked_params:
                 sl = np.asarray(p.data[i]) if not self._on_cpu(p.data) \
                     else np.asarray(p.data)[i]
-                row.append(self._park(sl))
+                row.append(self._park_whole(sl))
                 with jax.default_device(cpu):
                     st = opt._init_state(jnp.asarray(sl))
-                srow.append({k: self._park(np.asarray(v))
+                srow.append({k: self._park_whole(np.asarray(v))
                              for k, v in st.items()})
             self._layer_params.append(row)
             self._layer_states.append(srow)
@@ -617,7 +617,21 @@ class SegmentedTrainStep:
                 t.data = jax.device_put(np.asarray(t.data), dev)
         self._jitted = None
 
-    _park = StreamedTrainStep._park
+    def _park_whole(self, np_arr):
+        """Park ONE layer's slice on pinned host UNPACKED (true shape).
+
+        StreamedTrainStep._park packs [L, ...] stacks into aligned [L, R,
+        128] slabs because its compiled step dynamic-slices INTO the host
+        arrays (the async-copy emitter needs sublane/lane alignment). The
+        segmented step transfers each buffer WHOLE (h2d/d2h of the full
+        array inside one jit), so the true shape is what the template and
+        the optimizer rule must see — packing here bound slab-shaped
+        weights into the model (r5 regression, caught by the seg bench
+        row going red on TPU)."""
+        np_arr = np.asarray(np_arr)
+        if self._host_sh is None:
+            return jnp.asarray(np_arr)
+        return jax.device_put(np_arr, self._host_sh)
     _on_cpu = staticmethod(StreamedTrainStep._on_cpu)
 
     def state_dict_arrays(self):

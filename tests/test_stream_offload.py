@@ -174,3 +174,38 @@ def test_segmented_requires_single_run():
     with pytest.raises(ValueError, match="StackedStageRun"):
         jit.SegmentedTrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(),
                                o)
+
+
+def test_segmented_buffers_keep_true_shapes(monkeypatch):
+    """r5 TPU regression guard: with a real host sharding, SegmentedTrainStep
+    must park per-layer buffers at their TRUE shapes (StreamedTrainStep's
+    [L,R,128] slab packing bound slab-shaped weights into the template on
+    TPU — CPU tests missed it because _memory_sharding is None there).
+    Forcing a plain CPU SingleDeviceSharding exercises the non-None path."""
+    import jax
+    from jax.sharding import SingleDeviceSharding
+
+    from paddle_tpu.distributed.meta_parallel import stage_stack
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cpu = jax.devices("cpu")[0]
+    monkeypatch.setattr(stage_stack, "_memory_sharding",
+                        lambda kind: SingleDeviceSharding(cpu))
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(num_hidden_layers=3, hidden_size=64,
+                           intermediate_size=96,  # 96 % 128 != 0: odd shape
+                           num_attention_heads=4, num_key_value_heads=4,
+                           vocab_size=128)
+    m = LlamaForCausalLM(cfg)
+    o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+    step = jit.SegmentedTrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+    tpl = dict(step.run._template[0].named_parameters())
+    for j, (safe, orig) in enumerate(step.run._names):
+        want = tuple(tpl[orig].shape)
+        for i in range(step.depth):
+            got = tuple(step._layer_params[i][j].shape)
+            assert got == want, f"layer {i} param {orig}: {got} != {want}"
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (2, 16)).astype("int32"))
+    losses = [float(step(ids, ids)) for _ in range(3)]
+    assert losses[-1] < losses[0]
