@@ -85,6 +85,18 @@ def render(src_name, d) -> str:
             f"`jit.StreamedTrainStep` streams stacked decoder weights + "
             f"optimizer state through pinned host memory, training "
             f"**{sc['params_b']}B params** on the same chip")
+    if "seg_capacity" in d:
+        sg = d["seg_capacity"]
+        parts.append(
+            f"`jit.SegmentedTrainStep` (per-layer executables, no stacked "
+            f"grad chain) lifts the ceiling to **{sg['params_b']}B**")
+    if "llama7b_seg" in d:
+        l7 = d["llama7b_seg"]
+        parts.append(
+            f"the segmented path trains the published **Llama-2-7B "
+            f"architecture ({l7['params_b']}B params) on the single chip** "
+            f"({l7['step_time_s']}s/step, {l7['gb_moved_per_step']}GB/step "
+            f"over a {l7['effective_host_gbps']}GB/s effective host link)")
     if "resnet_cifar" in d:
         rc = d["resnet_cifar"]
         pr = rc.get("loss_parity", {})
