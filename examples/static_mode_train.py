@@ -1,0 +1,68 @@
+"""Reference-era static-graph training script, unmodified style.
+
+Usage:  python examples/static_mode_train.py
+
+`paddle.enable_static()` switches to record-and-replay: the first
+Executor.run records the program from the dygraph dispatch stream, then
+replays a jit-compiled executable per feed shape. Ends with
+save_inference_model -> create_predictor, the static world's deployment
+handoff.
+"""
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def main():
+    paddle.enable_static()
+    try:
+        main_prog = static.Program()
+        startup = static.Program()
+        with static.program_guard(main_prog, startup):
+            x = static.data(name="x", shape=[None, 16], dtype="float32")
+            y = static.data(name="y", shape=[None, 1], dtype="float32")
+            hidden = paddle.nn.Linear(16, 32)(x)
+            hidden = paddle.nn.functional.relu(hidden)
+            pred = paddle.nn.Linear(32, 1)(hidden)
+            loss = paddle.nn.functional.mse_loss(pred, y)
+            opt = paddle.optimizer.SGD(learning_rate=0.05)
+            opt.minimize(loss)
+
+        exe = static.Executor()
+        exe.run(startup)
+        rng = np.random.RandomState(0)
+        w = rng.randn(16, 1).astype("float32")
+        first = None
+        for i in range(30):
+            xb = rng.randn(64, 16).astype("float32")
+            yb = xb @ w
+            (lv,) = exe.run(main_prog, feed={"x": xb, "y": yb},
+                            fetch_list=[loss])
+            first = first if first is not None else float(lv)
+        print("loss:", first, "->", float(lv))
+        assert float(lv) < first
+
+        with tempfile.TemporaryDirectory() as td:
+            static.save_inference_model(td + "/servable", [x], [pred], exe,
+                                        program=main_prog)
+            from paddle_tpu import inference
+
+            cfg = inference.Config(td + "/servable")
+            predictor = inference.create_predictor(cfg)
+            out = predictor.run([rng.randn(4, 16).astype("float32")])
+            print("served output shape:", out[0].shape)
+    finally:
+        paddle.disable_static()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,40 @@
+"""The examples/ scripts must actually run (tiny variants, CPU)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=420):
+    r = subprocess.run([sys.executable] + args, capture_output=True,
+                       text=True, timeout=timeout, cwd=ROOT)
+    assert r.returncode == 0, r.stdout[-1500:] + r.stderr[-1500:]
+    return r.stdout
+
+
+def test_train_llama_tiny():
+    out = _run(["examples/train_llama_tpu.py", "--tiny", "--steps", "6"])
+    assert "loss" in out
+
+
+def test_finetune_bert_tiny():
+    out = _run(["examples/finetune_bert.py", "--tiny"])
+    assert "held-out accuracy" in out
+
+
+def test_static_mode_example():
+    out = _run(["examples/static_mode_train.py"])
+    assert "served output shape" in out
+
+
+def test_ps_recsys_example():
+    out = _run(["examples/ps_recsys.py"])
+    assert "epoch 2" in out
+
+
+def test_distributed_example_virtual_mesh():
+    out = _run(["examples/distributed_data_parallel.py", "--virtual", "4"])
+    assert "OK" in out

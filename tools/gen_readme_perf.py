@@ -22,10 +22,11 @@ BEGIN, END = "<!-- BENCH:begin", "<!-- BENCH:end -->"
 
 def _load(path=None):
     if path is None:
-        cands = sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")))
+        cands = glob.glob(os.path.join(ROOT, "BENCH_r*.json")) + \
+            glob.glob(os.path.join(ROOT, "bench_artifacts", "*.json"))
         if not cands:
-            raise SystemExit("no BENCH_r*.json found")
-        path = cands[-1]
+            raise SystemExit("no bench artifact found")
+        path = max(cands, key=os.path.getmtime)  # newest by mtime
     with open(path) as f:
         data = json.load(f)
     if "detail" not in data and isinstance(data.get("parsed"), dict):
