@@ -19,7 +19,6 @@ _sys.path.insert(0, _os.path.dirname(_os.path.dirname(
     _os.path.abspath(__file__))))  # run from anywhere
 
 import argparse
-import sys
 
 
 def main(virtual: int = 0):

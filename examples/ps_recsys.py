@@ -6,8 +6,8 @@ Usage:  python examples/ps_recsys.py
 One process hosts the TCPStore + server loop (thread), the trainer pulls
 rows, computes a logistic-regression step on the CTR label, and pushes
 sparse grads back — the reference's async-PS workflow at library scale.
-Swap SpillSparseTable in via create_table(..., spill=...) for beyond-RAM
-tables.
+Swap the disk-spill tier in via create_table(..., hot_bytes=...,
+spill_dir=...) for beyond-RAM tables.
 """
 import os as _os
 import sys as _sys
@@ -66,8 +66,8 @@ def main():
                     -(y * np.log(p + 1e-7)
                       + (1 - y) * np.log(1 - p + 1e-7)))))
                 dlogit = (p - y) / len(y)
+                dfeat = np.outer(dlogit, w) / ids.shape[1]  # pre-update w
                 w -= 0.5 * (feat.T @ dlogit)
-                dfeat = np.outer(dlogit, w) / ids.shape[1]
                 grads = np.repeat(dfeat[:, None, :], ids.shape[1], axis=1)
                 tr.push("emb", ids.reshape(-1),
                         grads.reshape(-1, dim), wait=True)

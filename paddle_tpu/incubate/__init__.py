@@ -4,6 +4,7 @@ from . import optimizer  # noqa: F401
 from . import asp  # noqa: F401
 from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from .graph import graph_khop_sampler  # noqa: F401
+from . import checkpoint  # noqa: F401
 
 
 def softmax_mask_fuse(x, mask, name=None):

@@ -1,0 +1,134 @@
+"""Epoch-level auto-checkpoint (fault-tolerant training loops).
+
+Reference: python/paddle/fluid/incubate/checkpoint/auto_checkpoint.py:71
+(AutoCheckpointChecker + train_epoch_range: the training loop iterates
+`for epoch in acp.train_epoch_range(N)`, the framework checkpoints train
+state each epoch and, after a relaunch, fast-forwards past completed
+epochs). TPU-native collapse: no HDFS tier — state_dicts go through the
+distributed checkpoint writer (mesh-reshard-safe) into a local/NFS dir;
+the resume marker is a tiny json written ATOMICALLY (tmp + rename) after
+the state save, so a crash between the two leaves the previous epoch as
+the resume point, never a torn checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from typing import Dict, Iterator, Optional
+
+__all__ = ["train_epoch_range"]
+
+
+def _ckpt_dir(explicit: Optional[str]) -> str:
+    return explicit or os.environ.get("PADDLE_CHECKPOINT_DIR") or \
+        os.path.join(tempfile.gettempdir(), "paddle_tpu_auto_ckpt")
+
+
+class _EpochRange:
+    def __init__(self, max_epoch_num: int, name: str, checkpoint_dir,
+                 state: Optional[Dict], save_interval: int):
+        self.max_epoch_num = int(max_epoch_num)
+        self.name = name
+        self.dir = os.path.join(_ckpt_dir(checkpoint_dir), name)
+        self.state = state or {}
+        self.save_interval = max(int(save_interval), 1)
+        self._marker = os.path.join(self.dir, "range.json")
+        self.restored_from: Optional[int] = None
+
+    # -- persistence ---------------------------------------------------------
+    def _load_marker(self) -> int:
+        """Last COMPLETED epoch, or -1."""
+        try:
+            with open(self._marker) as f:
+                return int(json.load(f)["epoch"])
+        except (OSError, ValueError, KeyError):
+            return -1
+
+    def _write_marker(self, epoch: int):
+        """Atomic (tmp + rename): a crash mid-write keeps the old marker."""
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump({"epoch": epoch, "name": self.name}, f)
+            os.replace(tmp, self._marker)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    def _saved_epochs(self):
+        out = []
+        try:
+            for d in os.listdir(self.dir):
+                if d.startswith("e") and d[1:].isdigit() and \
+                        os.path.isdir(os.path.join(self.dir, d)):
+                    out.append(int(d[1:]))
+        except OSError:
+            pass
+        return sorted(out)
+
+    def _restore(self, epoch: int):
+        from ..distributed.checkpoint import load_state_dict
+
+        edir = os.path.join(self.dir, f"e{epoch}")
+        if not os.path.isdir(edir):
+            # a marker-only run (or an interrupted cleanup) left a marker
+            # without state dirs: fast-forward WITHOUT restoring, loudly
+            import warnings
+
+            warnings.warn(
+                f"auto_checkpoint '{self.name}': marker says epoch {epoch} "
+                f"completed but {edir} has no saved state — resuming the "
+                f"epoch count with the CURRENT in-memory state")
+            return
+        for key, obj in self.state.items():
+            sd = obj.state_dict()
+            load_state_dict(sd, os.path.join(edir, key))
+            obj.set_state_dict(sd)
+        self.restored_from = epoch
+
+    def _save(self, epoch: int):
+        from ..distributed.checkpoint import save_state_dict
+
+        edir = os.path.join(self.dir, f"e{epoch}")
+        for key, obj in self.state.items():
+            save_state_dict(obj.state_dict(),
+                            os.path.join(edir, key))
+        # atomic marker LAST: a crash mid-save resumes from the prior epoch
+        self._write_marker(epoch)
+        # keep the two newest SAVED checkpoints (save_interval gaps mean
+        # epoch dirs are not consecutive); the second-newest survives in
+        # case a reader raced the marker flip
+        for old in self._saved_epochs()[:-2]:
+            shutil.rmtree(os.path.join(self.dir, f"e{old}"),
+                          ignore_errors=True)
+
+    # -- the loop ------------------------------------------------------------
+    def __iter__(self) -> Iterator[int]:
+        os.makedirs(self.dir, exist_ok=True)
+        last_done = self._load_marker()
+        if last_done >= 0 and self.state:
+            self._restore(last_done)
+        for epoch in range(last_done + 1, self.max_epoch_num):
+            yield epoch
+            if self.state and (epoch % self.save_interval == 0
+                               or epoch == self.max_epoch_num - 1):
+                self._save(epoch)
+            elif not self.state:
+                # marker-only mode still fast-forwards the loop on restart
+                self._write_marker(epoch)
+
+
+def train_epoch_range(max_epoch_num: int, name: str = "default",
+                      checkpoint_dir: Optional[str] = None,
+                      state: Optional[Dict] = None,
+                      save_interval: int = 1) -> _EpochRange:
+    """`for epoch in train_epoch_range(N, state={"model": m, "opt": o})`:
+    every completed epoch checkpoints the registered state; a relaunched
+    job restores the newest checkpoint and resumes at the next epoch
+    (reference auto_checkpoint.py train_epoch_range role). `state` maps
+    names to objects with state_dict/set_state_dict (Layers, optimizers,
+    GradScaler). With no `state`, only the epoch fast-forward happens."""
+    return _EpochRange(max_epoch_num, name, checkpoint_dir, state,
+                       save_interval)

@@ -35,6 +35,11 @@ def test_ps_recsys_example():
     assert "epoch 2" in out
 
 
+def test_generate_gpt_example():
+    out = _run(["examples/generate_gpt.py"])
+    assert "OK" in out
+
+
 def test_distributed_example_virtual_mesh():
     out = _run(["examples/distributed_data_parallel.py", "--virtual", "4"])
     assert "OK" in out

@@ -20,13 +20,22 @@ ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 BEGIN, END = "<!-- BENCH:begin", "<!-- BENCH:end -->"
 
 
+def _round_of(p: str) -> int:
+    m = re.search(r"r(\d+)", os.path.basename(p))
+    return int(m.group(1)) if m else -1
+
+
 def _load(path=None):
     if path is None:
         cands = glob.glob(os.path.join(ROOT, "BENCH_r*.json")) + \
             glob.glob(os.path.join(ROOT, "bench_artifacts", "*.json"))
         if not cands:
             raise SystemExit("no bench artifact found")
-        path = max(cands, key=os.path.getmtime)  # newest by mtime
+        # deterministic: highest round number wins (parsed from the name,
+        # so fresh-clone mtimes don't matter); session artifacts beat the
+        # driver artifact of the same round (they carry the later rows)
+        path = max(cands, key=lambda p: (
+            _round_of(p), "bench_artifacts" in p, os.path.basename(p)))
     with open(path) as f:
         data = json.load(f)
     if "detail" not in data and isinstance(data.get("parsed"), dict):
