@@ -112,6 +112,10 @@ except ImportError:
 from .framework.place import CUDAPinnedPlace, NPUPlace  # noqa: F401,E402
 from .framework import dtype as dtype  # noqa: F401,E402  (paddle.dtype module-alias)
 from .distributed.parallel import DataParallel  # noqa: F401,E402
+from . import compat  # noqa: F401,E402
+from . import reader  # noqa: F401,E402
+from . import dataset  # noqa: F401,E402
+from . import cost_model  # noqa: F401,E402
 from .ops.creation import create_parameter  # noqa: F401,E402
 
 

@@ -1,0 +1,75 @@
+"""paddle.compat — text/bytes conversion helpers.
+
+Reference: python/paddle/compat.py:25 (to_text/to_bytes recursing through
+containers, py2-era round/floor_division retained for script compat).
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+
+def _convert(obj, one, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(i, one, inplace) for i in obj]
+            return obj
+        return [_convert(i, one, inplace) for i in obj]
+    if isinstance(obj, set):
+        conv = {_convert(i, one, inplace) for i in obj}
+        if inplace:
+            obj.clear()
+            obj.update(conv)
+            return obj
+        return conv
+    if isinstance(obj, dict):
+        conv = {_convert(k, one, False): _convert(v, one, False)
+                for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(conv)
+            return obj
+        return conv
+    if isinstance(obj, (tuple,)):
+        return tuple(_convert(i, one, False) for i in obj)
+    return one(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes -> str recursively through list/set/dict/tuple (compat.py:25)."""
+    def one(x):
+        return x.decode(encoding) if isinstance(x, (bytes, bytearray)) else x
+    return _convert(obj, one, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str -> bytes recursively through containers (compat.py:121)."""
+    def one(x):
+        return x.encode(encoding) if isinstance(x, str) else x
+    return _convert(obj, one, inplace)
+
+
+def round(x, d=0):  # noqa: A001 (the reference shadows the builtin too)
+    """Python-2-style half-away-from-zero rounding (compat.py:206)."""
+    if x is None or (isinstance(x, float) and math.isnan(x)):
+        return x
+    if isinstance(x, float) and math.isinf(x):
+        return x
+    p = 10 ** d
+    if x >= 0:
+        out = math.floor(x * p + 0.5) / p
+    else:
+        out = math.ceil(x * p - 0.5) / p
+    return out if d > 0 else float(int(out)) if d == 0 else out
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc) -> str:
+    return str(exc)

@@ -1,0 +1,75 @@
+"""paddle.dataset — the legacy reader-creator facade.
+
+Reference: python/paddle/dataset/ (uci_housing.py, imdb.py, mnist.py, …:
+each module exposes train()/test() returning zero-arg reader creators).
+TPU-native collapse: every loader adapts the corresponding
+paddle_tpu.vision/text Dataset class (file-backed, loud on missing
+downloads) into the reader-creator protocol that paddle.reader and the
+PS data pipelines compose. Usage:
+
+    train_reader = paddle.reader.shuffle(
+        paddle.dataset.uci_housing.train(data_file=...), buf_size=500)
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+from . import common  # noqa: F401
+
+__all__ = ["common"]
+
+
+def _creator(cls, mode, kwargs):
+    def reader():
+        import inspect
+
+        if "mode" in inspect.signature(cls.__init__).parameters:
+            ds = cls(mode=mode, **kwargs)
+        else:  # single-split datasets (Conll05st ships test only)
+            ds = cls(**kwargs)
+        for i in range(len(ds)):
+            yield ds[i]
+    return reader
+
+
+def _module(name, cls_path, modes=("train", "test")):
+    """Build a paddle.dataset.<name> module whose train()/test() wrap the
+    Dataset class at cls_path ('pkg.mod:Class')."""
+    mod = types.ModuleType(f"{__name__}.{name}")
+    mod.__doc__ = (f"reader-creator facade over {cls_path} "
+                   f"(reference python/paddle/dataset/{name}.py)")
+
+    def _cls():
+        path, cname = cls_path.split(":")
+        import importlib
+
+        return getattr(importlib.import_module(path), cname)
+
+    def make(mode):
+        def fn(**kwargs):
+            return _creator(_cls(), mode, kwargs)
+        fn.__name__ = mode
+        fn.__doc__ = (f"{name}.{mode}(**dataset_kwargs) -> reader creator "
+                      f"(pass the Dataset class's data_file=... here)")
+        return fn
+
+    for m in modes:
+        setattr(mod, m, make(m))
+    sys.modules[mod.__name__] = mod
+    globals()[name] = mod
+    __all__.append(name)
+    return mod
+
+
+_module("uci_housing", "paddle_tpu.text.datasets:UCIHousing")
+_module("imdb", "paddle_tpu.text.datasets:Imdb")
+_module("imikolov", "paddle_tpu.text.datasets:Imikolov")
+_module("movielens", "paddle_tpu.text.datasets:Movielens")
+_module("conll05", "paddle_tpu.text.datasets:Conll05st",
+        modes=("test",))  # reference ships test split only
+_module("wmt14", "paddle_tpu.text.datasets:WMT14")
+_module("wmt16", "paddle_tpu.text.datasets:WMT16")
+_module("mnist", "paddle_tpu.vision.datasets:MNIST")
+_module("cifar", "paddle_tpu.vision.datasets:Cifar10")
+_module("flowers", "paddle_tpu.vision.datasets:Flowers")

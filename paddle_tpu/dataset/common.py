@@ -1,0 +1,79 @@
+"""paddle.dataset.common (reference python/paddle/dataset/common.py):
+DATA_HOME resolution, md5 checking, and the split/cluster helpers the
+PS-era pipelines used. Downloads are environment-blocked here — loaders
+take explicit local files, and `download()` raises the same loud pointer
+the vision/text Dataset classes do."""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+
+__all__ = ["DATA_HOME", "md5file", "download", "split",
+           "cluster_files_reader"]
+
+DATA_HOME = os.path.expanduser(
+    os.environ.get("PADDLE_DATA_HOME", "~/.cache/paddle/dataset"))
+
+
+def md5file(fname: str) -> str:
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url: str, module_name: str, md5sum: str, save_name=None) -> str:
+    """The reference fetches to DATA_HOME/<module>; this image has no
+    egress. If the file is already cached (same layout), use it."""
+    name = save_name or url.split("/")[-1]
+    path = os.path.join(DATA_HOME, module_name, name)
+    if os.path.exists(path) and (not md5sum or md5file(path) == md5sum):
+        return path
+    raise RuntimeError(
+        f"paddle.dataset download is unavailable in this environment; "
+        f"place the file at {path} (md5 {md5sum or 'any'}) or pass an "
+        f"explicit data_file to the paddle_tpu.vision/text Dataset class")
+
+
+def split(reader, line_count, suffix="%05d.pickle", dumper=None):
+    """Split a reader's samples into pickled chunk files of `line_count`
+    (reference common.py split role for cluster training)."""
+    dumper = dumper or (lambda obj, f: pickle.dump(obj, f))
+    buf, idx, out = [], 0, []
+    if "%" not in suffix:
+        raise ValueError("split: suffix must contain a %d-style placeholder")
+    for sample in reader():
+        buf.append(sample)
+        if len(buf) == line_count:
+            path = suffix % idx
+            with open(path, "wb") as f:
+                dumper(buf, f)
+            out.append(path)
+            buf, idx = [], idx + 1
+    if buf:
+        path = suffix % idx
+        with open(path, "wb") as f:
+            dumper(buf, f)
+        out.append(path)
+    return out
+
+
+def cluster_files_reader(files_pattern, trainer_count, trainer_id,
+                         loader=None):
+    """Reader creator over this trainer's shard of chunk files
+    (round-robin by index, reference common.py)."""
+    import glob
+
+    loader = loader or (lambda f: pickle.load(f))
+
+    def creator():
+        files = sorted(glob.glob(files_pattern))
+        for i, path in enumerate(files):
+            if i % trainer_count != trainer_id:
+                continue
+            with open(path, "rb") as f:
+                for sample in loader(f):
+                    yield sample
+    return creator

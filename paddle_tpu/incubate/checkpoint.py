@@ -68,8 +68,41 @@ class _EpochRange:
             pass
         return sorted(out)
 
+    @staticmethod
+    def _pos_key_maps(obj):
+        """Optimizer accumulator keys embed parameter NAMES (tensor_N from
+        a process-global counter), which drift if a relaunched script
+        builds layers in a different order. Translate name-keyed entries
+        to position-keyed ones ('__p<i>__<acc>') on save and back to the
+        CURRENT names on restore. Returns (to_pos, to_name) key-mapping
+        callables; identity for non-optimizer state."""
+        params = getattr(obj, "_parameter_list", None)
+        if not params:
+            return (lambda k: k), (lambda k: k)
+        # longest name first: 'tensor_12' must not match as 'tensor_1'+'2_'
+        by_len = sorted(enumerate(params),
+                        key=lambda ip: -len(ip[1].name))
+
+        def to_pos(k):
+            for i, p in by_len:
+                if k.startswith(p.name + "_"):
+                    return f"__p{i}__{k[len(p.name) + 1:]}"
+            return k
+
+        def to_name(k):
+            if k.startswith("__p"):
+                pos, suffix = k[3:].split("__", 1)
+                return f"{params[int(pos)].name}_{suffix}"
+            return k
+        return to_pos, to_name
+
     def _restore(self, epoch: int):
-        from ..distributed.checkpoint import load_state_dict
+        # restore from the MANIFEST, not the fresh object's state_dict():
+        # a just-constructed optimizer has no accumulator keys yet, so
+        # loading "into" it would silently drop the saved Adam moments
+        # (set_state_dict accepts the full restored dict and rebuilds)
+        from ..core.tensor import Tensor
+        from ..distributed.checkpoint import _assemble, load_manifest
 
         edir = os.path.join(self.dir, f"e{epoch}")
         if not os.path.isdir(edir):
@@ -83,18 +116,39 @@ class _EpochRange:
                 f"epoch count with the CURRENT in-memory state")
             return
         for key, obj in self.state.items():
-            sd = obj.state_dict()
-            load_state_dict(sd, os.path.join(edir, key))
+            _, to_name = self._pos_key_maps(obj)
+            kdir = os.path.join(edir, key)
+            manifest = load_manifest(kdir)
+            sd = {to_name(k): Tensor(_assemble(kdir, entry))
+                  for k, entry in manifest["entries"].items()}
+            meta_path = os.path.join(kdir, "meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    sd.update({to_name(k): v
+                               for k, v in json.load(f).items()})
             obj.set_state_dict(sd)
         self.restored_from = epoch
 
     def _save(self, epoch: int):
+        import numpy as np
+
+        from ..core.tensor import Tensor
         from ..distributed.checkpoint import save_state_dict
 
         edir = os.path.join(self.dir, f"e{epoch}")
         for key, obj in self.state.items():
-            save_state_dict(obj.state_dict(),
-                            os.path.join(edir, key))
+            to_pos, _ = self._pos_key_maps(obj)
+            sd = {to_pos(k): v for k, v in obj.state_dict().items()}
+            # arrays go through the sharded writer; scalars and nested
+            # dicts (global_step, LR_Scheduler state) to a json sidecar
+            tensors = {k: v for k, v in sd.items()
+                       if isinstance(v, (Tensor, np.ndarray)) or
+                       (hasattr(v, "dtype") and hasattr(v, "shape"))}
+            meta = {k: v for k, v in sd.items() if k not in tensors}
+            kdir = os.path.join(edir, key)
+            save_state_dict(tensors, kdir)
+            with open(os.path.join(kdir, "meta.json"), "w") as f:
+                json.dump(meta, f)
         # atomic marker LAST: a crash mid-save resumes from the prior epoch
         self._write_marker(epoch)
         # keep the two newest SAVED checkpoints (save_interval gaps mean

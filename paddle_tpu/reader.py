@@ -1,0 +1,217 @@
+"""paddle.reader — composable reader-creator decorators.
+
+Reference: python/paddle/reader/decorator.py (cache :52, map_readers :92,
+shuffle :134, chain :183, compose :248, buffered :308, firstn :367,
+xmap_readers :412, multiprocess_reader :505). A "reader creator" is a
+zero-arg callable returning an iterator of samples — the PS/dataset era's
+input pipeline algebra. Thread/process plumbing maps onto the stdlib
+(queue + threads) exactly like the reference; multiprocess_reader keeps
+fork+pipe semantics via multiprocessing.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "shuffle", "chain", "compose",
+           "buffered", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Materialize the first full pass; replay from memory after
+    (decorator.py:52)."""
+    all_data = []
+    filled = [False]
+
+    def creator():
+        if not filled[0]:
+            all_data.extend(reader())
+            filled[0] = True
+        return iter(all_data)
+    return creator
+
+
+def map_readers(func, *readers):
+    """Zip readers, yield func(*one_of_each) (decorator.py:92)."""
+    def creator():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+    return creator
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle: fill buf_size samples, emit shuffled, repeat
+    (decorator.py:134)."""
+    def creator():
+        buf = []
+        for s in reader():
+            buf.append(s)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+    return creator
+
+
+def chain(*readers):
+    """Concatenate readers back-to-back (decorator.py:183)."""
+    def creator():
+        return itertools.chain(*[r() for r in readers])
+    return creator
+
+
+def compose(*readers, **kwargs):
+    """Parallel-compose: one tuple per step, flattening tuple samples;
+    check_alignment=True (default) raises ComposeNotAligned when readers
+    run out at different lengths (decorator.py:248)."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"compose: unexpected kwargs {sorted(kwargs)}")
+
+    def _flat(item):
+        return item if isinstance(item, tuple) else (item,)
+
+    def creator():
+        iters = [r() for r in readers]
+        if not check_alignment:
+            for items in zip(*iters):
+                yield sum((_flat(i) for i in items), ())
+            return
+        sentinel = object()
+        for items in itertools.zip_longest(*iters, fillvalue=sentinel):
+            if any(i is sentinel for i in items):
+                raise ComposeNotAligned(
+                    "compose: readers have different lengths")
+            yield sum((_flat(i) for i in items), ())
+    return creator
+
+
+def buffered(reader, size):
+    """Background thread keeps up to `size` samples ready
+    (decorator.py:308)."""
+    end = object()
+
+    def creator():
+        q = _queue.Queue(maxsize=size)
+
+        def fill():
+            try:
+                for s in reader():
+                    q.put(s)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            s = q.get()
+            if s is end:
+                break
+            yield s
+    return creator
+
+
+def firstn(reader, n):
+    """First n samples (decorator.py:367)."""
+    def creator():
+        return itertools.islice(reader(), n)
+    return creator
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply `mapper` with `process_num` worker THREADS over the stream
+    (decorator.py:412 — the reference's workers are threads too);
+    order=True preserves input order."""
+    end = object()
+
+    def creator():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+
+        def feed():
+            for i, s in enumerate(reader()):
+                in_q.put((i, s))
+            for _ in range(process_num):
+                in_q.put(end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is end:
+                    out_q.put(end)
+                    return
+                i, s = item
+                out_q.put((i, mapper(s)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is end:
+                    finished += 1
+                    continue
+                yield item[1]
+            return
+        pending = {}
+        want = 0
+        while finished < process_num or pending:
+            if want in pending:
+                yield pending.pop(want)
+                want += 1
+                continue
+            item = out_q.get()
+            if item is end:
+                finished += 1
+                continue
+            i, val = item
+            pending[i] = val
+        while want in pending:
+            yield pending.pop(want)
+            want += 1
+    return creator
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Fan-in several readers from fork'd worker processes
+    (decorator.py:505). Workers must only touch fork-safe state (numpy,
+    files) — the same contract as the DataLoader workers."""
+    import multiprocessing as mp
+
+    def creator():
+        q = mp.Queue(queue_size)
+
+        def work(r):
+            try:
+                for s in r():
+                    q.put(s)
+            finally:
+                q.put(None)
+
+        procs = [mp.Process(target=work, args=(r,), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        while finished < len(readers):
+            s = q.get()
+            if s is None:
+                finished += 1
+                continue
+            yield s
+        for p in procs:
+            p.join(timeout=5)
+    return creator

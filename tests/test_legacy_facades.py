@@ -1,0 +1,118 @@
+"""paddle.compat / paddle.reader / paddle.dataset / paddle.cost_model —
+the legacy facades PS-era scripts import.
+
+Reference roles: python/paddle/compat.py, reader/decorator.py,
+dataset/, cost_model/cost_model.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# -- compat -------------------------------------------------------------------
+def test_compat_text_bytes_roundtrip():
+    from paddle_tpu import compat
+
+    assert compat.to_text(b"abc") == "abc"
+    assert compat.to_bytes("abc") == b"abc"
+    nested = {"k": [b"a", (b"b",), {b"c"}]}
+    out = compat.to_text(nested)
+    assert out == {"k": ["a", ("b",), {"c"}]}
+    lst = [b"x", b"y"]
+    assert compat.to_text(lst, inplace=True) is lst and lst == ["x", "y"]
+    assert compat.round(2.5) == 3.0 and compat.round(-2.5) == -3.0
+    assert compat.floor_division(7, 2) == 3
+
+
+# -- reader -------------------------------------------------------------------
+def _nums(n):
+    def r():
+        return iter(range(n))
+    return r
+
+
+def test_reader_algebra():
+    from paddle_tpu import reader
+
+    assert list(reader.firstn(_nums(10), 3)()) == [0, 1, 2]
+    assert list(reader.chain(_nums(2), _nums(2))()) == [0, 1, 0, 1]
+    assert list(reader.map_readers(lambda a, b: a + b,
+                                   _nums(3), _nums(3))()) == [0, 2, 4]
+    assert sorted(reader.shuffle(_nums(5), 2)()) == [0, 1, 2, 3, 4]
+    assert list(reader.buffered(_nums(4), 2)()) == [0, 1, 2, 3]
+    cached = reader.cache(_nums(3))
+    assert list(cached()) == [0, 1, 2] and list(cached()) == [0, 1, 2]
+
+
+def test_reader_compose_alignment():
+    from paddle_tpu import reader
+
+    c = reader.compose(_nums(3), _nums(3))
+    assert list(c()) == [(0, 0), (1, 1), (2, 2)]
+    with pytest.raises(reader.ComposeNotAligned):
+        list(reader.compose(_nums(3), _nums(4))())
+    ok = reader.compose(_nums(3), _nums(4), check_alignment=False)
+    assert len(list(ok())) == 3
+
+
+def test_reader_xmap_order():
+    from paddle_tpu import reader
+
+    out = list(reader.xmap_readers(lambda x: x * 10, _nums(20), 4, 8,
+                                   order=True)())
+    assert out == [i * 10 for i in range(20)]
+    unordered = list(reader.xmap_readers(lambda x: x * 10, _nums(20), 4, 8)())
+    assert sorted(unordered) == out
+
+
+# -- dataset ------------------------------------------------------------------
+def test_dataset_facade_wraps_text_datasets(tmp_path):
+    rng = np.random.RandomState(0)
+    rows = rng.rand(50, 14).astype("float32")
+    f = tmp_path / "housing.data"
+    np.savetxt(f, rows)
+    creator = paddle.dataset.uci_housing.train(data_file=str(f))
+    samples = list(creator())
+    assert len(samples) == 40  # 80% train split
+    x, y = samples[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    # composes with paddle.reader
+    first2 = list(paddle.reader.firstn(creator, 2)())
+    assert len(first2) == 2
+
+
+def test_dataset_common_split_and_cluster_reader(tmp_path, monkeypatch):
+    from paddle_tpu.dataset import common
+
+    monkeypatch.chdir(tmp_path)
+    files = common.split(_nums(10), 4, suffix="chunk-%05d.pickle")
+    assert len(files) == 3
+    r0 = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"), 2, 0)
+    r1 = common.cluster_files_reader(str(tmp_path / "chunk-*.pickle"), 2, 1)
+    assert sorted(list(r0()) + list(r1())) == list(range(10))
+
+
+def test_dataset_download_blocked_points_at_cache():
+    from paddle_tpu.dataset import common
+
+    with pytest.raises(RuntimeError, match="unavailable"):
+        common.download("http://x/y.tgz", "mnist", "d41d8cd9")
+
+
+# -- cost_model ---------------------------------------------------------------
+def test_cost_model_profile_and_static_costs():
+    from paddle_tpu.cost_model import CostModel
+
+    cm = CostModel()
+    startup, main = cm.build_program()
+    try:
+        out = cm.profile_measure(startup, main, iters=2)
+    finally:
+        paddle.disable_static()
+    assert out["time"] > 0
+    t = cm.get_static_op_time("matmul")
+    assert t["op_time_ms"] > 0
+    assert cm.get_static_op_time("matmul", forward=False)["op_time_ms"] > \
+        t["op_time_ms"] * 1.5
+    with pytest.raises(KeyError, match="no static cost entry"):
+        cm.get_static_op_time("conv3d_transpose")
