@@ -71,5 +71,22 @@ _module("conll05", "paddle_tpu.text.datasets:Conll05st",
 _module("wmt14", "paddle_tpu.text.datasets:WMT14")
 _module("wmt16", "paddle_tpu.text.datasets:WMT16")
 _module("mnist", "paddle_tpu.vision.datasets:MNIST")
-_module("cifar", "paddle_tpu.vision.datasets:Cifar10")
 _module("flowers", "paddle_tpu.vision.datasets:Flowers")
+
+# cifar keeps the reference's split names: train10/test10 wrap Cifar10,
+# train100/test100 wrap Cifar100 (python/paddle/dataset/cifar.py)
+_cifar = _module("cifar", "paddle_tpu.vision.datasets:Cifar10", modes=())
+for _m, _cls in (("train10", "Cifar10"), ("test10", "Cifar10"),
+                 ("train100", "Cifar100"), ("test100", "Cifar100")):
+    def _make_cifar(mode_name=_m, cls_name=_cls):
+        mode = "train" if mode_name.startswith("train") else "test"
+
+        def fn(**kwargs):
+            import importlib
+
+            cls = getattr(importlib.import_module(
+                "paddle_tpu.vision.datasets"), cls_name)
+            return _creator(cls, mode, kwargs)
+        fn.__name__ = mode_name
+        return fn
+    setattr(_cifar, _m, _make_cifar())

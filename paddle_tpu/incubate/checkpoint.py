@@ -115,17 +115,47 @@ class _EpochRange:
                 f"completed but {edir} has no saved state — resuming the "
                 f"epoch count with the CURRENT in-memory state")
             return
+        import jax
+
         for key, obj in self.state.items():
             _, to_name = self._pos_key_maps(obj)
             kdir = os.path.join(edir, key)
             manifest = load_manifest(kdir)
-            sd = {to_name(k): Tensor(_assemble(kdir, entry))
-                  for k, entry in manifest["entries"].items()}
+            fresh = obj.state_dict()
+            sd = {}
+            for k, entry in manifest["entries"].items():
+                name = to_name(k)
+                arr = _assemble(kdir, entry)
+                tgt = fresh.get(name)
+                if isinstance(tgt, Tensor):
+                    # keep the target's GSPMD layout (the load_state_dict
+                    # resharding contract — restored arrays must not come
+                    # back replicated on the default device)
+                    arr = jax.device_put(arr, tgt.data.sharding)
+                sd[name] = Tensor(arr)
+            # strict for Layers: a checkpoint missing model keys must not
+            # silently resume from random init (optimizers create their
+            # accumulator keys lazily, so absence there is normal)
+            missing = [k for k, v in fresh.items()
+                       if isinstance(v, Tensor) and k not in sd
+                       and not hasattr(obj, "_parameter_list")]
+            if missing:
+                raise KeyError(
+                    f"auto_checkpoint '{self.name}' epoch {epoch}: "
+                    f"checkpoint for '{key}' lacks {missing[:5]}"
+                    f"{'...' if len(missing) > 5 else ''}")
             meta_path = os.path.join(kdir, "meta.json")
             if os.path.exists(meta_path):
                 with open(meta_path) as f:
                     sd.update({to_name(k): v
                                for k, v in json.load(f).items()})
+            pkl_path = os.path.join(kdir, "meta.pkl")
+            if os.path.exists(pkl_path):
+                import pickle
+
+                with open(pkl_path, "rb") as f:
+                    sd.update({to_name(k): v
+                               for k, v in pickle.load(f).items()})
             obj.set_state_dict(sd)
         self.restored_from = epoch
 
@@ -147,8 +177,18 @@ class _EpochRange:
             meta = {k: v for k, v in sd.items() if k not in tensors}
             kdir = os.path.join(edir, key)
             save_state_dict(tensors, kdir)
-            with open(os.path.join(kdir, "meta.json"), "w") as f:
-                json.dump(meta, f)
+            # json when possible (inspectable); pickle fallback for
+            # scheduler state holding callables (LambdaDecay.lr_lambda,
+            # LinearWarmup.lr_after)
+            try:
+                payload = json.dumps(meta)
+                with open(os.path.join(kdir, "meta.json"), "w") as f:
+                    f.write(payload)
+            except TypeError:
+                import pickle
+
+                with open(os.path.join(kdir, "meta.pkl"), "wb") as f:
+                    pickle.dump(meta, f)
         # atomic marker LAST: a crash mid-save resumes from the prior epoch
         self._write_marker(epoch)
         # keep the two newest SAVED checkpoints (save_interval gaps mean

@@ -98,8 +98,10 @@ def compose(*readers, **kwargs):
 
 def buffered(reader, size):
     """Background thread keeps up to `size` samples ready
-    (decorator.py:308)."""
+    (decorator.py:308). Producer exceptions re-raise in the consumer —
+    a failed read must not look like a shorter dataset."""
     end = object()
+    fail = object()
 
     def creator():
         q = _queue.Queue(maxsize=size)
@@ -108,8 +110,9 @@ def buffered(reader, size):
             try:
                 for s in reader():
                     q.put(s)
-            finally:
                 q.put(end)
+            except BaseException as e:  # forward, don't truncate
+                q.put((fail, e))
 
         t = threading.Thread(target=fill, daemon=True)
         t.start()
@@ -117,6 +120,8 @@ def buffered(reader, size):
             s = q.get()
             if s is end:
                 break
+            if isinstance(s, tuple) and len(s) == 2 and s[0] is fail:
+                raise s[1]
             yield s
     return creator
 
@@ -133,16 +138,22 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     (decorator.py:412 — the reference's workers are threads too);
     order=True preserves input order."""
     end = object()
+    fail = object()
 
     def creator():
         in_q = _queue.Queue(buffer_size)
         out_q = _queue.Queue(buffer_size)
 
         def feed():
-            for i, s in enumerate(reader()):
-                in_q.put((i, s))
-            for _ in range(process_num):
-                in_q.put(end)
+            try:
+                for i, s in enumerate(reader()):
+                    in_q.put((i, s))
+                for _ in range(process_num):
+                    in_q.put(end)
+            except BaseException as e:  # source died: wake every worker
+                out_q.put((fail, e))
+                for _ in range(process_num):
+                    in_q.put(end)
 
         def work():
             while True:
@@ -151,16 +162,28 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(end)
                     return
                 i, s = item
-                out_q.put((i, mapper(s)))
+                try:
+                    out_q.put((i, mapper(s)))
+                except BaseException as e:  # mapper died: surface, exit
+                    out_q.put((fail, e))
+                    out_q.put(end)
+                    return
 
         threading.Thread(target=feed, daemon=True).start()
         for _ in range(process_num):
             threading.Thread(target=work, daemon=True).start()
 
+        def next_item():
+            item = out_q.get()
+            if isinstance(item, tuple) and len(item) == 2 and \
+                    item[0] is fail:
+                raise item[1]
+            return item
+
         finished = 0
         if not order:
             while finished < process_num:
-                item = out_q.get()
+                item = next_item()
                 if item is end:
                     finished += 1
                     continue
@@ -173,7 +196,7 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                 yield pending.pop(want)
                 want += 1
                 continue
-            item = out_q.get()
+            item = next_item()
             if item is end:
                 finished += 1
                 continue
@@ -188,7 +211,9 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     """Fan-in several readers from fork'd worker processes
     (decorator.py:505). Workers must only touch fork-safe state (numpy,
-    files) — the same contract as the DataLoader workers."""
+    files) — the same contract as the DataLoader workers. Samples ride
+    tagged tuples so a None sample is data and a worker crash raises
+    in the consumer instead of truncating the stream."""
     import multiprocessing as mp
 
     def creator():
@@ -197,9 +222,10 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         def work(r):
             try:
                 for s in r():
-                    q.put(s)
-            finally:
-                q.put(None)
+                    q.put(("S", s))
+                q.put(("E", None))
+            except BaseException as e:  # cross-process: send the repr
+                q.put(("F", f"{type(e).__name__}: {e}"))
 
         procs = [mp.Process(target=work, args=(r,), daemon=True)
                  for r in readers]
@@ -207,11 +233,14 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             p.start()
         finished = 0
         while finished < len(readers):
-            s = q.get()
-            if s is None:
+            tag, val = q.get()
+            if tag == "E":
                 finished += 1
-                continue
-            yield s
+            elif tag == "F":
+                raise RuntimeError(
+                    f"multiprocess_reader worker failed: {val}")
+            else:
+                yield val
         for p in procs:
             p.join(timeout=5)
     return creator

@@ -35,6 +35,11 @@ def test_ps_recsys_example():
     assert "epoch 2" in out
 
 
+def test_train_moe_tiny():
+    out = _run(["examples/train_moe.py", "--tiny", "--steps", "6"])
+    assert "OK" in out
+
+
 def test_generate_gpt_example():
     out = _run(["examples/generate_gpt.py"])
     assert "OK" in out

@@ -65,7 +65,46 @@ def test_reader_xmap_order():
     assert sorted(unordered) == out
 
 
+def test_reader_errors_surface_not_truncate():
+    from paddle_tpu import reader
+
+    def bad():
+        yield 1
+        raise ValueError("corrupt sample")
+
+    with pytest.raises(ValueError, match="corrupt sample"):
+        list(reader.buffered(lambda: bad(), 2)())
+    with pytest.raises(ZeroDivisionError):
+        list(reader.xmap_readers(lambda x: 1 // x,
+                                 lambda: iter([1, 0, 2]), 2, 4)())
+    with pytest.raises(ValueError, match="corrupt sample"):
+        list(reader.xmap_readers(lambda x: x, lambda: bad(), 2, 4,
+                                 order=True)())
+
+
+def test_multiprocess_reader_none_and_errors():
+    from paddle_tpu import reader
+
+    r = reader.multiprocess_reader([lambda: iter([1, None, 2])])
+    assert list(r()) == [1, None, 2]  # None is data, not a sentinel
+
+    def crashing():
+        yield 1
+        raise RuntimeError("worker exploded")
+
+    with pytest.raises(RuntimeError, match="worker failed"):
+        list(reader.multiprocess_reader([lambda: crashing()])())
+
+
 # -- dataset ------------------------------------------------------------------
+def test_dataset_cifar_reference_split_names():
+    for name in ("train10", "test10", "train100", "test100"):
+        assert callable(getattr(paddle.dataset.cifar, name))
+    with pytest.raises(AttributeError):
+        paddle.dataset.cifar.train  # the legacy API has no plain train()
+
+
+
 def test_dataset_facade_wraps_text_datasets(tmp_path):
     rng = np.random.RandomState(0)
     rows = rng.rand(50, 14).astype("float32")
