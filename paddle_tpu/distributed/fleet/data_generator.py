@@ -15,7 +15,7 @@ sparse ids stay ragged lists, the embedding pull pads per batch.
 from __future__ import annotations
 
 import sys
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import Iterable, List, Optional, Sequence
 
 import numpy as np
 

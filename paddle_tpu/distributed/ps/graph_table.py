@@ -10,7 +10,7 @@ sampling results feed the jit'ed GNN compute path as ordinary arrays
 (data-dependent shapes stay OUTSIDE jit by design, like every io path)."""
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
