@@ -763,26 +763,94 @@ def _spawn(name: str, timeout=1200):
     raise RuntimeError(f"bench config {name} failed:\n{r.stderr[-2000:]}")
 
 
+# keys too large for the driver-parsed line (r4's parse failure was an
+# oversized single line); they live in the artifact file instead
+_HEAVY_KEYS = ("device_op_table", "op_table", "losses_tpu", "losses_cpu",
+               "dispatch_probe")
+
+
+def _compact(obj):
+    """Strip bulky sub-objects so a printed line stays parseable-small."""
+    if isinstance(obj, dict):
+        return {k: _compact(v) for k, v in obj.items()
+                if k not in _HEAVY_KEYS}
+    if isinstance(obj, list):
+        return obj if len(obj) <= 16 else obj[:16]
+    return obj
+
+
+def _headline(big, detail):
+    line = json.dumps({
+        "metric": "llama_pretrain_mfu",
+        "value": big["mfu"],
+        "unit": "%",
+        "vs_baseline": round(big["mfu"] / 38.0, 3),
+        "detail": _compact(detail),
+    })
+    if len(line) > 8000:  # belt and braces: never print an unparseable blob
+        line = json.dumps({
+            "metric": "llama_pretrain_mfu", "value": big["mfu"],
+            "unit": "%", "vs_baseline": round(big["mfu"] / 38.0, 3),
+            "detail": {"truncated": True,
+                       "see": "bench_artifacts/bench_progress.json"}})
+    return line
+
+
+def _write_artifact(detail):
+    try:
+        os.makedirs("bench_artifacts", exist_ok=True)
+        tmp = os.path.join("bench_artifacts", ".bench_progress.tmp")
+        with open(tmp, "w") as f:
+            json.dump(detail, f, indent=1)
+        os.replace(tmp, os.path.join("bench_artifacts",
+                                     "bench_progress.json"))
+    except OSError:
+        pass  # artifact bookkeeping must never sink the bench
+
+
 def main():
+    """Driver contract (two rounds of parsed=null taught us this shape):
+
+    - the flagship runs FIRST and its compact headline JSON line prints
+      IMMEDIATELY (flushed) — a later wall-clock kill still leaves a
+      parseable result on stdout;
+    - after every additional recipe the headline reprints with the detail
+      accumulated SO FAR (compact: heavy tables live in
+      bench_artifacts/bench_progress.json), so the last line on stdout is
+      always the most complete parseable result;
+    - slow capacity/parity legs (10-90 min each) only run with --full or
+      BENCH_FULL=1: the default run fits a CI budget.
+    """
     import jax
 
     from paddle_tpu.models import LlamaConfig
 
+    full = "--full" in sys.argv or \
+        os.environ.get("BENCH_FULL", "") in ("1", "true")
     on_tpu = jax.devices()[0].platform != "cpu"
     if not on_tpu:  # CI smoke on CPU
         big = _measure(LlamaConfig.tiny(), batch=2, seq=64, iters=2)
         detail = dict(big)
         detail["platform"] = jax.devices()[0].platform
-        print(json.dumps({"metric": "llama_pretrain_mfu", "value": big["mfu"],
-                          "unit": "%",
-                          "vs_baseline": round(big["mfu"] / 38.0, 3),
-                          "detail": detail}))
+        _write_artifact(detail)  # same artifact contract as the TPU path
+        print(_headline(big, detail), flush=True)
         return
 
-    big = _spawn("big")
+    big = _spawn("big", timeout=1500)
     detail = dict(big)
     detail["platform"] = "tpu"
-    try:
+    print(_headline(big, detail), flush=True)  # the early headline
+    _write_artifact(detail)
+
+    def leg(key, fn):
+        try:
+            fn()
+        except Exception as e:
+            detail[f"{key}_error"] = str(e)[:300]
+        _write_artifact(detail)
+        print(_headline(big, detail), flush=True)
+
+    def _adafactor():
         big_model = _spawn("adafactor_1p8b")
         detail["adafactor_1p8b"] = big_model
         detail["hbm_envelope"] = {
@@ -790,73 +858,72 @@ def main():
             "method": "OOM bisection (memory_stats unavailable via tunnel)",
             "resident_max_params_m": big_model["params_m"],
             "oom_resident_2p0b": True, "oom_offload_2p1b": True}
-    except Exception as e:
-        detail["adafactor_1p8b_error"] = str(e)[:300]
-    try:
-        detail["long_seq_16k"] = _spawn("long_seq_16k")
-    except Exception as e:
-        detail["long_seq_16k_error"] = str(e)[:300]
-    try:
-        detail["compat_374m"] = _spawn("compat_374m")
-    except Exception as e:
-        detail["compat_374m_error"] = str(e)[:300]
-    try:
+
+    leg("adafactor_1p8b", _adafactor)
+    leg("long_seq_16k",
+        lambda: detail.__setitem__("long_seq_16k", _spawn("long_seq_16k")))
+    leg("compat_374m",
+        lambda: detail.__setitem__("compat_374m", _spawn("compat_374m")))
+
+    def _moe():
         detail["moe"] = _spawn("moe")
         try:
             detail["moe"]["cf1_variant"] = _spawn("moe_cf1")
         except Exception as e:
             detail["moe"]["cf1_variant_error"] = str(e)[:300]
-    except Exception as e:
-        detail["moe_error"] = str(e)[:300]
-    try:
-        detail["dit"] = _spawn("dit")
-    except Exception as e:
-        detail["dit_error"] = str(e)[:300]
-    try:
-        # BASELINE config 1: parity (the child spawns the CPU-ref
-        # grandchild, which trains on 1 CPU core — generous budget)
-        detail["resnet_cifar"] = _spawn("resnet_cifar", timeout=3600)
-    except Exception as e:
-        detail["resnet_cifar_error"] = str(e)[:300]
-    try:
-        detail["bert_finetune"] = _spawn("bert_finetune", timeout=2400)
-    except Exception as e:
-        detail["bert_finetune_error"] = str(e)[:300]
-    try:
-        detail["seg_capacity"] = _spawn("seg_capacity", timeout=3600)
-        detail.setdefault("hbm_envelope", {})["segmented_max_params_b"] = \
-            detail["seg_capacity"]["params_b"]
-    except Exception as e:
-        detail["seg_capacity_error"] = str(e)[:300]
-    try:
-        # BASELINE config 3 architecture (Llama-2-7B) as a single-chip
-        # capacity row — slow by nature (host-link bound), own budget
-        detail["llama7b_seg"] = _spawn("llama7b_seg", timeout=5400)
-        detail.setdefault("hbm_envelope", {})["segmented_llama7b"] = True
-    except Exception as e:
-        detail["llama7b_seg_error"] = str(e)[:300]
-    try:
-        # host-side init + the layerwise-streaming compile are slow by
-        # nature; give this capacity demo its own generous budget
-        detail["stream_capacity"] = _spawn("stream_capacity", timeout=3000)
-        detail["hbm_envelope"] = dict(
-            detail.get("hbm_envelope", {}),
-            streamed_max_params_b=detail["stream_capacity"]["params_b"],
-            streamed_step_time_s=detail["stream_capacity"]["step_time_s"],
-            note="resident ceiling 1.83B (2.0B OOMs); streamed pinned-host "
-                 "offload trains 3.08B on the same chip; larger sizes stop "
-                 "in the compiler's memory-space pass, which HBM-places the "
-                 "grad chains (18.7G estimate at 4B)")
-    except Exception as e:
-        detail["stream_capacity_error"] = str(e)[:300]
-    result = {
-        "metric": "llama_pretrain_mfu",
-        "value": big["mfu"],
-        "unit": "%",
-        "vs_baseline": round(big["mfu"] / 38.0, 3),
-        "detail": detail,
-    }
-    print(json.dumps(result))
+
+    leg("moe", _moe)
+    leg("dit", lambda: detail.__setitem__("dit", _spawn("dit")))
+
+    if full:
+        def _resnet():
+            # BASELINE config 1: parity (the child spawns the CPU-ref
+            # grandchild, which trains on 1 CPU core — generous budget)
+            detail["resnet_cifar"] = _spawn("resnet_cifar", timeout=3600)
+
+        leg("resnet_cifar", _resnet)
+        leg("bert_finetune", lambda: detail.__setitem__(
+            "bert_finetune", _spawn("bert_finetune", timeout=2400)))
+
+        def _seg():
+            detail["seg_capacity"] = _spawn("seg_capacity", timeout=3600)
+            detail.setdefault("hbm_envelope", {})["segmented_max_params_b"] \
+                = detail["seg_capacity"]["params_b"]
+
+        leg("seg_capacity", _seg)
+
+        def _llama7b():
+            # BASELINE config 3 architecture (Llama-2-7B) as a single-chip
+            # capacity row — slow by nature (host-link bound), own budget
+            detail["llama7b_seg"] = _spawn("llama7b_seg", timeout=5400)
+            detail.setdefault("hbm_envelope", {})["segmented_llama7b"] = True
+
+        leg("llama7b_seg", _llama7b)
+
+        def _stream():
+            # host-side init + the layerwise-streaming compile are slow by
+            # nature; give this capacity demo its own generous budget
+            detail["stream_capacity"] = _spawn("stream_capacity",
+                                               timeout=3000)
+            detail["hbm_envelope"] = dict(
+                detail.get("hbm_envelope", {}),
+                streamed_max_params_b=detail["stream_capacity"]["params_b"],
+                streamed_step_time_s=detail["stream_capacity"]["step_time_s"],
+                note="resident ceiling 1.83B (2.0B OOMs); streamed "
+                     "pinned-host offload trains 3.08B on the same chip; "
+                     "larger sizes stop in the compiler's memory-space "
+                     "pass, which HBM-places the grad chains (18.7G "
+                     "estimate at 4B)")
+
+        leg("stream_capacity", _stream)
+    else:
+        detail["skipped_legs"] = {
+            "names": ["resnet_cifar", "bert_finetune", "seg_capacity",
+                      "llama7b_seg", "stream_capacity"],
+            "reason": "slow capacity/parity legs; rerun with --full or "
+                      "BENCH_FULL=1 (rows land in bench_artifacts/)"}
+        _write_artifact(detail)
+        print(_headline(big, detail), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,40 @@
+"""paddle_tpu.analysis — jaxpr-level static checking, no chip required.
+
+The analysis half of the reference's graph-IR pass framework (SURVEY
+§2.1), rebuilt TPU-native: the IR is the jaxpr jax already builds, and
+every pass inspects traced programs WITHOUT running them.
+
+    import paddle_tpu.analysis as A
+
+    prog  = A.capture(step, x, y)          # TrainStep/callable -> op-graph
+    diags = A.run_passes(prog)             # memory + spmd lints
+    print(A.render(diags))
+
+    A.retrace.enable()                     # or PT_RETRACE_AUDIT=1
+    ... train ...
+    print(A.render(A.retrace.report()))    # why did it recompile?
+
+    A.selfcheck.run_selfcheck()            # repo footgun lint (CI)
+
+CLI: ``python tools/pd_check.py [--self]``.
+"""
+from __future__ import annotations
+
+from .diagnostics import Diagnostic, max_severity, render, to_json  # noqa: F401
+from .program import OpNode, Program, capture, run_passes, PASSES  # noqa: F401
+from . import memory  # noqa: F401  (registers the "memory" pass)
+from . import spmd  # noqa: F401    (registers the "spmd" pass)
+from . import retrace  # noqa: F401
+from . import selfcheck  # noqa: F401
+from .memory import (HBM_BYTES, PeakEstimate, estimate_peak,  # noqa: F401
+                     estimate_train_step_hbm)
+
+__all__ = [
+    "Diagnostic", "max_severity", "render", "to_json",
+    "OpNode", "Program", "capture", "run_passes", "PASSES",
+    "memory", "spmd", "retrace", "selfcheck",
+    "HBM_BYTES", "PeakEstimate", "estimate_peak", "estimate_train_step_hbm",
+]
+
+# env-gated retrace audit (default off; zero overhead unless set)
+retrace._maybe_enable_from_env()

@@ -1,0 +1,93 @@
+"""Structured findings for the static-analysis passes.
+
+Reference role: the pass-level diagnostics of the graph-IR pass framework
+(paddle/fluid/framework/ir/pass.h reports per-pass graph violations at
+compile time). TPU-native mapping: every `paddle_tpu.analysis` pass returns
+a flat list of `Diagnostic` records — severity, stable code, offending op,
+source location, suggested fix — that render identically from the library
+API, `tools/pd_check.py`, and CI.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["Diagnostic", "render", "max_severity", "to_json",
+           "SEVERITIES"]
+
+# ordered weakest -> strongest; max_severity() compares by index
+SEVERITIES = ("info", "warning", "error")
+
+
+@dataclass
+class Diagnostic:
+    """One finding from one analysis pass.
+
+    code is stable across releases (tests and suppressions key on it):
+      RTxxx retrace, SPxxx spmd, MMxxx memory, SLxxx selfcheck, PGxxx program.
+    """
+
+    severity: str                      # "info" | "warning" | "error"
+    code: str                          # e.g. "SP002"
+    pass_name: str                     # "retrace" | "spmd" | "memory" | ...
+    message: str
+    op: Optional[str] = None           # primitive / op name, when applicable
+    location: Optional[str] = None     # "file:line" (user frame)
+    suggestion: Optional[str] = None   # short actionable fix
+    data: Dict = field(default_factory=dict)  # pass-specific structured extras
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(f"bad severity {self.severity!r}")
+
+    def to_dict(self) -> Dict:
+        d = {"severity": self.severity, "code": self.code,
+             "pass": self.pass_name, "message": self.message}
+        for k in ("op", "location", "suggestion"):
+            v = getattr(self, k)
+            if v is not None:
+                d[k] = v
+        if self.data:
+            d["data"] = self.data
+        return d
+
+    def render(self) -> str:
+        loc = f" [{self.location}]" if self.location else ""
+        op = f" op={self.op}" if self.op else ""
+        fix = f"\n    fix: {self.suggestion}" if self.suggestion else ""
+        return (f"{self.severity.upper():7s} {self.code} ({self.pass_name})"
+                f"{op}{loc}: {self.message}{fix}")
+
+
+def max_severity(diags: List[Diagnostic]) -> Optional[str]:
+    """Strongest severity present, or None for a clean run."""
+    if not diags:
+        return None
+    return SEVERITIES[max(SEVERITIES.index(d.severity) for d in diags)]
+
+
+def render(diags: List[Diagnostic], header: Optional[str] = None) -> str:
+    """Human renderer: one block per pass, errors first within a pass."""
+    lines: List[str] = []
+    if header:
+        lines.append(header)
+    if not diags:
+        lines.append("clean: no findings")
+        return "\n".join(lines)
+    by_pass: Dict[str, List[Diagnostic]] = {}
+    for d in diags:
+        by_pass.setdefault(d.pass_name, []).append(d)
+    for pname in sorted(by_pass):
+        group = sorted(by_pass[pname],
+                       key=lambda d: -SEVERITIES.index(d.severity))
+        lines.append(f"-- {pname}: {len(group)} finding(s)")
+        lines.extend("  " + d.render() for d in group)
+    counts = {s: sum(1 for d in diags if d.severity == s) for s in SEVERITIES}
+    lines.append("summary: " + ", ".join(
+        f"{counts[s]} {s}" for s in reversed(SEVERITIES) if counts[s]))
+    return "\n".join(lines)
+
+
+def to_json(diags: List[Diagnostic]) -> str:
+    return json.dumps([d.to_dict() for d in diags])

@@ -1,0 +1,285 @@
+"""Trace-cache auditor: names the cache-key delta behind every recompile.
+
+Reference role: the reference logs kernel-cache misses per KernelKey
+(paddle/phi/core/kernel_factory); on TPU the analogous silent perf killer
+is a retrace — a jax.jit cache miss caused by shape / dtype / weak-type /
+static-attr drift, which recompiles an XLA executable mid-training and
+shows up only as mysteriously slow steps (the flat-MFU failure mode).
+
+This auditor hooks the two trace-cache layers the framework owns:
+
+- the per-(op, attrs) jit caches in ``core.dispatch`` (eager path), via
+  ``dispatch.install_audit_hook`` — a sanctioned extension point that is a
+  single ``is None`` check when auditing is off;
+- the whole-step compilers (``jit.TrainStep`` family, ``to_static``), via
+  ``jit._TRACE_AUDIT_HOOK`` wrapping each freshly built jitted callable.
+
+Default OFF. Enable with ``analysis.retrace.enable()`` or the env flag
+``PT_RETRACE_AUDIT=1`` (checked once at ``paddle_tpu.analysis`` import).
+When disabled nothing is wrapped and the hot dispatch path is untouched.
+
+Every call records the abstract signature (shape, dtype, weak-type) of its
+array leaves; the FIRST signature per cache key is the baseline compile,
+every subsequent new signature is a retrace event annotated with the
+per-leaf delta against the closest previously seen signature — the "why"
+of the recompile.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["RetraceEvent", "RetraceAuditor", "enable", "disable",
+           "is_enabled", "get_auditor", "report", "reset"]
+
+
+def _leaf_sig(x) -> Tuple:
+    """(shape, dtype, weak_type) for an array-ish leaf; scalars are weak."""
+    try:
+        import jax
+
+        aval = jax.api_util.shaped_abstractify(x)
+        return (tuple(aval.shape), str(aval.dtype),
+                bool(getattr(aval, "weak_type", False)))
+    except Exception:
+        return ("static", repr(type(x)), False)
+
+
+def _signature(args) -> Tuple:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(args)
+    return tuple(_leaf_sig(a) if hasattr(a, "dtype")
+                 or isinstance(a, (int, float, complex, bool))
+                 else ("static", repr(a)[:64], False) for a in leaves)
+
+
+def _sig_delta(old: Tuple, new: Tuple) -> List[str]:
+    """Human-readable per-leaf drift between two signatures."""
+    out: List[str] = []
+    if len(old) != len(new):
+        out.append(f"leaf count {len(old)} -> {len(new)}")
+    for i, (o, n) in enumerate(zip(old, new)):
+        if o == n:
+            continue
+        parts = []
+        if o[0] != n[0]:
+            parts.append(f"shape {o[0]} -> {n[0]}")
+        if len(o) > 1 and len(n) > 1 and o[1] != n[1]:
+            parts.append(f"dtype {o[1]} -> {n[1]}")
+        if len(o) > 2 and len(n) > 2 and o[2] != n[2]:
+            parts.append(f"weak_type {o[2]} -> {n[2]}")
+        if not parts:
+            parts.append(f"{o} -> {n}")
+        out.append(f"leaf[{i}]: " + ", ".join(parts))
+    return out
+
+
+def _key_delta(old: Tuple, new: Tuple) -> List[str]:
+    """Positional drift between two python-level cache keys (attr tuples)."""
+    out: List[str] = []
+    if len(old) != len(new):
+        out.append(f"key arity {len(old)} -> {len(new)}")
+    for i, (o, n) in enumerate(zip(old, new)):
+        if o != n:
+            out.append(f"key[{i}]: {o!r} -> {n!r}")
+    return out
+
+
+def _closest(sigs: Sequence[Tuple], new: Tuple) -> Tuple:
+    """Previously seen signature with the fewest differing leaves."""
+    def dist(s):
+        if len(s) != len(new):
+            return 1 + abs(len(s) - len(new)) + len(new)
+        return sum(1 for a, b in zip(s, new) if a != b)
+
+    return min(sigs, key=dist)
+
+
+@dataclass
+class RetraceEvent:
+    label: str                     # "op:add fwd", "TrainStep", "to_static:..."
+    kind: str                      # "signature-drift" | "new-cache-key"
+    deltas: List[str]              # per-leaf / per-attr reasons
+    n_prior_traces: int
+    data: Dict[str, Any] = field(default_factory=dict)
+
+    def why(self) -> str:
+        return "; ".join(self.deltas) or "unknown delta"
+
+
+class RetraceAuditor:
+    """Singleton recorder. All state lives here so tests can reset it."""
+
+    def __init__(self):
+        self.events: List[RetraceEvent] = []
+        self._sigs: Dict[str, List[Tuple]] = {}
+        self._attr_keys: Dict[str, List[Tuple]] = {}   # op name -> attr keys
+        self._wrapped: Dict[int, Any] = {}             # id(fn) -> wrapper
+        self.enabled = False
+
+    # -- recording ------------------------------------------------------------
+    def record_call(self, label: str, args) -> None:
+        sig = _signature(args)
+        seen = self._sigs.setdefault(label, [])
+        if sig in seen:
+            return
+        if seen:
+            prev = _closest(seen, sig)
+            self.events.append(RetraceEvent(
+                label=label, kind="signature-drift",
+                deltas=_sig_delta(prev, sig),
+                n_prior_traces=len(seen)))
+        seen.append(sig)
+
+    def record_new_key(self, op_name: str, key: Tuple,
+                       label: Optional[str] = None) -> None:
+        """A new python-level cache key for an op family (attrs drift) —
+        each is a fresh jit cache, i.e. a guaranteed compile."""
+        keys = self._attr_keys.setdefault(op_name, [])
+        if key in keys:
+            return
+        if keys:
+            prev = _closest(keys, key)
+            self.events.append(RetraceEvent(
+                label=label or f"op:{op_name}", kind="new-cache-key",
+                deltas=_key_delta(prev, key) or
+                [f"attrs {prev!r} -> {key!r}"],
+                n_prior_traces=len(keys)))
+        keys.append(key)
+
+    # -- wrapping -------------------------------------------------------------
+    def wrap(self, label: str, fn):
+        """Return a call-recording wrapper for a jitted callable (cached so
+        repeated cache hits reuse one wrapper)."""
+        w = self._wrapped.get(id(fn))
+        if w is not None:
+            return w
+
+        def audited(*args, **kwargs):
+            # wrappers outlive disable() inside TrainStep._jitted /
+            # StaticLayer._cache — the flag check keeps them inert (and
+            # near-free) once auditing is off
+            if self.enabled:
+                self.record_call(label,
+                                 (args, tuple(sorted(kwargs.items()))))
+            return fn(*args, **kwargs)
+
+        audited.__wrapped__ = fn
+        self._wrapped[id(fn)] = audited
+        return audited
+
+    # -- reporting ------------------------------------------------------------
+    def report(self) -> List[Diagnostic]:
+        diags: List[Diagnostic] = []
+        for ev in self.events:
+            sev = "warning" if ev.n_prior_traces >= 1 else "info"
+            code = "RT001" if ev.kind == "signature-drift" else "RT002"
+            diags.append(Diagnostic(
+                severity=sev, code=code, pass_name="retrace",
+                op=ev.label,
+                message=(f"recompile #{ev.n_prior_traces} of {ev.label}: "
+                         f"{ev.why()}"),
+                suggestion=("pin input shapes/dtypes (pad batches, cast "
+                            "before the step) or hoist the drifting attr "
+                            "out of the cache key"),
+                data={"kind": ev.kind, "deltas": ev.deltas}))
+        return diags
+
+    def summary(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled,
+                "tracked_keys": len(self._sigs) + len(self._attr_keys),
+                "retrace_events": len(self.events)}
+
+    def reset(self) -> None:
+        self.events.clear()
+        self._sigs.clear()
+        self._attr_keys.clear()
+        self._wrapped.clear()
+
+
+_AUDITOR = RetraceAuditor()
+
+
+def get_auditor() -> RetraceAuditor:
+    return _AUDITOR
+
+
+def is_enabled() -> bool:
+    return _AUDITOR.enabled
+
+
+# -- dispatch/jit hook plumbing ----------------------------------------------
+
+_KEY_LABELS: Dict[Tuple, str] = {}
+
+
+def _dispatch_hook(op_name: str, stage: str, key: Tuple, jitted):
+    base = f"op:{op_name} {stage}"
+    _AUDITOR.record_new_key(op_name, key, label=base)
+    # signature buckets are PER jit cache (op, attrs): pooling attr
+    # variants under one label would report phantom signature drift for
+    # compiles that each happened exactly once
+    label = _KEY_LABELS.get((stage, key))
+    if label is None:
+        label = f"{base}/k{len(_KEY_LABELS)}"
+        _KEY_LABELS[(stage, key)] = label
+    return _AUDITOR.wrap(label, jitted)
+
+
+def _jit_hook(label: str, jitted):
+    return _AUDITOR.wrap(label, jitted)
+
+
+def _jit_key_hook(label: str, key: Tuple):
+    _AUDITOR.record_new_key(label, key, label=label)
+
+
+def enable() -> RetraceAuditor:
+    """Install the audit hooks (idempotent). Returns the auditor."""
+    if _AUDITOR.enabled:
+        return _AUDITOR
+    from ..core import dispatch as dispatch_mod
+
+    dispatch_mod.install_audit_hook(_dispatch_hook)
+    from .. import jit as jit_mod
+
+    jit_mod._TRACE_AUDIT_HOOK = _jit_hook
+    jit_mod._TRACE_NEWKEY_HOOK = _jit_key_hook
+    _AUDITOR.enabled = True
+    return _AUDITOR
+
+
+def disable() -> None:
+    """Remove the hooks; recorded events are kept until reset()."""
+    if not _AUDITOR.enabled:
+        return
+    from ..core import dispatch as dispatch_mod
+
+    dispatch_mod.install_audit_hook(None)
+    from .. import jit as jit_mod
+
+    jit_mod._TRACE_AUDIT_HOOK = None
+    jit_mod._TRACE_NEWKEY_HOOK = None
+    _AUDITOR.enabled = False
+    # wrappers cached by callers (TrainStep._jitted, StaticLayer._cache)
+    # go inert via the enabled flag; drop OUR references so discarded
+    # jitted executables can be GC'd instead of living in this map forever
+    _AUDITOR._wrapped.clear()
+
+
+def reset() -> None:
+    _AUDITOR.reset()
+    _KEY_LABELS.clear()
+
+
+def report() -> List[Diagnostic]:
+    return _AUDITOR.report()
+
+
+def _maybe_enable_from_env() -> None:
+    if os.environ.get("PT_RETRACE_AUDIT", "").strip() in ("1", "true", "on"):
+        enable()

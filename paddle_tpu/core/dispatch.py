@@ -31,6 +31,19 @@ _BWD_CACHE: Dict[Tuple, Callable] = {}
 
 _REGISTRY: Dict[str, "Primitive"] = {}
 
+# Trace-cache audit extension point (paddle_tpu.analysis.retrace). When
+# installed, fwd/bwd route their jitted callables through the hook so the
+# auditor can attribute recompiles to cache-key drift. A single `is None`
+# check when auditing is off — the default hot path is untouched.
+_AUDIT_HOOK: Optional[Callable] = None
+
+
+def install_audit_hook(hook: Optional[Callable]) -> None:
+    """hook(op_name, stage, cache_key, jitted_fn) -> callable, or None to
+    uninstall. Installed by analysis.retrace.enable()."""
+    global _AUDIT_HOOK
+    _AUDIT_HOOK = hook
+
 
 def _hashable(v):
     if isinstance(v, (list, tuple)):
@@ -76,6 +89,8 @@ class Primitive:
         if f is None:
             f = jax.jit(functools.partial(self.fn, **attrs))
             _FWD_CACHE[key] = f
+        if _AUDIT_HOOK is not None:
+            return _AUDIT_HOOK(self.name, "fwd", key, f)
         return f
 
     # -- backward -----------------------------------------------------------
@@ -101,6 +116,8 @@ class Primitive:
 
                 b = jax.jit(b)
             _BWD_CACHE[key] = b
+        if _AUDIT_HOOK is not None:
+            return _AUDIT_HOOK(self.name, "bwd", key, b)
         return b
 
     def __call__(self, *args, **attrs):

@@ -23,7 +23,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from .mesh import MeshEnv, get_mesh_env
+from .mesh import (MeshEnv, get_mesh_env, shard_map_compat,
+                   shard_map_requires_native)
 
 
 def _merge(o1, lse1, o2, lse2):
@@ -96,7 +97,8 @@ def ring_attention_bhsd(q, k, v, causal=True, scale=None,
     def local(ql, kl, vl):
         return _ring_local(ql, kl, vl, cp, causal, float(scale), axis)
 
-    return jax.shard_map(
+    shard_map_requires_native({axis}, env)  # pallas inside the manual region
+    return shard_map_compat(
         local, mesh=env.mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis), axis_names={axis}, check_vma=False,
@@ -166,7 +168,8 @@ def ulysses_attention_bshd(q, k, v, causal=True, scale=None,
         # [b, s, h/cp, d] -> [b, s/cp, h, d]: scatter sequence, gather heads
         return lax.all_to_all(oh, axis, split_axis=1, concat_axis=2, tiled=True)
 
-    return jax.shard_map(
+    shard_map_requires_native({axis}, env)  # pallas inside the manual region
+    return shard_map_compat(
         local, mesh=env.mesh,
         in_specs=(P(None, axis), P(None, axis), P(None, axis)),
         out_specs=P(None, axis), axis_names={axis}, check_vma=False,

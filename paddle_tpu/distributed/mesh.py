@@ -29,6 +29,54 @@ AXES = ("dp", "pp", "sdp", "mp", "cp", "ep")
 _GLOBAL: Dict[str, Optional[object]] = {"env": None}
 
 
+def _auto_axes(mesh, axis_names) -> frozenset:
+    """Mesh axes that must stay AUTO (GSPMD) for a shard_map manual over
+    `axis_names`. Size-1 axes are harmless to treat as manual, so they are
+    excluded — which routes pure-manual meshes down the (much better
+    supported) full-manual path of the older shard_map."""
+    sizes = dict(mesh.shape)
+    return frozenset(ax for ax in mesh.axis_names
+                     if ax not in axis_names and sizes.get(ax, 1) > 1)
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs, axis_names=None,
+                     check_vma=False):
+    """`jax.shard_map` (the jax>=0.8 surface: axis_names = the manual set,
+    check_vma) over whatever this jax provides. Older jax spells the same
+    thing `jax.experimental.shard_map.shard_map(check_rep=..., auto=...)`
+    with auto = the complement of the manual set."""
+    if hasattr(jax, "shard_map"):
+        kw = {"check_vma": check_vma}
+        if axis_names:
+            kw["axis_names"] = set(axis_names)
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {"check_rep": bool(check_vma)}
+    if axis_names:
+        auto = _auto_axes(mesh, axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def shard_map_requires_native(axis_names, env) -> None:
+    """Raise a clear error when a partial-auto shard_map over THIS mesh
+    cannot work on an older jax (no jax.shard_map): kernels inside the
+    manual region crash the 0.4-era partial-auto lowering outright."""
+    if hasattr(jax, "shard_map"):
+        return
+    auto = _auto_axes(env.mesh, axis_names)
+    if auto:
+        raise NotImplementedError(
+            f"this operation needs a partial-auto shard_map (manual over "
+            f"{sorted(axis_names)}, auto over {sorted(auto)}) which this "
+            f"jax ({jax.__version__}) cannot lower reliably; upgrade jax "
+            f"or collapse the auto axes to size 1")
+
+
 class MeshEnv:
     """The live mesh + axis degrees (HybridCommunicateGroup role)."""
 

@@ -47,11 +47,28 @@ def constrain_spec(arr, spec):
     env = get_mesh_env()
     if env is None:
         return arr
-    am = jax.sharding.get_abstract_mesh()
+    try:
+        am = jax.sharding.get_abstract_mesh()
+    except AttributeError:  # jax < 0.7: no AbstractMesh context accessor
+        am = None
     if am is not None and not am.empty and am._any_axis_manual:
         manual = {name for name, ty in zip(am.axis_names, am.axis_types)
                   if "Manual" in str(ty)}
+        mesh_for_ns = am
+    else:
+        # older jax: inside a shard_map trace the mesh axes are bound in the
+        # axis env; stripping ALL of them from the spec is safe (a weaker
+        # constraint, never a wrong one) and required for the manual ones
+        try:
+            from jax._src import core as _core_src
 
+            manual = {n for n in _core_src.get_axis_env().axis_sizes
+                      if isinstance(n, str)}
+        except Exception:
+            manual = set()
+        mesh_for_ns = env.mesh
+
+    if manual:
         def strip(entry):
             if entry is None:
                 return None
@@ -60,7 +77,7 @@ def constrain_spec(arr, spec):
                 return kept or None
             return None if entry in manual else entry
 
-        ns = NamedSharding(am, P(*(strip(e) for e in spec)))
+        ns = NamedSharding(mesh_for_ns, P(*(strip(e) for e in spec)))
     else:
         ns = NamedSharding(env.mesh, P(*spec))
     return jax.lax.with_sharding_constraint(arr, ns)

@@ -681,7 +681,11 @@ class ShardedTrainStep:
         if self.scaler is not None or self.accum_steps > 1:
             return self._call_amp(arrays)
         if self._jitted is None:
-            self._jitted = self._build(arrays)
+            from ..jit import _audit_instance_label, _maybe_audit
+
+            self._jitted = _maybe_audit(
+                _audit_instance_label("ShardedTrainStep"),
+                self._build(arrays))
         params = [p.data for p in self.train_params]
         states = [opt._accumulators[id(p)] for p in self.train_params]
         frozen_arrays = [t.data for t in self.frozen]

@@ -20,7 +20,8 @@ import sys
 from typing import List, Optional
 
 from ..launch.process import ProcessContext
-from .master import Master, free_port, node_payload
+from .master import (Master, free_port, node_payload,
+                     release_reserved_ports, reserve_port)
 
 
 class ControleMode:  # sic — the reference's spelling, kept for parity
@@ -79,6 +80,7 @@ class Controller:
                    self.worker_envs(peers, node_rank, 0).items()
                    if k not in ("PADDLE_TRAINER_ID", "PADDLE_TRAINERS_NUM",
                                 "PADDLE_LOCAL_RANK")}
+            release_reserved_ports()
             ec = ElasticController(
                 cmd, np=np, min_np=self.args.elastic_min or max(1, np - 1),
                 log_dir=self.args.log_dir, extra_env=env)
@@ -87,6 +89,10 @@ class Controller:
             return 0 if getattr(status, "name", str(status)) in (
                 "COMPLETED", "0") else 1
 
+        # hand the reserved rendezvous ports to the workers that bind them
+        # for real (jax.distributed coordinator / PS store) — held bound
+        # until here to close the free_port() TOCTOU window
+        release_reserved_ports()
         self.ctx = ProcessContext.start(
             cmd, self.n_local_procs(), log_dir=self.args.log_dir,
             extra_env_fn=lambda r: self.worker_envs(peers, node_rank, r))
@@ -148,7 +154,7 @@ class PSController(Controller):
 
     def __init__(self, args):
         super().__init__(args)
-        self._ps_port = free_port()  # single-node fallback endpoint
+        self._ps_port = reserve_port()  # single-node fallback endpoint
 
     def n_local_procs(self) -> int:
         return self.args.servers + self.args.trainers

@@ -39,6 +39,45 @@ def free_port() -> int:
         return s.getsockname()[1]
 
 
+class PortReservation:
+    """A port that stays BOUND (SO_REUSEADDR) until release() — closing the
+    free_port() probe socket immediately lets any process steal the port
+    between rendezvous and the real server's bind (TOCTOU). The controller
+    holds reservations through rendezvous and releases them right before
+    spawning the workers that bind for real, shrinking the race window from
+    the whole rendezvous to milliseconds."""
+
+    def __init__(self):
+        self.sock = socket.socket()
+        self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.sock.bind(("", 0))
+        self.port = self.sock.getsockname()[1]
+
+    def release(self) -> int:
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            finally:
+                self.sock = None
+        return self.port
+
+
+_HELD_PORTS: List[PortReservation] = []
+
+
+def reserve_port() -> int:
+    """free_port() that keeps the socket bound; pair with
+    release_reserved_ports() just before handing the ports to binders."""
+    r = PortReservation()
+    _HELD_PORTS.append(r)
+    return r.port
+
+
+def release_reserved_ports() -> None:
+    while _HELD_PORTS:
+        _HELD_PORTS.pop().release()
+
+
 class Master:
     """One node is MAIN (hosts the TCPStore daemon), the rest PARTICIPANT —
     decided by a bind race on the master endpoint exactly like the
@@ -79,20 +118,78 @@ class Master:
 
     def sync_peers(self, prefix: str, value: str, size: int,
                    rank: int = -1, timeout: float = 300.0,
+                   main_timeout: Optional[float] = None,
                    ) -> Tuple[List[str], int]:
         """Block until `size` peers registered under `prefix`; return
         (ordered peer values, my rank). rank=-1 -> arrival order, with the
-        MAIN node pinned to rank 0 (the reference's 'aaaaaa' trick)."""
+        MAIN node pinned to rank 0 (the reference's 'aaaaaa' trick).
+
+        Mixed explicit/auto gangs: explicit-rank nodes also publish the
+        main-arrival marker (an explicit-rank MAIN would otherwise never
+        publish it and every auto node would hang), auto nodes skip rank
+        slots explicit peers claimed (start explicit nodes first for a
+        deterministic layout), and the MAIN wait is BOUNDED — it raises a
+        diagnosis instead of blocking forever. `main_timeout` defaults to
+        min(timeout, 120s): generous enough for a slow MAIN bring-up
+        (TPU init, staggered launch), short enough to name the
+        misconfiguration while the operator is still watching; raise it
+        for launches where MAIN arrives minutes late."""
         if size < 2:
             return [value], 0
+        if main_timeout is None:
+            main_timeout = min(timeout, 120.0)
         st = self.store
-        if rank < 0:
+        if rank >= 0:
+            # explicit rank: unblock any auto peers waiting on the marker
+            st.add(f"{prefix}/main_present", 1)
+        else:
             if self.role == Master.MAIN:
                 rank = 0
-                st.set(f"{prefix}/main_taken", b"1")
+                st.add(f"{prefix}/main_present", 1)
             else:
-                st.wait([f"{prefix}/main_taken"])
-                rank = st.add(f"{prefix}/arrival", 1)  # 1..size-1
+                deadline = time.time() + main_timeout
+                while st.add(f"{prefix}/main_present", 0) < 1:
+                    if time.time() > deadline:
+                        raise RuntimeError(
+                            f"sync_peers: no MAIN arrived under '{prefix}' "
+                            f"within {main_timeout:.0f}s. Likely "
+                            f"misconfiguration: (a) --master points at a "
+                            f"host where no controller is running, or (b) "
+                            f"a mixed explicit/auto --rank gang where the "
+                            f"rank-0/MAIN node never joined. Start the "
+                            f"MAIN controller first, pass a uniform "
+                            f"--rank scheme across the gang, or raise "
+                            f"main_timeout for very staggered launches.")
+                    time.sleep(0.1)
+                rank = -1  # assigned by the claim loop below
+        # claim the rank slot atomically. Auto nodes take arrival-order
+        # slots, SKIPPING ranks already claimed explicitly (a mixed gang's
+        # usual shape: low explicit ranks + auto fill); an explicit rank
+        # claimed twice is a genuine misconfiguration and raises instead
+        # of silently overwriting one peer's payload and hanging the gang
+        # on the missing slot.
+        if rank >= 0:
+            if rank >= size:
+                raise RuntimeError(
+                    f"sync_peers: explicit rank {rank} is outside "
+                    f"[0, {size}) — ranks are 0-based; a 1-based scheme "
+                    f"would stall the whole gang on the empty slot")
+            if st.add(f"{prefix}/claim/{rank}", 1) > 1:
+                raise RuntimeError(
+                    f"sync_peers: rank {rank} claimed twice under "
+                    f"'{prefix}' — duplicate explicit --rank, or an "
+                    f"auto-rank peer already took this slot (start "
+                    f"explicit-rank nodes first in mixed gangs).")
+        else:
+            while True:
+                rank = st.add(f"{prefix}/arrival", 1)  # 1..size-1, ...
+                if rank >= size:
+                    raise RuntimeError(
+                        f"sync_peers: no free rank slot left under "
+                        f"'{prefix}' (size={size}) — more peers than "
+                        f"`size`, or stale state (pass a fresh job id)")
+                if st.add(f"{prefix}/claim/{rank}", 1) == 1:
+                    break  # skip slots explicit-rank peers claimed
         st.set(f"{prefix}/{rank}", value.encode())
         n = st.add(f"{prefix}/n", 1)
         if n > size:
@@ -128,10 +225,13 @@ class Master:
 def node_payload(nproc: int, coordinator_port: Optional[int] = None) -> str:
     """What each node advertises at rendezvous: its ip, local proc count,
     and pre-reserved ports the node COULD serve on — jax.distributed
-    coordination and the PS store (only rank 0's are used)."""
+    coordination and the PS store (only rank 0's are used). The ports stay
+    BOUND in this controller (reserve_port) until the controller releases
+    them at worker-spawn time — closing them at probe time (free_port) left
+    the whole rendezvous window for another process to steal them."""
     return json.dumps({
         "ip": _local_ip(),
         "nproc": nproc,
-        "coord_port": coordinator_port or free_port(),
-        "ps_port": free_port(),
+        "coord_port": coordinator_port or reserve_port(),
+        "ps_port": reserve_port(),
     })

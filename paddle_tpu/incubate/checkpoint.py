@@ -119,6 +119,7 @@ class _EpochRange:
 
         for key, obj in self.state.items():
             _, to_name = self._pos_key_maps(obj)
+            params = getattr(obj, "_parameter_list", None)
             kdir = os.path.join(edir, key)
             manifest = load_manifest(kdir)
             fresh = obj.state_dict()
@@ -132,6 +133,21 @@ class _EpochRange:
                     # resharding contract — restored arrays must not come
                     # back replicated on the default device)
                     arr = jax.device_put(arr, tgt.data.sharding)
+                elif params is not None and k.startswith("__p"):
+                    # optimizer accumulators are created lazily, so the
+                    # fresh state_dict has no target to copy a sharding
+                    # from — but the pos-key encodes the OWNING param, and
+                    # moment-shaped state mirrors its layout. device_put to
+                    # the param's sharding so restored moments land in the
+                    # target GSPMD layout exactly like params do (factored
+                    # / scalar state keeps the default placement).
+                    try:
+                        idx = int(k[3:].split("__", 1)[0])
+                        p = params[idx]
+                        if tuple(arr.shape) == tuple(p.shape):
+                            arr = jax.device_put(arr, p.data.sharding)
+                    except (ValueError, IndexError):
+                        pass
                 sd[name] = Tensor(arr)
             # strict for Layers: a checkpoint missing model keys must not
             # silently resume from random init (optimizers create their

@@ -34,6 +34,29 @@ def _collect_params(layer: Layer):
     return named, buffers
 
 
+# Trace-cache audit hooks (paddle_tpu.analysis.retrace installs these; both
+# default None so the path is untouched when auditing is off):
+# _TRACE_AUDIT_HOOK(label, jitted) -> callable wraps freshly built compiled
+# steps; _TRACE_NEWKEY_HOOK(label, key) records python-level cache-key drift
+# (a new to_static specialization == a guaranteed recompile).
+_TRACE_AUDIT_HOOK = None
+_TRACE_NEWKEY_HOOK = None
+_AUDIT_INSTANCE_NO = [0]
+
+
+def _maybe_audit(label, jitted):
+    return _TRACE_AUDIT_HOOK(label, jitted) if _TRACE_AUDIT_HOOK is not None \
+        else jitted
+
+
+def _audit_instance_label(kind: str) -> str:
+    """Per-instance audit label ("TrainStep#2"): two train steps with
+    different batch shapes must not pool signatures in one bucket — that
+    would report phantom recompiles."""
+    _AUDIT_INSTANCE_NO[0] += 1
+    return f"{kind}#{_AUDIT_INSTANCE_NO[0]}"
+
+
 class _Binder:
     """Temporarily swap Layer parameter/buffer .data with traced arrays."""
 
@@ -62,6 +85,7 @@ class StaticLayer:
         self._is_layer = isinstance(layer_or_fn, Layer)
         self._target = layer_or_fn
         self._cache = {}
+        self._audit_label = None  # assigned per instance on first compile
         # AST-lite dy2static (program_translator.py:775 role): rewrite simple
         # tensor-dependent if/while into runtime-dispatched cond/while_loop.
         # The conversion is scoped to THIS wrapper — the user's layer object
@@ -161,7 +185,20 @@ class StaticLayer:
                     lambda t: t.data if isinstance(t, Tensor) else t, out,
                     is_leaf=lambda t: isinstance(t, Tensor))
 
-            jitted = jax.jit(run)
+            base = "to_static:" + getattr(self._target, "__name__",
+                                          type(self._target).__name__)
+            if self._audit_label is None:
+                self._audit_label = _audit_instance_label(base)
+            if _TRACE_NEWKEY_HOOK is not None:
+                # a NEW python-level cache key == a guaranteed recompile
+                # (static-arg / kwarg-structure drift): let the auditor
+                # attribute it per WRAPPER instance
+                _TRACE_NEWKEY_HOOK(self._audit_label, key)
+            # each specialization is its own jit cache: give its call-
+            # signature bucket a distinct label too, or two specializations
+            # of one wrapper would read as phantom signature drift
+            jitted = _maybe_audit(
+                f"{self._audit_label}/k{len(self._cache)}", jax.jit(run))
             self._cache[key] = jitted
         param_arrays = [t.data for t in tensors]
         out = jitted(param_arrays, arrays, kw_arrays, random_mod.next_key())
@@ -259,7 +296,8 @@ class TrainStep:
 
     def __call__(self, *batch):
         if self._jitted is None:
-            self._jitted = self._build()
+            self._jitted = _maybe_audit(_audit_instance_label("TrainStep"),
+                                        self._build())
         opt = self.optimizer
         params = [p.data for p in self.train_params]
         states = [opt._accumulators[id(p)] for p in self.train_params]

@@ -173,8 +173,11 @@ def test_isend_irecv_tags():
     dist.isend(b, dst=0, tag=2)
     out2 = paddle.zeros([2])
     out1 = paddle.zeros([2])
-    dist.irecv(out2, src=0, tag=2)
-    dist.irecv(out1, src=0, tag=1)
+    # irecv fills the buffer from a background thread: the task must be
+    # waited before the buffer is read (asserting without wait() races)
+    t2 = dist.irecv(out2, src=0, tag=2)
+    t1 = dist.irecv(out1, src=0, tag=1)
+    assert t1.wait(timeout=30) and t2.wait(timeout=30)
     np.testing.assert_array_equal(_np(out1), [3, 3])
     np.testing.assert_array_equal(_np(out2), [7, 7])
 

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# CI entrypoint: repo self-lint + the tier-1 test suite.
+#
+#   bash tools/ci.sh            # both gates
+#   bash tools/ci.sh --lint     # self-lint only (fast)
+#
+# Mirrors the reference's hard CI gates (tools/ci_op_benchmark.sh role):
+# a PR that trips the static checker or the tier-1 suite does not land.
+set -o pipefail
+cd "$(dirname "$0")/.."
+
+echo "== pd_check --self (repo footgun lint) =="
+JAX_PLATFORMS=cpu python tools/pd_check.py --self || exit 1
+
+if [ "${1:-}" = "--lint" ]; then
+    exit 0
+fi
+
+echo "== tier-1 test suite =="
+rm -f /tmp/_t1.log
+timeout -k 10 870 env JAX_PLATFORMS=cpu \
+    python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors -p no:cacheprovider \
+    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log
+rc=${PIPESTATUS[0]}
+echo "DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)"
+exit $rc
