@@ -616,6 +616,57 @@ def _measure_bert_finetune(steps=500, batch=32, seq=128):
     }
 
 
+def _measure_serving(clients_sweep=(2, 8), per_client=100):
+    """Serving smoke (docs/serving.md): closed-loop offered-load sweep over
+    the batching engine — N client threads submit-and-wait against one
+    ServingEngine; reports throughput + tail latency + occupancy per load
+    point. Model is engine-jitted, so this runs the same on CPU CI and
+    TPU."""
+    import threading
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 256), nn.Tanh(), nn.Linear(256, 16))
+    net.eval()
+    rng = np.random.RandomState(0)
+    xs = rng.randn(64, 64).astype("float32")
+    rows = []
+    for n_clients in clients_sweep:
+        eng = serving.ServingEngine(
+            net, buckets=serving.BucketSpec(batch_sizes=(1, 2, 4, 8, 16)),
+            input_specs=[((64,), "float32")],
+            config=serving.ServingConfig(max_batch_wait_ms=1.0,
+                                         max_queue=1024))
+        eng.start()
+
+        def client(c):
+            for j in range(per_client):
+                eng.submit([xs[(c * per_client + j) % 64]]).result(timeout=120)
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(c,))
+                   for c in range(n_clients)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        stats = eng.stats()
+        eng.close()
+        rows.append({
+            "clients": n_clients,
+            "throughput_rps": round(n_clients * per_client / dt, 1),
+            "p50_ms": stats["latency_ms"]["p50"],
+            "p99_ms": stats["latency_ms"]["p99"],
+            "batch_occupancy": stats["batch_occupancy"],
+            "batches": stats["counters"]["batches_total"],
+        })
+    return {"sweep": rows, "requests_per_client": per_client}
+
+
 def _configs():
     from paddle_tpu.models import LlamaConfig
 
@@ -709,6 +760,9 @@ def _run_one(name: str):
         out = (_measure_resnet_cifar() if name == "resnet_cifar"
                else _measure_bert_finetune())
         print("BENCH_RESULT " + json.dumps(out))
+        return
+    if name == "serving":
+        print("BENCH_RESULT " + json.dumps(_measure_serving()))
         return
     import paddle_tpu.optimizer as opt_mod
 
@@ -832,6 +886,11 @@ def main():
         big = _measure(LlamaConfig.tiny(), batch=2, seq=64, iters=2)
         detail = dict(big)
         detail["platform"] = jax.devices()[0].platform
+        try:
+            detail["serving"] = _measure_serving(clients_sweep=(2, 8),
+                                                 per_client=30)
+        except Exception as e:  # the smoke must never sink the bench
+            detail["serving_error"] = str(e)[:300]
         _write_artifact(detail)  # same artifact contract as the TPU path
         print(_headline(big, detail), flush=True)
         return
@@ -874,6 +933,7 @@ def main():
 
     leg("moe", _moe)
     leg("dit", lambda: detail.__setitem__("dit", _spawn("dit")))
+    leg("serving", lambda: detail.__setitem__("serving", _spawn("serving")))
 
     if full:
         def _resnet():

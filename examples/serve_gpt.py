@@ -1,0 +1,82 @@
+"""Continuous-batching GPT serving demo.
+
+Usage:  python examples/serve_gpt.py [--clients 8] [--steps 60]
+
+Trains a tiny GPT on a repeating pattern (the generate_gpt.py recipe), then
+stands up a ``serving.GenerationEngine`` — slot-based KV cache, prompts
+joining mid-flight as slots free — and fires concurrent clients at it.
+Verifies every continuation and prints the engine's stats snapshot (QPS,
+latency percentiles, slot occupancy). Swap in a real checkpoint via
+paddle.load + set_state_dict unchanged.
+"""
+import argparse
+import json
+import os as _os
+import sys as _sys
+import threading
+
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(
+    _os.path.abspath(__file__))))  # run from anywhere
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.optimizer as opt
+from paddle_tpu import jit, serving
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--clients", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=60)
+    args = ap.parse_args()
+
+    cfg = GPTConfig(vocab_size=64, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64,
+                    dtype="float32")
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-3,
+                          parameters=model.parameters())
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y), optimizer)
+
+    pattern = np.tile(np.arange(8), 8)[None, :]  # 0..7 repeating
+    ids = paddle.to_tensor(pattern.astype("int64"))
+    for _ in range(args.steps):
+        loss = step(ids, ids)
+    print("final loss:", float(loss))
+
+    engine = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=48,
+                                        prefill_buckets=(16, 24)))
+    engine.start()
+
+    failures = []
+
+    def client(c):
+        plen = 9 + (c % 7)
+        fut = engine.submit(pattern[0, :plen].astype("int64"),
+                            max_new_tokens=4 + (c % 3))
+        full = fut.result(timeout=300)
+        gen = full[plen:]
+        want = [(plen + i) % 8 for i in range(len(gen))]
+        if gen.tolist() != want:
+            failures.append((c, gen.tolist(), want))
+        print(f"client {c}: prompt[{plen}] -> {gen.tolist()}")
+
+    threads = [threading.Thread(target=client, args=(c,))
+               for c in range(args.clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    print("stats:", json.dumps(engine.stats(), default=str))
+    engine.close()
+    assert not failures, failures
+    print("OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,14 @@ def _check_slots(line):
             "the output of generate_sample must be a list/tuple of "
             "(slot_name, values) pairs, e.g. "
             "[('words', [1926, 8, 17]), ('label', [1])]")
+    for name, elements in line:
+        # a 0-length slot would emit "0" and desync the reader's
+        # len-prefixed scan one slot later — fail at GENERATION time, the
+        # reference data_generator contract
+        if len(elements) == 0:
+            raise ValueError(
+                "the elements of each field can not be empty, please check "
+                f"slot '{name}'")
 
 
 class MultiSlotStringDataGenerator(DataGenerator):

@@ -85,17 +85,26 @@ class Predictor:
         if not config.prefix:
             raise ValueError("Config needs the model path prefix")
         self._layer = jit.load(config.prefix)
-        n = self._n_inputs = self._layer_num_inputs(config.prefix)
+        self._meta = self._load_meta(config.prefix)
+        n = self._n_inputs = int(self._meta["num_inputs"])
         self._inputs: Dict[str, PredictorTensor] = {
             f"x{i}": PredictorTensor(f"x{i}") for i in range(n)}
         self._outputs: Dict[str, PredictorTensor] = {}
 
     @staticmethod
-    def _layer_num_inputs(prefix):
+    def _load_meta(prefix):
         import json
 
         with open(prefix + ".pdmeta") as f:
-            return int(json.load(f)["num_inputs"])
+            return json.load(f)
+
+    def get_input_specs(self):
+        """Saved trace signatures (batch dim included; ``None`` dims were
+        exported symbolic). Consumed by ``serving.ServingEngine``."""
+        from ..static import InputSpec
+
+        return [InputSpec(tuple(s["shape"]), s["dtype"])
+                for s in self._meta.get("input_specs", [])]
 
     def get_input_names(self) -> List[str]:
         return list(self._inputs)

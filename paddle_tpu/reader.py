@@ -208,24 +208,67 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
     return creator
 
 
+def _mp_failure_payload(e):
+    """Cross-process failure envelope: the pickled exception INSTANCE (so
+    the consumer re-raises the real type and can catch it specifically)
+    plus the worker-side traceback text (lost by pickling)."""
+    import pickle
+    import traceback
+
+    tb = traceback.format_exc()
+    try:
+        payload = pickle.dumps(e)
+        pickle.loads(payload)  # must survive the round trip NOW, not later
+    except Exception:
+        payload = None  # unpicklable exception: fall back to the repr
+    return ("F", payload, f"{type(e).__name__}: {e}", tb)
+
+
+def _mp_raise(payload, desc, tb):
+    """Re-raise a worker failure in the consumer. The original exception
+    type propagates when it pickles; the worker traceback rides along as
+    the __cause__ so nothing is flattened to a bare string."""
+    import pickle
+
+    cause = RuntimeError(
+        f"multiprocess_reader worker failed: {desc}\n"
+        f"worker traceback:\n{tb}")
+    if payload is not None:
+        try:
+            exc = pickle.loads(payload)
+        except Exception:
+            raise cause
+        raise exc from cause
+    raise cause
+
+
 def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
     """Fan-in several readers from fork'd worker processes
     (decorator.py:505). Workers must only touch fork-safe state (numpy,
     files) — the same contract as the DataLoader workers. Samples ride
-    tagged tuples so a None sample is data and a worker crash raises
-    in the consumer instead of truncating the stream."""
+    tagged tuples so a None sample is data and a worker crash re-raises
+    in the consumer (original exception type when picklable, worker
+    traceback text chained as the __cause__) instead of truncating the
+    stream.
+
+    ``use_pipe`` selects the transport, like the reference's
+    _read_into_pipe/_read_into_queue split: True (default) gives each
+    worker its own one-way ``multiprocessing.Pipe`` and the consumer
+    fans in via ``connection.wait``; False funnels every worker through
+    one bounded ``multiprocessing.Queue(queue_size)``.
+    """
     import multiprocessing as mp
 
-    def creator():
+    def creator_queue():
         q = mp.Queue(queue_size)
 
         def work(r):
             try:
                 for s in r():
                     q.put(("S", s))
-                q.put(("E", None))
-            except BaseException as e:  # cross-process: send the repr
-                q.put(("F", f"{type(e).__name__}: {e}"))
+                q.put(("E",))
+            except BaseException as e:
+                q.put(_mp_failure_payload(e))
 
         procs = [mp.Process(target=work, args=(r,), daemon=True)
                  for r in readers]
@@ -233,14 +276,68 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
             p.start()
         finished = 0
         while finished < len(readers):
-            tag, val = q.get()
-            if tag == "E":
+            msg = q.get()
+            if msg[0] == "E":
                 finished += 1
-            elif tag == "F":
-                raise RuntimeError(
-                    f"multiprocess_reader worker failed: {val}")
+            elif msg[0] == "F":
+                _mp_raise(*msg[1:])
             else:
-                yield val
+                yield msg[1]
         for p in procs:
             p.join(timeout=5)
-    return creator
+
+    def creator_pipe():
+        from multiprocessing.connection import wait
+
+        def work(r, conn):
+            try:
+                for s in r():
+                    conn.send(("S", s))
+                conn.send(("E",))
+            except BaseException as e:
+                try:
+                    conn.send(_mp_failure_payload(e))
+                except Exception:  # payload itself unsendable
+                    conn.send(("F", None, f"{type(e).__name__}: {e}", ""))
+            finally:
+                conn.close()
+
+        conns, procs, owner = [], [], {}
+        for r in readers:
+            recv, send = mp.Pipe(duplex=False)
+            p = mp.Process(target=work, args=(r, send), daemon=True)
+            p.start()
+            # close OUR copy of the write end immediately: recv() can then
+            # raise EOFError when a worker dies without an envelope
+            # (SIGKILL/OOM) instead of blocking forever — and the
+            # start-then-next-pipe order keeps later workers from
+            # inheriting this pipe's send fd
+            send.close()
+            procs.append(p)
+            conns.append(recv)
+            owner[recv] = p
+        live = list(conns)
+        while live:
+            for conn in wait(live):
+                try:
+                    msg = conn.recv()
+                except EOFError:
+                    # EOF without the ("E",) envelope = the worker DIED
+                    # (SIGKILL/OOM/os._exit): a truncated stream must not
+                    # look like a shorter dataset
+                    p = owner[conn]
+                    p.join(timeout=5)
+                    raise RuntimeError(
+                        "multiprocess_reader worker died without finishing "
+                        f"(exitcode {p.exitcode}); stream would be "
+                        "truncated")
+                if msg[0] == "E":
+                    live.remove(conn)
+                elif msg[0] == "F":
+                    _mp_raise(*msg[1:])
+                else:
+                    yield msg[1]
+        for p in procs:
+            p.join(timeout=5)
+
+    return creator_pipe if use_pipe else creator_queue

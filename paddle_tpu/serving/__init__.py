@@ -1,0 +1,37 @@
+"""paddle_tpu.serving: the batching inference server.
+
+Reference lineage: the reference deploys ``AnalysisPredictor`` (one request
+= one run) behind FleetExecutor's ``dist_model.cc`` multi-rank driver
+(SURVEY §L9). TPU-native redesign: concurrency is won by COALESCING — a
+thread-safe queue feeds a micro-batcher that pads concurrent requests into
+pre-declared shape buckets, and every bucket is AOT-warmed so steady-state
+traffic executes warm XLA programs only (asserted via
+``analysis.retrace``).
+
+Three layers:
+- ``ServingEngine`` (+ ``BucketSpec``, ``ServingConfig``): generic batched
+  inference over an ``inference.Predictor``, ``nn.Layer``, or array fn —
+  admission control, deadlines, per-request error isolation;
+- ``GenerationEngine`` (+ ``GenerationConfig``): continuous-batching
+  causal-LM decode — slot-based fixed-shape KV cache, finished sequences
+  release their slot, queued prompts join mid-flight;
+- ``MetricsRegistry``: QPS, latency percentiles, batch occupancy, queue
+  depth, compile-cache hits/misses, exposed via ``engine.stats()`` and
+  ``profiler.RecordEvent`` spans.
+
+See docs/serving.md.
+"""
+from .buckets import BucketSpec  # noqa: F401
+from .engine import (  # noqa: F401
+    BadRequest, DeadlineExceeded, EngineClosed, QueueFull, ServingConfig,
+    ServingEngine,
+)
+from .generation import GenerationConfig, GenerationEngine  # noqa: F401
+from .metrics import LatencyWindow, MetricsRegistry  # noqa: F401
+
+__all__ = [
+    "BucketSpec", "ServingConfig", "ServingEngine",
+    "GenerationConfig", "GenerationEngine",
+    "MetricsRegistry", "LatencyWindow",
+    "QueueFull", "DeadlineExceeded", "EngineClosed", "BadRequest",
+]

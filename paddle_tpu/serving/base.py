@@ -1,0 +1,133 @@
+"""Shared scaffolding for the serving engines: lifecycle (start/close/
+context manager), the bounded admission queue, and retrace-label
+observability. ``ServingEngine`` and ``GenerationEngine`` differ in what
+their worker loop DOES (micro-batch vs continuous decode), not in how it
+lives — that part exists exactly once, here.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, Optional
+
+from .metrics import MetricsRegistry
+
+__all__ = ["EngineBase", "QueueFull", "DeadlineExceeded", "EngineClosed",
+           "BadRequest"]
+
+
+class QueueFull(RuntimeError):
+    """Admission control: the bounded request queue is at capacity."""
+
+
+class EngineClosed(RuntimeError):
+    """The engine is shut down; no further submissions."""
+
+
+class BadRequest(ValueError):
+    """Payload rejected by validation (shape/dtype/rank/length)."""
+
+
+class DeadlineExceeded(TimeoutError):
+    """The request expired before execution and was shed."""
+
+
+class EngineBase:
+    """Queue + condition + worker-thread lifecycle. Subclasses implement
+    ``_worker`` (the loop) and may override ``_on_start`` (e.g. AOT
+    warmup). Requests must carry a ``.future`` attribute."""
+
+    _close_timeout = 30.0
+
+    def __init__(self, name: str, qps_window_s: float = 30.0):
+        self.name = name
+        self.metrics = MetricsRegistry(qps_window_s=qps_window_s)
+        self.metrics.gauge("queue_depth", self.queue_depth)
+        self._queue: deque = deque()
+        self._cond = threading.Condition()
+        self._start_lock = threading.Lock()
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- hooks ----------------------------------------------------------------
+    def _on_start(self) -> None:
+        pass
+
+    def _worker(self) -> None:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        with self._start_lock:  # concurrent submits race the auto-start
+            if self._thread is not None:
+                return self
+            self._on_start()
+            self._thread = threading.Thread(target=self._worker,
+                                            name=f"pt-serving-{self.name}",
+                                            daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True, timeout: Optional[float] = None):
+        """Stop the worker. ``drain=True`` serves what is already queued;
+        ``drain=False`` fails queued requests with ``EngineClosed``."""
+        with self._cond:
+            self._closed = True
+            if not drain:
+                while self._queue:
+                    r = self._queue.popleft()
+                    if not r.future.done():
+                        r.future.set_exception(EngineClosed("engine closed"))
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=self._close_timeout
+                              if timeout is None else timeout)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- admission ------------------------------------------------------------
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def _enqueue(self, req, max_queue: int) -> None:
+        """Bounded-queue admission (raises ``EngineClosed``/``QueueFull``);
+        auto-starts the worker on first use."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine closed")
+            if len(self._queue) >= max_queue:
+                self.metrics.inc("rejected_total")
+                raise QueueFull(f"queue at capacity ({max_queue})")
+            self._queue.append(req)
+            self._cond.notify()
+        if self._thread is None:
+            self.start()
+
+    # -- observability --------------------------------------------------------
+    def retrace_events(self) -> Optional[int]:
+        """Recompiles recorded under this engine's ``serving:<name>:``
+        labels (None when the retrace auditor is not enabled)."""
+        try:
+            from ..analysis import retrace
+        except Exception:  # pragma: no cover - analysis always present
+            return None
+        if not retrace.is_enabled() and not retrace.get_auditor().events:
+            return None
+        prefix = f"serving:{self.name}:"
+        return sum(1 for e in retrace.get_auditor().events
+                   if str(e.label).startswith(prefix))
+
+    def _stats_base(self) -> Dict[str, Any]:
+        snap = self.metrics.snapshot()
+        snap["name"] = self.name
+        rt = self.retrace_events()
+        if rt is not None:
+            snap["retrace_events"] = rt
+        return snap

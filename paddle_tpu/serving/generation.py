@@ -1,0 +1,401 @@
+"""Continuous batching for causal-LM generation (slot-based KV cache).
+
+The static-batch decode loop (``GPTForCausalLM.generate``) holds the whole
+batch until its slowest sequence finishes, and its KV cache grows one token
+per step — a new XLA program per step. Serving inverts both decisions:
+
+- the KV cache is a fixed-shape slot arena ``[slots, max_len, heads, dim]``
+  per layer, so ONE decode executable serves every step (zero retraces);
+- each sequence owns a slot only while it is generating — a finished
+  sequence releases its slot and a queued prompt joins mid-flight at the
+  next step boundary (the vLLM/Orca-style continuous-batching contract).
+
+Prefill reuses ``models.gpt``'s KV-cache forward (``use_cache=True``) on
+the user's model, padded to a small set of prompt buckets; the per-layer
+K/V it returns is copied into the slot arena. The decode step re-reads the
+SAME model weights (no duplication of math: qkv/out/fc projections, pre-LN,
+tied embedding head — the GPT-2 recipe) but runs them at fixed shapes with
+per-slot length masks, compiled once.
+
+Greedy decoding (matching ``generate``'s argmax contract).
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .base import BadRequest, EngineBase
+
+__all__ = ["GenerationConfig", "GenerationEngine"]
+
+_GEN_NO = itertools.count(1)
+
+
+class GenerationConfig:
+    """Slot arena + prompt bucket shape declaration."""
+
+    def __init__(self, max_slots: int = 4, max_seq_len: Optional[int] = None,
+                 prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128),
+                 max_queue: int = 256, eos_token_id: Optional[int] = None,
+                 donate_cache: bool = True):
+        self.max_slots = int(max_slots)
+        self.max_seq_len = max_seq_len  # None: model max_position_embeddings
+        self.prefill_buckets = tuple(sorted({int(b)
+                                             for b in prefill_buckets}))
+        self.max_queue = int(max_queue)
+        self.eos_token_id = eos_token_id
+        self.donate_cache = donate_cache
+
+
+class _GenRequest:
+    __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
+                 "generated")
+
+    def __init__(self, prompt, max_new_tokens, future, t_submit):
+        self.prompt = prompt
+        self.max_new_tokens = max_new_tokens
+        self.future = future
+        self.t_submit = t_submit
+        self.generated: List[int] = []
+
+
+class _Slot:
+    __slots__ = ("req", "length", "last_token")
+
+    def __init__(self):
+        self.req: Optional[_GenRequest] = None
+        self.length = 0
+        self.last_token = 0
+
+
+def _extract_gpt_params(model):
+    """Read the live weights of a ``GPTForCausalLM`` as a jax pytree (the
+    decode step closes over nothing — set_state_dict + a new engine picks
+    up new weights)."""
+    g = model.gpt
+
+    def a(t):
+        return t.data
+
+    return {
+        "embed": a(g.embed_tokens.weight),          # [vocab, h]
+        "pos": a(g.embed_positions.weight),         # [P, h]
+        "lnf_w": a(g.ln_f.weight), "lnf_b": a(g.ln_f.bias),
+        "layers": [
+            {"ln1_w": a(L.ln_1.weight), "ln1_b": a(L.ln_1.bias),
+             "qkv_w": a(L.attn.qkv_proj.weight),
+             "qkv_b": a(L.attn.qkv_proj.bias),
+             "out_w": a(L.attn.out_proj.weight),
+             "out_b": a(L.attn.out_proj.bias),
+             "ln2_w": a(L.ln_2.weight), "ln2_b": a(L.ln_2.bias),
+             "fc_in_w": a(L.fc_in.weight), "fc_in_b": a(L.fc_in.bias),
+             "fc_out_w": a(L.fc_out.weight), "fc_out_b": a(L.fc_out.bias)}
+            for L in g.layers],
+    }
+
+
+def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool):
+    """One fixed-shape executable: token+position embed, per-layer pre-LN
+    attention against the slot arena (length-masked), MLP, tied head,
+    greedy argmax. Cache buffers are donated so XLA updates in place."""
+    import jax
+    import jax.numpy as jnp
+
+    nh = cfg.num_attention_heads
+    hd = cfg.hidden_size // nh
+    eps = cfg.layer_norm_epsilon
+    scale = 1.0 / math.sqrt(hd)
+
+    def ln(x, w, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    def step(params, k_caches, v_caches, tokens, lengths):
+        # tokens/lengths: [slots] int32; caches: per-layer [S, max_len, nh, hd]
+        S = max_slots
+        x = params["embed"][tokens] + params["pos"][lengths]       # [S, h]
+        pos = jnp.arange(max_len)
+        mask = pos[None, :] <= lengths[:, None]                    # [S, L]
+        slot_idx = jnp.arange(S)
+        new_k, new_v = [], []
+        for p, kc, vc in zip(params["layers"], k_caches, v_caches):
+            h1 = ln(x, p["ln1_w"], p["ln1_b"])
+            qkv = (h1 @ p["qkv_w"] + p["qkv_b"]).reshape(S, 3, nh, hd)
+            q, k1, v1 = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kc = kc.at[slot_idx, lengths].set(k1)
+            vc = vc.at[slot_idx, lengths].set(v1)
+            logits = jnp.einsum("shd,sLhd->shL", q, kc)
+            logits = logits.astype(jnp.float32) * scale
+            logits = jnp.where(mask[:, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum("shL,sLhd->shd", probs, vc).reshape(S, nh * hd)
+            x = x + (ctx @ p["out_w"] + p["out_b"])
+            h2 = ln(x, p["ln2_w"], p["ln2_b"])
+            m = jax.nn.gelu(h2 @ p["fc_in_w"] + p["fc_in_b"],
+                            approximate=True)
+            x = x + (m @ p["fc_out_w"] + p["fc_out_b"])
+            new_k.append(kc)
+            new_v.append(vc)
+        xf = ln(x, params["lnf_w"], params["lnf_b"])
+        logits = xf @ params["embed"].T                            # [S, vocab]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, new_k, new_v
+
+    donate_argnums = (1, 2) if donate else ()
+    return jax.jit(step, donate_argnums=donate_argnums)
+
+
+class GenerationEngine(EngineBase):
+    """Continuous-batching generation server over a ``GPTForCausalLM``.
+
+    ::
+
+        eng = GenerationEngine(model, GenerationConfig(max_slots=4))
+        eng.start()
+        fut = eng.submit(prompt_ids, max_new_tokens=8)
+        full = fut.result()          # np.int64 [len(prompt) + generated]
+        eng.stats()
+        eng.close()
+
+    Requests queue under admission control (``QueueFull`` beyond
+    ``max_queue``); a prompt joins the decode batch as soon as a slot frees
+    — it never waits for the running sequences to finish.
+    """
+
+    _close_timeout = 60.0  # an in-flight decode batch may take a while
+
+    def __init__(self, model, config: Optional[GenerationConfig] = None,
+                 name: Optional[str] = None):
+        import jax.numpy as jnp
+
+        self.config = config or GenerationConfig()
+        super().__init__(name or f"gen#{next(_GEN_NO)}")
+
+        model.eval()  # serving semantics: dropout off
+        self.model = model
+        mcfg = model.config
+        self.max_len = int(self.config.max_seq_len
+                           or mcfg.max_position_embeddings)
+        if self.max_len > mcfg.max_position_embeddings:
+            raise ValueError(
+                f"max_seq_len {self.max_len} exceeds the model's position "
+                f"table ({mcfg.max_position_embeddings})")
+        for b in self.config.prefill_buckets:
+            if b > self.max_len:
+                raise ValueError(
+                    f"prefill bucket {b} exceeds max_seq_len {self.max_len}")
+        self._params = _extract_gpt_params(model)
+        dtype = self._params["embed"].dtype
+        nh = mcfg.num_attention_heads
+        hd = mcfg.hidden_size // nh
+        S = self.config.max_slots
+        self._k = [jnp.zeros((S, self.max_len, nh, hd), dtype)
+                   for _ in range(mcfg.num_hidden_layers)]
+        self._v = [jnp.zeros((S, self.max_len, nh, hd), dtype)
+                   for _ in range(mcfg.num_hidden_layers)]
+
+        import jax
+
+        donate = self.config.donate_cache and jax.default_backend() != "cpu"
+        from .. import jit as jit_mod
+
+        self._decode = jit_mod._maybe_audit(
+            f"serving:{self.name}:decode",
+            _build_decode_step(mcfg, S, self.max_len, donate))
+        self._insert = jax.jit(
+            lambda cache, kv, slot: jax.lax.dynamic_update_slice(
+                cache, kv, (slot, 0, 0, 0)),
+            donate_argnums=(0,) if donate else ())
+
+        self._slots = [_Slot() for _ in range(S)]
+
+    # -- submission -----------------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 16) -> "Future":
+        """Queue one prompt (1-D int array). The future resolves to the
+        full sequence (prompt + generated) as a 1-D np.int64 array."""
+        self.metrics.inc("requests_total")
+        fut: Future = Future()
+        prompt = np.asarray(prompt_ids)
+        if prompt.ndim != 1 or prompt.size == 0 or \
+                not np.issubdtype(prompt.dtype, np.integer):
+            self.metrics.inc("errors_total")
+            fut.set_exception(BadRequest(
+                "prompt must be a non-empty 1-D integer array"))
+            return fut
+        if max_new_tokens < 1:
+            self.metrics.inc("errors_total")
+            fut.set_exception(BadRequest("max_new_tokens must be >= 1"))
+            return fut
+        bucket = self._prefill_bucket(len(prompt))
+        if bucket is None:
+            self.metrics.inc("errors_total")
+            fut.set_exception(BadRequest(
+                f"prompt length {len(prompt)} exceeds the largest prefill "
+                f"bucket {self.config.prefill_buckets[-1]}"))
+            return fut
+        if len(prompt) + max_new_tokens > self.max_len:
+            # don't silently truncate: the slot arena cannot hold the asked-
+            # for continuation (len(out) == len(prompt) + max_new_tokens is
+            # part of the contract)
+            self.metrics.inc("errors_total")
+            fut.set_exception(BadRequest(
+                f"prompt ({len(prompt)}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds max_seq_len {self.max_len}"))
+            return fut
+        req = _GenRequest(prompt.astype(np.int64), int(max_new_tokens), fut,
+                          time.monotonic())
+        self._enqueue(req, self.config.max_queue)
+        return fut
+
+    def _prefill_bucket(self, n: int) -> Optional[int]:
+        for b in self.config.prefill_buckets:
+            if b >= n:
+                return b if b <= self.max_len else None
+        return None
+
+    # -- the continuous-batching loop -----------------------------------------
+    def _active(self) -> List[int]:
+        return [i for i, s in enumerate(self._slots) if s.req is not None]
+
+    def _worker(self):
+        while True:
+            # admit queued prompts into free slots (join mid-flight)
+            admitted = True
+            while admitted:
+                admitted = False
+                free = next((i for i, s in enumerate(self._slots)
+                             if s.req is None), None)
+                if free is None:
+                    break
+                with self._cond:
+                    req = self._queue.popleft() if self._queue else None
+                if req is None:
+                    break
+                try:
+                    self._admit(free, req)
+                except Exception as e:  # isolate: fail this prompt only
+                    if not req.future.done():
+                        req.future.set_exception(e)
+                    self.metrics.inc("errors_total")
+                    slot = self._slots[free]
+                    slot.req, slot.length, slot.last_token = None, 0, 0
+                admitted = True
+            active = self._active()
+            if not active:
+                with self._cond:
+                    if self._closed and not self._queue:
+                        return
+                    if not self._queue:
+                        # untimed: submit/close notify — no idle polling
+                        self._cond.wait()
+                continue
+            try:
+                self._decode_once(active)
+            except Exception as e:  # decode fault: fail the in-flight batch
+                for i in active:
+                    s = self._slots[i]
+                    if s.req is not None and not s.req.future.done():
+                        s.req.future.set_exception(e)
+                    s.req, s.length, s.last_token = None, 0, 0
+                self.metrics.inc("errors_total", len(active))
+                self.metrics.inc("batch_failures")
+
+    def _admit(self, slot_no: int, req: _GenRequest):
+        """Prefill the prompt through the model's own KV-cache forward and
+        land its K/V in the slot arena; the first generated token comes from
+        the prefill logits (matching ``generate``'s contract)."""
+        import jax.numpy as jnp
+
+        from ..core.tensor import Tensor
+
+        p = len(req.prompt)
+        bucket = self._prefill_bucket(p)
+        padded = np.zeros((1, bucket), dtype=np.int64)
+        padded[0, :p] = req.prompt
+        t0 = time.monotonic()
+        from ..core import autograd
+
+        with autograd.no_grad():
+            hidden, caches = self.model.gpt(Tensor(jnp.asarray(padded)),
+                                            use_cache=True)
+        # per-layer K/V [1, bucket, nh, hd] -> arena rows (tail is garbage
+        # from padded positions; decode masks j <= length so it is never
+        # read before being overwritten)
+        slot = np.int32(slot_no)
+        for li, (k, v) in enumerate(caches):
+            self._k[li] = self._insert(self._k[li], k.data, slot)
+            self._v[li] = self._insert(self._v[li], v.data, slot)
+        # first token: argmax of the tied-head logits at the last REAL
+        # prompt position (hidden[:, p-1])
+        logits = hidden.data[0, p - 1, :] @ self._params["embed"].T
+        first = int(np.asarray(jnp.argmax(logits)))
+        self.metrics.inc("prefills_total")
+        self.metrics.observe_queue_wait((t0 - req.t_submit) * 1e3)
+
+        s = self._slots[slot_no]
+        s.req = req
+        s.length = p
+        s.last_token = first
+        req.generated.append(first)
+        self._maybe_finish(slot_no)
+
+    def _decode_once(self, active: List[int]):
+        from .. import profiler
+
+        S = self.config.max_slots
+        tokens = np.zeros(S, dtype=np.int32)
+        lengths = np.zeros(S, dtype=np.int32)
+        for i, s in enumerate(self._slots):
+            if s.req is not None:
+                tokens[i] = s.last_token
+                # write position: current length (clamped defensively; a
+                # slot at max_len is finished before decode in
+                # _maybe_finish, so the clamp never fires for active slots)
+                lengths[i] = min(s.length, self.max_len - 1)
+        with profiler.RecordEvent(
+                f"serving::decode[{self.name} n{len(active)}]", "Serving"):
+            nxt, self._k, self._v = self._decode(
+                self._params, self._k, self._v, tokens, lengths)
+        nxt = np.asarray(nxt)
+        self.metrics.inc("decode_steps")
+        self.metrics.inc("tokens_total", len(active))
+        self.metrics.observe_occupancy(len(active) / S)
+        for i in active:
+            s = self._slots[i]
+            s.length += 1
+            s.last_token = int(nxt[i])
+            s.req.generated.append(s.last_token)
+            self._maybe_finish(i)
+
+    def _maybe_finish(self, slot_no: int):
+        s = self._slots[slot_no]
+        req = s.req
+        eos = self.config.eos_token_id
+        done = (len(req.generated) >= req.max_new_tokens
+                or (eos is not None and req.generated[-1] == eos)
+                or s.length >= self.max_len - 1)
+        if not done:
+            return
+        full = np.concatenate([req.prompt,
+                               np.asarray(req.generated, dtype=np.int64)])
+        if not req.future.done():
+            req.future.set_result(full)
+        self.metrics.observe_latency((time.monotonic() - req.t_submit) * 1e3)
+        self.metrics.inc("responses_total")
+        self.metrics.mark_done()
+        s.req = None
+        s.length = 0
+        s.last_token = 0
+
+    # -- observability --------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        snap = self._stats_base()
+        snap["max_slots"] = self.config.max_slots
+        snap["active_slots"] = len(self._active())
+        return snap

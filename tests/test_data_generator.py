@@ -74,6 +74,29 @@ def test_generate_batch_hook():
         ["2 a a\n", "2 b b\n", "2 c c\n"]
 
 
+def test_empty_slot_rejected_at_generation_time():
+    """A 0-length slot would desync the len-prefixed reader one slot later;
+    both generators must refuse to emit it (reference contract)."""
+
+    class Empty(MultiSlotDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", []), ("label", [1])]
+            return it
+
+    with pytest.raises(ValueError, match="can not be empty"):
+        Empty().run_from_memory([None])
+
+    class EmptyStr(MultiSlotStringDataGenerator):
+        def generate_sample(self, line):
+            def it():
+                yield [("words", ["a"]), ("label", [])]
+            return it
+
+    with pytest.raises(ValueError, match="can not be empty"):
+        EmptyStr().run_from_memory([None])
+
+
 def test_run_from_stdin_pipe(monkeypatch, capsys):
     gen = WordsLabel()
     monkeypatch.setattr(sys, "stdin", io.StringIO("5 6 1\n7 0\n"))

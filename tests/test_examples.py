@@ -45,6 +45,13 @@ def test_generate_gpt_example():
     assert "OK" in out
 
 
+@pytest.mark.slow  # tier-1 wall clock is near its budget; tools/ci.sh runs
+def test_serve_gpt_example():  # this demo directly in the serving gate
+    out = _run(["examples/serve_gpt.py", "--clients", "4"])
+    assert "OK" in out
+    assert "stats:" in out
+
+
 def test_distributed_example_virtual_mesh():
     out = _run(["examples/distributed_data_parallel.py", "--virtual", "4"])
     assert "OK" in out

@@ -85,15 +85,51 @@ def test_reader_errors_surface_not_truncate():
 def test_multiprocess_reader_none_and_errors():
     from paddle_tpu import reader
 
-    r = reader.multiprocess_reader([lambda: iter([1, None, 2])])
-    assert list(r()) == [1, None, 2]  # None is data, not a sentinel
+    for use_pipe in (True, False):
+        r = reader.multiprocess_reader([lambda: iter([1, None, 2])],
+                                       use_pipe=use_pipe)
+        assert list(r()) == [1, None, 2]  # None is data, not a sentinel
 
     def crashing():
         yield 1
         raise RuntimeError("worker exploded")
 
-    with pytest.raises(RuntimeError, match="worker failed"):
+    # the ORIGINAL exception type + message re-raise in the consumer
+    with pytest.raises(RuntimeError, match="worker exploded"):
         list(reader.multiprocess_reader([lambda: crashing()])())
+
+
+def test_multiprocess_reader_typed_exception_and_traceback():
+    from paddle_tpu import reader
+
+    def crashing():
+        yield 1
+        raise ValueError("bad sample 42")
+
+    for use_pipe in (True, False):
+        with pytest.raises(ValueError, match="bad sample 42") as ei:
+            list(reader.multiprocess_reader([lambda: crashing()],
+                                            use_pipe=use_pipe)())
+        # worker traceback text rides along as the __cause__
+        assert ei.value.__cause__ is not None
+        assert "worker traceback" in str(ei.value.__cause__)
+        assert "ValueError" in str(ei.value.__cause__)
+
+
+def test_multiprocess_reader_dead_worker_not_truncated():
+    """A worker killed without an envelope (OOM/SIGKILL-style) must raise,
+    not end the stream early as if the dataset were shorter."""
+    import os
+
+    from paddle_tpu import reader
+
+    def suicidal():
+        yield 1
+        os._exit(9)
+
+    with pytest.raises(RuntimeError, match="died without finishing"):
+        list(reader.multiprocess_reader([lambda: suicidal()],
+                                        use_pipe=True)())
 
 
 # -- dataset ------------------------------------------------------------------

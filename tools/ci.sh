@@ -16,6 +16,11 @@ if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
 
+echo "== serving gate (engine tests + demo) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python examples/serve_gpt.py --clients 4 || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
