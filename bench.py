@@ -616,6 +616,109 @@ def _measure_bert_finetune(steps=500, batch=32, seq=128):
     }
 
 
+def _measure_warm_path(cfg, batch, seq, iters=4, accum=4):
+    """Warm-path trio in one number: steady-state per-microbatch step time
+    with async device prefetch (io.DevicePrefetcher) feeding a FUSED
+    gradient-accumulation executable (TrainStep.accumulate), next to the
+    same model's plain per-call step — the dispatch+transfer overhead the
+    warm-path pass removes. Model-size agnostic: runs in the CPU smoke on
+    the tiny config and on TPU at flagship shapes."""
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import io, jit
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          weight_decay=0.1)
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y), optimizer)
+    ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+    plain_dt, _ = _time_train_step(step, (ids, ids), iters)
+
+    acc = step.accumulate(accum)
+    rng = np.random.RandomState(0)
+    wins = [(paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, (accum * batch, seq)).astype("int64")),) * 2
+        for _ in range(iters + 1)]
+    first = True
+    loss = None
+    t0 = None
+    for x, y in io.DevicePrefetcher(wins):
+        loss = acc(x, y)
+        if first:  # compile window, then start the clock
+            float(loss)
+            t0 = time.perf_counter()
+            first = False
+    float(loss)
+    per_win = (time.perf_counter() - t0) / iters
+    fused_dt = per_win / accum
+    return {
+        "plain_step_time_s": round(plain_dt, 4),
+        "prefetch_accum_step_time_s": round(fused_dt, 4),
+        "accumulate_steps": accum,
+        "window_time_s": round(per_win, 4),
+        "speedup_vs_plain": round(plain_dt / fused_dt, 3) if fused_dt else None,
+        "batch": batch, "seq": seq,
+        "mode": "DevicePrefetcher + TrainStep.accumulate (one executable "
+                "per window, donated)",
+    }
+
+
+def _measure_serving_warmstart():
+    """Child config: time a ServingEngine bucket warmup (AOT compile of
+    every declared bucket) under the persistent executable cache, and
+    report the cache counters — the parent runs this twice against one
+    cache dir to get cold-start vs warm-start."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import serving
+    from paddle_tpu.jit import persistent_cache as pcache
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(64, 256), nn.Tanh(), nn.Linear(256, 16))
+    net.eval()
+    eng = serving.ServingEngine(
+        net, buckets=serving.BucketSpec(batch_sizes=(1, 2, 4, 8)),
+        input_specs=[((64,), "float32")],
+        config=serving.ServingConfig(warmup_on_start=True))
+    t0 = time.perf_counter()
+    eng.start()
+    warmup_s = time.perf_counter() - t0
+    snap = pcache.stats()
+    eng.close()
+    return {"warmup_s": round(warmup_s, 3),
+            "buckets_warmed": 4,
+            "cache_hits": snap["hits"], "cache_misses": snap["misses"],
+            "fresh_xla_compiles": snap["compiles"],
+            "cache_enabled": snap["enabled"]}
+
+
+def _warm_start_probe():
+    """Cold vs warm serving startup through the persistent cache: two
+    subprocesses share one fresh cache directory; the second must warm its
+    buckets from disk with zero fresh XLA compiles."""
+    import shutil
+    import tempfile
+
+    d = tempfile.mkdtemp(prefix="pt_benchcache_")
+    try:
+        env = {"PT_PERSISTENT_CACHE_DIR": d}
+        cold = _spawn("serving_warmstart", timeout=600, env=env)
+        warm = _spawn("serving_warmstart", timeout=600, env=env)
+        return {
+            "cold_warmup_s": cold["warmup_s"],
+            "warm_warmup_s": warm["warmup_s"],
+            "speedup": round(cold["warmup_s"] / warm["warmup_s"], 2)
+            if warm["warmup_s"] else None,
+            "warm_cache_hits": warm["cache_hits"],
+            "warm_fresh_xla_compiles": warm["fresh_xla_compiles"],
+            "cold": cold, "warm": warm,
+        }
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def _measure_serving(clients_sweep=(2, 8), per_client=100):
     """Serving smoke (docs/serving.md): closed-loop offered-load sweep over
     the batching engine — N client threads submit-and-wait against one
@@ -764,6 +867,22 @@ def _run_one(name: str):
     if name == "serving":
         print("BENCH_RESULT " + json.dumps(_measure_serving()))
         return
+    if name == "serving_warmstart":
+        print("BENCH_RESULT " + json.dumps(_measure_serving_warmstart()))
+        return
+    if name == "warm_path":
+        import jax
+
+        from paddle_tpu.models import LlamaConfig
+
+        if jax.devices()[0].platform == "cpu":
+            out = _measure_warm_path(LlamaConfig.tiny(), batch=2, seq=64,
+                                     iters=3, accum=4)
+        else:
+            out = _measure_warm_path(_configs()["big"], batch=4, seq=2048,
+                                     iters=4, accum=4)
+        print("BENCH_RESULT " + json.dumps(out))
+        return
     import paddle_tpu.optimizer as opt_mod
 
     cfg = _configs()[name]
@@ -805,12 +924,24 @@ def _run_one(name: str):
     print("BENCH_RESULT " + json.dumps(out))
 
 
-def _spawn(name: str, timeout=1200):
+def _spawn(name: str, timeout=1200, env=None):
     import subprocess
 
+    # every leg respects the process-wide deadline: never start a child
+    # whose own budget would outlive it (the r05 blackout was one recipe
+    # eating the whole harness window)
+    rem = _remaining_s()
+    if rem is not None:
+        if rem < 60:
+            raise RuntimeError(f"bench budget exhausted before {name}")
+        timeout = min(timeout, max(rem - 30, 30))
+    child_env = None
+    if env:
+        child_env = dict(os.environ)
+        child_env.update(env)
     r = subprocess.run([sys.executable, os.path.abspath(__file__),
                         "--config", name], capture_output=True, text=True,
-                       timeout=timeout)
+                       timeout=timeout, env=child_env)
     for line in r.stdout.splitlines():
         if line.startswith("BENCH_RESULT "):
             return json.loads(line[len("BENCH_RESULT "):])
@@ -820,7 +951,59 @@ def _spawn(name: str, timeout=1200):
 # keys too large for the driver-parsed line (r4's parse failure was an
 # oversized single line); they live in the artifact file instead
 _HEAVY_KEYS = ("device_op_table", "op_table", "losses_tpu", "losses_cpu",
-               "dispatch_probe")
+               "dispatch_probe", "cold", "warm")
+
+# -- wall-clock contract ------------------------------------------------------
+# the r05 blackout was rc=124 with NOTHING on stdout: one leg overran the
+# harness window before the first headline printed. Two defenses now:
+# a process-wide deadline every leg respects (skip-and-note past it), and
+# a headline that is the FIRST line printed and is re-printed as the LAST
+# line on ANY exit, SIGTERM included.
+_DEADLINE = None          # monotonic seconds; None = no budget
+_LAST_HEADLINE = None     # most recent parseable headline line
+
+
+def _arm_budget():
+    global _DEADLINE
+    budget = float(os.environ.get("BENCH_BUDGET_S", "3000"))
+    if budget > 0:
+        _DEADLINE = time.monotonic() + budget
+
+
+def _remaining_s():
+    if _DEADLINE is None:
+        return None
+    return _DEADLINE - time.monotonic()
+
+
+def _emit(line):
+    global _LAST_HEADLINE
+    _LAST_HEADLINE = line
+    print(line, flush=True)
+
+
+def _emit_final(*_sig):
+    """Last line of output = the most complete parseable headline (also
+    the SIGTERM path: an external timeout still leaves a result)."""
+    if _sig:  # signal path: the main thread may be mid-print on the same
+        # buffered stdout, where print() would raise a reentrancy error —
+        # os.write is signal-safe. Exit before the -k SIGKILL lands.
+        if _LAST_HEADLINE is not None:
+            os.write(1, ("\n" + _LAST_HEADLINE + "\n").encode())
+        os._exit(0 if _LAST_HEADLINE is not None else 1)
+    if _LAST_HEADLINE is not None:
+        print(_LAST_HEADLINE, flush=True)
+
+
+def _install_exit_headline():
+    import atexit
+    import signal
+
+    atexit.register(_emit_final)
+    try:
+        signal.signal(signal.SIGTERM, _emit_final)
+    except (ValueError, OSError):  # non-main thread / exotic platform
+        pass
 
 
 def _compact(obj):
@@ -863,15 +1046,17 @@ def _write_artifact(detail):
 
 
 def main():
-    """Driver contract (two rounds of parsed=null taught us this shape):
+    """Driver contract (three rounds of parsed=null taught us this shape):
 
-    - the flagship runs FIRST and its compact headline JSON line prints
-      IMMEDIATELY (flushed) — a later wall-clock kill still leaves a
-      parseable result on stdout;
-    - after every additional recipe the headline reprints with the detail
-      accumulated SO FAR (compact: heavy tables live in
-      bench_artifacts/bench_progress.json), so the last line on stdout is
-      always the most complete parseable result;
+    - a compact headline is the FIRST line of output (a stub until the
+      flagship lands) and is re-printed as the LAST line on every exit
+      path, SIGTERM included — an external kill still leaves the most
+      complete parseable result on stdout;
+    - every recipe runs under the process-wide budget (BENCH_BUDGET_S,
+      default 3000s) AND its own leg timeout; a leg that would outlive the
+      budget is skipped with a note instead of blacking out the run;
+    - after every recipe the headline reprints with the detail so far
+      (compact: heavy tables live in bench_artifacts/bench_progress.json);
     - slow capacity/parity legs (10-90 min each) only run with --full or
       BENCH_FULL=1: the default run fits a CI budget.
     """
@@ -879,6 +1064,12 @@ def main():
 
     from paddle_tpu.models import LlamaConfig
 
+    _arm_budget()
+    _install_exit_headline()
+    # FIRST line of output: parseable immediately, value filled in later
+    _emit(json.dumps({"metric": "llama_pretrain_mfu", "value": None,
+                      "unit": "%", "vs_baseline": None,
+                      "detail": {"status": "starting"}}))
     full = "--full" in sys.argv or \
         os.environ.get("BENCH_FULL", "") in ("1", "true")
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -886,28 +1077,43 @@ def main():
         big = _measure(LlamaConfig.tiny(), batch=2, seq=64, iters=2)
         detail = dict(big)
         detail["platform"] = jax.devices()[0].platform
-        try:
-            detail["serving"] = _measure_serving(clients_sweep=(2, 8),
-                                                 per_client=30)
-        except Exception as e:  # the smoke must never sink the bench
-            detail["serving_error"] = str(e)[:300]
+        _emit(_headline(big, detail))
+        for key, fn in (
+                ("warm_path", lambda: _measure_warm_path(
+                    LlamaConfig.tiny(), batch=2, seq=64, iters=3, accum=4)),
+                ("serving", lambda: _measure_serving(clients_sweep=(2, 8),
+                                                     per_client=30)),
+                ("persistent_cache", _warm_start_probe)):
+            rem = _remaining_s()
+            if rem is not None and rem < 90:  # same skip-and-note contract
+                detail.setdefault("skipped_over_budget", []).append(key)
+                continue
+            try:  # the smoke must never sink the bench
+                detail[key] = fn()
+            except Exception as e:
+                detail[f"{key}_error"] = str(e)[:300]
         _write_artifact(detail)  # same artifact contract as the TPU path
-        print(_headline(big, detail), flush=True)
+        _emit(_headline(big, detail))
         return
 
     big = _spawn("big", timeout=1500)
     detail = dict(big)
     detail["platform"] = "tpu"
-    print(_headline(big, detail), flush=True)  # the early headline
+    _emit(_headline(big, detail))  # the early headline
     _write_artifact(detail)
 
     def leg(key, fn):
+        rem = _remaining_s()
+        if rem is not None and rem < 90:
+            detail.setdefault("skipped_over_budget", []).append(key)
+            _write_artifact(detail)
+            return
         try:
             fn()
         except Exception as e:
             detail[f"{key}_error"] = str(e)[:300]
         _write_artifact(detail)
-        print(_headline(big, detail), flush=True)
+        _emit(_headline(big, detail))
 
     def _adafactor():
         big_model = _spawn("adafactor_1p8b")
@@ -934,6 +1140,10 @@ def main():
     leg("moe", _moe)
     leg("dit", lambda: detail.__setitem__("dit", _spawn("dit")))
     leg("serving", lambda: detail.__setitem__("serving", _spawn("serving")))
+    leg("warm_path",
+        lambda: detail.__setitem__("warm_path", _spawn("warm_path")))
+    leg("persistent_cache",
+        lambda: detail.__setitem__("persistent_cache", _warm_start_probe()))
 
     if full:
         def _resnet():
@@ -983,7 +1193,7 @@ def main():
             "reason": "slow capacity/parity legs; rerun with --full or "
                       "BENCH_FULL=1 (rows land in bench_artifacts/)"}
         _write_artifact(detail)
-        print(_headline(big, detail), flush=True)
+        _emit(_headline(big, detail))
 
 
 if __name__ == "__main__":

@@ -190,9 +190,26 @@ class RetraceAuditor:
         return diags
 
     def summary(self) -> Dict[str, Any]:
-        return {"enabled": self.enabled,
-                "tracked_keys": len(self._sigs) + len(self._attr_keys),
-                "retrace_events": len(self.events)}
+        out = {"enabled": self.enabled,
+               "tracked_keys": len(self._sigs) + len(self._attr_keys),
+               "retrace_events": len(self.events)}
+        # the persistent executable cache shares the same label namespace
+        # (TrainStep / to_static:... / serving:<name>:...): a compile the
+        # auditor would count as a baseline trace may have been a disk HIT
+        # that skipped XLA entirely — surface those rows next to the
+        # retrace counts so cold-start analyses see both halves
+        try:
+            from ..jit import persistent_cache as pcache
+
+            if pcache.is_enabled():
+                snap = pcache.stats()
+                out["persistent_cache"] = {
+                    "hits": snap["hits"], "misses": snap["misses"],
+                    "compiles": snap["compiles"],
+                    "by_label": snap["by_label"]}
+        except Exception:  # pragma: no cover - cache is optional here
+            pass
+        return out
 
     def reset(self) -> None:
         self.events.clear()

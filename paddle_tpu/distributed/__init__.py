@@ -15,7 +15,8 @@ from .collective import (  # noqa: F401
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
-from .parallel import DataParallel, ShardedTrainStep, place_model  # noqa: F401
+from .parallel import (DataParallel, ShardedAccumulateStep,  # noqa: F401
+                       ShardedTrainStep, place_model)
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 from .utils_recompute import recompute  # noqa: F401
 from . import models  # noqa: F401

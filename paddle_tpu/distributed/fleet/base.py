@@ -46,6 +46,13 @@ class DistributedStrategy:
         "fuse_grad_size_in_MB": 32,
         "nccl_comm_num": 1,
     }
+    # pipeline_configs contract: these keys ARE consumed (accumulate_steps
+    # drives the fused gradient-accumulation window, micro_batch_size the
+    # split), so a typo'd key or a nonsense value must fail at assignment,
+    # not be silently carried into a training run
+    _PIPELINE_KEYS = frozenset(
+        {"accumulate_steps", "micro_batch_size", "schedule_mode"})
+    _PIPELINE_POSITIVE = ("accumulate_steps", "micro_batch_size")
 
     def __init__(self):
         self.hybrid_configs = {
@@ -77,9 +84,30 @@ class DistributedStrategy:
         self.nccl_comm_num = 1
         self.gradient_scale_configs = {"scale_strategy": "avg"}
 
+    @classmethod
+    def _validate_pipeline_configs(cls, cfg):
+        if not isinstance(cfg, dict):
+            raise TypeError(
+                f"pipeline_configs must be a dict, got {type(cfg).__name__}")
+        unknown = set(cfg) - cls._PIPELINE_KEYS
+        if unknown:
+            raise ValueError(
+                f"pipeline_configs: unknown key(s) {sorted(unknown)}; "
+                f"valid keys: {sorted(cls._PIPELINE_KEYS)}")
+        for key in cls._PIPELINE_POSITIVE:
+            if key in cfg:
+                v = cfg[key]
+                if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                    raise ValueError(
+                        f"pipeline_configs[{key!r}] must be a positive "
+                        f"int, got {v!r}")
+
     def __setattr__(self, k, v):
         import warnings
 
+        if k == "pipeline_configs":
+            self._validate_pipeline_configs(v)
+            v = _PipelineConfigs(v)  # item assignment validates too
         if k in self._UNSUPPORTED and v:
             warnings.warn(
                 f"DistributedStrategy.{k} has no effect on the TPU stack: "
@@ -96,6 +124,21 @@ class DistributedStrategy:
     def __repr__(self):
         live = {k: v for k, v in self.__dict__.items() if v}
         return f"DistributedStrategy({live})"
+
+
+class _PipelineConfigs(dict):
+    """pipeline_configs with validated item assignment:
+    ``strategy.pipeline_configs["accumulate_steps"] = 0`` raises at the
+    assignment site instead of surfacing steps later as a bad window."""
+
+    def __setitem__(self, key, value):
+        DistributedStrategy._validate_pipeline_configs({key: value})
+        super().__setitem__(key, value)
+
+    def update(self, *args, **kwargs):
+        incoming = dict(*args, **kwargs)
+        DistributedStrategy._validate_pipeline_configs(incoming)
+        super().update(incoming)
 
 
 class _FleetState:

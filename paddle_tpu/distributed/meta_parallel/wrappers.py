@@ -110,6 +110,34 @@ class PipelineParallel(_MetaParallelBase):
             return model.compute_loss(x, y)
         return F.cross_entropy(model(x), y)
 
+    def _pp_window(self, n):
+        """The microbatch window the reference's 1F1B schedule runs per
+        train_batch call, from strategy.pipeline_configs. Both spellings
+        are honored: ``accumulate_steps`` gives the count directly;
+        ``micro_batch_size`` alone derives it (count = global batch /
+        micro size); both set (>1) must agree with the fed batch — a
+        mismatch raises instead of letting the wrong one win silently.
+        ``micro_batch_size=1`` is the dict's default and therefore reads
+        as unset (an explicit 1 is indistinguishable from it)."""
+        strat = self._strategy
+        if strat is None or not getattr(strat, "pipeline", False):
+            return 1
+        cfg = getattr(strat, "pipeline_configs", None) or {}
+        k = int(cfg.get("accumulate_steps", 1))
+        mbs = int(cfg.get("micro_batch_size", 1))
+        if k > 1 and mbs > 1 and n != k * mbs:
+            raise ValueError(
+                f"pipeline_configs: global batch {n} != accumulate_steps "
+                f"{k} * micro_batch_size {mbs}; feed batches of {k * mbs} "
+                f"or fix the config")
+        if k == 1 and mbs > 1:  # derive the count from the micro size
+            if n % mbs:
+                raise ValueError(
+                    f"pipeline_configs: global batch {n} does not divide "
+                    f"by micro_batch_size {mbs}")
+            k = n // mbs
+        return k
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         from ...nn import functional as F
         from ..mesh import get_mesh_env
@@ -120,6 +148,36 @@ class PipelineParallel(_MetaParallelBase):
         gm_k = int(getattr(optimizer, "_gm_k", 1))
         gm_avg = bool(getattr(optimizer, "_gm_avg", True))
         sc = getattr(scaler, "_scaler", scaler)
+        pp_k = self._pp_window(int(x.shape[0]))
+        if pp_k > 1 and gm_k == 1:
+            # pipeline accumulate_steps contract (reference 1F1B): ONE
+            # train_batch call = the full batch split into pp_k
+            # microbatches = one applied update. gradient_merge (per-call
+            # windows) keeps its own path below and wins when both are set.
+            if sc is None and not getattr(inner, "_offload", False) \
+                    and env is not None:
+                # the fused executable: microbatch loop as a lax.scan
+                # (jit/parallel accumulate tentpole)
+                key = ("pp_accum", id(inner), pp_k)
+                step = self._steps.get(key)
+                if step is None:
+                    from ..parallel import ShardedTrainStep
+
+                    base = ShardedTrainStep(self._layers, self._loss_fn,
+                                            inner, env=env)
+                    step = base.accumulate(pp_k)
+                    self._steps[key] = step
+                    if hasattr(optimizer, "_attach_step"):
+                        optimizer._attach_step(base)
+                loss = step(x, y)
+                if lr_scheduler is not None:
+                    lr_scheduler.step()
+                return loss
+            # scaler/offload/no-mesh can't host the fused scan — SAME
+            # window semantics, eager microbatch split
+            return self._eager_accum_batch(x, y, optimizer, pp_k,
+                                           scaler=scaler,
+                                           lr_scheduler=lr_scheduler)
         # optimizer-state offload splits the step across host/device and
         # can't host the in-graph scaler/accumulation state machine — keep
         # the (numerically identical) eager schedule for that combination
@@ -152,6 +210,36 @@ class PipelineParallel(_MetaParallelBase):
         if lr_scheduler is not None:
             lr_scheduler.step()
         return loss
+
+    def _eager_accum_batch(self, x, y, optimizer, k, scaler=None,
+                           lr_scheduler=None):
+        """Eager twin of the fused window: split the global batch into k
+        microbatches, backward(loss/k) each, ONE optimizer update. Keeps
+        train_batch's call semantics identical across the fused, scaler,
+        offload, and mesh-less paths."""
+        n = int(x.shape[0])
+        if n % k:
+            raise ValueError(
+                f"pipeline_configs accumulate_steps={k}: global batch dim "
+                f"{n} must divide by the microbatch count")
+        mb = n // k
+        total = None
+        for i in range(k):
+            loss_i = self._loss_fn(self._layers, x[i * mb:(i + 1) * mb],
+                                   y[i * mb:(i + 1) * mb])
+            if scaler is not None:
+                scaler.scale(loss_i * (1.0 / k)).backward()
+            else:
+                (loss_i * (1.0 / k)).backward()
+            total = loss_i if total is None else total + loss_i
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total * (1.0 / k)
 
     def eval_batch(self, data, compute_loss=True):
         x, y = data

@@ -222,38 +222,28 @@ class ShardedTrainStep:
             return P()
         return P(tuple(data_axes))
 
+    def batch_sharding(self, arr) -> NamedSharding:
+        """NamedSharding for one batch leaf — the hook
+        ``io.DevicePrefetcher(loader, sharding=step.batch_sharding)`` uses
+        to land prefetched batches already laid out for this step, so the
+        compiled program starts without a host transfer OR a reshard."""
+        return self.env.sharding_for(self._default_batch_spec(arr))
+
     def _make_updater(self):
         """Per-param optimizer update math shared by every build variant:
-        grads (param dtype) + states -> (new_params, new_states)."""
-        opt = self.optimizer
-        rule = type(opt)._rule
-        hyper = opt._hyper()
-        wd = opt._weight_decay
-        decoupled = opt._decoupled
-        wd_flags = tuple(
-            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
-            for p in self.train_params)
+        grads (param dtype) + states -> (new_params, new_states). One
+        source with the single-chip compilers (jit.make_param_updater)."""
+        from ..jit import make_param_updater
 
-        def apply(params, grads, states, lr, step_no):
-            new_p, new_s = [], []
-            for p, g, s, flag in zip(params, grads, states, wd_flags):
-                g = g.astype(p.dtype)
-                if wd and not decoupled and flag:
-                    g = g + wd * p
-                hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
-                np_, ns = rule(p, g, s, lr, step_no, hyper_i)
-                if wd and decoupled and flag:
-                    np_ = np_ - (lr * wd * p).astype(p.dtype)
-                new_p.append(np_)
-                new_s.append(ns)
-            return new_p, new_s
+        return make_param_updater(self.optimizer, self.train_params)
 
-        return apply
-
-    def _make_grad_fn(self, scale_in_graph=False):
+    def _make_grad_fn(self, scale_in_graph=False, remat=False):
         """value_and_grad closure over the bound model; returns
         (loss f32, grads in param dtype). When scale_in_graph, the loss is
-        multiplied by a traced loss-scale before differentiation."""
+        multiplied by a traced loss-scale before differentiation. When
+        remat, the forward is checkpointed so backward recomputes it
+        instead of holding residuals (the accumulate-window memory
+        saver)."""
         model, loss_fn = self.target, self.loss_fn
         train_params = self.train_params
         frozen = self.frozen
@@ -270,6 +260,8 @@ class ShardedTrainStep:
                 loss = loss.data.astype(jnp.float32)
                 return loss * scale if scale_in_graph else loss
 
+            if remat:
+                loss_of = jax.checkpoint(loss_of)
             return jax.value_and_grad(loss_of)(tuple(params))
 
         return grad_of
@@ -336,8 +328,28 @@ class ShardedTrainStep:
         in_shardings = (param_sh, state_sh, frozen_sh, repl, repl, repl, *batch_sh)
         out_shardings = (repl, param_sh, state_sh)
         donate = (0, 1) if self.donate else ()
-        return jax.jit(step, in_shardings=in_shardings, out_shardings=out_shardings,
-                       donate_argnums=donate)
+        from ..jit import persistent_cache
+
+        return persistent_cache.cached_jit(
+            step, in_shardings=in_shardings, out_shardings=out_shardings,
+            donate_argnums=donate, label="ShardedTrainStep")
+
+    def accumulate(self, steps: int, remat: bool = False,
+                   average: bool = True) -> "ShardedAccumulateStep":
+        """Fused gradient accumulation over the mesh: the multi-chip twin of
+        ``jit.TrainStep.accumulate`` — ``steps`` microbatches scanned inside
+        ONE pjit'ed executable (fp32 carried accumulators at the grad
+        placement, optional remat on the microbatch body), one optimizer
+        update per call. Call with the FULL (global) batch; dim 0 must
+        divide by ``steps``. Unlike ``accum_steps`` (which spreads the
+        window over k calls), this is one dispatch per window."""
+        if self.scaler is not None or self.offload:
+            raise NotImplementedError(
+                "ShardedTrainStep.accumulate: fused accumulation does not "
+                "compose with the in-graph GradScaler or optimizer-state "
+                "offload; use accum_steps for the scaler path")
+        return ShardedAccumulateStep(self, steps, remat=remat,
+                                     average=average)
 
     # -- in-graph AMP / gradient accumulation --------------------------------
     def _grad_shardings(self):
@@ -699,3 +711,118 @@ class ShardedTrainStep:
             opt._accumulators[id(p)] = s
         opt._global_step += 1
         return Tensor(loss)
+
+
+class ShardedAccumulateStep:
+    """Fused gradient-accumulation pjit (``ShardedTrainStep.accumulate``).
+
+    One executable over the mesh: ``lax.scan`` over ``steps`` microbatches
+    (each sliced from the global batch, so the dp sharding of the inputs
+    carries straight into every microbatch), fp32 grad accumulators carried
+    at the grad placement, a single optimizer update at the end. Params and
+    optimizer state are donated. Duck-types the TrainStep capture surface
+    so ``analysis.capture`` / the HBM estimator model it.
+    """
+
+    def __init__(self, step: ShardedTrainStep, steps: int,
+                 remat: bool = False, average: bool = True):
+        if int(steps) < 1:
+            raise ValueError(f"accumulate: steps must be >= 1, got {steps}")
+        self._step = step
+        self.env = step.env
+        self.steps = int(steps)
+        self.remat = bool(remat)
+        self.average = bool(average)
+        self.optimizer = step.optimizer
+        self.donate = step.donate
+        self.train_params = step.train_params
+        self.frozen = step.frozen
+        self._jitted = None
+
+    def _build(self, batch_arrays):
+        outer = self._step
+        opt = self.optimizer
+        clip = opt._grad_clip
+        k = self.steps
+        scale = 1.0 / k if self.average else 1.0
+        updater = outer._make_updater()
+        grad_of = outer._make_grad_fn(remat=self.remat)
+        zero2_shardings = outer._zero2_plan()
+
+        def step(params, states, frozen_arrays, lr, step_no, rngkey, *batch):
+            micro = tuple(
+                a.reshape((k, a.shape[0] // k) + a.shape[1:]) for a in batch)
+            keys = jax.random.split(rngkey, k)
+
+            def body(acc, xs):
+                key_i, mb = xs[0], xs[1:]
+                random_mod.default_generator().set_trace_key(key_i)
+                try:
+                    loss_i, grads = grad_of(tuple(params), frozen_arrays, mb)
+                finally:
+                    random_mod.default_generator().clear_trace_key()
+                grads = [g.astype(jnp.float32) * scale for g in grads]
+                if zero2_shardings is not None:
+                    grads = [g if sh is None
+                             else jax.lax.with_sharding_constraint(g, sh)
+                             for g, sh in zip(grads, zero2_shardings)]
+                acc2 = [a + g for a, g in zip(acc, grads)]
+                return acc2, loss_i
+
+            acc0 = [jnp.zeros(p.shape, jnp.float32)
+                    for p in self.train_params]
+            accT, losses = jax.lax.scan(body, acc0, (keys,) + micro)
+            grads = list(accT)
+            if clip is not None:
+                grads = clip._apply_jax(grads)
+            new_p, new_s = updater(params, grads, states, lr, step_no)
+            return jnp.mean(losses), new_p, new_s
+
+        param_sh, state_sh, frozen_sh, batch_sh = \
+            outer._sharding_plan(batch_arrays)
+        repl = self.env.replicated()
+        in_sh = (param_sh, state_sh, frozen_sh, repl, repl, repl, *batch_sh)
+        out_sh = (repl, param_sh, state_sh)
+        donate = (0, 1) if self.donate else ()
+        from ..jit import persistent_cache
+
+        return persistent_cache.cached_jit(
+            step, in_shardings=in_sh, out_shardings=out_sh,
+            donate_argnums=donate,
+            label=f"ShardedTrainStep.accumulate({k})",
+            extra_meta=("accum", k, self.average, self.remat))
+
+    def __call__(self, *batch):
+        opt = self.optimizer
+        arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        for a in arrays:
+            if a.ndim == 0 or a.shape[0] % self.steps != 0:
+                raise ValueError(
+                    f"accumulate({self.steps}): batch dim {a.shape} must "
+                    f"divide by the microbatch count")
+        if self._jitted is None:
+            from ..jit import _audit_instance_label, _maybe_audit
+
+            self._jitted = _maybe_audit(
+                _audit_instance_label(
+                    f"ShardedTrainStep.accumulate({self.steps})"),
+                self._build(arrays))
+        params = [p.data for p in self.train_params]
+        states = [opt._accumulators[id(p)] for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+        loss, new_p, new_s = self._jitted(
+            params, states, frozen_arrays, lr, step_no,
+            random_mod.next_key(), *arrays)
+        for p, a in zip(self.train_params, new_p):
+            p.data = a
+        for p, s in zip(self.train_params, new_s):
+            opt._accumulators[id(p)] = s
+        opt._global_step += 1
+        return Tensor(loss)
+
+    def batch_sharding(self, arr) -> NamedSharding:
+        """Prefetch placement hook (see ShardedTrainStep.batch_sharding)."""
+        return self._step.batch_sharding(arr)

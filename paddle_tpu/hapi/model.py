@@ -186,11 +186,23 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None,
+            prefetch_to_device=False, device_sharding=None):
         assert train_data is not None, "train_data must be given"
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
                                    drop_last=drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        if prefetch_to_device:
+            # io.prefetch: a background thread device_puts batch N+1 while
+            # batch N trains, so the step never waits on the host transfer.
+            # device_sharding: Sharding or leaf->sharding callable (e.g.
+            # ShardedTrainStep.batch_sharding) for mesh-placed batches.
+            from ..io import DevicePrefetcher
+
+            loader = DevicePrefetcher(loader, sharding=device_sharding)
+            if eval_loader is not None:
+                eval_loader = DevicePrefetcher(eval_loader,
+                                               sharding=device_sharding)
         steps = len(loader) if hasattr(loader, "__len__") else None
         metric_names = ["loss"] + [n for m in self._metrics for n in to_list(m.name())]
         cbks = config_callbacks(

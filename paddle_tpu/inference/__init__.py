@@ -45,6 +45,18 @@ class Config:
     def enable_memory_optim(self, flag=True):
         self._memory_optim = flag
 
+    def enable_persistent_cache(self, dir: Optional[str] = None):
+        """Warm-start switch: route this predictor's per-shape compiles of
+        the loaded program through the on-disk executable cache
+        (``paddle_tpu.jit.persistent_cache``) so a fresh serving process
+        performs zero fresh XLA compiles for shapes it has served before.
+        The reference analogue is AnalysisConfig's optimized-program
+        serialization (``SetOptimCacheDir``)."""
+        from ..jit import persistent_cache
+
+        persistent_cache.enable(dir)
+        return self
+
     def disable_glog_info(self):
         pass
 

@@ -19,6 +19,7 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..framework import random as random_mod
+from .prefetch import DevicePrefetcher, prefetch_to_device
 
 
 class Dataset:
@@ -291,8 +292,15 @@ class DataLoader:
                  batch_sampler=None, batch_size=1, shuffle=False, drop_last=False,
                  collate_fn=None, num_workers=0, use_buffer_reader=True,
                  prefetch_factor=2, use_shared_memory=True, timeout=0,
-                 worker_init_fn=None, persistent_workers=False):
+                 worker_init_fn=None, persistent_workers=False,
+                 prefetch_to_device=False, device_sharding=None):
         self.dataset = dataset
+        # async device prefetch (io.prefetch): overlap the NEXT batch's
+        # host->device transfer with the current step's compute.
+        # device_sharding: a Sharding or leaf->sharding callable for
+        # ShardedTrainStep batch layouts; None = plain committed transfer.
+        self.prefetch_to_device = bool(prefetch_to_device)
+        self.device_sharding = device_sharding
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.worker_init_fn = worker_init_fn
@@ -330,6 +338,13 @@ class DataLoader:
                 yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def __iter__(self):
+        if self.prefetch_to_device:
+            yield from DevicePrefetcher(self._host_iter(),
+                                        sharding=self.device_sharding)
+            return
+        yield from self._host_iter()
+
+    def _host_iter(self):
         if self.num_workers > 0 and not self._iterable_mode:
             yield from _MultiprocessIterator(self)
             return

@@ -26,6 +26,7 @@ from ..core.tensor import Tensor
 from ..core import autograd
 from ..framework import random as random_mod
 from ..nn.layer.layers import Layer
+from . import persistent_cache
 
 
 def _collect_params(layer: Layer):
@@ -55,6 +56,38 @@ def _audit_instance_label(kind: str) -> str:
     would report phantom recompiles."""
     _AUDIT_INSTANCE_NO[0] += 1
     return f"{kind}#{_AUDIT_INSTANCE_NO[0]}"
+
+
+def make_param_updater(opt, train_params):
+    """Per-param optimizer update math (grads -> new params/states): the
+    ONE source of the weight-decay coupling / decoupled-decay / rule
+    application every compiled step uses — TrainStep, the fused
+    AccumulateStep, and ShardedTrainStep's mesh builds all call this, so
+    their numerics cannot drift apart."""
+    rule = type(opt)._rule
+    hyper = opt._hyper()
+    wd = opt._weight_decay
+    decoupled = opt._decoupled
+    wd_flags = tuple(
+        1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
+        for p in train_params)
+
+    def apply(params, grads, states, lr, step_no):
+        new_p, new_s = [], []
+        for p, g, s, flag in zip(params, grads, states, wd_flags):
+            g = g.astype(p.dtype)
+            if wd and not decoupled and flag:
+                g = g + wd * p
+            hyper_i = hyper if flag or "wd" not in hyper \
+                else dict(hyper, wd=0.0)
+            np_, ns = rule(p, g, s, lr, step_no, hyper_i)
+            if wd and decoupled and flag:
+                np_ = np_ - (lr * wd * p).astype(p.dtype)
+            new_p.append(np_)
+            new_s.append(ns)
+        return new_p, new_s
+
+    return apply
 
 
 class _Binder:
@@ -198,7 +231,10 @@ class StaticLayer:
             # signature bucket a distinct label too, or two specializations
             # of one wrapper would read as phantom signature drift
             jitted = _maybe_audit(
-                f"{self._audit_label}/k{len(self._cache)}", jax.jit(run))
+                f"{self._audit_label}/k{len(self._cache)}",
+                persistent_cache.cached_jit(
+                    run, label=self._audit_label,
+                    extra_meta=("to_static", repr(key))))
             self._cache[key] = jitted
         param_arrays = [t.data for t in tensors]
         out = jitted(param_arrays, arrays, kw_arrays, random_mod.next_key())
@@ -247,19 +283,16 @@ class TrainStep:
             if id(p) not in opt._accumulators:
                 opt._accumulators[id(p)] = opt._init_state(p.data)
 
+    def _make_updater(self):
+        return make_param_updater(self.optimizer, self.train_params)
+
     def _build(self):
         opt = self.optimizer
         model, loss_fn = self.model, self.loss_fn
-        rule = type(opt)._rule
-        hyper = opt._hyper()
-        wd = opt._weight_decay
-        decoupled = opt._decoupled
         clip = opt._grad_clip
         train_params = self.train_params
         frozen = self.frozen
-        wd_flags = tuple(
-            1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
-            for p in train_params)
+        updater = self._make_updater()
 
         def step(params, states, frozen_arrays, lr, step_no, rngkey, *batch):
             random_mod.default_generator().set_trace_key(rngkey)
@@ -276,23 +309,25 @@ class TrainStep:
                 grads = list(grads)
                 if clip is not None:
                     grads = clip._apply_jax(grads)
-                new_p, new_s = [], []
-                for p, g, s, flag in zip(params, grads, states, wd_flags):
-                    g = g.astype(p.dtype)
-                    if wd and not decoupled and flag:
-                        g = g + wd * p
-                    hyper_i = hyper if flag or "wd" not in hyper else dict(hyper, wd=0.0)
-                    np_, ns = rule(p, g, s, lr, step_no, hyper_i)
-                    if wd and decoupled and flag:
-                        np_ = np_ - (lr * wd * p).astype(p.dtype)
-                    new_p.append(np_)
-                    new_s.append(ns)
+                new_p, new_s = updater(params, grads, states, lr, step_no)
                 return loss_val, new_p, new_s
             finally:
                 random_mod.default_generator().clear_trace_key()
 
         donate = (0, 1) if self.donate else ()
-        return jax.jit(step, donate_argnums=donate)
+        return persistent_cache.cached_jit(step, donate_argnums=donate,
+                                           label="TrainStep")
+
+    def accumulate(self, steps: int, remat: bool = False,
+                   average: bool = True) -> "AccumulateStep":
+        """Fused gradient accumulation: one executable that scans ``steps``
+        microbatches (fwd+bwd each, optional remat), accumulates grads in
+        fp32, and applies ONE optimizer update — numerically the k
+        sequential micro-steps of the eager accumulation recipe (loss
+        scaled 1/k when ``average``) without k dispatches or k optimizer
+        launches. Call it with the FULL batch; dim 0 must divide by
+        ``steps``."""
+        return AccumulateStep(self, steps, remat=remat, average=average)
 
     def __call__(self, *batch):
         if self._jitted is None:
@@ -307,6 +342,122 @@ class TrainStep:
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         loss, new_p, new_s = self._jitted(
             params, states, frozen_arrays, lr, step_no, random_mod.next_key(), *arrays)
+        for p, a in zip(self.train_params, new_p):
+            p.data = a
+        for p, s in zip(self.train_params, new_s):
+            opt._accumulators[id(p)] = s
+        opt._global_step += 1
+        return Tensor(loss)
+
+
+class AccumulateStep:
+    """Fused gradient-accumulation executable (``TrainStep.accumulate``).
+
+    The microbatch loop is a ``lax.scan`` INSIDE one jitted-and-donated
+    program: per iteration fwd+bwd on one microbatch (optionally under
+    ``jax.checkpoint`` so activations rematerialize instead of living for
+    the whole window), gradients accumulated into fp32 carries, then a
+    single optimizer update from the window total. Equivalent to the eager
+    recipe ``for mb: backward(loss(mb)/k); optimizer.step()`` — the
+    lr-equivalent scaling of a full-batch mean loss — with one dispatch
+    and no per-microbatch host round-trips.
+
+    Duck-types the TrainStep capture surface (``_build``/``train_params``/
+    ``frozen``/``optimizer``/``donate``) so ``analysis.capture`` and the
+    HBM estimator model it, donation included.
+    """
+
+    def __init__(self, step: TrainStep, steps: int, remat: bool = False,
+                 average: bool = True):
+        if int(steps) < 1:
+            raise ValueError(f"accumulate: steps must be >= 1, got {steps}")
+        self._step = step
+        self.steps = int(steps)
+        self.remat = bool(remat)
+        self.average = bool(average)
+        self.model = step.model
+        self.loss_fn = step.loss_fn
+        self.optimizer = step.optimizer
+        self.donate = step.donate
+        self.train_params = step.train_params
+        self.frozen = step.frozen
+        self._jitted = None
+
+    def _build(self):
+        opt = self.optimizer
+        model, loss_fn = self.model, self.loss_fn
+        clip = opt._grad_clip
+        train_params = self.train_params
+        frozen = self.frozen
+        k = self.steps
+        scale = 1.0 / k if self.average else 1.0
+        remat = self.remat
+        updater = self._step._make_updater()
+
+        def loss_of(param_arrays, frozen_arrays, mb):
+            ts = train_params + frozen
+            with _Binder(ts) as b:
+                b.bind(list(param_arrays) + list(frozen_arrays))
+                with autograd.no_grad():
+                    loss = loss_fn(model, *[Tensor(a) for a in mb])
+            return loss.data.astype(jnp.float32)
+
+        # grads w.r.t. argnum 0 (params) only; remat recomputes the
+        # microbatch forward during backward so window activations never
+        # accumulate across scan iterations
+        grad_fn = jax.value_and_grad(
+            jax.checkpoint(loss_of) if remat else loss_of)
+
+        def step(params, states, frozen_arrays, lr, step_no, rngkey, *batch):
+            micro = tuple(
+                a.reshape((k, a.shape[0] // k) + a.shape[1:]) for a in batch)
+            keys = jax.random.split(rngkey, k)
+
+            def body(acc, xs):
+                key_i, mb = xs[0], xs[1:]
+                random_mod.default_generator().set_trace_key(key_i)
+                try:
+                    loss_i, grads = grad_fn(tuple(params), frozen_arrays, mb)
+                finally:
+                    random_mod.default_generator().clear_trace_key()
+                acc2 = [a + g.astype(jnp.float32) * scale
+                        for a, g in zip(acc, grads)]
+                return acc2, loss_i
+
+            acc0 = [jnp.zeros(p.shape, jnp.float32) for p in train_params]
+            accT, losses = jax.lax.scan(body, acc0, (keys,) + micro)
+            grads = list(accT)
+            if clip is not None:
+                grads = clip._apply_jax(grads)
+            new_p, new_s = updater(params, grads, states, lr, step_no)
+            return jnp.mean(losses), new_p, new_s
+
+        donate = (0, 1) if self.donate else ()
+        return persistent_cache.cached_jit(
+            step, donate_argnums=donate, label=f"TrainStep.accumulate({k})",
+            extra_meta=("accum", k, self.average, self.remat))
+
+    def __call__(self, *batch):
+        opt = self.optimizer
+        arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b)
+                  for b in batch]
+        for a in arrays:
+            if a.ndim == 0 or a.shape[0] % self.steps != 0:
+                raise ValueError(
+                    f"accumulate({self.steps}): batch dim {a.shape} must "
+                    f"divide by the microbatch count")
+        if self._jitted is None:
+            self._jitted = _maybe_audit(
+                _audit_instance_label(f"TrainStep.accumulate({self.steps})"),
+                self._build())
+        params = [p.data for p in self.train_params]
+        states = [opt._accumulators[id(p)] for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+        loss, new_p, new_s = self._jitted(
+            params, states, frozen_arrays, lr, step_no,
+            random_mod.next_key(), *arrays)
         for p, a in zip(self.train_params, new_p):
             p.data = a
         for p, s in zip(self.train_params, new_s):
@@ -408,6 +559,7 @@ class TranslatedLayer(Layer):
 def load(path, **configs):
     """jit.load: rehydrate a jit.save artifact as a callable Layer."""
     import json
+    import os
 
     import numpy as np
     from jax import export as jexport
@@ -431,10 +583,16 @@ def load(path, **configs):
         layer.add_parameter(key, p)
         params.append(p)
 
+    # the exported program still pays an XLA compile per concrete input
+    # shape; route it through the persistent cache so a warm process
+    # (inference.Predictor load, serving warmup) skips those compiles
+    call = persistent_cache.cached_jit(
+        exp.call, label=f"jit.load:{os.path.basename(path)}")
+
     def forward(*inputs):
         arrs = [x.data if isinstance(x, Tensor) else jnp.asarray(x)
                 for x in inputs]
-        out = exp.call([p.data for p in params], *arrs)
+        out = call([p.data for p in params], *arrs)
         return jax.tree_util.tree_map(Tensor, out)
 
     layer.forward = forward

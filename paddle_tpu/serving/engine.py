@@ -200,9 +200,12 @@ class ServingEngine(EngineBase):
                         lambda t: t.data if isinstance(t, Tensor) else t, out,
                         is_leaf=lambda t: isinstance(t, Tensor))
 
+                label = self._label(bucket_b, key)
                 jitted = jit_mod._maybe_audit(
-                    self._label(bucket_b, key),
-                    jax.jit(raw, donate_argnums=(1,) if donate else ()))
+                    label,
+                    jit_mod.persistent_cache.cached_jit(
+                        raw, donate_argnums=(1,) if donate else (),
+                        label=label))
 
                 def runner(np_inputs):
                     out = jitted([t.data for t in tensors],
@@ -219,9 +222,12 @@ class ServingEngine(EngineBase):
                 def raw(input_arrays):
                     return target(*input_arrays)
 
+                label = self._label(bucket_b, key)
                 jitted = jit_mod._maybe_audit(
-                    self._label(bucket_b, key),
-                    jax.jit(raw, donate_argnums=(0,) if donate else ()))
+                    label,
+                    jit_mod.persistent_cache.cached_jit(
+                        raw, donate_argnums=(0,) if donate else (),
+                        label=label))
 
                 def runner(np_inputs):
                     out = jitted(tuple(jax.numpy.asarray(a)
@@ -447,8 +453,21 @@ class ServingEngine(EngineBase):
     # -- observability --------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
         """One snapshot: QPS, latency percentiles, occupancy, counters,
-        queue depth, warmed executables, steady-state retrace count."""
+        queue depth, warmed executables, steady-state retrace count, and —
+        when the persistent executable cache is on — this engine's on-disk
+        hit/miss rows (warm starts skip the bucket compiles entirely)."""
         snap = self._stats_base()
         snap["buckets"] = repr(self.buckets)
         snap["warmed_executables"] = len(self._compiled)
+        from ..jit import persistent_cache as pcache
+
+        if pcache.is_enabled():
+            prefix = f"serving:{self.name}:"
+            rows = {k: v for k, v in pcache.stats()["by_label"].items()
+                    if k.startswith(prefix)}
+            snap["persistent_cache"] = {
+                "hits": sum(r.get("hits", 0) for r in rows.values()),
+                "misses": sum(r.get("misses", 0) for r in rows.values()),
+                "dir": pcache.cache_dir(),
+            }
         return snap

@@ -1,0 +1,533 @@
+"""Warm-path pass tests: fused gradient accumulation, async device
+prefetch, and the persistent executable cache (ISSUE 3).
+
+Accumulation parity is the hard contract: ``TrainStep.accumulate(k)`` must
+match k sequential eager micro-steps (loss scaled 1/k, one optimizer
+update) to numerical noise, keep buffer donation, and never retrace.
+The persistent-cache contract is cross-process: a second process warming
+the same programs performs ZERO fresh XLA compiles (counter-asserted),
+and corrupt/stale entries degrade to a miss, never an error.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+import paddle_tpu.optimizer as opt
+from paddle_tpu import analysis, io, jit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _mlp_and_opt(seed=3, wd=0.01):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+    o = opt.AdamW(learning_rate=1e-2, parameters=net.parameters(),
+                  weight_decay=wd)
+    return net, o
+
+
+def _batch(n=8, seed=0):
+    rng = np.random.RandomState(seed)
+    return (rng.randn(n, 8).astype("float32"),
+            rng.randint(0, 4, n).astype("int64"))
+
+
+class TestFusedAccumulation:
+    def test_parity_with_sequential_microsteps(self):
+        """accumulate(k) == the eager recipe: k micro-steps of
+        backward(loss_i/k) then ONE optimizer update."""
+        k = 4
+        X, Y = _batch(8)
+
+        net1, o1 = _mlp_and_opt()
+        step = jit.TrainStep(net1, lambda m, x, y: F.cross_entropy(m(x), y),
+                             o1)
+        acc = step.accumulate(k)
+        loss_fused = float(acc(paddle.to_tensor(X), paddle.to_tensor(Y)))
+        assert o1._global_step == 1  # one applied update per window
+
+        net2, o2 = _mlp_and_opt()
+        mb = 8 // k
+        losses = []
+        for i in range(k):
+            xb = paddle.to_tensor(X[i * mb:(i + 1) * mb])
+            yb = paddle.to_tensor(Y[i * mb:(i + 1) * mb])
+            loss = F.cross_entropy(net2(xb), yb)
+            losses.append(float(loss))
+            (loss * (1.0 / k)).backward()
+        o2.step()
+        o2.clear_grad()
+
+        assert loss_fused == pytest.approx(sum(losses) / k, abs=1e-6)
+        for p1, p2 in zip(net1.parameters(), net2.parameters()):
+            np.testing.assert_allclose(np.asarray(p1.data),
+                                       np.asarray(p2.data),
+                                       rtol=1e-6, atol=1e-7)
+
+    @pytest.mark.slow  # tier-1 wall clock is near budget; ci.sh covers it
+    def test_remat_variant_matches(self):
+        """remat changes memory, not math: same params either way."""
+        X, Y = _batch(8)
+        net1, o1 = _mlp_and_opt()
+        jit.TrainStep(net1, lambda m, x, y: F.cross_entropy(m(x), y),
+                      o1).accumulate(4)(paddle.to_tensor(X),
+                                        paddle.to_tensor(Y))
+        net2, o2 = _mlp_and_opt()
+        jit.TrainStep(net2, lambda m, x, y: F.cross_entropy(m(x), y),
+                      o2).accumulate(4, remat=True)(paddle.to_tensor(X),
+                                                    paddle.to_tensor(Y))
+        for p1, p2 in zip(net1.parameters(), net2.parameters()):
+            np.testing.assert_allclose(np.asarray(p1.data),
+                                       np.asarray(p2.data),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_donation_still_in_effect(self):
+        """Params + optimizer state stay donated in the fused executable
+        (asserted through the analysis capture the HBM estimator uses)."""
+        net, o = _mlp_and_opt()
+        step = jit.TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                             o)
+        acc = step.accumulate(2)
+        X, Y = _batch(8)
+        prog = analysis.capture(acc, paddle.to_tensor(X),
+                                paddle.to_tensor(Y))
+        import jax
+
+        n_donated = len(jax.tree_util.tree_leaves(
+            ([p.data for p in acc.train_params],
+             [o._accumulators[id(p)] for p in acc.train_params])))
+        assert sum(prog.donated_invars) == n_donated > 0
+        # the estimator consumes the donation mask: peak must come in
+        # UNDER the no-donation resident floor (params+states die at use)
+        est = analysis.estimate_peak(prog)
+        est_nodonate = analysis.memory.estimate_peak_jaxpr(
+            prog.jaxpr, (False,) * len(prog.donated_invars), prog.label)
+        assert est.peak_bytes <= est_nodonate.peak_bytes
+
+    def test_zero_retrace_across_calls(self):
+        net, o = _mlp_and_opt()
+        acc = jit.TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                            o).accumulate(2)
+        X, Y = _batch(8)
+        aud = analysis.retrace.enable()
+        base = len(aud.events)
+        try:
+            for i in range(3):
+                acc(paddle.to_tensor(X), paddle.to_tensor(Y))
+            mine = [e for e in aud.events[base:]
+                    if "accumulate" in str(e.label)]
+            assert not mine, [e.why() for e in mine]
+        finally:
+            analysis.retrace.disable()
+
+    def test_bad_steps_and_indivisible_batch_raise(self):
+        net, o = _mlp_and_opt()
+        step = jit.TrainStep(net, lambda m, x, y: F.cross_entropy(m(x), y),
+                             o)
+        with pytest.raises(ValueError):
+            step.accumulate(0)
+        acc = step.accumulate(3)
+        X, Y = _batch(8)  # 8 % 3 != 0
+        with pytest.raises(ValueError):
+            acc(paddle.to_tensor(X), paddle.to_tensor(Y))
+
+    @pytest.mark.slow  # tier-1 wall clock is near budget; ci.sh covers it
+    def test_sharded_accumulate_parity(self):
+        """ShardedTrainStep.accumulate on a 1-device mesh matches the
+        unsharded fused step."""
+        import jax
+
+        import paddle_tpu.distributed as dist
+
+        X, Y = _batch(8)
+        net1, o1 = _mlp_and_opt()
+        acc1 = jit.TrainStep(net1, lambda m, x, y: F.cross_entropy(m(x), y),
+                             o1).accumulate(2)
+        l1 = float(acc1(paddle.to_tensor(X), paddle.to_tensor(Y)))
+
+        dist.reset_mesh()
+        dist.init_mesh(devices=jax.devices()[:1])
+        try:
+            net2, o2 = _mlp_and_opt()
+            acc2 = dist.ShardedTrainStep(
+                net2, lambda m, x, y: F.cross_entropy(m(x), y),
+                o2).accumulate(2)
+            l2 = float(acc2(paddle.to_tensor(X), paddle.to_tensor(Y)))
+            assert l1 == pytest.approx(l2, abs=1e-6)
+            for p1, p2 in zip(net1.parameters(), net2.parameters()):
+                np.testing.assert_allclose(np.asarray(p1.data),
+                                           np.asarray(p2.data),
+                                           rtol=1e-5, atol=1e-6)
+        finally:
+            dist.reset_mesh()
+
+
+class TestPipelineConfigsHonored:
+    def test_validation_at_assignment(self):
+        import paddle_tpu.distributed.fleet as fleet
+
+        s = fleet.DistributedStrategy()
+        s.pipeline_configs = {"accumulate_steps": 4, "micro_batch_size": 2}
+        with pytest.raises(ValueError, match="unknown key"):
+            s.pipeline_configs = {"acumulate_steps": 4}  # the typo case
+        with pytest.raises(ValueError, match="positive"):
+            s.pipeline_configs = {"accumulate_steps": 0}
+        with pytest.raises(ValueError, match="positive"):
+            s.pipeline_configs = {"micro_batch_size": -1}
+        with pytest.raises(ValueError, match="positive"):
+            s.pipeline_configs["accumulate_steps"] = -2  # item assignment
+        with pytest.raises(ValueError, match="unknown key"):
+            s.pipeline_configs.update(bogus=1)
+        s.pipeline_configs["accumulate_steps"] = 8
+        assert s.pipeline_configs["accumulate_steps"] == 8
+
+    def test_accumulate_steps_drives_fused_window(self):
+        """pipeline_configs["accumulate_steps"] is CONSUMED: train_batch
+        applies exactly one update per call through the fused executable,
+        matching the unsharded accumulate numerics."""
+        import jax
+
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu.distributed.meta_parallel import PipelineParallel
+
+        X, Y = _batch(8)
+        net1, o1 = _mlp_and_opt()
+        acc = jit.TrainStep(net1, lambda m, x, y: F.cross_entropy(m(x), y),
+                            o1).accumulate(2)
+        ref_loss = float(acc(paddle.to_tensor(X), paddle.to_tensor(Y)))
+
+        dist.reset_mesh()
+        s = fleet.DistributedStrategy()
+        s.pipeline = True
+        s.pipeline_configs = {"accumulate_steps": 2}
+        fleet.init(strategy=s)
+        try:
+            net2, o2 = _mlp_and_opt()
+
+            class _XentPipe(nn.Layer):
+                def __init__(self, inner):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, x):
+                    return self.inner(x)
+
+                def compute_loss(self, x, y):
+                    return F.cross_entropy(self.inner(x), y)
+
+            pp = PipelineParallel(_XentPipe(net2),
+                                  fleet.get_hybrid_communicate_group(),
+                                  strategy=s)
+            hopt = fleet.distributed_optimizer(o2, strategy=s)
+            loss = pp.train_batch((paddle.to_tensor(X), paddle.to_tensor(Y)),
+                                  hopt)
+            assert any(k[0] == "pp_accum" for k in pp._steps)
+            assert o2._global_step == 1
+            assert float(loss) == pytest.approx(ref_loss, abs=1e-6)
+        finally:
+            dist.reset_mesh()
+
+    @pytest.mark.slow  # tier-1 wall clock is near budget; ci.sh covers it
+    def test_accumulate_steps_with_scaler_same_window_semantics(self):
+        """Paths that can't host the fused scan (in-graph scaler) keep the
+        SAME call contract — one call = the full batch = one update — via
+        the eager microbatch split, not a silent per-call window."""
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.fleet as fleet
+        from paddle_tpu import amp
+        from paddle_tpu.distributed.meta_parallel import PipelineParallel
+
+        X, Y = _batch(8)
+        net1, o1 = _mlp_and_opt()
+        acc = jit.TrainStep(net1, lambda m, x, y: F.cross_entropy(m(x), y),
+                            o1).accumulate(4)
+        ref_loss = float(acc(paddle.to_tensor(X), paddle.to_tensor(Y)))
+
+        dist.reset_mesh()
+        s = fleet.DistributedStrategy()
+        s.pipeline = True
+        s.pipeline_configs = {"accumulate_steps": 4}
+        fleet.init(strategy=s)
+        try:
+            net2, o2 = _mlp_and_opt()
+
+            class _XentPipe(nn.Layer):
+                def __init__(self, inner):
+                    super().__init__()
+                    self.inner = inner
+
+                def forward(self, x):
+                    return self.inner(x)
+
+                def compute_loss(self, x, y):
+                    return F.cross_entropy(self.inner(x), y)
+
+            pp = PipelineParallel(_XentPipe(net2),
+                                  fleet.get_hybrid_communicate_group(),
+                                  strategy=s)
+            hopt = fleet.distributed_optimizer(o2, strategy=s)
+            loss = pp.train_batch((paddle.to_tensor(X), paddle.to_tensor(Y)),
+                                  hopt,
+                                  scaler=amp.GradScaler(
+                                      init_loss_scaling=1024.0))
+            assert o2._global_step == 1
+            assert float(loss) == pytest.approx(ref_loss, abs=1e-5)
+            for p1, p2 in zip(net1.parameters(), net2.parameters()):
+                np.testing.assert_allclose(np.asarray(p1.data),
+                                           np.asarray(p2.data),
+                                           rtol=2e-4, atol=1e-5)
+        finally:
+            dist.reset_mesh()
+
+
+class TestDevicePrefetch:
+    def test_order_values_and_device_residency(self):
+        import jax
+
+        xs = np.random.RandomState(0).randn(12, 4).astype("float32")
+        ys = np.arange(12).astype("int64")
+        ds = io.TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        loader = io.DataLoader(ds, batch_size=3, prefetch_to_device=True)
+        assert len(loader) == 4
+        got = list(loader)
+        assert len(got) == 4
+        for i, (xb, yb) in enumerate(got):
+            assert isinstance(xb.data, jax.Array)
+            np.testing.assert_array_equal(np.asarray(yb.data),
+                                          ys[i * 3:(i + 1) * 3])
+
+    def test_reiterable_and_error_propagation(self):
+        pf = io.DevicePrefetcher([np.zeros(2), np.ones(2)])
+        assert len(list(pf)) == 2
+        assert len(list(pf)) == 2  # fresh thread per epoch
+
+        def boom():
+            yield np.zeros(2)
+            raise RuntimeError("reader died")
+
+        with pytest.raises(RuntimeError, match="reader died"):
+            list(io.DevicePrefetcher(boom()))
+
+    def test_sharding_callable_applied(self):
+        import jax
+
+        import paddle_tpu.distributed as dist
+
+        dist.reset_mesh()
+        dist.init_mesh(devices=jax.devices()[:1])
+        try:
+            net, o = _mlp_and_opt()
+            step = dist.ShardedTrainStep(
+                net, lambda m, x, y: F.cross_entropy(m(x), y), o)
+            X, Y = _batch(4)
+            (xb, yb), = list(io.DevicePrefetcher(
+                [(paddle.to_tensor(X), paddle.to_tensor(Y))],
+                sharding=step.batch_sharding))
+            assert xb.data.sharding == step.batch_sharding(xb.data)
+            # a prefetched batch feeds the compiled step unchanged
+            float(step(xb, yb))
+        finally:
+            dist.reset_mesh()
+
+    def test_fit_smoke_with_prefetch(self):
+        from paddle_tpu.hapi import Model
+
+        xs = np.random.RandomState(0).randn(16, 4).astype("float32")
+        ys = np.random.RandomState(1).randint(0, 2, 16).astype("int64")
+        ds = io.TensorDataset([paddle.to_tensor(xs), paddle.to_tensor(ys)])
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(opt.SGD(learning_rate=0.1, parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss())
+        m.fit(ds, batch_size=4, epochs=2, verbose=0, prefetch_to_device=True)
+
+
+_CHILD = textwrap.dedent("""
+    import json, sys
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import jit, serving
+    from paddle_tpu.jit import persistent_cache as pc
+
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+    net.eval()
+    # a serving bucket warmup...
+    eng = serving.ServingEngine(
+        net, buckets=serving.BucketSpec(batch_sizes=(1, 2)),
+        input_specs=[((4,), "float32")])
+    eng.start()
+    out = eng.submit([np.ones(4, "float32")]).result(timeout=60)
+    stats = eng.stats()
+    eng.close()
+    # ...and a to_static function
+    st = jit.to_static(net)
+    y = st(paddle.to_tensor(np.ones((2, 4), "float32")))
+    print("CHILD " + json.dumps({
+        "pc": pc.stats(),
+        "engine_pc": stats.get("persistent_cache"),
+        "out0": float(np.asarray(y.data)[0, 0])}))
+""")
+
+
+def _run_child(cache_dir):
+    env = dict(os.environ)
+    env["PT_PERSISTENT_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run([sys.executable, "-c", _CHILD], capture_output=True,
+                       text=True, env=env, timeout=300, cwd=REPO)
+    for line in r.stdout.splitlines():
+        if line.startswith("CHILD "):
+            return json.loads(line[len("CHILD "):])
+    raise AssertionError(f"child produced no result:\n{r.stderr[-2000:]}")
+
+
+class TestPersistentCache:
+    def test_warm_start_zero_fresh_compiles(self, tmp_path):
+        """The acceptance contract, one cache dir, two processes: cold —
+        THIS process compiles and serializes a serving bucket warmup and a
+        to_static forward; warm — a fresh subprocess re-warms both with
+        ZERO fresh XLA compiles (counter-asserted)."""
+        from paddle_tpu import serving
+        from paddle_tpu.jit import persistent_cache as pc
+
+        d = str(tmp_path / "cache")
+        old_dir, old_enabled = pc.cache_dir(), pc.is_enabled()
+        pc.enable(d)
+        pc.reset_stats()
+        try:
+            # the same programs _CHILD builds (lowered HLO must match)
+            paddle.seed(0)
+            net = nn.Sequential(nn.Linear(4, 8), nn.Tanh(), nn.Linear(8, 2))
+            net.eval()
+            eng = serving.ServingEngine(
+                net, buckets=serving.BucketSpec(batch_sizes=(1, 2)),
+                input_specs=[((4,), "float32")])
+            eng.start()
+            eng.submit([np.ones(4, "float32")]).result(timeout=60)
+            eng.close()
+            st = jit.to_static(net)
+            y = st(paddle.to_tensor(np.ones((2, 4), "float32")))
+            cold = pc.stats()
+            out0 = float(np.asarray(y.data)[0, 0])
+        finally:
+            pc.disable()
+            pc.reset_stats()
+            if old_enabled and old_dir:
+                pc.enable(old_dir)
+        assert cold["misses"] > 0
+        assert cold["compiles"] == cold["misses"]
+
+        warm = _run_child(d)
+        assert warm["pc"]["hits"] > 0
+        assert warm["pc"]["misses"] == 0
+        assert warm["pc"]["compiles"] == 0          # zero fresh XLA compiles
+        assert warm["engine_pc"]["hits"] > 0
+        assert warm["engine_pc"]["misses"] == 0
+        assert warm["out0"] == pytest.approx(out0)
+        # labels attribute the hits (surfaced via analysis.retrace summary)
+        assert any(k.startswith("serving:") for k in warm["pc"]["by_label"])
+
+    def test_corrupt_entries_ignored(self, tmp_path):
+        """Garbage on disk degrades to miss + recompile + atomic rewrite,
+        never an error (in-process: a fresh CachedJit instance re-consults
+        the disk, so no subprocess is needed to exercise the load path)."""
+        import pickle
+
+        import jax.numpy as jnp
+
+        from paddle_tpu.jit import persistent_cache as pc
+
+        d = str(tmp_path / "cache")
+        old_dir, old_enabled = pc.cache_dir(), pc.is_enabled()
+        pc.enable(d)
+        pc.reset_stats()
+        try:
+            fn = lambda x: (x * 3 - 1).sum()  # noqa: E731
+            out0 = float(pc.cached_jit(fn, label="corrupt-probe")(
+                jnp.ones((4,))))
+            entries = [f for f in os.listdir(d) if f.endswith(".ptxc")]
+            assert len(entries) == 1
+            for f in entries:  # truncate/garbage the entry
+                with open(os.path.join(d, f), "wb") as fh:
+                    fh.write(b"garbage" * 3)
+            pc.reset_stats()
+            out1 = float(pc.cached_jit(fn, label="corrupt-probe")(
+                jnp.ones((4,))))
+            snap = pc.stats()
+            assert out1 == pytest.approx(out0)
+            assert snap["hits"] == 0 and snap["misses"] == 1
+            assert snap["errors"] >= 1
+            # the recompile healed the entry on disk
+            for f in os.listdir(d):
+                if f.endswith(".ptxc"):
+                    with open(os.path.join(d, f), "rb") as fh:
+                        blob = fh.read()
+                    assert blob.startswith(pc._MAGIC)
+                    pickle.loads(blob[len(pc._MAGIC):])
+        finally:
+            pc.disable()
+            pc.reset_stats()
+            if old_enabled and old_dir:
+                pc.enable(old_dir)
+
+    def test_stale_env_header_rejected_in_process(self, tmp_path):
+        """A tampered entry whose header names another jax/platform is
+        rejected at load (belt and braces over the key hash)."""
+        import pickle
+
+        from paddle_tpu.jit import persistent_cache as pc
+
+        d = str(tmp_path / "cache3")
+        old_dir, old_enabled = pc.cache_dir(), pc.is_enabled()
+        pc.enable(d)
+        pc.reset_stats()
+        try:
+            import jax.numpy as jnp
+
+            cj = pc.cached_jit(lambda x: x * 2, label="stale-probe")
+            cj(jnp.ones((3,)))
+            assert pc.stats()["misses"] == 1
+            entries = [f for f in os.listdir(d) if f.endswith(".ptxc")]
+            assert len(entries) == 1
+            path = os.path.join(d, entries[0])
+            with open(path, "rb") as fh:
+                blob = fh.read()
+            header, payload = pickle.loads(blob[len(pc._MAGIC):])
+            header["env"] = ("0.0.0", "0.0.0", "cpu", "1")
+            with open(path, "wb") as fh:
+                fh.write(pc._MAGIC + pickle.dumps((header, payload)))
+            pc.reset_stats()
+            cj2 = pc.cached_jit(lambda x: x * 2, label="stale-probe")
+            out = cj2(jnp.ones((3,)))
+            np.testing.assert_allclose(np.asarray(out), 2.0)
+            snap = pc.stats()
+            assert snap["hits"] == 0 and snap["misses"] == 1
+            assert snap["errors"] >= 1
+        finally:
+            pc.disable()
+            pc.reset_stats()
+            if old_enabled and old_dir:
+                pc.enable(old_dir)
+
+    def test_disabled_cache_is_passthrough(self):
+        from paddle_tpu.jit import persistent_cache as pc
+
+        assert not pc.is_enabled()  # tier-1 runs with the cache off
+        import jax.numpy as jnp
+
+        cj = pc.cached_jit(lambda x: x + 1, label="off-probe")
+        np.testing.assert_allclose(np.asarray(cj(jnp.zeros((2,)))), 1.0)
+        assert pc.stats()["misses"] == 0  # nothing counted, nothing written

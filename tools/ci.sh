@@ -21,6 +21,31 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 JAX_PLATFORMS=cpu python examples/serve_gpt.py --clients 4 || exit 1
 
+echo "== perf gate (warm path: bench headline + persistent-cache warm start) =="
+# the full warm-path file, slow-marked legs included (tier-1 excludes
+# them for wall clock): a fresh process must warm previously-compiled
+# programs with ZERO fresh XLA compiles (the ISSUE-3 acceptance counter)
+JAX_PLATFORMS=cpu python -m pytest tests/test_warm_path.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+# the CPU bench smoke must emit a parseable non-null headline as its last
+# line (first line is the parseable stub) within its own budget
+rm -f /tmp/_bench_smoke.log
+timeout -k 10 700 env JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 \
+    python bench.py > /tmp/_bench_smoke.log 2>/tmp/_bench_smoke.err || {
+        echo "bench smoke failed"; tail -20 /tmp/_bench_smoke.err; exit 1; }
+python - <<'PY' || exit 1
+import json
+lines = [l for l in open("/tmp/_bench_smoke.log") if l.strip()]
+first, last = json.loads(lines[0]), json.loads(lines[-1])
+assert last["value"] is not None, "bench headline is null"
+assert "warm_path" in last["detail"], "warm-path row missing"
+assert "persistent_cache" in last["detail"], "cold/warm startup row missing"
+pc = last["detail"]["persistent_cache"]
+assert pc["warm_fresh_xla_compiles"] == 0, pc
+print("perf gate OK:", {k: last["detail"][k]
+                        for k in ("warm_path", "persistent_cache")})
+PY
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
