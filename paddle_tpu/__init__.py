@@ -94,7 +94,8 @@ _try_import(["nn", "optimizer", "io", "amp", "jit", "metric", "vision",
               "distributed", "regularizer", "autograd", "profiler", "text",
               "distribution", "static", "incubate", "device", "hapi",
               "inference", "utils", "fft", "signal", "sparse", "onnx",
-              "version", "sysconfig", "quantization", "analysis"])
+              "version", "sysconfig", "quantization", "analysis",
+              "observability"])
 try:
     from .hapi import Model, summary, flops  # noqa: F401,E402
     from .hapi import hub  # noqa: F401,E402

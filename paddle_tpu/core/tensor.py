@@ -250,16 +250,45 @@ class Tensor:
     # paddle_tpu/ops/_bind.py once the op corpus is defined.
 
 
+_NAN_INF_FAM = None  # lazily-bound observability family
+
+
+def _count_nan_inf(op_name, dtype) -> None:
+    """Record the trip in the ``nan_inf_events`` counter family (op, dtype)
+    so monitors can alert on non-finite outputs without crashing the run."""
+    global _NAN_INF_FAM
+    try:
+        if _NAN_INF_FAM is None:
+            from ..observability import family
+
+            _NAN_INF_FAM = family("nan_inf_events", ("op", "dtype"))
+        _NAN_INF_FAM.inc((op_name, str(dtype)))
+    except Exception:  # telemetry must never mask the trip itself
+        pass
+
+
 def _check_nan_inf(op_name, outs):
-    """FLAGS_check_nan_inf per-op guard (nan_inf_utils_detail.* equivalent)."""
+    """FLAGS_check_nan_inf per-op guard (nan_inf_utils_detail.* equivalent).
+
+    Every trip lands a ``nan_inf_events`` row; FLAGS_check_nan_inf_action
+    picks raise (default, reference behavior) vs log-and-continue."""
+    from ..framework import flags as _flags
+
     for i, o in enumerate(outs):
         if not hasattr(o, "dtype") or not jnp.issubdtype(o.dtype, jnp.inexact):
             continue
         bad = int(jnp.sum(~jnp.isfinite(o)))
         if bad:
-            raise RuntimeError(
+            _count_nan_inf(op_name, o.dtype)
+            msg = (
                 f"check_nan_inf: op '{op_name}' output {i} contains {bad} "
                 f"nan/inf values (shape={tuple(o.shape)}, dtype={o.dtype})")
+            if _flags.flag("check_nan_inf_action") == "log":
+                import warnings
+
+                warnings.warn(msg, RuntimeWarning, stacklevel=3)
+                continue
+            raise RuntimeError(msg)
 
 
 _HOT = None  # lazily-bound (amp_state, maybe_cast_inputs, flags, profiler, time)

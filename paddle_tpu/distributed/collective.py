@@ -117,6 +117,29 @@ def get_rank(group: Optional[Group] = None) -> int:
 # ---------------------------------------------------------------------------
 
 _JIT_CACHE = {}
+_COLL_FAM = None  # lazily-bound observability family
+
+
+def _record_collective(op: str, arr) -> None:
+    """Call/byte counters per collective op (observability "collectives"
+    family). Host-side bookkeeping only — two dict adds per call."""
+    global _COLL_FAM
+    try:
+        if _COLL_FAM is None:
+            from ..observability import family
+
+            _COLL_FAM = family("collectives", ("op", "kind"))
+        size = int(getattr(arr, "size", 0) or 0)
+        itemsize = 0
+        dt = getattr(arr, "dtype", None)
+        if dt is not None:
+            import numpy as _np
+
+            itemsize = _np.dtype(dt).itemsize
+        _COLL_FAM.inc((op, "calls"))
+        _COLL_FAM.inc((op, "bytes"), size * itemsize)
+    except Exception:  # telemetry must never sink a collective
+        pass
 
 
 def _axis_jit(kind, group: Group, **kw):
@@ -203,6 +226,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Stacked layout: in [world*b, ...] sharded by rank; out same shape, every
     rank's slice replaced by the reduction."""
     arr, g = _prep(tensor, group)
+    _record_collective("all_reduce", arr)
     if g.nranks == 1:
         out = arr
     else:
@@ -219,6 +243,7 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None, sync_op=Tru
     if tensor is None:  # functional style: all_gather(tensor)
         tensor, tensor_list = tensor_list, None
     arr, g = _prep(tensor, group)
+    _record_collective("all_gather", arr)
     n = g.nranks
     per = arr.shape[0] // n
     shards = [Tensor(arr[i * per : (i + 1) * per]) for i in range(n)]
@@ -231,6 +256,7 @@ def all_gather(tensor_list: Optional[List], tensor=None, group=None, sync_op=Tru
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     arr, g = _prep(tensor, group)
+    _record_collective("broadcast", arr)
     if g.nranks > 1:
         per = arr.shape[0] // g.nranks
         src_slice = arr[src * per : (src + 1) * per]
@@ -250,6 +276,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None, sync_op=True):
     arr, g = _prep(tensor, group)
+    _record_collective("reduce_scatter", arr)
     if g.nranks == 1:
         return Tensor(arr)
     out = _axis_jit("reduce_scatter", g)(arr)
@@ -264,6 +291,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         g = group or _default_group()
     else:
         arr, g = _prep(in_tensor_list, group)
+    _record_collective("alltoall", arr)
     if g.nranks > 1:
         flat = arr.reshape((-1,) + arr.shape[2:]) if isinstance(in_tensor_list, (list, tuple)) else arr
         out = _axis_jit("alltoall", g)(flat)
@@ -280,6 +308,7 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
     arr, g = _prep(tensor, group)
+    _record_collective("scatter", arr)
     return Tensor(arr)  # single-controller: data already placed
 
 

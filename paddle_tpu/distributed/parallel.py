@@ -121,6 +121,31 @@ def place_model(model: Layer, env: Optional[MeshEnv] = None):
     return model
 
 
+def default_batch_sharding(env: Optional[MeshEnv] = None):
+    """leaf -> NamedSharding callable landing batch leaves at the mesh's
+    data layout (dim 0 over dp/sdp) — ``ShardedTrainStep.batch_sharding``
+    without needing a step object. ``hapi.Model.fit`` uses this to thread
+    device prefetch through ``DistributedBatchSampler``-driven loops by
+    default, and it is the right ``device_sharding=`` for hand loops too."""
+    env = env or require_mesh_env()
+
+    def leaf_sharding(arr):
+        data_axes = [ax for ax in ("dp", "sdp") if env.get_dim(ax) > 1]
+        shape = getattr(arr, "shape", ())
+        if not data_axes or not shape:
+            return env.sharding_for(P())
+        deg = 1
+        for ax in data_axes:
+            deg *= env.get_dim(ax)
+        if shape[0] % deg != 0:
+            # ragged tail batch (drop_last=False): land it replicated
+            # instead of failing the device_put mid-prefetch
+            return env.sharding_for(P())
+        return env.sharding_for(P(tuple(data_axes)))
+
+    return leaf_sharding
+
+
 class ShardedTrainStep:
     """pjit'ed fwd+bwd+update over the mesh (jit.TrainStep + GSPMD).
 
@@ -686,30 +711,43 @@ class ShardedTrainStep:
         return Tensor(loss)
 
     def __call__(self, *batch):
+        from ..jit import _obs
+
         opt = self.optimizer
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+        tl, tc = _obs()
         if self.offload:
-            return self._call_offload(arrays)
+            with tl.step(), tl.phase("host_dispatch"):
+                return self._call_offload(arrays)
         if self.scaler is not None or self.accum_steps > 1:
-            return self._call_amp(arrays)
-        if self._jitted is None:
-            from ..jit import _audit_instance_label, _maybe_audit
+            with tl.step(), tl.phase("host_dispatch"):
+                return self._call_amp(arrays)
+        with tl.step():
+            cold = self._jitted is None
+            if cold:
+                from ..jit import _audit_instance_label, _maybe_audit
 
-            self._jitted = _maybe_audit(
-                _audit_instance_label("ShardedTrainStep"),
-                self._build(arrays))
-        params = [p.data for p in self.train_params]
-        states = [opt._accumulators[id(p)] for p in self.train_params]
-        frozen_arrays = [t.data for t in self.frozen]
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
-        loss, new_p, new_s = self._jitted(
-            params, states, frozen_arrays, lr, step_no, random_mod.next_key(), *arrays)
-        for p, a in zip(self.train_params, new_p):
-            p.data = a
-        for p, s in zip(self.train_params, new_s):
-            opt._accumulators[id(p)] = s
-        opt._global_step += 1
+                tc.inc(("sharded_train_step", "build"))
+                self._jitted = _maybe_audit(
+                    _audit_instance_label("ShardedTrainStep"),
+                    self._build(arrays))
+            params = [p.data for p in self.train_params]
+            states = [opt._accumulators[id(p)] for p in self.train_params]
+            frozen_arrays = [t.data for t in self.frozen]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            with tl.phase("compile" if cold else "host_dispatch"):
+                loss, new_p, new_s = self._jitted(
+                    params, states, frozen_arrays, lr, step_no,
+                    random_mod.next_key(), *arrays)
+            if tl.detailed:
+                with tl.phase("device_compute"):
+                    jax.block_until_ready(loss)
+            for p, a in zip(self.train_params, new_p):
+                p.data = a
+            for p, s in zip(self.train_params, new_s):
+                opt._accumulators[id(p)] = s
+            opt._global_step += 1
         return Tensor(loss)
 
 
@@ -801,26 +839,36 @@ class ShardedAccumulateStep:
                 raise ValueError(
                     f"accumulate({self.steps}): batch dim {a.shape} must "
                     f"divide by the microbatch count")
-        if self._jitted is None:
-            from ..jit import _audit_instance_label, _maybe_audit
+        from ..jit import _obs
 
-            self._jitted = _maybe_audit(
-                _audit_instance_label(
-                    f"ShardedTrainStep.accumulate({self.steps})"),
-                self._build(arrays))
-        params = [p.data for p in self.train_params]
-        states = [opt._accumulators[id(p)] for p in self.train_params]
-        frozen_arrays = [t.data for t in self.frozen]
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
-        loss, new_p, new_s = self._jitted(
-            params, states, frozen_arrays, lr, step_no,
-            random_mod.next_key(), *arrays)
-        for p, a in zip(self.train_params, new_p):
-            p.data = a
-        for p, s in zip(self.train_params, new_s):
-            opt._accumulators[id(p)] = s
-        opt._global_step += 1
+        tl, tc = _obs()
+        with tl.step():
+            cold = self._jitted is None
+            if cold:
+                from ..jit import _audit_instance_label, _maybe_audit
+
+                tc.inc(("sharded_accumulate", "build"))
+                self._jitted = _maybe_audit(
+                    _audit_instance_label(
+                        f"ShardedTrainStep.accumulate({self.steps})"),
+                    self._build(arrays))
+            params = [p.data for p in self.train_params]
+            states = [opt._accumulators[id(p)] for p in self.train_params]
+            frozen_arrays = [t.data for t in self.frozen]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            with tl.phase("compile" if cold else "host_dispatch"):
+                loss, new_p, new_s = self._jitted(
+                    params, states, frozen_arrays, lr, step_no,
+                    random_mod.next_key(), *arrays)
+            if tl.detailed:
+                with tl.phase("device_compute"):
+                    jax.block_until_ready(loss)
+            for p, a in zip(self.train_params, new_p):
+                p.data = a
+            for p, s in zip(self.train_params, new_s):
+                opt._accumulators[id(p)] = s
+            opt._global_step += 1
         return Tensor(loss)
 
     def batch_sharding(self, arr) -> NamedSharding:

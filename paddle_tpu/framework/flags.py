@@ -81,6 +81,11 @@ def flag(name: str):
 define_flag("check_nan_inf", False,
             "Assert every op's outputs are finite; raises naming the op "
             "(reference: framework/details/nan_inf_utils_detail.*).")
+define_flag("check_nan_inf_action", "raise",
+            "What a check_nan_inf trip does: 'raise' (default) aborts the "
+            "step naming the op; 'log' downgrades to a warning + a "
+            "nan_inf_events counter row so monitors can alert without "
+            "crashing the run. Either way the trip is counted.")
 define_flag("benchmark", False,
             "Block on every op so host timings are true device timings "
             "(reference: flags.cc FLAGS_benchmark).")
@@ -140,7 +145,17 @@ def _apply_matmul_precision(value: str):
                       None if value == "default" else value)
 
 
+def _validate_nan_inf_action(value: str):
+    if value not in ("raise", "log"):
+        raise ValueError(
+            f"FLAGS_check_nan_inf_action must be 'raise' or 'log', "
+            f"got {value!r}")
+
+
+on_set("check_nan_inf_action", _validate_nan_inf_action)
 on_set("matmul_precision", _apply_matmul_precision)
-# env-var initialization fires the hook too (define_flag only stores)
+# env-var initialization fires the hooks too (define_flag only stores)
 if _VALUES.get("matmul_precision", "default") != "default":
     _apply_matmul_precision(_VALUES["matmul_precision"])
+if _VALUES.get("check_nan_inf_action", "raise") != "raise":
+    _validate_nan_inf_action(_VALUES["check_nan_inf_action"])

@@ -9,6 +9,8 @@ way hand loops do (DistributedBatchSampler + GSPMD-annotated layers).
 from __future__ import annotations
 
 import os
+import time as _time
+from contextlib import nullcontext
 from typing import List, Optional
 
 import numpy as np
@@ -35,6 +37,39 @@ def _as_tensor(x):
 
 def _scalar(t):
     return float(np.asarray(t.data if isinstance(t, Tensor) else t))
+
+
+def _timeline():
+    from ..observability.timeline import timeline
+
+    return timeline()
+
+
+def _auto_device_prefetch(loader, device_sharding):
+    """fit(prefetch_to_device=None) default: a DistributedBatchSampler-
+    driven DataLoader on an active multi-device mesh prefetches to the
+    mesh's data placement automatically (the PR-3 follow-up) — the batch
+    lands laid out for the sharded step, and the timeline's ``data_wait``
+    shows the overlap win. Returns (enable, device_sharding)."""
+    from ..io import DataLoader, DistributedBatchSampler
+
+    if not isinstance(loader, DataLoader) or loader.prefetch_to_device:
+        return False, device_sharding  # loader already prefetches (or n/a)
+    if not isinstance(getattr(loader, "batch_sampler", None),
+                      DistributedBatchSampler):
+        return False, device_sharding
+    try:
+        from ..distributed.mesh import get_mesh_env
+        from ..distributed.parallel import default_batch_sharding
+
+        env = get_mesh_env()
+        if env is None or env.nranks <= 1:
+            return False, device_sharding
+        if device_sharding is None:
+            device_sharding = default_batch_sharding(env)
+    except Exception:
+        return False, device_sharding
+    return True, device_sharding
 
 
 class Model:
@@ -74,28 +109,33 @@ class Model:
         return losses
 
     def train_batch(self, inputs, labels=None, update=True, _loss_scale=1.0):
+        tl = _timeline()
         self.network.train()
         self.mode = "train"
         inputs = [_as_tensor(x) for x in to_list(inputs)]
         labels = [_as_tensor(x) for x in to_list(labels)]
-        outputs = self.network(*inputs)
-        losses = self._compute_loss(outputs, labels)
-        total = losses[0]
-        for extra in losses[1:]:
-            total = total + extra
-        if _loss_scale != 1.0:  # gradient accumulation averages micro-batches
-            (total * _loss_scale).backward()
-        else:
-            total.backward()
-        if update and self._optimizer is not None:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
-        metrics = []
-        for m in self._metrics:
-            metric_outs = m.compute(*(to_list(outputs) + labels))
-            metrics.append(m.update(*[np.asarray(
-                t.data if isinstance(t, Tensor) else t) for t in to_list(metric_outs)]))
-        loss_vals = [_scalar(l) for l in losses]
+        # StepTimeline phases: dispatch (fwd+bwd+update enqueue, async under
+        # jax) vs the host blocking on device results (loss/metric readback)
+        with tl.phase("host_dispatch"):
+            outputs = self.network(*inputs)
+            losses = self._compute_loss(outputs, labels)
+            total = losses[0]
+            for extra in losses[1:]:
+                total = total + extra
+            if _loss_scale != 1.0:  # gradient accumulation averages micro-batches
+                (total * _loss_scale).backward()
+            else:
+                total.backward()
+            if update and self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+        with tl.phase("device_compute"):
+            metrics = []
+            for m in self._metrics:
+                metric_outs = m.compute(*(to_list(outputs) + labels))
+                metrics.append(m.update(*[np.asarray(
+                    t.data if isinstance(t, Tensor) else t) for t in to_list(metric_outs)]))
+            loss_vals = [_scalar(l) for l in losses]
         if metrics:
             return loss_vals, metrics[0] if len(metrics) == 1 else metrics
         return loss_vals
@@ -187,11 +227,18 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            prefetch_to_device=False, device_sharding=None):
+            prefetch_to_device=None, device_sharding=None):
         assert train_data is not None, "train_data must be given"
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
                                    drop_last=drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)
+        auto_prefetch = False
+        if prefetch_to_device is None:
+            # default = auto: DistributedBatchSampler-driven loaders on an
+            # active mesh prefetch to the mesh data placement
+            prefetch_to_device, device_sharding = _auto_device_prefetch(
+                loader, device_sharding)
+            auto_prefetch = prefetch_to_device
         if prefetch_to_device:
             # io.prefetch: a background thread device_puts batch N+1 while
             # batch N trains, so the step never waits on the host transfer.
@@ -200,7 +247,10 @@ class Model:
             from ..io import DevicePrefetcher
 
             loader = DevicePrefetcher(loader, sharding=device_sharding)
-            if eval_loader is not None:
+            # the auto decision was made on the TRAIN loader only — an eval
+            # loader with its own sampler/batching keeps its old behavior
+            # unless the caller opted in explicitly
+            if eval_loader is not None and not auto_prefetch:
                 eval_loader = DevicePrefetcher(eval_loader,
                                                sharding=device_sharding)
         steps = len(loader) if hasattr(loader, "__len__") else None
@@ -270,38 +320,58 @@ class Model:
         logs = {}
         count = 0
         pending = False
-        for step, batch in enumerate(loader):
+        tl = _timeline() if mode == "train" else None
+        it = iter(loader)
+        step = 0
+        _END = object()
+        while True:
             if num_iters is not None and step >= num_iters:
                 break
-            inputs, labels = self._split_batch(batch)
-            cbks.on_batch_begin(mode, step, logs)
-            if mode == "train" and self.stop_training:
-                break
-            if mode == "train":
-                update = (step + 1) % accumulate_grad_batches == 0
-                outs = self.train_batch(
-                    inputs, labels, update=update,
-                    _loss_scale=1.0 / accumulate_grad_batches)
-                pending = not update
-            else:
-                outs = self.eval_batch(inputs, labels)
-            if self._metrics and self._loss is not None:
-                loss_vals, metric_vals = outs
-            elif self._loss is not None:
-                loss_vals, metric_vals = outs, None
-            else:
-                loss_vals, metric_vals = None, outs
-            if loss_vals:
-                logs["loss"] = loss_vals[0] if len(loss_vals) == 1 else loss_vals
-            if metric_vals is not None:
-                names = [n for m in self._metrics for n in to_list(m.name())]
-                vals = to_list(metric_vals)
-                for n, v in zip(names, vals if len(vals) == len(names) else vals * len(names)):
-                    logs[n] = v
-            bsz = inputs[0].shape[0] if inputs and hasattr(inputs[0], "shape") else 1
-            count += bsz
-            logs["batch_size"] = bsz
-            cbks.on_batch_end(mode, step, logs)
+            # one StepTimeline step = wait for the batch + run it; the
+            # data_wait phase is where prefetch overlap shows up (near-zero
+            # when the DevicePrefetcher keeps the queue fed)
+            with (tl.step() if tl is not None else nullcontext()) as st:
+                t_wait = _time.perf_counter()
+                batch = next(it, _END)
+                t_got = _time.perf_counter()
+                if batch is _END:
+                    if st is not None:
+                        st.cancel()  # exhausted-loader probe is not a step
+                    break
+                inputs, labels = self._split_batch(batch)
+                cbks.on_batch_begin(mode, step, logs)
+                if mode == "train" and self.stop_training:
+                    if st is not None:
+                        st.cancel()  # cancelled steps record no phases
+                    break
+                if tl is not None:
+                    tl.record("data_wait", (t_got - t_wait) * 1e3, t0=t_wait)
+                if mode == "train":
+                    update = (step + 1) % accumulate_grad_batches == 0
+                    outs = self.train_batch(
+                        inputs, labels, update=update,
+                        _loss_scale=1.0 / accumulate_grad_batches)
+                    pending = not update
+                else:
+                    outs = self.eval_batch(inputs, labels)
+                if self._metrics and self._loss is not None:
+                    loss_vals, metric_vals = outs
+                elif self._loss is not None:
+                    loss_vals, metric_vals = outs, None
+                else:
+                    loss_vals, metric_vals = None, outs
+                if loss_vals:
+                    logs["loss"] = loss_vals[0] if len(loss_vals) == 1 else loss_vals
+                if metric_vals is not None:
+                    names = [n for m in self._metrics for n in to_list(m.name())]
+                    vals = to_list(metric_vals)
+                    for n, v in zip(names, vals if len(vals) == len(names) else vals * len(names)):
+                        logs[n] = v
+                bsz = inputs[0].shape[0] if inputs and hasattr(inputs[0], "shape") else 1
+                count += bsz
+                logs["batch_size"] = bsz
+                cbks.on_batch_end(mode, step, logs)
+            step += 1
         if pending and self._optimizer is not None:
             # flush the trailing partial accumulation group
             self._optimizer.step()

@@ -25,9 +25,22 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
+import weakref
 from typing import Any, Callable, Iterable, Optional, Union
 
 __all__ = ["DevicePrefetcher", "prefetch_to_device"]
+
+_FAM = None  # lazily-bound observability family (keeps import light)
+
+
+def _fam():
+    global _FAM
+    if _FAM is None:
+        from ..observability import family
+
+        _FAM = family("prefetcher", ("metric",))
+    return _FAM
 
 
 def _resolve_sharding(sharding, leaf):
@@ -140,6 +153,17 @@ class _PrefetchRun:
         self._thread = threading.Thread(target=worker, daemon=True,
                                         name="pt-device-prefetch")
         self._thread.start()
+        try:
+            # live queue-depth gauge for the most recent active run (weak:
+            # an abandoned run reads 0, never pins the iterator alive)
+            from ..observability import gauge
+
+            ref = weakref.ref(self)
+            gauge("prefetch_queue_depth",
+                  lambda: (lambda r: r._q.qsize() if r is not None else 0)(
+                      ref()))
+        except Exception:
+            pass
 
     def __iter__(self):
         return self
@@ -147,6 +171,7 @@ class _PrefetchRun:
     def __next__(self):
         if self._done:  # exhausted iterators must KEEP raising, not block
             raise StopIteration
+        t0 = time.perf_counter()
         item = self._q.get()
         if item is self._SENTINEL:
             self._done = True
@@ -154,6 +179,12 @@ class _PrefetchRun:
             if self._err_box[0] is not None:
                 raise self._err_box[0]
             raise StopIteration
+        # occupancy telemetry: how long the consumer stalled on this batch
+        # and how deep the device-side queue ran (avg = depth_sum/batches)
+        fam = _fam()
+        fam.inc(("data_wait_ms",), (time.perf_counter() - t0) * 1e3)
+        fam.inc(("batches",))
+        fam.inc(("queue_depth_sum",), self._q.qsize())
         return item
 
     def close(self):

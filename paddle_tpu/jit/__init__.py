@@ -50,6 +50,22 @@ def _maybe_audit(label, jitted):
         else jitted
 
 
+_OBS = None  # lazily bound (StepTimeline, trace_cache CounterFamily)
+
+
+def _obs():
+    """(timeline, trace_cache family) — the observability hooks every
+    compiled-step call site feeds. One-time late bind; per-call cost after
+    that is a tuple load."""
+    global _OBS
+    if _OBS is None:
+        from ..observability import family
+        from ..observability.timeline import timeline
+
+        _OBS = (timeline(), family("trace_cache", ("site", "event")))
+    return _OBS
+
+
 def _audit_instance_label(kind: str) -> str:
     """Per-instance audit label ("TrainStep#2"): two train steps with
     different batch shapes must not pool signatures in one bucket — that
@@ -178,7 +194,9 @@ class StaticLayer:
         else:
             tensors = []
             key = ("fn", kw_names, static_key, data_idx, static_args)
+        _tc = _obs()[1]
         jitted = self._cache.get(key)
+        _tc.inc(("to_static", "hit" if jitted is not None else "miss"))
         if jitted is None:
             target, is_layer = self._target, self._is_layer
 
@@ -330,23 +348,35 @@ class TrainStep:
         return AccumulateStep(self, steps, remat=remat, average=average)
 
     def __call__(self, *batch):
-        if self._jitted is None:
-            self._jitted = _maybe_audit(_audit_instance_label("TrainStep"),
-                                        self._build())
-        opt = self.optimizer
-        params = [p.data for p in self.train_params]
-        states = [opt._accumulators[id(p)] for p in self.train_params]
-        frozen_arrays = [t.data for t in self.frozen]
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
-        arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
-        loss, new_p, new_s = self._jitted(
-            params, states, frozen_arrays, lr, step_no, random_mod.next_key(), *arrays)
-        for p, a in zip(self.train_params, new_p):
-            p.data = a
-        for p, s in zip(self.train_params, new_s):
-            opt._accumulators[id(p)] = s
-        opt._global_step += 1
+        tl, tc = _obs()
+        with tl.step():
+            cold = self._jitted is None
+            if cold:
+                tc.inc(("train_step", "build"))
+                self._jitted = _maybe_audit(
+                    _audit_instance_label("TrainStep"), self._build())
+            opt = self.optimizer
+            params = [p.data for p in self.train_params]
+            states = [opt._accumulators[id(p)] for p in self.train_params]
+            frozen_arrays = [t.data for t in self.frozen]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+            # cold call = trace + XLA compile + first run; warm = async
+            # dispatch (a warm retrace from signature drift lands here too —
+            # analysis.retrace names it)
+            with tl.phase("compile" if cold else "host_dispatch"):
+                loss, new_p, new_s = self._jitted(
+                    params, states, frozen_arrays, lr, step_no,
+                    random_mod.next_key(), *arrays)
+            if tl.detailed:
+                with tl.phase("device_compute"):
+                    jax.block_until_ready(loss)
+            for p, a in zip(self.train_params, new_p):
+                p.data = a
+            for p, s in zip(self.train_params, new_s):
+                opt._accumulators[id(p)] = s
+            opt._global_step += 1
         return Tensor(loss)
 
 
@@ -446,23 +476,32 @@ class AccumulateStep:
                 raise ValueError(
                     f"accumulate({self.steps}): batch dim {a.shape} must "
                     f"divide by the microbatch count")
-        if self._jitted is None:
-            self._jitted = _maybe_audit(
-                _audit_instance_label(f"TrainStep.accumulate({self.steps})"),
-                self._build())
-        params = [p.data for p in self.train_params]
-        states = [opt._accumulators[id(p)] for p in self.train_params]
-        frozen_arrays = [t.data for t in self.frozen]
-        lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
-        loss, new_p, new_s = self._jitted(
-            params, states, frozen_arrays, lr, step_no,
-            random_mod.next_key(), *arrays)
-        for p, a in zip(self.train_params, new_p):
-            p.data = a
-        for p, s in zip(self.train_params, new_s):
-            opt._accumulators[id(p)] = s
-        opt._global_step += 1
+        tl, tc = _obs()
+        with tl.step():
+            cold = self._jitted is None
+            if cold:
+                tc.inc(("accumulate", "build"))
+                self._jitted = _maybe_audit(
+                    _audit_instance_label(
+                        f"TrainStep.accumulate({self.steps})"),
+                    self._build())
+            params = [p.data for p in self.train_params]
+            states = [opt._accumulators[id(p)] for p in self.train_params]
+            frozen_arrays = [t.data for t in self.frozen]
+            lr = jnp.asarray(opt.get_lr(), jnp.float32)
+            step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            with tl.phase("compile" if cold else "host_dispatch"):
+                loss, new_p, new_s = self._jitted(
+                    params, states, frozen_arrays, lr, step_no,
+                    random_mod.next_key(), *arrays)
+            if tl.detailed:
+                with tl.phase("device_compute"):
+                    jax.block_until_ready(loss)
+            for p, a in zip(self.train_params, new_p):
+                p.data = a
+            for p, s in zip(self.train_params, new_s):
+                opt._accumulators[id(p)] = s
+            opt._global_step += 1
         return Tensor(loss)
 
 

@@ -1,0 +1,95 @@
+"""paddle_tpu.observability — the unified telemetry layer.
+
+Reference role: the reference ships a real observability stack
+(host_tracer.cc lock-free span buffers, chrometracing_logger.cc export,
+profiler_statistic.py summary tables). This package is its TPU-native
+counterpart, and the ONE answer to "where did this step's milliseconds
+go?":
+
+- a process-wide metrics hub (``hub()``/``family()``) every island
+  registers into: jit trace-cache + persistent-cache counters,
+  ``analysis.retrace`` recompile events, ``DevicePrefetcher`` occupancy,
+  serving engine registries, collective call/byte counters, nan/inf trips;
+- a ``StepTimeline`` (``timeline()``) fed by ``jit.TrainStep`` /
+  ``ShardedTrainStep`` / ``accumulate`` / ``hapi.Model.fit`` — per-step
+  data-wait / host-dispatch / device-compute / compile phases, emitted as
+  ``RecordEvent`` spans while a Profiler records;
+- export surfaces: ``snapshot()`` (one JSON), ``report()`` (human
+  tables), ``prometheus_text()`` + ``serve(port)`` / ``PT_METRICS_PORT``
+  (stdlib-http exposition), ``tools/pd_top.py`` (CLI).
+
+Off-path overhead contract: with no Profiler active and exposition
+disabled, the per-step cost is a few locked counter adds and
+``perf_counter`` reads; percentiles, provider snapshots and rendering all
+happen at read time. See docs/observability.md.
+"""
+from __future__ import annotations
+
+import os
+
+from .registry import (  # noqa: F401
+    CounterFamily, Hub, LatencyWindow, MetricsRegistry, family, gauge, hub,
+    register_provider, register_registry,
+)
+from .timeline import StepTimeline, timeline  # noqa: F401
+from .exposition import (  # noqa: F401
+    dump, prometheus_text, render_snapshot, report, serve, snapshot,
+    stop_serving,
+)
+
+__all__ = [
+    "CounterFamily", "Hub", "LatencyWindow", "MetricsRegistry",
+    "StepTimeline", "family", "gauge", "hub", "register_provider",
+    "register_registry", "timeline",
+    "dump", "prometheus_text", "render_snapshot", "report", "serve",
+    "snapshot", "stop_serving",
+]
+
+
+def _register_builtin_providers() -> None:
+    """The pre-existing islands, registered once at import. Providers are
+    lazy closures — nothing here imports jit/analysis at module load, and
+    a provider that cannot import degrades to an error row, never a
+    raise."""
+
+    def _persistent_cache():
+        from ..jit import persistent_cache
+
+        return persistent_cache.stats()
+
+    def _retrace_events():
+        from ..analysis import retrace
+
+        auditor = retrace.get_auditor()
+        by_label: dict = {}
+        for ev in auditor.events:
+            by_label[ev.label] = by_label.get(ev.label, 0) + 1
+        return {"enabled": auditor.enabled,
+                "events": len(auditor.events),
+                "tracked_keys": len(auditor._sigs) + len(auditor._attr_keys),
+                "by_label": by_label}
+
+    register_provider("persistent_cache", _persistent_cache)
+    register_provider("retrace_events", _retrace_events)
+    register_provider("step_timeline", lambda: timeline().summary())
+    # counter families the wired call sites feed — created here so every
+    # snapshot carries the full schema even before the first event
+    family("trace_cache", ("site", "event"))
+    family("nan_inf_events", ("op", "dtype"))
+    family("collectives", ("op", "kind"))
+    family("prefetcher", ("metric",))
+
+
+_register_builtin_providers()
+
+# PT_METRICS_PORT: opt-in exposition endpoint at import ("" / unset = off)
+_port = os.environ.get("PT_METRICS_PORT", "").strip()
+if _port:
+    try:
+        serve(int(_port))
+    except Exception as _e:  # a bad port must not sink `import paddle_tpu`
+        import warnings
+
+        warnings.warn(f"observability: metrics endpoint disabled ({_e})",
+                      stacklevel=2)
+del _port

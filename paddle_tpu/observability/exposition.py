@@ -1,0 +1,217 @@
+"""Export surfaces: one JSON snapshot, a human report, Prometheus text,
+and an optional stdlib-http endpoint.
+
+- ``snapshot()``: every registered family/provider/registry as one
+  JSON-able dict (the ``tools/pd_top.py`` and bench-telemetry payload);
+- ``report()``: human tables (chrometracing_logger.cc's summary role);
+- ``prometheus_text()``: text exposition format 0.0.4 — counters become
+  ``pt_<family>_total{label="..."}`` samples;
+- ``serve(port)`` / ``PT_METRICS_PORT``: a daemon-thread
+  ``http.server`` with ``/metrics`` (Prometheus) and ``/snapshot``
+  (JSON). Nothing is served unless explicitly enabled.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from typing import Any, Dict, Optional
+
+from .registry import hub
+
+__all__ = ["snapshot", "report", "prometheus_text", "serve", "stop_serving",
+           "dump", "render_snapshot"]
+
+
+def snapshot() -> Dict[str, Any]:
+    """One JSON of every registered family (the hub snapshot plus process
+    meta)."""
+    snap = hub().snapshot()
+    snap["meta"] = {"pid": os.getpid()}
+    return snap
+
+
+def dump(path: str) -> str:
+    """Write ``snapshot()`` as JSON (atomic rename); returns the path."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(snapshot(), f, indent=1, default=str)
+    os.replace(tmp, path)
+    return path
+
+
+# -- human report -------------------------------------------------------------
+
+def _flat(prefix: str, obj, out):
+    if isinstance(obj, dict):
+        for k, v in sorted(obj.items()):
+            _flat(f"{prefix}.{k}" if prefix else str(k), v, out)
+    elif isinstance(obj, (list, tuple)):
+        out.append((prefix, json.dumps(obj, default=str)[:60]))
+    else:
+        out.append((prefix, obj))
+
+
+def render_snapshot(snap: Dict[str, Any]) -> str:
+    """Pretty-print a snapshot dict (live or loaded from disk) — the one
+    renderer ``report()`` and ``tools/pd_top.py`` share."""
+    lines = []
+    for fam in sorted(snap):
+        if fam == "meta":
+            continue
+        body = snap[fam]
+        lines.append(f"== {fam} ==")
+        if fam == "step_timeline" and isinstance(body, dict) \
+                and "phases" in body:
+            lines.append(_timeline_table(body))
+            lines.append("")
+            continue
+        rows: list = []
+        _flat("", body, rows)
+        for key, val in rows:
+            if isinstance(val, float):
+                val = round(val, 4)
+            lines.append(f"  {key:<44} {val}")
+        lines.append("")
+    meta = snap.get("meta")
+    if meta:
+        lines.append(f"-- pid {meta.get('pid')} --")
+    return "\n".join(lines)
+
+
+def _timeline_table(body: Dict[str, Any]) -> str:
+    lines = [f"  steps={body.get('steps')}  "
+             f"avg={body.get('step_total_ms', {}).get('avg')}ms  "
+             f"detailed={body.get('detailed')}"]
+    phases = body.get("phases", {})
+    for name in sorted(phases, key=lambda n: -phases[n].get("total_ms", 0)):
+        row = phases[name]
+        lines.append(
+            f"  {name:<18} count={row.get('count'):>6}  "
+            f"total={row.get('total_ms'):>10}ms  avg={row.get('avg_ms'):>8}ms"
+            f"  max={row.get('max_ms'):>8}ms")
+    last = body.get("last_step") or []
+    if last:
+        seq = " -> ".join(p["phase"] for p in last)
+        lines.append(f"  last step: {seq}")
+    return "\n".join(lines)
+
+
+def report() -> str:
+    """Human-readable tables of the whole hub (à la profiler summaries)."""
+    return render_snapshot(snapshot())
+
+
+# -- Prometheus text exposition -----------------------------------------------
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+_LABEL_ESC = str.maketrans({"\\": r"\\", '"': r"\"", "\n": r"\n"})
+
+
+def _metric_name(*parts: str) -> str:
+    return _NAME_RE.sub("_", "_".join(p for p in parts if p)).strip("_")
+
+
+def _emit_sample(lines, name, value, labels: Optional[Dict[str, str]] = None):
+    if not isinstance(value, (int, float)) or isinstance(value, bool):
+        return
+    if labels:
+        lab = ",".join(f'{_metric_name(k)}="{str(v).translate(_LABEL_ESC)}"'
+                       for k, v in labels.items())
+        lines.append(f"pt_{name}{{{lab}}} {value}")
+    else:
+        lines.append(f"pt_{name} {value}")
+
+
+def _emit_tree(lines, base: str, obj, labels=None):
+    """Numeric leaves of nested dicts become samples with dotted names
+    flattened into the metric name."""
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            _emit_tree(lines, _metric_name(base, str(k)), v, labels)
+    else:
+        _emit_sample(lines, base, obj, labels)
+
+
+def prometheus_text() -> str:
+    """Text exposition (format 0.0.4) of the current snapshot. Counter
+    families emit from their live label tuples (never re-split from the
+    display keys, so '|' inside a label value stays intact); provider
+    trees flatten numeric leaves."""
+    h = hub()
+    families = h.families()
+    snap = h.snapshot()
+    lines: list = []
+    for fam in sorted(snap):
+        name = _metric_name(fam)
+        live = families.get(fam)
+        if live is not None:
+            lines.append(f"# TYPE pt_{name}_total counter")
+            for key, val in live.items():
+                labels = dict(zip(live.label_names, key)) if key else None
+                _emit_sample(lines, f"{name}_total", val, labels)
+        else:
+            lines.append(f"# TYPE pt_{name} gauge")
+            _emit_tree(lines, name, snap[fam])
+    return "\n".join(lines) + "\n"
+
+
+# -- stdlib HTTP endpoint -----------------------------------------------------
+
+_SERVER = None
+_SERVER_LOCK = threading.Lock()
+
+
+def serve(port: Optional[int] = None) -> int:
+    """Start (idempotently) a daemon-thread HTTP server exposing
+    ``/metrics`` (Prometheus text) and ``/snapshot`` (JSON) on
+    localhost. ``port=0`` picks a free port; returns the bound port."""
+    global _SERVER
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    if port is None:
+        port = int(os.environ.get("PT_METRICS_PORT", "0") or 0)
+
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER.server_address[1]
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path.startswith("/snapshot"):
+                    payload = json.dumps(snapshot(), default=str).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    payload = prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4"
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):  # no access-log noise on stderr
+                pass
+
+        _SERVER = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        t = threading.Thread(target=_SERVER.serve_forever, daemon=True,
+                             name="pt-metrics-http")
+        t.start()
+        return _SERVER.server_address[1]
+
+
+def stop_serving() -> None:
+    global _SERVER
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            _SERVER.shutdown()
+            _SERVER.server_close()
+            _SERVER = None

@@ -1,0 +1,345 @@
+"""Process-wide metrics registry: counters, gauges, latency windows, families.
+
+Reference role: the reference's observability stack is split across
+host_tracer.cc (spans), profiler_statistic.py (summaries) and the serving
+stack's brpc metrics; here ONE process hub owns every counter the framework
+emits, and each subsystem registers its island into it:
+
+- ``MetricsRegistry`` (promoted from ``paddle_tpu.serving.metrics``, which
+  is now a thin alias): per-engine QPS / latency windows / occupancy;
+- ``CounterFamily``: labeled monotonic counters (``nan_inf_events`` by
+  (op, dtype), ``collectives`` by op, ``trace_cache`` by site/event);
+- providers: snapshot-time callables for state that already lives
+  elsewhere (``jit.persistent_cache.stats()``, ``analysis.retrace``
+  summaries, the ``StepTimeline``) — zero steady-state cost;
+- gauges: live values sampled at snapshot time (prefetcher queue depth).
+
+Hot-path contract: recording into a family is one lock + one dict add —
+a few "atomic increments" per step. Everything heavier (percentiles,
+provider snapshots, exposition) happens at read time.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+__all__ = ["LatencyWindow", "MetricsRegistry", "CounterFamily", "Hub",
+           "hub", "family", "gauge", "register_provider",
+           "register_registry"]
+
+
+class LatencyWindow:
+    """Ring buffer of the most recent latencies (ms); percentiles on read.
+
+    A fixed-size window keeps snapshot cost bounded and the percentiles
+    honest about *recent* traffic rather than the whole process lifetime.
+    """
+
+    def __init__(self, capacity: int = 8192):
+        self._buf = np.zeros(capacity, dtype=np.float64)
+        self._capacity = capacity
+        self._n = 0          # total observations ever
+        self._count = 0      # filled entries (<= capacity)
+        self._idx = 0
+
+    def observe(self, ms: float) -> None:
+        self._buf[self._idx] = ms
+        self._idx = (self._idx + 1) % self._capacity
+        self._count = min(self._count + 1, self._capacity)
+        self._n += 1
+
+    def percentiles(self, qs=(50, 95, 99)) -> Dict[str, float]:
+        if self._count == 0:
+            return {f"p{q}": 0.0 for q in qs}
+        vals = np.percentile(self._buf[: self._count], qs)
+        return {f"p{q}": round(float(v), 3) for q, v in zip(qs, vals)}
+
+    @property
+    def count(self) -> int:
+        return self._n
+
+
+class MetricsRegistry:
+    """Thread-safe registry for one subsystem (a serving engine, a loader).
+
+    - ``inc(name)``: monotonic counters (requests, responses, errors, shed,
+      rejected, batches, compile-cache hits/misses, ...)
+    - ``observe_latency(ms)``: end-to-end request latency (submit -> result)
+    - ``observe_occupancy(frac)``: real rows / bucket rows per executed batch
+    - ``mark_done()``: completion timestamp feeding the sliding-window QPS
+    - ``gauge(name, fn)``: live values sampled at snapshot time (queue depth)
+    """
+
+    def __init__(self, qps_window_s: float = 30.0, latency_capacity: int = 8192):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._latency = LatencyWindow(latency_capacity)
+        self._queue_wait = LatencyWindow(latency_capacity)
+        self._occ_sum = 0.0
+        self._occ_n = 0
+        self._qps_window_s = qps_window_s
+        self._done_ts: deque = deque()
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._t0 = time.monotonic()
+
+    # -- writes ---------------------------------------------------------------
+    def inc(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + n
+
+    def observe_latency(self, ms: float) -> None:
+        with self._lock:
+            self._latency.observe(ms)
+
+    def observe_queue_wait(self, ms: float) -> None:
+        with self._lock:
+            self._queue_wait.observe(ms)
+
+    def observe_occupancy(self, frac: float) -> None:
+        with self._lock:
+            self._occ_sum += frac
+            self._occ_n += 1
+
+    def mark_done(self, n: int = 1) -> None:
+        now = time.monotonic()
+        with self._lock:
+            for _ in range(n):
+                self._done_ts.append(now)
+            self._prune_locked(now)
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def _prune_locked(self, now: float) -> None:
+        horizon = now - self._qps_window_s
+        while self._done_ts and self._done_ts[0] < horizon:
+            self._done_ts.popleft()
+
+    # -- reads ----------------------------------------------------------------
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def qps(self) -> float:
+        """Completions per second over the sliding window (or since start
+        when the process is younger than the window)."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune_locked(now)
+            span = min(self._qps_window_s, max(now - self._t0, 1e-6))
+            return len(self._done_ts) / span
+
+    def snapshot(self) -> Dict:
+        """One coherent stats dict: QPS, latency percentiles (ms), batch
+        occupancy, counters, live gauges."""
+        now = time.monotonic()
+        with self._lock:
+            self._prune_locked(now)
+            span = min(self._qps_window_s, max(now - self._t0, 1e-6))
+            snap = {
+                "qps": round(len(self._done_ts) / span, 3),
+                "latency_ms": self._latency.percentiles(),
+                "queue_wait_ms": self._queue_wait.percentiles(),
+                "batch_occupancy": round(self._occ_sum / self._occ_n, 4)
+                if self._occ_n else 0.0,
+                "counters": dict(self._counters),
+            }
+            gauges = {name: fn for name, fn in self._gauges.items()}
+        # gauges sampled outside the lock: a gauge callback may itself take
+        # the engine lock (queue depth), and lock nesting here could deadlock
+        for name, fn in gauges.items():
+            try:
+                snap[name] = fn()
+            except Exception:
+                snap[name] = None
+        return snap
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._latency = LatencyWindow(self._latency._capacity)
+            self._queue_wait = LatencyWindow(self._queue_wait._capacity)
+            self._occ_sum = 0.0
+            self._occ_n = 0
+            self._done_ts.clear()
+            self._t0 = time.monotonic()
+
+
+_Labels = Union[Tuple[str, ...], str]
+
+
+class CounterFamily:
+    """Labeled monotonic counters: one family, one value per label tuple.
+
+    ``fam.inc(("divide", "float32"))`` with ``label_names=("op", "dtype")``
+    is the nan_inf_events row for that op/dtype pair. Values may be
+    fractional (byte totals, milliseconds) — still add-only.
+    """
+
+    def __init__(self, name: str, label_names: Sequence[str] = ()):
+        self.name = name
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, labels: _Labels = (), n: float = 1) -> None:
+        key = (labels,) if isinstance(labels, str) else tuple(
+            str(l) for l in labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def get(self, labels: _Labels = ()) -> float:
+        key = (labels,) if isinstance(labels, str) else tuple(
+            str(l) for l in labels)
+        with self._lock:
+            return self._values.get(key, 0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._values.values())
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-able view; keys are '|'-joined label tuples for DISPLAY —
+        consumers needing exact labels use ``items()`` (true tuples)."""
+        with self._lock:
+            rows = {"|".join(k) if k else "total": v
+                    for k, v in self._values.items()}
+        return {"label_names": list(self.label_names), "values": rows}
+
+    def items(self):
+        with self._lock:
+            return list(self._values.items())
+
+    def reset(self) -> None:
+        with self._lock:
+            self._values.clear()
+
+
+class Hub:
+    """The process-wide telemetry hub: every family lives (or is reachable)
+    here, and ``snapshot()`` is the one JSON of all of them."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, CounterFamily] = {}
+        self._providers: Dict[str, Callable[[], Any]] = {}
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        # registries belong to their owners (engines); weak values so a
+        # closed+collected engine's rows disappear instead of pinning it
+        self._registries: "weakref.WeakValueDictionary[str, MetricsRegistry]" \
+            = weakref.WeakValueDictionary()
+
+    # -- registration ---------------------------------------------------------
+    def family(self, name: str, label_names: Sequence[str] = ()
+               ) -> CounterFamily:
+        """Get-or-create a labeled counter family (idempotent). Omitting
+        ``label_names`` fetches whatever exists; conflicting non-empty
+        schemas are a wiring bug and raise at the call site."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = CounterFamily(name, label_names)
+                self._families[name] = fam
+            elif label_names:
+                if not fam.label_names:
+                    fam.label_names = tuple(label_names)
+                elif tuple(label_names) != fam.label_names:
+                    raise ValueError(
+                        f"observability family {name!r} already registered "
+                        f"with labels {fam.label_names}, got "
+                        f"{tuple(label_names)}")
+            return fam
+
+    def register_provider(self, name: str, fn: Callable[[], Any]) -> None:
+        """A snapshot-time callable for state owned elsewhere (cache stats,
+        retrace summaries, the step timeline). Zero steady-state cost."""
+        with self._lock:
+            self._providers[name] = fn
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def register_registry(self, name: str, registry: MetricsRegistry) -> None:
+        """Attach a subsystem MetricsRegistry (e.g. a serving engine's) so
+        its snapshot rides along under ``registries.<name>``."""
+        self._registries[name] = registry
+
+    # -- reads ----------------------------------------------------------------
+    def families(self) -> Dict[str, CounterFamily]:
+        """The live CounterFamily objects (exact label tuples via
+        ``items()`` — the Prometheus emitter's source of truth)."""
+        with self._lock:
+            return dict(self._families)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """One JSON-able dict of every registered family/provider/gauge.
+        Provider or gauge failures degrade to an error string — a telemetry
+        read must never raise into the caller."""
+        with self._lock:
+            families = dict(self._families)
+            providers = dict(self._providers)
+            gauges = dict(self._gauges)
+            registries = dict(self._registries)
+        out: Dict[str, Any] = {}
+        for name, fam in families.items():
+            out[name] = fam.snapshot()
+        for name, fn in providers.items():
+            try:
+                out[name] = fn()
+            except Exception as e:
+                out[name] = {"error": str(e)[:200]}
+        if gauges:
+            g = {}
+            for name, fn in gauges.items():
+                try:
+                    g[name] = fn()
+                except Exception:
+                    g[name] = None
+            out["gauges"] = g
+        if registries:
+            regs = {}
+            for name, reg in registries.items():
+                try:
+                    regs[name] = reg.snapshot()
+                except Exception as e:
+                    regs[name] = {"error": str(e)[:200]}
+            out["registries"] = regs
+        return out
+
+    def reset(self) -> None:
+        """Zero the hub-owned families (providers/registries are owned by
+        their subsystems and reset there). Test hygiene, not a hot path."""
+        with self._lock:
+            families = list(self._families.values())
+        for fam in families:
+            fam.reset()
+
+
+_HUB = Hub()
+
+
+def hub() -> Hub:
+    return _HUB
+
+
+def family(name: str, label_names: Sequence[str] = ()) -> CounterFamily:
+    return _HUB.family(name, label_names)
+
+
+def gauge(name: str, fn: Callable[[], float]) -> None:
+    _HUB.gauge(name, fn)
+
+
+def register_provider(name: str, fn: Callable[[], Any]) -> None:
+    _HUB.register_provider(name, fn)
+
+
+def register_registry(name: str, registry: MetricsRegistry) -> None:
+    _HUB.register_registry(name, registry)

@@ -1,0 +1,255 @@
+"""StepTimeline: where did this training step's milliseconds go?
+
+Reference role: profiler_statistic.py's per-step breakdown tables over
+host_tracer.cc spans. TPU-native translation: the compiled step makes the
+device timeline XLA's business, so the host-side question becomes a
+four-phase split per step:
+
+- ``data_wait``      blocked on the loader / prefetcher for the next batch
+- ``host_dispatch``  python + dispatch until the compiled step call returns
+                     (async under jax: the device keeps computing after)
+- ``device_compute`` blocking on the step's outputs — recorded only in
+                     *detailed* mode (a Profiler is active or
+                     ``timeline().detail(True)``), because the block itself
+                     would serialize the async pipeline the warm path won
+- ``compile``        cold builds: trace + XLA compile + first execution
+
+Producers: ``jit.TrainStep`` / ``AccumulateStep`` / ``ShardedTrainStep`` /
+``ShardedAccumulateStep`` wrap their calls, ``hapi.Model.fit`` wraps its
+epoch loop. Each phase is aggregated (count/total/max/last — a few adds
+per step) and, while a ``profiler.Profiler`` is recording, emitted as a
+``RecordEvent`` span named ``step:<phase>`` so the chrome-trace export
+shows the full warm path next to op and user spans.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+__all__ = ["StepTimeline", "timeline"]
+
+
+class _PhaseAgg:
+    __slots__ = ("count", "total_ms", "max_ms", "last_ms")
+
+    def __init__(self):
+        self.count = 0
+        self.total_ms = 0.0
+        self.max_ms = 0.0
+        self.last_ms = 0.0
+
+    def add(self, ms: float):
+        self.count += 1
+        self.total_ms += ms
+        self.last_ms = ms
+        if ms > self.max_ms:
+            self.max_ms = ms
+
+
+class _PhaseCtx:
+    __slots__ = ("_tl", "_name", "_t0")
+
+    def __init__(self, tl: "StepTimeline", name: str):
+        self._tl = tl
+        self._name = name
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._tl.record(self._name,
+                        (time.perf_counter() - self._t0) * 1e3,
+                        t0=self._t0)
+        return False
+
+
+class _StepCtx:
+    __slots__ = ("_tl", "_t0", "_cancelled")
+
+    def __init__(self, tl: "StepTimeline"):
+        self._tl = tl
+        self._t0 = None
+        self._cancelled = False
+
+    def cancel(self):
+        """Don't count this bracket as a step (an exhausted-loader probe)."""
+        self._cancelled = True
+
+    def __enter__(self):
+        self._t0 = self._tl._begin_step()
+        return self
+
+    def __exit__(self, *exc):
+        self._tl._end_step(self._t0, cancelled=self._cancelled)
+        return False
+
+
+class StepTimeline:
+    """Per-step phase aggregator (process-global via ``timeline()``).
+
+    Off-path cost per phase: two ``perf_counter`` reads and a locked
+    aggregate add — the "few atomic increments" overhead contract.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._phases: Dict[str, _PhaseAgg] = {}
+        self._steps = 0
+        self._step_total = _PhaseAgg()
+        self._detail = False
+        # last completed step's phase spans, (name, rel_ms, dur_ms) in
+        # record order — the "ordered" assertion surface for tests/pd_top
+        self._last_step: List[Tuple[str, float, float]] = []
+        # step bracketing is PER THREAD (depth, open-step span list, t0):
+        # two loops stepping concurrently must not nest into each other;
+        # the aggregates above stay shared under the lock
+        self._tls = threading.local()
+
+    # -- configuration --------------------------------------------------------
+    def detail(self, on: bool = True) -> "StepTimeline":
+        """Force detailed mode (device_compute blocking) regardless of the
+        profiler state."""
+        self._detail = bool(on)
+        return self
+
+    @property
+    def detailed(self) -> bool:
+        if self._detail:
+            return True
+        try:
+            from .. import profiler
+
+            return profiler.is_recording()
+        except Exception:
+            return False
+
+    # -- recording ------------------------------------------------------------
+    def step(self) -> _StepCtx:
+        """Context manager bracketing one training step."""
+        return _StepCtx(self)
+
+    def phase(self, name: str) -> _PhaseCtx:
+        """Context manager timing one phase (inside or outside a step)."""
+        return _PhaseCtx(self, name)
+
+    def record(self, name: str, ms: float, t0: Optional[float] = None) -> None:
+        cur = getattr(self._tls, "cur", None)
+        with self._lock:
+            agg = self._phases.get(name)
+            if agg is None:
+                agg = self._phases[name] = _PhaseAgg()
+            agg.add(ms)
+            if cur is not None and t0 is not None:
+                cur.append((name, (t0 - self._tls.t0) * 1e3, ms))
+        self._maybe_span(name, ms, t0)
+
+    def _maybe_span(self, name: str, ms: float, t0: Optional[float]) -> None:
+        """Emit a host-tracer span while a Profiler is recording, so the
+        chrome trace shows step phases next to op and user spans."""
+        try:
+            from .. import profiler
+
+            if t0 is not None and profiler.is_recording():
+                profiler._RECORDER.record(f"step:{name}", t0 * 1e6,
+                                          ms * 1e3, "StepTimeline")
+        except Exception:
+            pass
+
+    def _begin_step(self) -> float:
+        t0 = time.perf_counter()
+        ts = self._tls
+        depth = getattr(ts, "depth", 0)
+        ts.depth = depth + 1
+        if depth == 0:  # the outermost bracket owns the step
+            ts.cur = []
+            ts.t0 = t0
+        return t0
+
+    def _end_step(self, t0: float, cancelled: bool = False) -> None:
+        ms = (time.perf_counter() - t0) * 1e3
+        ts = self._tls
+        ts.depth = max(getattr(ts, "depth", 1) - 1, 0)
+        if ts.depth > 0:
+            return
+        cur, ts.cur = getattr(ts, "cur", None), None
+        if cancelled:
+            return
+        with self._lock:
+            self._steps += 1
+            self._step_total.add(ms)
+            if cur is not None:
+                self._last_step = cur
+        self._maybe_span("total", ms, t0)
+
+    # -- reads ----------------------------------------------------------------
+    def summary(self) -> Dict:
+        """JSON-able aggregate: per-phase count/total/avg/max/last, step
+        count, and the last step's ordered phase list."""
+        with self._lock:
+            phases = {
+                name: {
+                    "count": a.count,
+                    "total_ms": round(a.total_ms, 3),
+                    "avg_ms": round(a.total_ms / a.count, 3) if a.count else 0.0,
+                    "max_ms": round(a.max_ms, 3),
+                    "last_ms": round(a.last_ms, 3),
+                }
+                for name, a in self._phases.items()
+            }
+            return {
+                "steps": self._steps,
+                "step_total_ms": {
+                    "avg": round(self._step_total.total_ms /
+                                 self._step_total.count, 3)
+                    if self._step_total.count else 0.0,
+                    "max": round(self._step_total.max_ms, 3),
+                    "last": round(self._step_total.last_ms, 3),
+                },
+                "phases": phases,
+                "last_step": [
+                    {"phase": n, "rel_ms": round(rel, 3),
+                     "dur_ms": round(d, 3)}
+                    for (n, rel, d) in self._last_step
+                ],
+                "detailed": self.detailed,
+            }
+
+    def table(self, time_unit: str = "ms") -> str:
+        """Human summary table (profiler_statistic.py shape)."""
+        s = self.summary()
+        div = {"s": 1e3, "ms": 1.0, "us": 1e-3}[time_unit]
+        lines = [
+            f"StepTimeline — {s['steps']} steps, "
+            f"avg {s['step_total_ms']['avg']} ms/step",
+            f"{'Phase':<20}{'Count':>8}{'Total(' + time_unit + ')':>14}"
+            f"{'Avg(' + time_unit + ')':>12}{'Max(' + time_unit + ')':>12}"
+            f"{'Last(' + time_unit + ')':>12}",
+            "-" * 78,
+        ]
+        order = sorted(s["phases"].items(), key=lambda kv: -kv[1]["total_ms"])
+        for name, row in order:
+            lines.append(
+                f"{name[:19]:<20}{row['count']:>8}"
+                f"{row['total_ms'] / div:>14.3f}{row['avg_ms'] / div:>12.3f}"
+                f"{row['max_ms'] / div:>12.3f}{row['last_ms'] / div:>12.3f}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._phases.clear()
+            self._steps = 0
+            self._step_total = _PhaseAgg()
+            self._last_step = []
+        self._tls.cur = None
+        self._tls.depth = 0
+
+
+_TIMELINE = StepTimeline()
+
+
+def timeline() -> StepTimeline:
+    """The process-global StepTimeline every train-step producer feeds."""
+    return _TIMELINE

@@ -43,6 +43,12 @@ class EngineBase:
         self.name = name
         self.metrics = MetricsRegistry(qps_window_s=qps_window_s)
         self.metrics.gauge("queue_depth", self.queue_depth)
+        # framework-wide telemetry: this engine's rows ride along in
+        # observability.snapshot() under registries["serving:<name>"]
+        # (weak-valued — a collected engine's rows disappear with it)
+        from ..observability import register_registry
+
+        register_registry(f"serving:{name}", self.metrics)
         self._queue: deque = deque()
         self._cond = threading.Condition()
         self._start_lock = threading.Lock()

@@ -1,161 +1,13 @@
-"""Serving observability: counters, latency percentiles, QPS, occupancy.
+"""Serving observability — thin alias over the framework-level registry.
 
-Reference role: the reference deployment stack exposes per-predictor timing
-through ``AnalysisPredictor``'s inference profiling switches and the
-FleetExecutor's brpc metrics; here the serving engine owns one
-``MetricsRegistry`` and snapshots it on demand — no background aggregation
-thread, every structure is O(1) per observation under one lock.
-
-Wired into ``paddle_tpu.profiler``: the engine brackets each batch execution
-in a ``profiler.RecordEvent`` span (category "Serving"), so a running
-``profiler.Profiler`` sees serving batches on the same host timeline as op
-dispatch and dataloader spans.
+``MetricsRegistry``/``LatencyWindow`` were born here (PR 2) and were
+promoted to ``paddle_tpu.observability.registry`` when the process-wide
+telemetry hub landed: the serving engine's counters are the same classes
+every other subsystem now uses, and each engine's registry is registered
+into ``observability.hub()`` (rows under ``registries["serving:<name>"]``
+in ``observability.snapshot()``). This module stays as the import path
+serving code and users already know.
 """
-from __future__ import annotations
-
-import threading
-import time
-from collections import deque
-from typing import Callable, Dict
-
-import numpy as np
+from ..observability.registry import LatencyWindow, MetricsRegistry  # noqa: F401
 
 __all__ = ["MetricsRegistry", "LatencyWindow"]
-
-
-class LatencyWindow:
-    """Ring buffer of the most recent latencies (ms); percentiles on read.
-
-    A fixed-size window keeps snapshot cost bounded and the percentiles
-    honest about *recent* traffic rather than the whole process lifetime.
-    """
-
-    def __init__(self, capacity: int = 8192):
-        self._buf = np.zeros(capacity, dtype=np.float64)
-        self._capacity = capacity
-        self._n = 0          # total observations ever
-        self._count = 0      # filled entries (<= capacity)
-        self._idx = 0
-
-    def observe(self, ms: float) -> None:
-        self._buf[self._idx] = ms
-        self._idx = (self._idx + 1) % self._capacity
-        self._count = min(self._count + 1, self._capacity)
-        self._n += 1
-
-    def percentiles(self, qs=(50, 95, 99)) -> Dict[str, float]:
-        if self._count == 0:
-            return {f"p{q}": 0.0 for q in qs}
-        vals = np.percentile(self._buf[: self._count], qs)
-        return {f"p{q}": round(float(v), 3) for q, v in zip(qs, vals)}
-
-    @property
-    def count(self) -> int:
-        return self._n
-
-
-class MetricsRegistry:
-    """Thread-safe registry for one serving engine.
-
-    - ``inc(name)``: monotonic counters (requests, responses, errors, shed,
-      rejected, batches, compile-cache hits/misses, ...)
-    - ``observe_latency(ms)``: end-to-end request latency (submit -> result)
-    - ``observe_occupancy(frac)``: real rows / bucket rows per executed batch
-    - ``mark_done()``: completion timestamp feeding the sliding-window QPS
-    - ``gauge(name, fn)``: live values sampled at snapshot time (queue depth)
-    """
-
-    def __init__(self, qps_window_s: float = 30.0, latency_capacity: int = 8192):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, int] = {}
-        self._latency = LatencyWindow(latency_capacity)
-        self._queue_wait = LatencyWindow(latency_capacity)
-        self._occ_sum = 0.0
-        self._occ_n = 0
-        self._qps_window_s = qps_window_s
-        self._done_ts: deque = deque()
-        self._gauges: Dict[str, Callable[[], float]] = {}
-        self._t0 = time.monotonic()
-
-    # -- writes ---------------------------------------------------------------
-    def inc(self, name: str, n: int = 1) -> None:
-        with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + n
-
-    def observe_latency(self, ms: float) -> None:
-        with self._lock:
-            self._latency.observe(ms)
-
-    def observe_queue_wait(self, ms: float) -> None:
-        with self._lock:
-            self._queue_wait.observe(ms)
-
-    def observe_occupancy(self, frac: float) -> None:
-        with self._lock:
-            self._occ_sum += frac
-            self._occ_n += 1
-
-    def mark_done(self, n: int = 1) -> None:
-        now = time.monotonic()
-        with self._lock:
-            for _ in range(n):
-                self._done_ts.append(now)
-            self._prune_locked(now)
-
-    def gauge(self, name: str, fn: Callable[[], float]) -> None:
-        with self._lock:
-            self._gauges[name] = fn
-
-    def _prune_locked(self, now: float) -> None:
-        horizon = now - self._qps_window_s
-        while self._done_ts and self._done_ts[0] < horizon:
-            self._done_ts.popleft()
-
-    # -- reads ----------------------------------------------------------------
-    def counter(self, name: str) -> int:
-        with self._lock:
-            return self._counters.get(name, 0)
-
-    def qps(self) -> float:
-        """Completions per second over the sliding window (or since start
-        when the process is younger than the window)."""
-        now = time.monotonic()
-        with self._lock:
-            self._prune_locked(now)
-            span = min(self._qps_window_s, max(now - self._t0, 1e-6))
-            return len(self._done_ts) / span
-
-    def snapshot(self) -> Dict:
-        """One coherent stats dict: QPS, latency percentiles (ms), batch
-        occupancy, counters, live gauges."""
-        now = time.monotonic()
-        with self._lock:
-            self._prune_locked(now)
-            span = min(self._qps_window_s, max(now - self._t0, 1e-6))
-            snap = {
-                "qps": round(len(self._done_ts) / span, 3),
-                "latency_ms": self._latency.percentiles(),
-                "queue_wait_ms": self._queue_wait.percentiles(),
-                "batch_occupancy": round(self._occ_sum / self._occ_n, 4)
-                if self._occ_n else 0.0,
-                "counters": dict(self._counters),
-            }
-            gauges = {name: fn for name, fn in self._gauges.items()}
-        # gauges sampled outside the lock: a gauge callback may itself take
-        # the engine lock (queue depth), and lock nesting here could deadlock
-        for name, fn in gauges.items():
-            try:
-                snap[name] = fn()
-            except Exception:
-                snap[name] = None
-        return snap
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-            self._latency = LatencyWindow(self._latency._capacity)
-            self._queue_wait = LatencyWindow(self._queue_wait._capacity)
-            self._occ_sum = 0.0
-            self._occ_n = 0
-            self._done_ts.clear()
-            self._t0 = time.monotonic()

@@ -30,6 +30,8 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_warm_path.py -q \
 # the CPU bench smoke must emit a parseable non-null headline as its last
 # line (first line is the parseable stub) within its own budget
 rm -f /tmp/_bench_smoke.log
+# stale telemetry must not satisfy the observability gate below
+rm -f bench_artifacts/telemetry_*.json
 timeout -k 10 700 env JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 \
     python bench.py > /tmp/_bench_smoke.log 2>/tmp/_bench_smoke.err || {
         echo "bench smoke failed"; tail -20 /tmp/_bench_smoke.err; exit 1; }
@@ -44,6 +46,28 @@ pc = last["detail"]["persistent_cache"]
 assert pc["warm_fresh_xla_compiles"] == 0, pc
 print("perf gate OK:", {k: last["detail"][k]
                         for k in ("warm_path", "persistent_cache")})
+PY
+
+echo "== observability gate (telemetry snapshot from the bench smoke) =="
+# the smoke above ran with PT_METRICS_PORT off; its per-recipe telemetry
+# dump must carry the unified-hub families, with real step-timeline and
+# bench rows (ISSUE-4 acceptance: the warm path is visible from outside)
+python - <<'PY' || exit 1
+import json
+snap = json.load(open("bench_artifacts/telemetry_warm_path.json"))
+for fam in ("persistent_cache", "retrace_events", "step_timeline",
+            "trace_cache", "bench"):
+    assert fam in snap, f"{fam} family missing from telemetry snapshot"
+tl = snap["step_timeline"]
+assert tl["steps"] > 0, tl
+assert tl["phases"].get("compile", {}).get("count", 0) >= 1, tl["phases"]
+assert tl["phases"].get("host_dispatch", {}).get("count", 0) >= 1, tl["phases"]
+assert "warm_path" in snap["bench"], snap["bench"].keys()
+probe = snap["bench"]["warm_path"].get("telemetry_overhead_us", {})
+assert probe.get("timeline_step", 1e9) < 500, probe  # off-path overhead bound
+print("observability gate OK:", {"steps": tl["steps"],
+                                 "phases": sorted(tl["phases"]),
+                                 "overhead_us": probe})
 PY
 
 echo "== tier-1 test suite =="
