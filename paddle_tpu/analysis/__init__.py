@@ -27,13 +27,16 @@ from . import spmd  # noqa: F401    (registers the "spmd" pass)
 from . import retrace  # noqa: F401
 from . import selfcheck  # noqa: F401
 from .memory import (HBM_BYTES, PeakEstimate, estimate_peak,  # noqa: F401
-                     estimate_train_step_hbm)
+                     estimate_offload_stream_hbm, estimate_train_step_hbm,
+                     offload_stream_plan, stream_plan_check)
 
 __all__ = [
     "Diagnostic", "max_severity", "render", "to_json",
     "OpNode", "Program", "capture", "run_passes", "PASSES",
     "memory", "spmd", "retrace", "selfcheck",
     "HBM_BYTES", "PeakEstimate", "estimate_peak", "estimate_train_step_hbm",
+    "estimate_offload_stream_hbm", "offload_stream_plan",
+    "stream_plan_check",
 ]
 
 # env-gated retrace audit (default off; zero overhead unless set)

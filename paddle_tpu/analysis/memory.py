@@ -24,7 +24,8 @@ from .program import (Program, register_pass, _aval_bytes, _sub_jaxprs,
                       _as_open, _user_location)
 
 __all__ = ["PeakEstimate", "estimate_peak", "estimate_train_step_hbm",
-           "memory_pass", "HBM_BYTES"]
+           "estimate_offload_stream_hbm", "offload_stream_plan",
+           "stream_plan_check", "memory_pass", "HBM_BYTES"]
 
 # the measured usable envelope of the target chip (OOM-bisection, BENCH):
 # nominal 16G, ~9.5G addressable through the tunnel
@@ -266,6 +267,107 @@ def memory_pass(program: Program, hbm_bytes: int = HBM_BYTES,
             op=est.peak_op, location=est.peak_location,
             suggestion="leave headroom: XLA temps and fragmentation land on top",
             data=est.to_dict()))
+    return diags
+
+
+def offload_stream_plan(step) -> Dict[str, Any]:
+    """Static plan of the streaming offload executor's memory story.
+
+    The two-deep lane holds at most TWO groups in flight, so the staging
+    working set is ``2 * max_group(f32 grads down + fresh params up)`` —
+    NOT the full fp32-master + optimizer-state residency a resident step
+    (or a naive whole-set offload round-trip) would pay. ``step`` is an
+    offload ``ShardedTrainStep`` (``optimizer._offload`` set)."""
+    from ..jit.offload_stream import plan_stream_groups
+
+    params = step.train_params
+    seg = int(getattr(step, "_stream_segment", 2 ** 20))
+    bufmax = int(getattr(step, "_stream_bufmax", 2 ** 23))
+    groups = plan_stream_groups([p.size * 4 for p in params], seg, bufmax)
+    # grads stream down in the fwd executable's dtype — the model dtype,
+    # unless a global-norm clip upcast them to f32 on the device side
+    clipped = getattr(step.optimizer, "_grad_clip", None) is not None
+    staging = []
+    for idx in groups:
+        down = sum(
+            params[i].size * (4 if clipped
+                              else int(params[i].data.dtype.itemsize))
+            for i in idx)                               # grads D2H
+        up = sum(int(params[i].data.nbytes) for i in idx)  # fresh params H2D
+        staging.append(down + up)
+    opt = step.optimizer
+    state_bytes = sum(
+        int(v.nbytes)
+        for p in params for v in opt._accumulators[id(p)].values())
+    master_bytes = sum(p.size * 4 for p in params)
+    return {
+        "groups": len(groups),
+        "group_param_counts": [len(g) for g in groups],
+        "max_group_staging_bytes": max(staging) if staging else 0,
+        "working_set_bytes": 2 * max(staging) if staging else 0,
+        "full_residency_bytes": master_bytes + state_bytes,
+        "segment_size": seg, "buffer_max_size": bufmax,
+    }
+
+
+def estimate_offload_stream_hbm(step, *batch) -> Dict[str, Any]:
+    """HBM model of one streamed-offload step: device side = the fwd+bwd
+    program's live-range peak (params + grads + activations; master and
+    optimizer state never HBM-resident) PLUS the lane's two-group staging
+    working set. The honest counterpart of ``estimate_train_step_hbm`` for
+    offload steps — the full-residency estimate would overcharge by the
+    whole master/state pool."""
+    import jax
+
+    from ..framework import random as random_mod
+    from .program import _data_of
+
+    arrays = [_data_of(b) for b in batch]
+    params = [p.data for p in step.train_params]
+    frozen = [t.data for t in step.frozen]
+    gen = random_mod.default_generator()
+    saved = gen.get_state()
+    try:
+        key = random_mod.next_key()
+    finally:
+        gen.set_state(saved)
+    closed = jax.make_jaxpr(step._build_offload(arrays))(
+        params, frozen, key, *arrays)
+    est = estimate_peak_jaxpr(_as_open(closed), (),
+                              label="ShardedTrainStep[offload]")
+    plan = offload_stream_plan(step)
+    peak = est.peak_bytes + plan["working_set_bytes"]
+    return {
+        "peak_bytes": int(peak), "peak_gb": round(peak / 1e9, 3),
+        "device_program_peak_bytes": est.peak_bytes,
+        "stream_working_set_bytes": plan["working_set_bytes"],
+        "avoided_full_residency_bytes": plan["full_residency_bytes"],
+        "plan": plan, "device_estimate": est.to_dict(),
+    }
+
+
+def stream_plan_check(step, *batch, hbm_bytes: int = HBM_BYTES
+                      ) -> List[Diagnostic]:
+    """MM012 info: streamed-offload peak (two-group working set model);
+    MM013: that peak still exceeds the envelope."""
+    est = estimate_offload_stream_hbm(step, *batch)
+    diags = [Diagnostic(
+        severity="info", code="MM012", pass_name="memory",
+        message=(f"streamed offload: estimated peak {est['peak_gb']:.3f} GB "
+                 f"(device program {est['device_program_peak_bytes'] / 1e9:.3f}"
+                 f" GB + 2-group staging "
+                 f"{est['stream_working_set_bytes'] / 1e9:.3f} GB; avoids "
+                 f"{est['avoided_full_residency_bytes'] / 1e9:.3f} GB of "
+                 f"master/state residency)"),
+        data=est)]
+    if est["peak_bytes"] > hbm_bytes:
+        diags.append(Diagnostic(
+            severity="error", code="MM013", pass_name="memory",
+            message=(f"streamed offload still exceeds the envelope "
+                     f"({est['peak_gb']:.2f} GB > {hbm_bytes / 1e9:.1f} GB)"),
+            suggestion=("shrink buffer_max_size (smaller stream groups), "
+                        "enable remat, or shard params (level p_g_os)"),
+            data=est))
     return diags
 
 

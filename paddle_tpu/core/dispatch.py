@@ -45,6 +45,24 @@ def install_audit_hook(hook: Optional[Callable]) -> None:
     _AUDIT_HOOK = hook
 
 
+def _op_jit(fn: Callable, op_name: str, stage: str, key: Tuple) -> Callable:
+    """Jit one eager op kernel, routed through the persistent executable
+    cache when it is enabled (ROADMAP PR-3 follow-up: the per-op dispatch
+    caches warm-start across processes — the bench per-op table shows
+    repeated sub-ms compiles every fresh process repays). The cache key is
+    prim + attrs (via ``key``) + the abstract call signature CachedJit
+    derives per call; with the cache disabled CachedJit is a one-flag-check
+    passthrough to ``jax.jit``. Lazy import: paddle_tpu.jit sits above the
+    core layer and is always imported by the time an op runs."""
+    try:
+        from ..jit.persistent_cache import cached_jit
+
+        return cached_jit(fn, label=f"op:{op_name}:{stage}",
+                          extra_meta=("op", op_name, stage, repr(key)))
+    except ImportError:  # mid-build partial package: plain jit
+        return jax.jit(fn)
+
+
 def _hashable(v):
     if isinstance(v, (list, tuple)):
         return tuple(_hashable(x) for x in v)
@@ -87,7 +105,8 @@ class Primitive:
         key = (self.name, _attrs_key(attrs))
         f = _FWD_CACHE.get(key)
         if f is None:
-            f = jax.jit(functools.partial(self.fn, **attrs))
+            f = _op_jit(functools.partial(self.fn, **attrs),
+                        self.name, "fwd", key)
             _FWD_CACHE[key] = f
         if _AUDIT_HOOK is not None:
             return _AUDIT_HOOK(self.name, "fwd", key, f)
@@ -106,7 +125,6 @@ class Primitive:
                     out = self.fn(*primals, **_attrs)
                     return _rule(ct, out, primals, **_attrs)
 
-                b = jax.jit(b)
             else:
                 pfn = functools.partial(self.fn, **attrs)
 
@@ -114,7 +132,7 @@ class Primitive:
                     _out, vjp = jax.vjp(_pfn, *primals)
                     return vjp(ct)
 
-                b = jax.jit(b)
+            b = _op_jit(b, self.name, "bwd", key)
             _BWD_CACHE[key] = b
         if _AUDIT_HOOK is not None:
             return _AUDIT_HOOK(self.name, "bwd", key, b)

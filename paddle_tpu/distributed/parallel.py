@@ -211,12 +211,19 @@ class ShardedTrainStep:
         self.offload = bool(getattr(optimizer, "_offload", False))
         if self.offload and (self.scaler is not None or self.accum_steps > 1):
             raise NotImplementedError(
-                "ShardedTrainStep: in-graph GradScaler / gradient accumulation "
-                "is not supported together with optimizer-state offload; run "
-                "the scaler eagerly or drop offload for this step")
+                "ShardedTrainStep: in-graph GradScaler / per-call accum_steps "
+                "windows are not supported together with optimizer-state "
+                "offload; run the scaler eagerly, or use the fused "
+                "step.accumulate(k) which composes with the streaming "
+                "offload executor")
         if self.offload:
             # reference sharding_utils.py offload: master weights + optimizer
-            # state pinned to host memory; see _build_offload
+            # state pinned to host memory; see _build_offload. The update
+            # streams per GROUP through a double-buffered lane (the
+            # TaskFlow-prefetch role) — group sizing honors the
+            # group_sharded_parallel segment_size/buffer_max_size knobs.
+            import os as _os
+
             self._cpu = jax.devices("cpu")[0]
             for p in self.train_params:
                 st = opt._accumulators[id(p)]
@@ -225,6 +232,14 @@ class ShardedTrainStep:
             self._master = [
                 jax.device_put(jnp.asarray(p.data, jnp.float32), self._cpu)
                 for p in self.train_params]
+            self._stream_segment = int(getattr(
+                optimizer, "_stream_segment_size", 2 ** 20))
+            self._stream_bufmax = int(getattr(
+                optimizer, "_stream_buffer_max_size", 2 ** 23))
+            self._stream_overlap = _os.environ.get(
+                "PT_OFFLOAD_OVERLAP", "1").strip().lower() not in (
+                "0", "false", "off")
+            self._stream = None  # (groups, per-group upd execs, clip, lane)
             return
         # place optimizer state at its (possibly ZeRO-sharded) placement
         for p in self.train_params:
@@ -368,11 +383,11 @@ class ShardedTrainStep:
         update per call. Call with the FULL (global) batch; dim 0 must
         divide by ``steps``. Unlike ``accum_steps`` (which spreads the
         window over k calls), this is one dispatch per window."""
-        if self.scaler is not None or self.offload:
+        if self.scaler is not None:
             raise NotImplementedError(
                 "ShardedTrainStep.accumulate: fused accumulation does not "
-                "compose with the in-graph GradScaler or optimizer-state "
-                "offload; use accum_steps for the scaler path")
+                "compose with the in-graph GradScaler; use accum_steps for "
+                "the scaler path")
         return ShardedAccumulateStep(self, steps, remat=remat,
                                      average=average)
 
@@ -641,16 +656,14 @@ class ShardedTrainStep:
                 "updates": int(self._upd_no)}
 
     def _build_offload(self, batch_arrays):
-        """Two executables instead of one: fwd+bwd on the mesh, update on the
-        host CPU device where the fp32 master + optimizer state live.
-        Per step the grads stream host-ward and the freshly-cast params stream
-        device-ward — the HBM never holds optimizer state."""
+        """Mesh fwd+bwd executable of the offload path (grads at their
+        param placements, ZeRO-2 reduce-scatter constraint honored); the
+        host update side lives in ``_ensure_stream_update``."""
         env = self.env
-        opt = self.optimizer
         model, loss_fn = self.target, self.loss_fn
         train_params = self.train_params
         frozen = self.frozen
-        dtypes = [p.data.dtype for p in train_params]
+        zero2_shardings = self._zero2_plan()
 
         from ..jit import _Binder
 
@@ -665,13 +678,18 @@ class ShardedTrainStep:
                             loss = loss_fn(model, *[Tensor(a) for a in batch])
                     return loss.data.astype(jnp.float32)
 
-                return jax.value_and_grad(loss_of)(tuple(params))
+                loss_val, grads = jax.value_and_grad(loss_of)(tuple(params))
+                if zero2_shardings is not None:
+                    # os_g: constrain grads to the state-shard layout so XLA
+                    # emits a reduce-scatter, not an all-reduce (the host
+                    # download gathers either way; ICI traffic halves)
+                    grads = tuple(
+                        g if sh is None
+                        else jax.lax.with_sharding_constraint(g, sh)
+                        for g, sh in zip(grads, zero2_shardings))
+                return loss_val, grads
             finally:
                 random_mod.default_generator().clear_trace_key()
-
-        from ..optimizer.optimizer import make_master_update
-
-        update = make_master_update(opt, train_params, dtypes)
 
         param_sh = [param_sharding(p, env) for p in train_params]
         frozen_sh = [param_sharding(p, env) for p in frozen]
@@ -680,35 +698,135 @@ class ShardedTrainStep:
         else:
             batch_sh = [env.sharding_for(self._default_batch_spec(a)) for a in batch_arrays]
         repl = env.replicated()
-        jit_fwd = jax.jit(fwd_bwd,
-                          in_shardings=(param_sh, frozen_sh, repl, *batch_sh),
-                          out_shardings=(repl, tuple(param_sh)))
-        jit_upd = jax.jit(update, donate_argnums=(0, 2))  # cpu via placement
-        return jit_fwd, jit_upd
+        from ..jit import persistent_cache
 
-    def _call_offload(self, arrays):
+        return persistent_cache.cached_jit(
+            fwd_bwd, in_shardings=(param_sh, frozen_sh, repl, *batch_sh),
+            out_shardings=(repl, tuple(param_sh)),
+            label="ShardedTrainStep.offload_fwd",
+            extra_meta=("offload_fwd", self.accum_steps))
+
+    def _ensure_stream_update(self):
+        """Build the streaming update side once: stream groups (sized by the
+        group_sharded_parallel segment_size / buffer_max_size knobs), one
+        donated host update executable per group, the device-side clip
+        (global-norm clip MUST see the full grad set — it cannot run per
+        group), and the transfer lane. Batch-shape independent, so the
+        fused accumulate step shares it."""
+        if self._stream is not None:
+            return self._stream
         opt = self.optimizer
-        if self._jitted is None:
+        from ..jit.offload_stream import StreamLane, plan_stream_groups
+        from ..optimizer.optimizer import make_master_update
+
+        groups = plan_stream_groups(
+            [p.size * 4 for p in self.train_params],  # fp32 master bytes
+            self._stream_segment, self._stream_bufmax)
+        from ..jit import persistent_cache
+
+        dtypes = [p.data.dtype for p in self.train_params]
+        jit_upds = []
+        for gi, idx in enumerate(groups):
+            upd = make_master_update(
+                opt, [self.train_params[i] for i in idx],
+                [dtypes[i] for i in idx], with_clip=False)
+            jit_upds.append(persistent_cache.cached_jit(
+                upd, donate_argnums=(0, 2),  # cpu via placement
+                label="ShardedTrainStep.offload_update",
+                extra_meta=("offload_upd", gi)))
+        clip = opt._grad_clip
+        jit_clip = None
+        if clip is not None:
+            def clip_all(grads):
+                return clip._apply_jax([g.astype(jnp.float32) for g in grads])
+
+            jit_clip = jax.jit(clip_all)
+        lane = StreamLane(overlap=self._stream_overlap)
+        self._param_sh = [param_sharding(p, self.env)
+                          for p in self.train_params]
+        self._stream = (groups, jit_upds, jit_clip, lane)
+        return self._stream
+
+    def _stream_update(self, grads, tl):
+        """Latency-hiding group walk: while group *i*'s host update
+        computes, the lane is downloading group *i+1*'s grads and uploading
+        group *i-1*'s fresh params — steady-state cost approaches
+        max(update compute, transfer) instead of their sum. Consumer-side
+        blocking is charged to the ``stream_wait`` timeline phase."""
+        opt = self.optimizer
+        groups, jit_upds, jit_clip, lane = self._ensure_stream_update()
+        if jit_clip is not None:
+            grads = jit_clip(list(grads))
+        cpu = self._cpu
+        lr = jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), cpu)
+        step_no = jax.device_put(
+            jnp.asarray(opt._global_step + 1, jnp.int32), cpu)
+        downs: dict = {}
+        ups: list = [None] * len(groups)
+
+        def submit_down(gi):
+            downs[gi] = lane.submit(
+                "d2h", [grads[i] for i in groups[gi]], cpu, tag=gi)
+
+        submit_down(0)
+        if len(groups) > 1:
+            submit_down(1)
+        for gi, idx in enumerate(groups):
+            with tl.phase("stream_wait"):
+                g_host = downs.pop(gi).wait()
+            if gi + 2 < len(groups):
+                submit_down(gi + 2)
+            master = [self._master[i] for i in idx]
+            states = [opt._accumulators[id(self.train_params[i])]
+                      for i in idx]
+            new_m, new_s, new_p = jit_upds[gi](master, g_host, states,
+                                               lr, step_no)
+            for i, m, s in zip(idx, new_m, new_s):
+                self._master[i] = m
+                opt._accumulators[id(self.train_params[i])] = s
+            ups[gi] = lane.submit(
+                "h2d", new_p, [self._param_sh[i] for i in idx], tag=gi)
+        new_params = [None] * len(self.train_params)
+        for gi, idx in enumerate(groups):
+            with tl.phase("stream_wait"):
+                fresh = ups[gi].wait()
+            for i, a in zip(idx, fresh):
+                new_params[i] = a
+        return new_params
+
+    def _call_offload(self, arrays, tl):
+        opt = self.optimizer
+        cold = self._jitted is None
+        if cold:
             self._jitted = self._build_offload(arrays)
-            self._param_sh = [param_sharding(p, self.env) for p in self.train_params]
-        jit_fwd, jit_upd = self._jitted
+        jit_fwd = self._jitted
         params = [p.data for p in self.train_params]
         frozen_arrays = [t.data for t in self.frozen]
-        loss, grads = jit_fwd(params, frozen_arrays, random_mod.next_key(), *arrays)
-        grads_host = [jax.device_put(g, self._cpu) for g in grads]
+        with tl.phase("compile" if cold else "host_dispatch"):
+            loss, grads = jit_fwd(params, frozen_arrays,
+                                  random_mod.next_key(), *arrays)
+            new_params = self._stream_update(grads, tl)
         del grads
-        states = [opt._accumulators[id(p)] for p in self.train_params]
-        lr = jax.device_put(jnp.asarray(opt.get_lr(), jnp.float32), self._cpu)
-        step_no = jax.device_put(jnp.asarray(opt._global_step + 1, jnp.int32),
-                                 self._cpu)
-        self._master, new_s, new_p = jit_upd(self._master, grads_host, states,
-                                             lr, step_no)
-        for p, s in zip(self.train_params, new_s):
-            opt._accumulators[id(p)] = s
-        for p, a, sh in zip(self.train_params, new_p, self._param_sh):
-            p.data = jax.device_put(a, sh)
+        for p, a in zip(self.train_params, new_params):
+            p.data = a
         opt._global_step += 1
         return Tensor(loss)
+
+    def stream_stats(self):
+        """Per-step-object lane counters (bytes up/down, transfer/stall ms,
+        overlap_efficiency) — None before the first offload step. The
+        process-wide view lives in the ``offload_stream`` observability
+        family."""
+        if not self.offload or self._stream is None:
+            return None
+        return self._stream[3].stats()
+
+    def stream_schedule(self):
+        """(kind, group index) lane submissions in order — the group
+        schedule the ordering tests pin. None before the first step."""
+        if not self.offload or self._stream is None:
+            return None
+        return list(self._stream[3].events)
 
     def __call__(self, *batch):
         from ..jit import _obs
@@ -717,8 +835,8 @@ class ShardedTrainStep:
         arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
         tl, tc = _obs()
         if self.offload:
-            with tl.step(), tl.phase("host_dispatch"):
-                return self._call_offload(arrays)
+            with tl.step():
+                return self._call_offload(arrays, tl)
         if self.scaler is not None or self.accum_steps > 1:
             with tl.step(), tl.phase("host_dispatch"):
                 return self._call_amp(arrays)
@@ -776,6 +894,72 @@ class ShardedAccumulateStep:
         self.train_params = step.train_params
         self.frozen = step.frozen
         self._jitted = None
+
+    def _build_offload(self, batch_arrays):
+        """Offload twin: the same fused microbatch scan, but the executable
+        returns the window's fp32 grads instead of applying the update —
+        the streaming executor (outer._stream_update) walks the host update
+        per stream group, exactly like the plain offload step."""
+        outer = self._step
+        k = self.steps
+        scale = 1.0 / k if self.average else 1.0
+        grad_of = outer._make_grad_fn(remat=self.remat)
+        zero2_shardings = outer._zero2_plan()
+
+        def step(params, frozen_arrays, rngkey, *batch):
+            micro = tuple(
+                a.reshape((k, a.shape[0] // k) + a.shape[1:]) for a in batch)
+            keys = jax.random.split(rngkey, k)
+
+            def body(acc, xs):
+                key_i, mb = xs[0], xs[1:]
+                random_mod.default_generator().set_trace_key(key_i)
+                try:
+                    loss_i, grads = grad_of(tuple(params), frozen_arrays, mb)
+                finally:
+                    random_mod.default_generator().clear_trace_key()
+                grads = [g.astype(jnp.float32) * scale for g in grads]
+                if zero2_shardings is not None:
+                    grads = [g if sh is None
+                             else jax.lax.with_sharding_constraint(g, sh)
+                             for g, sh in zip(grads, zero2_shardings)]
+                acc2 = [a + g for a, g in zip(acc, grads)]
+                return acc2, loss_i
+
+            acc0 = [jnp.zeros(p.shape, jnp.float32)
+                    for p in self.train_params]
+            accT, losses = jax.lax.scan(body, acc0, (keys,) + micro)
+            return jnp.mean(losses), tuple(accT)
+
+        param_sh, _state_sh, frozen_sh, batch_sh = \
+            outer._sharding_plan(batch_arrays)
+        repl = self.env.replicated()
+        in_sh = (param_sh, frozen_sh, repl, *batch_sh)
+        out_sh = (repl, tuple(param_sh))
+        from ..jit import persistent_cache
+
+        return persistent_cache.cached_jit(
+            step, in_shardings=in_sh, out_shardings=out_sh,
+            label=f"ShardedTrainStep.accumulate({k})[offload]",
+            extra_meta=("offload_accum", k, self.average, self.remat))
+
+    def _call_offload(self, arrays, tl):
+        outer = self._step
+        opt = self.optimizer
+        cold = self._jitted is None
+        if cold:
+            self._jitted = self._build_offload(arrays)
+        params = [p.data for p in self.train_params]
+        frozen_arrays = [t.data for t in self.frozen]
+        with tl.phase("compile" if cold else "host_dispatch"):
+            loss, grads = self._jitted(params, frozen_arrays,
+                                       random_mod.next_key(), *arrays)
+            new_params = outer._stream_update(grads, tl)
+        del grads
+        for p, a in zip(self.train_params, new_params):
+            p.data = a
+        opt._global_step += 1
+        return Tensor(loss)
 
     def _build(self, batch_arrays):
         outer = self._step
@@ -842,6 +1026,9 @@ class ShardedAccumulateStep:
         from ..jit import _obs
 
         tl, tc = _obs()
+        if self._step.offload:
+            with tl.step():
+                return self._call_offload(arrays, tl)
         with tl.step():
             cold = self._jitted is None
             if cold:

@@ -33,9 +33,19 @@ def group_sharded_parallel(model: Layer, optimizer, level: str = "p_g_os",
         # reference sharding_utils.py offload / sharding_stage3.py:50
         # offload=True: fp32 master params + optimizer state live on host
         # memory; ShardedTrainStep splits the step into a mesh fwd+bwd
-        # executable and a host update executable (grads stream down, fresh
-        # params stream up) — HBM holds only params+grads+activations.
+        # executable and per-GROUP host update executables driven by a
+        # double-buffered streaming lane (grads stream down, fresh params
+        # stream up, overlapped with the updates) — HBM holds only
+        # params+grads+activations plus a two-group staging working set.
         optimizer._offload = True
+    # group sizing for the streaming executor (reference segment_size /
+    # buffer_max_size of group_sharded_parallel, previously accepted and
+    # ignored): segment_size = minimum bytes before a stream group closes
+    # (small params coalesce), buffer_max_size = staging-buffer cap a group
+    # never grows past. Consumed by ShardedTrainStep._ensure_stream_update
+    # via jit.offload_stream.plan_stream_groups.
+    optimizer._stream_segment_size = int(segment_size)
+    optimizer._stream_buffer_max_size = int(buffer_max_size)
     if level == "p_g_os":
         # full parameter sharding
         apply_sharding_specs(model, env, axis="sdp")

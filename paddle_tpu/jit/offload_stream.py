@@ -27,7 +27,10 @@ semantics as training the layers unstacked.
 from __future__ import annotations
 
 import contextlib
-from typing import Callable, List
+import queue
+import threading
+import time
+from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -37,6 +40,218 @@ from ..core import autograd
 from ..core.tensor import Tensor
 from ..framework import random as random_mod
 from ..nn.layer.layers import Layer
+
+
+# -- latency-hiding streaming lane -------------------------------------------
+# The reference hides host<->device traffic behind compute with
+# ForwardPostHooks + TaskFlow prefetch (sharding_stage3.py:737); the
+# TPU-native counterpart is a background thread issuing jax.device_put while
+# the main thread keeps dispatching executables — the same one-thread double
+# buffer io/prefetch.py uses for batches, here carrying parameter/optimizer
+# stream groups for the offload train path (ZeRO-Offload's delayed, bucketed
+# CPU update, Rajbhandari et al.).
+
+_LANE_FAM = None  # lazily-bound "offload_stream" counter family
+
+
+def _lane_fam():
+    global _LANE_FAM
+    if _LANE_FAM is None:
+        from ..observability import family
+
+        _LANE_FAM = family("offload_stream", ("metric",))
+    return _LANE_FAM
+
+
+def plan_stream_groups(nbytes_list: Sequence[int],
+                       segment_size: int = 2 ** 20,
+                       buffer_max_size: int = 2 ** 23) -> List[List[int]]:
+    """Partition parameters (given per-param byte sizes, walk order
+    preserved) into contiguous stream groups — the unit the offload lane
+    transfers and the host update executes on.
+
+    ``segment_size`` is the reference group_sharded_parallel knob: a group
+    closes once it holds at least this many bytes (small params coalesce
+    instead of each paying a transfer/dispatch). ``buffer_max_size`` caps
+    the staging buffer: a group never grows past it by adding another
+    param (one param larger than the cap still gets its own group — it
+    cannot be split without changing the update math)."""
+    segment_size = max(int(segment_size), 1)
+    buffer_max_size = max(int(buffer_max_size), segment_size)
+    groups: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, nb in enumerate(nbytes_list):
+        nb = int(nb)
+        if cur and (cur_bytes + nb > buffer_max_size
+                    or cur_bytes >= segment_size):
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        groups.append(cur)
+    return groups
+
+
+class _TransferHandle:
+    """One in-flight group transfer; ``wait()`` blocks the consumer and
+    charges the blocked time to the lane's ``stall_ms``."""
+
+    __slots__ = ("_event", "_box", "_lane")
+
+    def __init__(self, lane):
+        self._event = threading.Event()
+        self._box: list = [None, None]  # result, exception
+        self._lane = lane
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self):
+        if not self._event.is_set():
+            t0 = time.perf_counter()
+            self._event.wait()
+            self._lane._note_stall((time.perf_counter() - t0) * 1e3)
+        if self._box[1] is not None:
+            raise self._box[1]
+        return self._box[0]
+
+
+class StreamLane:
+    """Double-buffered host<->device transfer lane for stream groups.
+
+    A single worker thread executes submitted transfers in order through a
+    bounded two-deep queue (the device ring): while group *i*'s update
+    computes, the lane is moving group *i+1* down and group *i-1* up, and a
+    third submission blocks until a slot frees — the backpressure that caps
+    staging memory at two groups. ``overlap=False`` runs every transfer
+    inline at submit (the serialized A/B twin: identical dispatch order,
+    nothing hidden).
+
+    Telemetry (``observability`` family ``offload_stream`` + per-lane
+    ``stats()``): bytes up/down, transfer/lane-busy ms, consumer stall ms,
+    groups in flight. ``overlap_efficiency`` = transfer time hidden behind
+    compute / total transfer time.
+    """
+
+    def __init__(self, overlap: bool = True, depth: int = 2):
+        self.overlap = bool(overlap)
+        self.depth = int(depth)
+        self._lock = threading.Lock()
+        self._stats = {"h2d_bytes": 0, "d2h_bytes": 0, "transfer_ms": 0.0,
+                       "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0}
+        self.events: List[tuple] = []  # (kind, tag) in submission order
+        self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- submission -----------------------------------------------------------
+    def submit(self, kind: str, arrays, placements, tag=None
+               ) -> _TransferHandle:
+        """Enqueue one group transfer. ``kind`` is ``"h2d"`` (params up) or
+        ``"d2h"`` (grads/state down); ``placements`` is one sharding/device
+        for every array or a per-array sequence. Blocks while the two-deep
+        ring is full."""
+        if self._closed:
+            raise RuntimeError("StreamLane is closed")
+        handle = _TransferHandle(self)
+        if not isinstance(placements, (list, tuple)):
+            placements = [placements] * len(arrays)
+        with self._lock:
+            self.events.append((kind, tag))
+            self._stats["in_flight_sum"] += self._q.qsize()
+        if not self.overlap:
+            self._run_job(kind, arrays, placements, handle, serialized=True)
+            return handle
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._worker, daemon=True,
+                                            name="pt-offload-stream")
+            self._thread.start()
+        self._q.put((kind, arrays, placements, handle))
+        return handle
+
+    def _worker(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            self._run_job(*job)
+
+    def _run_job(self, kind, arrays, placements, handle, serialized=False):
+        t0 = time.perf_counter()
+        try:
+            try:
+                out = [jax.device_put(a, p) if p is not None
+                       else jax.device_put(a)
+                       for a, p in zip(arrays, placements)]
+                # the transfer is only *done* when the bytes have landed —
+                # blocking HERE (off the consumer thread when overlapped) is
+                # what makes stall_ms mean "transfer not hidden"
+                for o in out:
+                    o.block_until_ready()
+                handle._box[0] = out
+                nbytes = sum(int(getattr(o, "nbytes", 0)) for o in out)
+            except BaseException as e:  # surfaces at the consumer's wait()
+                handle._box[1] = e
+                nbytes = 0
+            ms = (time.perf_counter() - t0) * 1e3
+            with self._lock:
+                self._stats[f"{kind}_bytes"] += nbytes
+                self._stats["transfer_ms"] += ms
+                self._stats["transfers"] += 1
+                if serialized:
+                    # inline transfer: the consumer waited for all of it
+                    self._stats["stall_ms"] += ms
+            fam = _lane_fam()
+            fam.inc((f"{kind}_bytes",), nbytes)
+            fam.inc(("transfer_ms",), ms)
+            fam.inc(("transfers",))
+            fam.inc(("groups_in_flight_sum",), self._q.qsize())
+            if serialized:
+                fam.inc(("stall_ms",), ms)
+        finally:
+            # the consumer may already be blocked in wait(): it must wake
+            # even if the telemetry above throws on this worker thread
+            handle._event.set()
+
+    def _note_stall(self, ms: float):
+        with self._lock:
+            self._stats["stall_ms"] += ms
+        _lane_fam().inc(("stall_ms",), ms)
+
+    # -- reads ----------------------------------------------------------------
+    def stats(self) -> dict:
+        with self._lock:
+            s = dict(self._stats)
+        s["overlap"] = self.overlap
+        s["hidden_ms"] = max(s["transfer_ms"] - s["stall_ms"], 0.0)
+        s["overlap_efficiency"] = round(
+            s["hidden_ms"] / s["transfer_ms"], 4) if s["transfer_ms"] else 0.0
+        return s
+
+    def overlap_efficiency(self) -> float:
+        return self.stats()["overlap_efficiency"]
+
+    def reset_stats(self) -> None:
+        with self._lock:
+            for k in self._stats:
+                self._stats[k] = 0 if isinstance(self._stats[k], int) else 0.0
+            self.events = []
+
+    def close(self) -> None:
+        self._closed = True
+        if self._thread is not None:
+            self._q.put(None)
+            self._thread = None
+
+    def __del__(self):
+        # lanes are owned by long-lived step objects; when the step goes,
+        # the worker thread must not outlive it
+        try:
+            self.close()
+        except Exception:
+            pass
 
 
 @contextlib.contextmanager

@@ -78,6 +78,11 @@ def _register_builtin_providers() -> None:
     family("nan_inf_events", ("op", "dtype"))
     family("collectives", ("op", "kind"))
     family("prefetcher", ("metric",))
+    # offload streaming lane (jit.offload_stream.StreamLane): bytes up/down,
+    # transfer/stall ms, groups in flight — the process-wide view of the
+    # latency-hiding offload executor; per-step-object counters live on
+    # ShardedTrainStep.stream_stats()
+    family("offload_stream", ("metric",))
 
 
 _register_builtin_providers()

@@ -70,6 +70,10 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             lines.append(_timeline_table(body))
             lines.append("")
             continue
+        if fam == "offload_stream" and isinstance(body, dict):
+            lines.append(_offload_stream_table(body))
+            lines.append("")
+            continue
         rows: list = []
         _flat("", body, rows)
         for key, val in rows:
@@ -99,6 +103,24 @@ def _timeline_table(body: Dict[str, Any]) -> str:
         seq = " -> ".join(p["phase"] for p in last)
         lines.append(f"  last step: {seq}")
     return "\n".join(lines)
+
+
+def _offload_stream_table(body: Dict[str, Any]) -> str:
+    """Streaming-lane family with the derived overlap line pd_top shows:
+    hidden transfer time = transfer_ms - stall_ms, efficiency = hidden /
+    transfer (1.0 = every byte moved behind compute)."""
+    vals = body.get("values", body) or {}
+    lines = []
+    for key in sorted(vals):
+        v = vals[key]
+        lines.append(f"  {key:<24} {round(v, 3) if isinstance(v, float) else v}")
+    t = float(vals.get("transfer_ms", 0) or 0)
+    s = float(vals.get("stall_ms", 0) or 0)
+    if t > 0:
+        hidden = max(t - s, 0.0)
+        lines.append(f"  {'hidden_ms':<24} {round(hidden, 3)}")
+        lines.append(f"  {'overlap_efficiency':<24} {round(hidden / t, 4)}")
+    return "\n".join(lines) if lines else "  (no transfers yet)"
 
 
 def report() -> str:

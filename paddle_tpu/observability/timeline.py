@@ -13,6 +13,8 @@ four-phase split per step:
                      ``timeline().detail(True)``), because the block itself
                      would serialize the async pipeline the warm path won
 - ``compile``        cold builds: trace + XLA compile + first execution
+- ``stream_wait``    offload-path steps only: blocked on the streaming
+                     lane (a group transfer not yet hidden behind compute)
 
 Producers: ``jit.TrainStep`` / ``AccumulateStep`` / ``ShardedTrainStep`` /
 ``ShardedAccumulateStep`` wrap their calls, ``hapi.Model.fit`` wraps its

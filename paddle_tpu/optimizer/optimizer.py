@@ -498,19 +498,24 @@ class Adadelta(Optimizer):
             "avg_squared_grad": g2, "avg_squared_update": u2}
 
 
-def make_master_update(opt, train_params, dtypes):
+def make_master_update(opt, train_params, dtypes, with_clip=True):
     """fp32-master offload update used by ShardedTrainStep's optimizer-state
     offload: (master, grads, states, lr, step_no) -> (new_master,
     new_states, new_params_cast_to_model_dtype). jit.StreamedTrainStep
     deliberately does NOT use this: it applies the rule in the model dtype
     per layer slice (matching resident jit.TrainStep semantics — no fp32
     master) and rejects grad_clip, so its update lives with its streaming
-    loop."""
+    loop.
+
+    ``with_clip=False`` strips the grad-clip application: the streaming
+    offload executor runs this update per stream GROUP, and a global-norm
+    clip applied to one group's grads would be wrong — the caller clips the
+    full grad set on the device side before streaming."""
     rule = type(opt)._rule
     hyper = opt._hyper()
     wd = opt._weight_decay
     decoupled = opt._decoupled
-    clip = opt._grad_clip
+    clip = opt._grad_clip if with_clip else None
     wd_flags = tuple(
         1.0 if (opt._decay_param_fn is None or opt._decay_param_fn(p)) else 0.0
         for p in train_params)

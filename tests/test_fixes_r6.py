@@ -21,6 +21,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 # -- bench.py headline contract ----------------------------------------------
 
+@pytest.mark.slow  # tier-1 wall-clock relief (ISSUE-5): the full CPU bench
+# smoke runs minutes; tools/ci.sh's perf gate runs it and asserts MORE
+# (first+last line parse, size cap, stream_capacity/persistent_cache rows)
 def test_bench_prints_compact_parseable_headline():
     """The driver contract: bench.py emits a compact parseable headline
     JSON line on stdout (CPU smoke path) well within budget."""

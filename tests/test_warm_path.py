@@ -395,6 +395,7 @@ def _run_child(cache_dir):
 
 
 class TestPersistentCache:
+    @pytest.mark.slow  # tier-1 wall-clock relief (ISSUE-5): run in full by tools/ci.sh's perf gate
     def test_warm_start_zero_fresh_compiles(self, tmp_path):
         """The acceptance contract, one cache dir, two processes: cold —
         THIS process compiles and serializes a serving bucket warmup and a

@@ -27,6 +27,13 @@ echo "== perf gate (warm path: bench headline + persistent-cache warm start) =="
 # programs with ZERO fresh XLA compiles (the ISSUE-3 acceptance counter)
 JAX_PLATFORMS=cpu python -m pytest tests/test_warm_path.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+
+echo "== streaming-offload gate (executor tests, slow legs included) =="
+# overlapped-vs-serialized bit parity, pipelined group schedule (also
+# under accumulate(k)), stream_wait/offload_stream telemetry, and the
+# Llama-scale A/B (slow-marked for tier-1 wall clock, run here)
+JAX_PLATFORMS=cpu python -m pytest tests/test_offload_executor.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 # the CPU bench smoke must emit a parseable non-null headline as its last
 # line (first line is the parseable stub) within its own budget
 rm -f /tmp/_bench_smoke.log
@@ -38,14 +45,23 @@ timeout -k 10 700 env JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 \
 python - <<'PY' || exit 1
 import json
 lines = [l for l in open("/tmp/_bench_smoke.log") if l.strip()]
+# the LAST stdout line is the contract the harness parses (the r04/r05
+# blackouts): it must be valid JSON and fit the driver's ~2KB tail window
+assert len(lines[-1]) < 2000, f"headline too long: {len(lines[-1])}B"
 first, last = json.loads(lines[0]), json.loads(lines[-1])
 assert last["value"] is not None, "bench headline is null"
+disk = json.loads(open("bench_artifacts/headline.json").read())
+assert disk["detail"] == last["detail"], "on-disk headline out of step"
 assert "warm_path" in last["detail"], "warm-path row missing"
 assert "persistent_cache" in last["detail"], "cold/warm startup row missing"
 pc = last["detail"]["persistent_cache"]
 assert pc["warm_fresh_xla_compiles"] == 0, pc
+sc = last["detail"]["stream_capacity"]
+assert sc["overlap_efficiency"] > 0, sc       # transfers actually hidden
+assert sc["losses_bit_equal"] is True, sc     # hiding changed no bits
 print("perf gate OK:", {k: last["detail"][k]
-                        for k in ("warm_path", "persistent_cache")})
+                        for k in ("warm_path", "persistent_cache",
+                                  "stream_capacity")})
 PY
 
 echo "== observability gate (telemetry snapshot from the bench smoke) =="
