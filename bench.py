@@ -738,6 +738,76 @@ def _measure_warm_path(cfg, batch, seq, iters=4, accum=4):
     }
 
 
+def _measure_checkpoint_stall(cfg, batch, seq, saves=4, steps_per_save=4):
+    """ISSUE-6 A/B: per-save train-thread stall of the synchronous commit
+    (d2h + serialize + fsync on the caller) vs AsyncCheckpointer's
+    background commit (caller only dispatches the d2h copies; blocking
+    serialization hides behind the next steps' compute). Same model, same
+    checkpoint root layout, one save per ``steps_per_save`` train steps
+    (the periodic-checkpoint shape: the writer hides behind the following
+    steps' compute). Acceptance: async stall < 25% of the synchronous
+    save time (``stall_ratio``)."""
+    import shutil
+    import tempfile
+
+    import paddle_tpu as paddle
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.distributed.resilience import AsyncCheckpointer
+    from paddle_tpu.models import LlamaForCausalLM
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-4, parameters=model.parameters(),
+                          weight_decay=0.1)
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y), optimizer)
+    ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+    float(step(ids, ids))  # compile + first save outside the clock
+
+    def run(sync):
+        root = tempfile.mkdtemp(prefix="pt_ckpt_stall_")
+        ck = AsyncCheckpointer(root, model=model, optimizer=optimizer,
+                               keep=2, name="bench")
+        handles = []
+        t0 = time.perf_counter()
+        try:
+            for i in range(saves):
+                float(step(ids, ids))
+                handles.append(ck.save_async(step=i, sync=sync))
+                for _ in range(steps_per_save - 1):
+                    # the compute window the async commit hides behind
+                    float(step(ids, ids))
+            ck.wait()
+        finally:
+            wall = time.perf_counter() - t0
+            ck.close()
+            shutil.rmtree(root, ignore_errors=True)
+        stall = sum(h.stall_ms for h in handles) / max(len(handles), 1)
+        total = sum(h.total_ms for h in handles) / max(len(handles), 1)
+        return stall, total, wall
+
+    sync_stall, sync_total, sync_wall = run(sync=True)
+    async_stall, async_total, async_wall = run(sync=False)
+    # the acceptance ratio: train-thread stall per async save over the
+    # synchronous save's full (all-stall) time
+    ratio = (async_stall / sync_total) if sync_total else None
+    return {
+        "sync_save_ms": round(sync_total, 2),
+        "sync_stall_ms": round(sync_stall, 2),
+        "async_stall_ms": round(async_stall, 2),
+        "async_save_ms": round(async_total, 2),
+        "stall_ratio": round(ratio, 4) if ratio is not None else None,
+        "hidden_frac": round(1.0 - max(async_stall, 0.0)
+                             / max(async_total, 1e-9), 4),
+        "saves": saves, "steps_per_save": steps_per_save,
+        "batch": batch, "seq": seq,
+        "sync_wall_s": round(sync_wall, 3),
+        "async_wall_s": round(async_wall, 3),
+        "mode": "AsyncCheckpointer d2h-dispatch-on-train-thread + "
+                "background serialize/commit vs sync=True twin",
+    }
+
+
 def _telemetry_overhead_probe(n=20000):
     """Micro-benchmark of the observability hot path (the ISSUE-4 overhead
     acceptance): per-increment cost of a labeled counter and per-step cost
@@ -999,6 +1069,20 @@ def _run_one(name: str):
         _note_recipe(name, out)
         print("BENCH_RESULT " + json.dumps(out))
         return
+    if name == "checkpoint_stall":
+        import jax
+
+        from paddle_tpu.models import LlamaConfig
+
+        if jax.devices()[0].platform == "cpu":
+            out = _measure_checkpoint_stall(LlamaConfig.tiny(), batch=2,
+                                            seq=64)
+        else:
+            out = _measure_checkpoint_stall(_configs()["big"], batch=4,
+                                            seq=2048)
+        _note_recipe(name, out)
+        print("BENCH_RESULT " + json.dumps(out))
+        return
     import paddle_tpu.optimizer as opt_mod
 
     cfg = _configs()[name]
@@ -1257,6 +1341,8 @@ def main():
                     LlamaConfig.tiny(), batch=2, seq=64, iters=3, accum=4)),
                 ("stream_capacity", lambda: _measure_stream_ab(
                     LlamaConfig.tiny(), batch=2, seq=64, iters=3)),
+                ("checkpoint_stall", lambda: _measure_checkpoint_stall(
+                    LlamaConfig.tiny(), batch=2, seq=64)),
                 ("serving", lambda: _measure_serving(clients_sweep=(2, 8),
                                                      per_client=30)),
                 ("persistent_cache", _warm_start_probe)):
@@ -1324,6 +1410,9 @@ def main():
     leg("stream_capacity",
         lambda: detail.__setitem__("stream_capacity",
                                    _spawn("stream_capacity")))
+    leg("checkpoint_stall",
+        lambda: detail.__setitem__("checkpoint_stall",
+                                   _spawn("checkpoint_stall")))
     leg("persistent_cache",
         lambda: detail.__setitem__("persistent_cache", _warm_start_probe()))
 

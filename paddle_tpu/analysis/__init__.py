@@ -29,6 +29,7 @@ from . import selfcheck  # noqa: F401
 from .memory import (HBM_BYTES, PeakEstimate, estimate_peak,  # noqa: F401
                      estimate_offload_stream_hbm, estimate_train_step_hbm,
                      offload_stream_plan, stream_plan_check)
+from .resilience_lint import checkpoint_story_check  # noqa: F401
 
 __all__ = [
     "Diagnostic", "max_severity", "render", "to_json",
@@ -36,7 +37,7 @@ __all__ = [
     "memory", "spmd", "retrace", "selfcheck",
     "HBM_BYTES", "PeakEstimate", "estimate_peak", "estimate_train_step_hbm",
     "estimate_offload_stream_hbm", "offload_stream_plan",
-    "stream_plan_check",
+    "stream_plan_check", "checkpoint_story_check",
 ]
 
 # env-gated retrace audit (default off; zero overhead unless set)

@@ -1,0 +1,66 @@
+"""Checkpoint-story lint (the resilience half of the static checker).
+
+An offload train step parks the CANONICAL fp32 masters / optimizer state
+in host memory — a preemption loses everything since the last checkpoint,
+and the reference's elastic stack assumes one exists (auto_checkpoint
+wraps every `_train_epoch`). This pass checks that a train step carries a
+checkpoint story: an attached ``distributed.resilience.AsyncCheckpointer``
+(``ck.attach(step)`` or ``hapi.Model.fit(checkpoint_every=...)``).
+
+Codes: RS001 info (story present), RS002 warning (offload/host-parked
+step with NO story), RS003 info (resident step without one — survivable:
+re-init + replay is possible, but long runs should still checkpoint).
+"""
+from __future__ import annotations
+
+from typing import List
+
+from .diagnostics import Diagnostic
+
+__all__ = ["checkpoint_story_check"]
+
+
+def _unwrap(step):
+    return getattr(step, "_step", step)
+
+
+def _is_host_parked(step) -> bool:
+    """True when the step's canonical training state lives host-side:
+    offload ShardedTrainStep (fp32 masters + state pinned to host) or the
+    single-chip Streamed/Segmented capacity steps (params parked)."""
+    if bool(getattr(step, "offload", False)):
+        return True
+    return type(step).__name__ in ("StreamedTrainStep", "SegmentedTrainStep")
+
+
+def checkpoint_story_check(step) -> List[Diagnostic]:
+    """RS001/RS002/RS003: does this train step have a checkpoint story?
+
+    Accepts any TrainStep-shaped object (``ShardedTrainStep``, its
+    accumulate twin, ``jit.TrainStep``, Streamed/Segmented steps)."""
+    target = _unwrap(step)
+    ck = getattr(target, "_checkpointer", None)
+    host_parked = _is_host_parked(target)
+    if ck is not None:
+        return [Diagnostic(
+            severity="info", code="RS001", pass_name="resilience",
+            message=(f"checkpoint story present: AsyncCheckpointer at "
+                     f"{ck.root!r} (keep={ck.keep})"),
+            data={"root": ck.root, "keep": ck.keep,
+                  "host_parked": host_parked})]
+    if host_parked:
+        return [Diagnostic(
+            severity="warning", code="RS002", pass_name="resilience",
+            message=("offload train step has NO checkpoint story: the "
+                     "canonical fp32 masters/optimizer state live host-side "
+                     "and a preemption loses the whole run"),
+            suggestion=("AsyncCheckpointer(root, keep=3).attach(step) and "
+                        "save_async(step=n) periodically — or drive the "
+                        "loop via hapi.Model.fit(checkpoint_every=N)"),
+            data={"step_type": type(target).__name__})]
+    return [Diagnostic(
+        severity="info", code="RS003", pass_name="resilience",
+        message=("train step has no checkpoint story (resident state; "
+                 "survivable, but long runs should checkpoint)"),
+        suggestion="fit(checkpoint_every=N) or AsyncCheckpointer.attach",
+        data={"step_type": type(target).__name__})]

@@ -267,11 +267,20 @@ def _count_nan_inf(op_name, dtype) -> None:
         pass
 
 
+class NanStepSkipped(ArithmeticError):
+    """A per-op nan/inf trip under ``FLAGS_check_nan_inf_action='skip'``:
+    step-aware loops (``hapi.Model.fit``) catch this, drop the poisoned
+    step (grads cleared, no optimizer update) and continue — the
+    skip-and-continue contract of the fault-tolerant runtime. Outside such
+    a loop it propagates like the 'raise' action."""
+
+
 def _check_nan_inf(op_name, outs):
     """FLAGS_check_nan_inf per-op guard (nan_inf_utils_detail.* equivalent).
 
     Every trip lands a ``nan_inf_events`` row; FLAGS_check_nan_inf_action
-    picks raise (default, reference behavior) vs log-and-continue."""
+    picks raise (default, reference behavior) vs log-and-continue vs skip
+    (raise ``NanStepSkipped`` for the train loop to eat)."""
     from ..framework import flags as _flags
 
     for i, o in enumerate(outs):
@@ -283,11 +292,14 @@ def _check_nan_inf(op_name, outs):
             msg = (
                 f"check_nan_inf: op '{op_name}' output {i} contains {bad} "
                 f"nan/inf values (shape={tuple(o.shape)}, dtype={o.dtype})")
-            if _flags.flag("check_nan_inf_action") == "log":
+            action = _flags.flag("check_nan_inf_action")
+            if action == "log":
                 import warnings
 
                 warnings.warn(msg, RuntimeWarning, stacklevel=3)
                 continue
+            if action == "skip":
+                raise NanStepSkipped(msg)
             raise RuntimeError(msg)
 
 

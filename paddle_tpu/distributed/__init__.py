@@ -14,6 +14,8 @@ from .collective import (  # noqa: F401
 )
 from . import checkpoint  # noqa: F401
 from .checkpoint import save_state_dict, load_state_dict  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import AsyncCheckpointer  # noqa: F401
 from .store import TCPStore, Store  # noqa: F401
 from .parallel import (DataParallel, ShardedAccumulateStep,  # noqa: F401
                        ShardedTrainStep, place_model)

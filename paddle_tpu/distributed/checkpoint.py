@@ -11,10 +11,11 @@ sdp8, restore on mp2·dp4 works without a converter matrix.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import re
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -22,6 +23,12 @@ import numpy as np
 from jax.sharding import NamedSharding
 
 from ..core.tensor import Tensor
+
+
+class CheckpointCorrupt(RuntimeError):
+    """A saved file does not match its manifest checksum (torn save,
+    bit rot, or a partially-overwritten directory)."""
+
 
 def _np_dtype(name: str) -> np.dtype:
     """Resolve a dtype name to numpy, including ml_dtypes (bfloat16, float8_*).
@@ -60,15 +67,65 @@ def _sanitize(key: str) -> str:
     return safe
 
 
+def shard_plan(arr) -> List[Tuple[List[int], List[int], "jax.Array"]]:
+    """The (starts, stops, device_shard) walk behind every writer: one row
+    per distinct owned slice (replica 0, deduped). A 0-d / unsharded array
+    degrades to one whole-array row. The async checkpointer dispatches its
+    d2h copies from this plan on the submitting thread (ordering-safe
+    against later donation) before the background writer serializes."""
+    if not isinstance(arr, jax.Array):
+        arr = jnp.asarray(np.asarray(arr))
+    rows: List[Tuple[List[int], List[int], jax.Array]] = []
+    seen_slices = set()
+    for shard in arr.addressable_shards:
+        if shard.replica_id != 0:
+            continue  # one copy per distinct slice
+        idx = shard.index  # tuple of slices into the global array
+        starts = [0 if s.start is None else int(s.start) for s in idx]
+        stops = [int(dim) if s.stop is None else int(s.stop)
+                 for s, dim in zip(idx, arr.shape)]
+        slice_key = (tuple(starts), tuple(stops))
+        if slice_key in seen_slices:
+            continue
+        seen_slices.add(slice_key)
+        rows.append((starts, stops, shard.data))
+    if not rows:  # 0-d or fully-remote (shouldn't happen 1-host)
+        rows.append(([0] * arr.ndim, [int(d) for d in arr.shape], arr))
+    return rows
+
+
+def _atomic_npy(path: str, data: np.ndarray) -> str:
+    """Write ``<path>`` via tmp + fsync + ``os.replace`` (no reader ever
+    sees a partial file); returns the sha256 of the written bytes."""
+    # call-time import: resilience.commit imports from this module
+    from .resilience.commit import HashingWriter
+
+    tmp = f"{path}.tmp-{os.getpid()}"
+    with open(tmp, "wb") as f:
+        hw = HashingWriter(f)  # sha256 computed as the bytes land
+        np.save(hw, data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return hw.hexdigest()
+
+
 def save_state_dict(state_dict: Dict, path: str, process_rank: Optional[int] = None):
     """Write a sharded checkpoint directory.
 
     state_dict values may be Tensors (possibly GSPMD-sharded), jax arrays, or
     numpy arrays. Layout: `<path>/manifest.json` + one `.npy` per owned shard.
+
+    Commit protocol (shared with ``distributed.resilience``): every shard
+    file lands via tmp + fsync + ``os.replace`` and carries a sha256 in the
+    manifest; the manifest fragment itself is replaced LAST. A crash
+    mid-save therefore leaves either the intact previous manifest (whose
+    checksums flag any half-overwritten shards at load) or no manifest at
+    all — never a silently-torn shard/manifest mix.
     """
     os.makedirs(path, exist_ok=True)
     rank = process_rank if process_rank is not None else jax.process_index()
-    manifest = {"format": 1, "entries": {}}
+    manifest = {"format": 2, "entries": {}}
     for key, val in state_dict.items():
         arr = val.data if isinstance(val, Tensor) else val
         safe = _sanitize(key)
@@ -82,40 +139,51 @@ def save_state_dict(state_dict: Dict, path: str, process_rank: Optional[int] = N
             "spec": _spec_to_json(spec),
             "shards": [],
         }
-        seen_slices = set()
-        for shard in arr.addressable_shards:
-            if shard.replica_id != 0:
-                continue  # one copy per distinct slice
-            idx = shard.index  # tuple of slices into the global array
-            starts = [0 if s.start is None else int(s.start) for s in idx]
-            stops = [int(dim) if s.stop is None else int(s.stop)
-                     for s, dim in zip(idx, arr.shape)]
-            slice_key = (tuple(starts), tuple(stops))
-            if slice_key in seen_slices:
-                continue
-            seen_slices.add(slice_key)
+        for starts, stops, shard_data in shard_plan(arr):
             fname = f"{safe}.r{rank}.s{len(entry['shards'])}.npy"
-            np.save(os.path.join(path, fname), np.asarray(shard.data))
-            entry["shards"].append({"file": fname, "starts": starts, "stops": stops})
-        if not entry["shards"]:  # 0-d or fully-remote (shouldn't happen 1-host)
-            fname = f"{safe}.r{rank}.s0.npy"
-            np.save(os.path.join(path, fname), np.asarray(arr))
-            entry["shards"].append({
-                "file": fname, "starts": [0] * arr.ndim,
-                "stops": [int(d) for d in arr.shape]})
+            sha = _atomic_npy(os.path.join(path, fname),
+                              np.asarray(shard_data))
+            entry["shards"].append({"file": fname, "starts": starts,
+                                    "stops": stops, "sha256": sha})
         manifest["entries"][key] = entry
-    # each rank writes its own fragment; load merges them (multi-host safe)
-    with open(os.path.join(path, f"manifest.r{rank}.json"), "w") as f:
+    # each rank writes its own fragment; load merges them (multi-host safe).
+    # fragment replaced atomically LAST: the commit point of this rank's save
+    frag = os.path.join(path, f"manifest.r{rank}.json")
+    tmp = f"{frag}.tmp-{os.getpid()}"
+    with open(tmp, "w") as f:
         json.dump(manifest, f, indent=1)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, frag)
 
 
-def _assemble(path: str, entry: dict) -> np.ndarray:
-    """Rebuild the global ndarray from saved shards (converter.merge role)."""
+def _read_shard(path: str, sh: dict, verify: bool) -> np.ndarray:
+    """Read a shard ONCE: hash the bytes and np.load from the same buffer
+    (the save path hashes while writing for the same single-pass reason)."""
+    want = sh.get("sha256")
+    if not verify or not want:  # format-1 checkpoints carry no checksums
+        return np.load(path)
+    import io
+
+    with open(path, "rb") as f:
+        raw = f.read()
+    if hashlib.sha256(raw).hexdigest() != want:
+        raise CheckpointCorrupt(
+            f"shard {sh['file']} fails its manifest checksum (torn or "
+            f"partially-overwritten save); restore from an older checkpoint")
+    return np.load(io.BytesIO(raw))
+
+
+def _assemble(path: str, entry: dict, verify: bool = True) -> np.ndarray:
+    """Rebuild the global ndarray from saved shards (converter.merge role).
+    ``verify`` re-hashes each shard against its manifest sha256 (when
+    present) so a torn shard/manifest mix raises ``CheckpointCorrupt``
+    instead of silently loading mixed-step weights."""
     shape = tuple(entry["global_shape"])
     out = np.empty(shape, dtype=_np_dtype(entry["dtype"]))
     filled = np.zeros(shape, dtype=bool) if shape else None
     for sh in entry["shards"]:
-        data = np.load(os.path.join(path, sh["file"]))
+        data = _read_shard(os.path.join(path, sh["file"]), sh, verify)
         if data.dtype != out.dtype:
             if (data.dtype.kind == "V"
                     and data.dtype.itemsize == out.dtype.itemsize):
@@ -156,9 +224,12 @@ def _read_manifest(path: str) -> dict:
     return entries
 
 
-def load_state_dict(state_dict: Dict, path: str, strict: bool = True):
+def load_state_dict(state_dict: Dict, path: str, strict: bool = True,
+                    verify: bool = True):
     """Fill `state_dict`'s tensors in place from `<path>`, resharding onto each
     target's current sharding (different mesh/layout than at save time is fine).
+    ``verify`` checks manifest sha256 checksums where present (raises
+    ``CheckpointCorrupt`` on a torn save).
     """
     entries = _read_manifest(path)
     missing = [k for k in state_dict if k not in entries]
@@ -168,7 +239,7 @@ def load_state_dict(state_dict: Dict, path: str, strict: bool = True):
         if key not in entries:
             continue
         entry = entries[key]
-        arr = _assemble(path, entry)
+        arr = _assemble(path, entry, verify=verify)
         if isinstance(val, Tensor):
             tgt = val.data
             if tuple(arr.shape) != tuple(tgt.shape):

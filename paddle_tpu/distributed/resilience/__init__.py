@@ -1,0 +1,51 @@
+"""paddle_tpu.distributed.resilience — the fault-tolerant training runtime.
+
+Reference role: the reference's elastic/fleet stack (``fleet/elastic.py``,
+``run/master.py``, ``incubate/checkpoint/auto_checkpoint.py``) keeps long
+training runs alive across preemptions and transient failures. This package
+is its TPU-native rebuild around three pieces:
+
+- **async streamed checkpointing** (``AsyncCheckpointer``): shard d2h
+  copies dispatched on the train thread (donation-safe ordering), then
+  serialization + the crash-consistent commit protocol (per-shard files +
+  sha256 in a manifest written last, staging dir sealed by one
+  ``os.replace``, ``LATEST`` flipped only after) run on a background
+  writer — save time hides behind the next steps' compute;
+- **preemption-safe resume**: a SIGTERM hook (``install_preemption_handler``
+  / ``preempted()``) lets the loop drain the lane, ``preempt_commit`` a
+  final checkpoint and exit cleanly; ``resume()`` restores step / epoch /
+  rng / optimizer state and re-shards every tensor onto the CURRENT device
+  count via the manifest reassembly path;
+- **deterministic fault injection + retry** (``FaultInjector`` /
+  ``PT_FAULTS``): scripted transfer failures, mid-save crashes, NaN steps
+  and slow transfers at exact step/group indices; transient transfer
+  failures in the checkpoint and offload lanes get bounded
+  retry-with-backoff (``retry.with_retries``).
+
+Everything counts into the ``resilience`` observability family: saves,
+hidden_save_ms, save_stall_ms, commit_ms, retries, skipped_steps,
+restores, preemptions, torn_checkpoints, injected_faults.
+
+See docs/resilience.md.
+"""
+from __future__ import annotations
+
+from .checkpointer import (AsyncCheckpointer, latest_checkpoint,  # noqa: F401
+                           resume)
+from .commit import (CheckpointCorrupt, list_checkpoints,  # noqa: F401
+                     read_latest, step_tag, verify)
+from .faults import FaultInjector, InjectedFault, inject, injector  # noqa: F401
+from .preempt import (Preempted, clear_preemption,  # noqa: F401
+                      install_preemption_handler, preempted,
+                      request_preemption, uninstall_preemption_handler)
+from .retry import retry_policy, with_retries  # noqa: F401
+
+__all__ = [
+    "AsyncCheckpointer", "latest_checkpoint", "resume",
+    "CheckpointCorrupt", "list_checkpoints", "read_latest", "step_tag",
+    "verify",
+    "FaultInjector", "InjectedFault", "inject", "injector",
+    "Preempted", "clear_preemption", "install_preemption_handler",
+    "preempted", "request_preemption", "uninstall_preemption_handler",
+    "retry_policy", "with_retries",
+]

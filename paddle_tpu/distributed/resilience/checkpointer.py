@@ -1,0 +1,495 @@
+"""Async streamed checkpointing + preemption-safe resume.
+
+``AsyncCheckpointer`` is the save half of the fault-tolerant runtime:
+
+- **snapshot on the calling thread**: every owned shard's d2h copy is
+  *dispatched* (``jax.device_put`` onto the host CPU backend) before
+  ``save_async`` returns — async dispatch ordering makes the copies read
+  pre-donation bytes even though the next step's executable will donate
+  the very same buffers;
+- **serialize + commit in a background writer**: blocking on the copies,
+  ``.npy`` serialization, checksumming and the atomic commit protocol
+  (``commit.py``) all happen off the train thread, so save time hides
+  behind the next steps' compute. ``hidden_save_ms`` vs ``save_stall_ms``
+  in the ``resilience`` family quantify exactly how much hid;
+- **backpressure**: at most one save is in flight; a second ``save_async``
+  first waits out the previous one (charged to ``save_stall_ms``), capping
+  host memory at one snapshot.
+
+``resume()`` is the load half: newest *verified* checkpoint wins (a torn
+one — detected by checksums — is counted and skipped), model/optimizer
+state is reassembled from the manifest and ``device_put`` onto each
+target's CURRENT sharding, so restoring onto a different device count
+than the save is the same code path as same-mesh restore. Step / epoch /
+rng-stream state ride in the manifest meta.
+"""
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..checkpoint import (CheckpointCorrupt, _assemble, _np_dtype,
+                          _sanitize, _spec_to_json, shard_plan)
+from . import commit as commit_mod
+from . import metrics
+from .faults import injector
+from .retry import with_retries
+
+__all__ = ["AsyncCheckpointer", "resume", "latest_checkpoint"]
+
+
+class _SaveHandle:
+    """One in-flight save: done/error state + the stall/hidden split."""
+
+    def __init__(self, tag: str, t_submit: float):
+        self.tag = tag
+        self.t_submit = t_submit
+        self.total_ms = 0.0
+        self.stall_ms = 0.0
+        self.error: Optional[BaseException] = None
+        self.path: Optional[str] = None
+        self._event = threading.Event()
+        self._finalized = False
+        self._failure_reported = False  # one warn+count per failed save
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self):
+        """Block until committed (blocked time -> ``save_stall_ms``);
+        re-raises the writer's error on EVERY call."""
+        if not self._event.is_set():
+            t0 = time.perf_counter()
+            self._event.wait()
+            ms = (time.perf_counter() - t0) * 1e3
+            self.stall_ms += ms
+            metrics.inc("save_stall_ms", ms)
+        self._finalize()
+        if self.error is not None:
+            raise self.error
+        return self.path
+
+    def _finalize(self):
+        if self._finalized or not self._event.is_set():
+            return
+        self._finalized = True
+        if self.error is None:
+            metrics.inc("hidden_save_ms",
+                        max(self.total_ms - self.stall_ms, 0.0))
+
+
+class AsyncCheckpointer:
+    """Crash-consistent, latency-hidden checkpointing for a (model,
+    optimizer) pair or a sharded/offload train step.
+
+    ::
+
+        ck = AsyncCheckpointer("ckpts", model=model, optimizer=opt, keep=3)
+        for s in range(steps):
+            loss = step(x, y)
+            if (s + 1) % 50 == 0:
+                ck.save_async(step=s)          # returns immediately
+            if resilience.preempted():
+                ck.preempt_commit(step=s)      # drain + final sync commit
+                sys.exit(0)
+        meta = ck.resume()                     # next launch, any device count
+    """
+
+    def __init__(self, root: str, model=None, optimizer=None, keep: int = 3,
+                 name: str = "ckpt"):
+        self.root = str(root)
+        self.model = model
+        self.optimizer = optimizer
+        self.keep = int(keep)
+        self.name = name
+        self.step_obj = None  # optional ShardedTrainStep (offload masters)
+        os.makedirs(self.root, exist_ok=True)
+        commit_mod.gc_staging(self.root)
+        self._q: "queue.Queue" = queue.Queue()
+        self._pending: Optional[_SaveHandle] = None
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._save_no = 0
+        metrics.fam()  # schema visible in snapshots before the first save
+
+    # -- wiring ---------------------------------------------------------------
+    def attach(self, step) -> "AsyncCheckpointer":
+        """Bind a train step (``ShardedTrainStep`` / its accumulate twin):
+        its offload master weights join the snapshot, and the step carries
+        ``_checkpointer`` so ``analysis.checkpoint_story_check`` sees the
+        checkpoint story."""
+        target = getattr(step, "_step", step)  # accumulate twin -> outer
+        self.step_obj = target
+        target._checkpointer = self
+        if self.optimizer is None:
+            self.optimizer = getattr(target, "optimizer", None)
+        if self.model is None:
+            self.model = getattr(target, "model", None)
+        return self
+
+    # -- save -----------------------------------------------------------------
+    def save_async(self, step: int, epoch: Optional[int] = None,
+                   extra: Optional[Dict] = None, sync: bool = False,
+                   reason: str = "periodic") -> _SaveHandle:
+        """Snapshot now, commit in the background. ``sync=True`` blocks
+        until the commit (the synchronous A/B twin — bench's
+        ``checkpoint_stall`` leg measures the difference)."""
+        if self._closed:
+            raise RuntimeError("AsyncCheckpointer is closed")
+        prev = self._pending
+        if prev is not None and not prev.done():
+            t0 = time.perf_counter()
+            prev._event.wait()  # backpressure: one snapshot in flight
+            ms = (time.perf_counter() - t0) * 1e3
+            prev.stall_ms += ms
+            metrics.inc("save_stall_ms", ms)
+        if prev is not None:
+            prev._finalize()
+            if prev.error is not None and not prev._failure_reported:
+                # the run believes it is checkpoint-protected — a failed
+                # background save must NOT stay silent (fit never wait()s
+                # on periodic handles). Warn + count; the error also stays
+                # re-raisable on the old handle.
+                import warnings
+
+                prev._failure_reported = True
+                metrics.inc("failed_saves")
+                warnings.warn(
+                    f"AsyncCheckpointer[{self.name}]: background save "
+                    f"{prev.tag!r} FAILED ({type(prev.error).__name__}: "
+                    f"{prev.error}); latest still points at the previous "
+                    f"complete checkpoint", RuntimeWarning, stacklevel=2)
+        self._save_no += 1
+        tag = commit_mod.step_tag(step)
+        t_submit = time.perf_counter()
+        plan = self._snapshot_plan()
+        meta = self._meta(step=step, epoch=epoch, extra=extra, reason=reason)
+        handle = _SaveHandle(tag, t_submit)
+        self._pending = handle
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._writer, daemon=True,
+                                            name=f"pt-ckpt-{self.name}")
+            self._thread.start()
+        self._q.put((handle, tag, plan, meta))
+        if sync:
+            handle.wait()
+        return handle
+
+    def preempt_commit(self, step: int, epoch: Optional[int] = None,
+                       extra: Optional[Dict] = None) -> _SaveHandle:
+        """The preemption path: drain any in-flight save, commit a final
+        checkpoint synchronously, count the preemption. After this returns
+        the process can exit; ``resume()`` continues from exactly here."""
+        handle = self.save_async(step=step, epoch=epoch, extra=extra,
+                                 sync=True, reason="preempt")
+        metrics.inc("preemptions")
+        return handle
+
+    def wait(self):
+        """Block until the pending save (if any) committed."""
+        if self._pending is not None:
+            self._pending.wait()
+
+    drain = wait
+
+    def latest(self) -> Optional[str]:
+        return commit_mod.read_latest(self.root)
+
+    def resume(self, verify: bool = True, strict: bool = True
+               ) -> Optional[Dict]:
+        return resume(self.root, model=self.model, optimizer=self.optimizer,
+                      step=self.step_obj, verify=verify, strict=strict)
+
+    def close(self):
+        """Drain and shut the writer down. A failed pending save does NOT
+        raise here (cleanup path — it already raises at ``wait()`` and
+        stays re-raisable on the handle)."""
+        self._closed = True
+        try:
+            self.wait()
+        except BaseException as e:
+            import warnings
+
+            h = self._pending
+            if h is None or not h._failure_reported:
+                if h is not None:
+                    h._failure_reported = True
+                metrics.inc("failed_saves")
+                warnings.warn(
+                    f"AsyncCheckpointer[{self.name}]: final save failed at "
+                    f"close ({type(e).__name__}: {e}); latest still points "
+                    f"at the previous complete checkpoint", RuntimeWarning,
+                    stacklevel=2)
+        finally:
+            if self._thread is not None:
+                self._q.put(None)
+                self._thread = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- snapshot (calling thread: dispatch only) ------------------------------
+    def _snapshot_plan(self) -> List:
+        """(key, spec, shape, dtype, [(starts, stops, host_copy)]) rows;
+        the host copies are dispatched HERE so later donation of the same
+        buffers cannot corrupt the save."""
+        import jax
+        import jax.numpy as jnp
+
+        cpu = jax.devices("cpu")[0]
+        plan: List = []
+
+        def snap(sd):
+            # same-device device_put ALIASES (no copy) — a later donation
+            # of the source would delete the "snapshot". jnp.copy dispatches
+            # a real copy executable; device ordering still guarantees it
+            # reads pre-donation bytes.
+            try:
+                aliased = cpu in sd.devices()
+            except Exception:
+                aliased = False
+            if aliased:
+                return with_retries(lambda: jnp.copy(sd), what="ckpt_copy")
+            return with_retries(lambda: jax.device_put(sd, cpu),
+                                what="ckpt_d2h")
+
+        def add(key: str, arr):
+            from ...core.tensor import Tensor
+
+            if isinstance(arr, Tensor):
+                arr = arr.data
+            if not isinstance(arr, jax.Array):
+                arr = jnp.asarray(np.asarray(arr))
+            rows = []
+            for starts, stops, sd in shard_plan(arr):
+                rows.append((starts, stops, snap(sd)))
+            spec = getattr(arr.sharding, "spec", None)
+            plan.append((key, _spec_to_json(spec),
+                         [int(d) for d in arr.shape], str(arr.dtype), rows))
+
+        if self.model is not None:
+            for name, t in self.model.state_dict().items():
+                if hasattr(t, "data") or hasattr(t, "shape"):
+                    add(f"model.{name}", t)
+        opt = self.optimizer
+        if opt is not None:
+            for i, p in enumerate(getattr(opt, "_parameter_list", [])):
+                for k, v in (opt._accumulators.get(id(p)) or {}).items():
+                    add(f"opt.__p{i}__.{k}", v)
+        step = self.step_obj
+        if step is not None and getattr(step, "_master", None) is not None:
+            for i, m in enumerate(step._master):
+                add(f"master.__p{i}__", m)
+        return plan
+
+    def _meta(self, step, epoch, extra, reason) -> Dict:
+        import jax
+
+        from ...framework import random as random_mod
+
+        seed, counter = random_mod.get_rng_state()
+        meta: Dict[str, Any] = {
+            "step": int(step), "epoch": None if epoch is None else int(epoch),
+            "save_no": self._save_no, "reason": reason,
+            "rng": [int(seed), int(counter)],
+            "devices": len(jax.devices()),
+            "extra": dict(extra or {}),
+        }
+        opt = self.optimizer
+        if opt is not None:
+            opt_meta: Dict[str, Any] = {
+                "global_step": int(getattr(opt, "_global_step", 0))}
+            sched = getattr(opt, "_learning_rate", None)
+            if hasattr(sched, "state_dict"):
+                try:
+                    opt_meta["LR_Scheduler"] = json.loads(
+                        json.dumps(sched.state_dict()))
+                except (TypeError, ValueError):
+                    opt_meta["lr_scheduler_skipped"] = True  # callables
+            meta["opt"] = opt_meta
+        return meta
+
+    # -- writer (background thread: block, serialize, commit) ------------------
+    def _writer(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            handle, tag, plan, meta = job
+            try:
+                handle.path = self._write_and_commit(tag, plan, meta)
+                handle.total_ms = (time.perf_counter()
+                                   - handle.t_submit) * 1e3
+                metrics.inc("saves")
+                metrics.inc("save_ms", handle.total_ms)
+            except BaseException as e:  # surfaces at wait()/next drain
+                handle.error = e
+                metrics.inc("save_failures")
+            finally:
+                handle._event.set()
+
+    def _write_and_commit(self, tag: str, plan: List, meta: Dict) -> str:
+        t0 = time.perf_counter()
+        staging = commit_mod.make_staging(self.root, tag)
+        entries: Dict[str, Dict] = {}
+        checksums: Dict[str, str] = {}
+        nbytes = 0
+        written = 0
+        for key, spec, shape, dtype, rows in plan:
+            safe = _sanitize(key)
+            entry = {"global_shape": shape, "dtype": dtype, "spec": spec,
+                     "shards": []}
+            for j, (starts, stops, host) in enumerate(rows):
+                data = np.asarray(host)  # blocks until the d2h copy landed
+                injector().check("crash_mid_save", tag=tag, phase="shards",
+                                 shard=written)
+                fname = f"{safe}.s{j}.npy"
+                with open(os.path.join(staging, fname), "wb") as f:
+                    hw = commit_mod.HashingWriter(f)
+                    np.save(hw, data)  # hash while serializing: no re-read
+                checksums[fname] = hw.hexdigest()
+                entry["shards"].append(
+                    {"file": fname, "starts": starts, "stops": stops})
+                nbytes += int(data.nbytes)
+                written += 1
+            entries[key] = entry
+        final = commit_mod.commit(self.root, tag, staging, entries, meta,
+                                  checksums=checksums)
+        commit_mod.retain(self.root, self.keep)
+        metrics.inc("ckpt_bytes", nbytes)
+        metrics.inc("commit_ms", (time.perf_counter() - t0) * 1e3)
+        return final
+
+
+def latest_checkpoint(root: str) -> Optional[str]:
+    """Absolute path of the newest committed checkpoint dir, or None."""
+    tag = commit_mod.read_latest(root)
+    return os.path.join(root, tag) if tag else None
+
+
+def resume(root: str, model=None, optimizer=None, step=None,
+           verify: bool = True, strict: bool = True) -> Optional[Dict]:
+    """Restore the newest VERIFIED checkpoint under ``root`` into the
+    given objects; returns its meta dict (step/epoch/rng/...) or None when
+    no usable checkpoint exists.
+
+    Re-sharding is implicit: arrays are reassembled to their global shape
+    from the manifest and ``device_put`` onto each target's *current*
+    sharding — a save from 8 devices restores onto 4 (or any other mesh)
+    through the same path. A checkpoint failing checksum verification is
+    counted as ``torn_checkpoints`` and skipped in favor of the previous
+    complete one.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    from ...core.tensor import Tensor
+    from ...framework import random as random_mod
+
+    metrics.fam()
+    commit_mod.gc_staging(root)
+    tags = commit_mod.list_checkpoints(root)
+    latest = commit_mod.read_latest(root)
+    candidates = ([latest] if latest else []) + \
+        [t for t in reversed(tags) if t != latest]
+    manifest = None
+    tag = None
+    for cand in candidates:
+        d = os.path.join(root, cand)
+        try:
+            manifest = commit_mod.verify(d) if verify \
+                else commit_mod.load_manifest(d)
+            tag = cand
+            break
+        except (CheckpointCorrupt, OSError, ValueError) as e:
+            import warnings
+
+            metrics.inc("torn_checkpoints")
+            warnings.warn(f"resilience.resume: skipping {cand}: {e}",
+                          stacklevel=2)
+    if manifest is None:
+        return None
+    ckpt_dir = os.path.join(root, tag)
+    entries = manifest["entries"]
+    meta = dict(manifest.get("meta", {}))
+
+    def put_like(arr: np.ndarray, target_data):
+        arr = arr.astype(_np_dtype(str(target_data.dtype)), copy=False)
+        sharding = getattr(target_data, "sharding", None)
+        if isinstance(sharding, NamedSharding):
+            return jax.device_put(jnp.asarray(arr), sharding)
+        return jax.device_put(jnp.asarray(arr), list(target_data.devices())[0])
+
+    if model is not None:
+        missing = []
+        for name, t in model.state_dict().items():
+            key = f"model.{name}"
+            if key not in entries:
+                if isinstance(t, Tensor):
+                    missing.append(name)
+                continue
+            arr = _assemble(ckpt_dir, entries[key], verify=False)
+            if isinstance(t, Tensor):
+                if tuple(arr.shape) != tuple(t.data.shape):
+                    raise ValueError(
+                        f"{name}: checkpoint shape {arr.shape} != target "
+                        f"{tuple(t.data.shape)}")
+                t.data = put_like(arr, t.data)
+        if strict and missing:
+            raise KeyError(f"checkpoint {tag} lacks model keys: "
+                           f"{missing[:5]}{'...' if len(missing) > 5 else ''}")
+    if optimizer is not None:
+        params = list(getattr(optimizer, "_parameter_list", []))
+        for i, p in enumerate(params):
+            prefix = f"opt.__p{i}__."
+            saved = {k[len(prefix):]: v for k, v in entries.items()
+                     if k.startswith(prefix)}
+            if not saved:
+                continue
+            proto = optimizer._init_state(p.data)
+            acc = {}
+            for k in set(proto) | set(saved):
+                if k in saved:
+                    arr = _assemble(ckpt_dir, saved[k], verify=False)
+                    tgt = proto.get(k, p.data)
+                    if tuple(arr.shape) == tuple(p.data.shape):
+                        acc[k] = put_like(arr, p.data)
+                    else:
+                        arr = arr.astype(_np_dtype(str(tgt.dtype)),
+                                         copy=False)
+                        acc[k] = jnp.asarray(arr)
+                else:
+                    acc[k] = proto[k]
+            optimizer._accumulators[id(p)] = acc
+        opt_meta = meta.get("opt", {})
+        optimizer._global_step = int(opt_meta.get("global_step", 0))
+        sched = getattr(optimizer, "_learning_rate", None)
+        if hasattr(sched, "set_state_dict") and "LR_Scheduler" in opt_meta:
+            sched.set_state_dict(opt_meta["LR_Scheduler"])
+        # compiled steps holding in-graph copies must re-seed (same contract
+        # as optimizer.set_state_dict)
+        optimizer._state_version = getattr(optimizer, "_state_version", 0) + 1
+    if step is not None and getattr(step, "_master", None) is not None:
+        cpu = jax.devices("cpu")[0]
+        for i in range(len(step._master)):
+            key = f"master.__p{i}__"
+            if key in entries:
+                arr = _assemble(ckpt_dir, entries[key], verify=False)
+                step._master[i] = jax.device_put(jnp.asarray(arr), cpu)
+    if meta.get("rng"):
+        random_mod.set_rng_state(tuple(int(v) for v in meta["rng"]))
+    metrics.inc("restores")
+    meta["tag"] = tag
+    meta["dir"] = ckpt_dir
+    return meta

@@ -1,0 +1,32 @@
+"""The ``resilience`` observability family: one labeled counter family
+(metric) shared by every module in this package — saves, hidden_save_ms,
+save_stall_ms, commit_ms, retries, skipped_steps, restores, preemptions,
+torn_checkpoints, injected_faults. Telemetry must never mask the event it
+records, so every write degrades to a no-op on failure.
+"""
+from __future__ import annotations
+
+_FAM = None
+
+
+def fam():
+    global _FAM
+    if _FAM is None:
+        from ...observability import family
+
+        _FAM = family("resilience", ("metric",))
+    return _FAM
+
+
+def inc(metric: str, n: float = 1) -> None:
+    try:
+        fam().inc((metric,), n)
+    except Exception:
+        pass
+
+
+def get(metric: str) -> float:
+    try:
+        return fam().get((metric,))
+    except Exception:
+        return 0.0

@@ -1,0 +1,74 @@
+"""Preemption handling: turn SIGTERM into a clean checkpoint-and-exit.
+
+Preemptible accelerator VMs deliver SIGTERM with a grace window. The
+handler here only sets a flag — signal context is no place for jax — and
+the training loop checks ``preempted()`` at step boundaries: drain the
+checkpoint lane, ``preempt_commit`` a final checkpoint, exit 0. A later
+launch ``resume()``\\ s from exactly the preempted step, on whatever
+device count the new allocation has.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Optional
+
+from . import metrics
+
+__all__ = ["install_preemption_handler", "uninstall_preemption_handler",
+           "preempted", "clear_preemption", "Preempted"]
+
+
+class Preempted(RuntimeError):
+    """Optional control-flow escape for loops that prefer raising over
+    polling ``preempted()``."""
+
+
+_FLAG = threading.Event()
+_PREV: dict = {}
+_LOCK = threading.Lock()
+
+
+def _handler(signum, frame):
+    _FLAG.set()
+    metrics.inc("preempt_signals")
+
+
+def install_preemption_handler(signals=(signal.SIGTERM,)) -> bool:
+    """Install the flag-setting handler (idempotent; previous handlers are
+    remembered for ``uninstall``). Returns False when not on the main
+    thread — Python only allows signal handlers there — so callers on
+    worker threads degrade gracefully instead of crashing."""
+    with _LOCK:
+        try:
+            for sig in signals:
+                if sig not in _PREV:
+                    _PREV[sig] = signal.signal(sig, _handler)
+        except ValueError:  # not the main thread
+            return False
+    return True
+
+
+def uninstall_preemption_handler() -> None:
+    with _LOCK:
+        for sig, prev in list(_PREV.items()):
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+            _PREV.pop(sig, None)
+
+
+def preempted() -> bool:
+    return _FLAG.is_set()
+
+
+def clear_preemption() -> None:
+    _FLAG.clear()
+
+
+def request_preemption() -> None:
+    """Programmatic preemption (tests, in-process drills): same flag the
+    SIGTERM handler sets."""
+    _FLAG.set()
+    metrics.inc("preempt_signals")

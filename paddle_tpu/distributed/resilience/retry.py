@@ -1,0 +1,66 @@
+"""Bounded retry-with-backoff for transient host<->device transfers.
+
+One policy for both lanes that move checkpoint/offload bytes: a transfer
+that throws is retried up to ``PT_TRANSFER_RETRIES`` times (default 2)
+with exponential backoff starting at ``PT_TRANSFER_BACKOFF_MS`` (default
+25 ms). ``InjectedFault(transient=False)`` and interpreter-exit signals
+are never retried; every retry lands in the ``resilience`` family.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Optional
+
+from . import metrics
+
+__all__ = ["retry_policy", "with_retries"]
+
+
+def retry_policy():
+    try:
+        retries = int(os.environ.get("PT_TRANSFER_RETRIES", "2"))
+    except ValueError:
+        retries = 2
+    try:
+        backoff_ms = float(os.environ.get("PT_TRANSFER_BACKOFF_MS", "25"))
+    except ValueError:
+        backoff_ms = 25.0
+    return max(retries, 0), max(backoff_ms, 0.0)
+
+
+def _transient(e: BaseException) -> bool:
+    """Retry runtime/transport errors; never interpreter exits or plain
+    programming errors (a TypeError retries to the same TypeError). An
+    explicit ``transient`` attribute (``InjectedFault``) always wins."""
+    t = getattr(e, "transient", None)
+    if t is not None:
+        return bool(t)
+    if isinstance(e, (KeyboardInterrupt, SystemExit, TypeError, ValueError)):
+        return False
+    return True
+
+
+def transient(e: BaseException) -> bool:
+    return _transient(e)
+
+
+def with_retries(fn: Callable, what: str = "transfer",
+                 retries: Optional[int] = None,
+                 backoff_ms: Optional[float] = None):
+    """Run ``fn()``; on a transient failure sleep-and-retry up to the
+    bound, then re-raise the last error. ``what`` labels nothing but the
+    debugger's stack — counting is uniform (``retries`` metric)."""
+    env_retries, env_backoff = retry_policy()
+    retries = env_retries if retries is None else int(retries)
+    backoff_ms = env_backoff if backoff_ms is None else float(backoff_ms)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except BaseException as e:
+            if attempt >= retries or not _transient(e):
+                raise
+            attempt += 1
+            metrics.inc("retries")
+            time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1e3)

@@ -85,7 +85,11 @@ define_flag("check_nan_inf_action", "raise",
             "What a check_nan_inf trip does: 'raise' (default) aborts the "
             "step naming the op; 'log' downgrades to a warning + a "
             "nan_inf_events counter row so monitors can alert without "
-            "crashing the run. Either way the trip is counted.")
+            "crashing the run; 'skip' raises NanStepSkipped, which "
+            "step-aware loops (hapi.Model.fit) eat — the poisoned step is "
+            "dropped (grads cleared, no update) and training continues, "
+            "counted as resilience skipped_steps. Either way the trip is "
+            "counted.")
 define_flag("benchmark", False,
             "Block on every op so host timings are true device timings "
             "(reference: flags.cc FLAGS_benchmark).")
@@ -146,9 +150,9 @@ def _apply_matmul_precision(value: str):
 
 
 def _validate_nan_inf_action(value: str):
-    if value not in ("raise", "log"):
+    if value not in ("raise", "log", "skip"):
         raise ValueError(
-            f"FLAGS_check_nan_inf_action must be 'raise' or 'log', "
+            f"FLAGS_check_nan_inf_action must be 'raise', 'log' or 'skip', "
             f"got {value!r}")
 
 

@@ -45,6 +45,18 @@ def _timeline():
     return timeline()
 
 
+def _resilience():
+    from ..distributed import resilience
+
+    return resilience
+
+
+def _nan_skip_exc():
+    from ..core.tensor import NanStepSkipped
+
+    return NanStepSkipped
+
+
 def _auto_device_prefetch(loader, device_sharding):
     """fit(prefetch_to_device=None) default: a DistributedBatchSampler-
     driven DataLoader on an active multi-device mesh prefetches to the
@@ -140,6 +152,24 @@ class Model:
             return loss_vals, metrics[0] if len(metrics) == 1 else metrics
         return loss_vals
 
+    def _check_nan_step_fault(self, gstep: int) -> None:
+        """``nan_step`` fault site: a scripted NaN-producing step at an
+        exact global step index (``PT_FAULTS="nan_step@step=5"``). Fires
+        as ``NanStepSkipped`` when FLAGS_check_nan_inf_action='skip' (the
+        loop drops the step and continues); as a RuntimeError otherwise —
+        the same two outcomes a REAL non-finite step has under the per-op
+        guard."""
+        from ..distributed.resilience.faults import injector
+
+        if not injector().peek("nan_step", step=gstep):
+            return
+        from ..framework import flags as _flags
+
+        msg = f"injected nan_step at step {gstep}"
+        if _flags.flag("check_nan_inf_action") == "skip":
+            raise _nan_skip_exc()(msg)
+        raise RuntimeError(msg)
+
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         self.mode = "eval"
@@ -227,7 +257,20 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None,
-            prefetch_to_device=None, device_sharding=None):
+            prefetch_to_device=None, device_sharding=None,
+            checkpoint_every=None, checkpoint_dir="checkpoints",
+            checkpoint_keep=3, resume=False):
+        """``checkpoint_every=N`` turns on the fault-tolerant runtime
+        (``distributed.resilience``): every N train steps an
+        ``AsyncCheckpointer`` snapshots params/optimizer/rng and commits in
+        the background (save time hides behind the next steps' compute);
+        SIGTERM is trapped and, at the next step boundary, drained into a
+        final synchronous commit before the loop stops — a later
+        ``fit(..., resume=True)`` continues from exactly that step, on
+        whatever device count the relaunch has. ``resume=True`` restores
+        the newest verified checkpoint under ``checkpoint_dir`` (epoch,
+        step-in-epoch, rng and optimizer state included) and fast-forwards
+        the loader to the first unseen batch."""
         assert train_data is not None, "train_data must be given"
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
                                    drop_last=drop_last)
@@ -259,22 +302,67 @@ class Model:
             callbacks, model=self, epochs=epochs, steps=steps, log_freq=log_freq,
             save_freq=save_freq, save_dir=save_dir, verbose=verbose,
             metrics=metric_names)
+        ckpt_ctx = None
+        start_epoch = 0
+        if checkpoint_every is not None:
+            rz = _resilience()
+            ck = rz.AsyncCheckpointer(checkpoint_dir, model=self.network,
+                                      optimizer=self._optimizer,
+                                      keep=checkpoint_keep, name="fit")
+            rz.install_preemption_handler()
+            ckpt_ctx = {"ck": ck, "every": max(int(checkpoint_every), 1),
+                        "global_step": 0, "skip_steps": 0, "preempted": False}
+            if resume:
+                meta = ck.resume()
+                if meta is not None:
+                    start_epoch = int(meta.get("epoch") or 0)
+                    ckpt_ctx["global_step"] = int(meta["step"]) + 1
+                    ckpt_ctx["last_save"] = int(meta["step"])
+                    # fast-forward past the batches the saved step consumed
+                    sie = meta.get("extra", {}).get("step_in_epoch")
+                    if sie is not None:
+                        ckpt_ctx["skip_steps"] = int(sie) + 1
+                    # the rng state the interrupted EPOCH began with: a
+                    # shuffling sampler redraws its permutation from the
+                    # global generator at iter() time, so the resumed epoch
+                    # must replay the draw from this state (the restored
+                    # mid-step rng would yield a different batch order)
+                    ckpt_ctx["resume_epoch_rng"] = \
+                        meta.get("extra", {}).get("epoch_rng")
         self.stop_training = False
         cbks.on_begin("train")
-        for epoch in range(epochs):
-            if self.stop_training:
-                break
-            cbks.on_epoch_begin(epoch)
-            logs = self._run_one_epoch(loader, cbks, "train",
-                                       accumulate_grad_batches, num_iters)
-            cbks.on_epoch_end(epoch, logs)
-            if eval_loader is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
-                eval_logs = {"steps": len(eval_loader) if hasattr(eval_loader, "__len__") else None,
-                             "metrics": metric_names}
-                cbks.on_begin("eval", eval_logs)
-                eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
-                cbks.on_end("eval", eval_logs)
-        cbks.on_end("train")
+        try:
+            for epoch in range(start_epoch, epochs):
+                if self.stop_training:
+                    break
+                cbks.on_epoch_begin(epoch)
+                if ckpt_ctx is not None:
+                    ckpt_ctx["epoch"] = epoch
+                    if ckpt_ctx.get("resume_epoch_rng") is not None:
+                        # resumed epoch: saves must carry the ORIGINAL
+                        # epoch-begin rng, not the mid-step restored state
+                        ckpt_ctx["epoch_rng"] = ckpt_ctx["resume_epoch_rng"]
+                    else:
+                        from ..framework import random as _random_mod
+
+                        ckpt_ctx["epoch_rng"] = [
+                            int(v) for v in _random_mod.get_rng_state()]
+                logs = self._run_one_epoch(loader, cbks, "train",
+                                           accumulate_grad_batches, num_iters,
+                                           ckpt_ctx=ckpt_ctx)
+                cbks.on_epoch_end(epoch, logs)
+                if ckpt_ctx is not None and ckpt_ctx["preempted"]:
+                    break
+                if eval_loader is not None and (epoch % eval_freq == 0 or epoch == epochs - 1):
+                    eval_logs = {"steps": len(eval_loader) if hasattr(eval_loader, "__len__") else None,
+                                 "metrics": metric_names}
+                    cbks.on_begin("eval", eval_logs)
+                    eval_logs = self._run_one_epoch(eval_loader, cbks, "eval")
+                    cbks.on_end("eval", eval_logs)
+            cbks.on_end("train")
+        finally:
+            if ckpt_ctx is not None:
+                ckpt_ctx["ck"].close()  # drain any in-flight save
         return self
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
@@ -314,16 +402,42 @@ class Model:
         return grouped
 
     def _run_one_epoch(self, loader, cbks, mode, accumulate_grad_batches=1,
-                       num_iters=None):
+                       num_iters=None, ckpt_ctx=None):
         for m in self._metrics:
             m.reset()
         logs = {}
         count = 0
         pending = False
+        nan_window = False  # current accumulation window had a NaN-skip
         tl = _timeline() if mode == "train" else None
+        resumed_rng = None
+        if mode == "train" and ckpt_ctx is not None and ckpt_ctx["skip_steps"] \
+                and ckpt_ctx.pop("resume_epoch_rng", None) is not None:
+            # rewind the global generator to the interrupted epoch's begin
+            # state so a shuffling sampler redraws the SAME permutation the
+            # original epoch trained on; the mid-step state (restored by
+            # resume()) comes back right after the fast-forward
+            from ..framework import random as _random_mod
+
+            resumed_rng = _random_mod.get_rng_state()
+            _random_mod.set_rng_state(
+                tuple(int(v) for v in ckpt_ctx["epoch_rng"]))
         it = iter(loader)
         step = 0
         _END = object()
+        if mode == "train" and ckpt_ctx is not None and ckpt_ctx["skip_steps"]:
+            # resume fast-forward: consume the batches the checkpointed step
+            # already trained on, so the loader replays the same sequence
+            # the uninterrupted run would have seen
+            for _ in range(ckpt_ctx["skip_steps"]):
+                if next(it, _END) is _END:
+                    break
+                step += 1
+            ckpt_ctx["skip_steps"] = 0
+            if resumed_rng is not None:
+                from ..framework import random as _random_mod
+
+                _random_mod.set_rng_state(resumed_rng)
         while True:
             if num_iters is not None and step >= num_iters:
                 break
@@ -348,10 +462,50 @@ class Model:
                     tl.record("data_wait", (t_got - t_wait) * 1e3, t0=t_wait)
                 if mode == "train":
                     update = (step + 1) % accumulate_grad_batches == 0
-                    outs = self.train_batch(
-                        inputs, labels, update=update,
-                        _loss_scale=1.0 / accumulate_grad_batches)
-                    pending = not update
+                    gstep = ckpt_ctx["global_step"] if ckpt_ctx is not None \
+                        else step
+                    try:
+                        self._check_nan_step_fault(gstep)
+                        outs = self.train_batch(
+                            inputs, labels, update=update and not nan_window,
+                            _loss_scale=1.0 / accumulate_grad_batches)
+                    except _nan_skip_exc() as e:
+                        # skip-and-continue: the poisoned step is dropped
+                        # whole (grads cleared, no optimizer update) and
+                        # training goes on — counted for the monitors.
+                        # Mid-accumulation-window the WINDOW is the step:
+                        # the earlier micro-grads are already gone, so the
+                        # boundary must not apply a partial, mis-scaled sum
+                        import warnings
+
+                        if self._optimizer is not None:
+                            self._optimizer.clear_grad()
+                        nan_window = accumulate_grad_batches > 1 and not update
+                        pending = False
+                        from ..distributed.resilience import metrics as _rm
+
+                        _rm.inc("skipped_steps")
+                        warnings.warn(
+                            f"fit: skipping non-finite step {gstep}: {e}",
+                            RuntimeWarning, stacklevel=2)
+                        cbks.on_batch_end(mode, step, logs)
+                        if st is not None:
+                            st.cancel()
+                        if ckpt_ctx is not None:
+                            ckpt_ctx["global_step"] = gstep + 1
+                        step += 1
+                        continue
+                    if update and nan_window:
+                        # the window contained a dropped step: discard the
+                        # partial remainder instead of stepping on it
+                        if self._optimizer is not None:
+                            self._optimizer.clear_grad()
+                        nan_window = False
+                        pending = False
+                        stepped = False
+                    else:
+                        pending = not update
+                        stepped = update
                 else:
                     outs = self.eval_batch(inputs, labels)
                 if self._metrics and self._loss is not None:
@@ -371,7 +525,45 @@ class Model:
                 count += bsz
                 logs["batch_size"] = bsz
                 cbks.on_batch_end(mode, step, logs)
+                if mode == "train" and ckpt_ctx is not None:
+                    gs = ckpt_ctx["global_step"]
+                    ckpt_ctx["global_step"] = gs + 1
+                    # checkpoints only at UPDATE boundaries: a snapshot
+                    # taken mid-accumulation-window would lose the window's
+                    # accumulated grads (never part of the snapshot) and a
+                    # resume could not reproduce the uninterrupted run. A
+                    # preemption therefore drains up to k-1 more micro-steps
+                    # before its final commit.
+                    if stepped:
+                        rz = _resilience()
+                        if rz.preempted():
+                            # SIGTERM landed: drain the lane, commit a final
+                            # synchronous checkpoint, stop cleanly —
+                            # resume() continues from exactly this step
+                            ckpt_ctx["ck"].preempt_commit(
+                                step=gs, epoch=ckpt_ctx.get("epoch"),
+                                extra={"step_in_epoch": step,
+                                       "epoch_rng": ckpt_ctx.get("epoch_rng")})
+                            ckpt_ctx["preempted"] = True
+                            # the preemption is CONSUMED by this commit — a
+                            # later fit() in the same process starts fresh
+                            rz.clear_preemption()
+                            self.stop_training = True
+                            break
+                        if gs - ckpt_ctx.get("last_save", -1) \
+                                >= ckpt_ctx["every"]:
+                            # since-last-save cadence, not (gs+1)%every:
+                            # with accumulation only boundary steps are
+                            # eligible and the modulo could starve
+                            ckpt_ctx["ck"].save_async(
+                                step=gs, epoch=ckpt_ctx.get("epoch"),
+                                extra={"step_in_epoch": step,
+                                       "epoch_rng": ckpt_ctx.get("epoch_rng")})
+                            ckpt_ctx["last_save"] = gs
             step += 1
+        if nan_window and self._optimizer is not None:
+            # epoch ended inside a poisoned window: drop its remainder
+            self._optimizer.clear_grad()
         if pending and self._optimizer is not None:
             # flush the trailing partial accumulation group
             self._optimizer.step()

@@ -63,6 +63,37 @@ def _lane_fam():
     return _LANE_FAM
 
 
+_RESIL = None  # lazily-bound (faults injector, transient, retry_policy)
+
+
+def _resil():
+    global _RESIL
+    if _RESIL is None:
+        from ..distributed.resilience import metrics as rmetrics
+        from ..distributed.resilience.faults import injector
+        from ..distributed.resilience.retry import retry_policy, transient
+
+        _RESIL = (injector, transient, retry_policy, rmetrics)
+    return _RESIL
+
+
+class StreamTransferError(RuntimeError):
+    """A lane transfer failed after its retry budget. Carries the failing
+    direction, stream-group tag and parameter names so the raise at the
+    consumer's ``wait()`` names WHAT was in flight, not just why. The
+    original exception is ``__cause__``."""
+
+    def __init__(self, kind: str, tag, names, cause: BaseException):
+        self.kind = kind
+        self.tag = tag
+        self.names = tuple(names or ())
+        named = f" params={list(self.names)}" if self.names else ""
+        super().__init__(
+            f"stream transfer failed: kind={kind} group={tag}{named}: "
+            f"{type(cause).__name__}: {cause}")
+        self.__cause__ = cause
+
+
 def plan_stream_groups(nbytes_list: Sequence[int],
                        segment_size: int = 2 ** 20,
                        buffer_max_size: int = 2 ** 23) -> List[List[int]]:
@@ -140,35 +171,52 @@ class StreamLane:
         self.depth = int(depth)
         self._lock = threading.Lock()
         self._stats = {"h2d_bytes": 0, "d2h_bytes": 0, "transfer_ms": 0.0,
-                       "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0}
+                       "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0,
+                       "retries": 0}
         self.events: List[tuple] = []  # (kind, tag) in submission order
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        self._seq = 0          # submission index (fault-site id)
+        self._failure: Optional[BaseException] = None
 
     # -- submission -----------------------------------------------------------
-    def submit(self, kind: str, arrays, placements, tag=None
+    def submit(self, kind: str, arrays, placements, tag=None, names=None
                ) -> _TransferHandle:
         """Enqueue one group transfer. ``kind`` is ``"h2d"`` (params up) or
         ``"d2h"`` (grads/state down); ``placements`` is one sharding/device
-        for every array or a per-array sequence. Blocks while the two-deep
-        ring is full."""
+        for every array or a per-array sequence; ``names`` (optional) are
+        the in-flight parameter names, carried into any raised error.
+        Blocks while the two-deep ring is full. A lane that already failed
+        a transfer re-raises that failure here — the pipeline is poisoned
+        and every subsequent interaction must say so."""
         if self._closed:
             raise RuntimeError("StreamLane is closed")
+        if self._failure is not None:
+            raise self._failure
         handle = _TransferHandle(self)
         if not isinstance(placements, (list, tuple)):
             placements = [placements] * len(arrays)
         with self._lock:
             self.events.append((kind, tag))
             self._stats["in_flight_sum"] += self._q.qsize()
+            seq = self._seq
+            self._seq += 1
         if not self.overlap:
-            self._run_job(kind, arrays, placements, handle, serialized=True)
+            self._run_job(kind, arrays, placements, handle, tag, names, seq,
+                          serialized=True)
             return handle
         if self._thread is None:
             self._thread = threading.Thread(target=self._worker, daemon=True,
                                             name="pt-offload-stream")
             self._thread.start()
-        self._q.put((kind, arrays, placements, handle))
+        self._q.put((kind, arrays, placements, handle, tag, names, seq))
+        if self._failure is not None and not handle._event.is_set():
+            # the worker may have poisoned + drained (or exited) while we
+            # were blocked in put() — our job could be sitting in a queue no
+            # thread reads. Fail it here; idempotent vs the worker's drain.
+            handle._box[1] = self._failure
+            handle._event.set()
         return handle
 
     def _worker(self):
@@ -177,24 +225,67 @@ class StreamLane:
             if job is None:
                 return
             self._run_job(*job)
+            if self._failure is not None:
+                # the walk is poisoned: fail everything already queued so
+                # every consumer wait() raises instead of hanging, then die
+                while True:
+                    try:
+                        job = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if job is None:
+                        break
+                    job[3]._box[1] = self._failure
+                    job[3]._event.set()
+                with self._lock:
+                    self._thread = None
+                return
 
-    def _run_job(self, kind, arrays, placements, handle, serialized=False):
+    def _transfer_once(self, kind, arrays, placements, tag, seq):
+        injector, _transient, _policy, _rm = _resil()
+        inj = injector()
+        inj.check("slow_transfer", seq=seq, kind=kind, group=tag)
+        inj.check("transfer", seq=seq, kind=kind, group=tag)
+        out = [jax.device_put(a, p) if p is not None
+               else jax.device_put(a)
+               for a, p in zip(arrays, placements)]
+        # the transfer is only *done* when the bytes have landed —
+        # blocking HERE (off the consumer thread when overlapped) is
+        # what makes stall_ms mean "transfer not hidden"
+        for o in out:
+            o.block_until_ready()
+        return out
+
+    def _run_job(self, kind, arrays, placements, handle, tag, names, seq,
+                 serialized=False):
         t0 = time.perf_counter()
         try:
-            try:
-                out = [jax.device_put(a, p) if p is not None
-                       else jax.device_put(a)
-                       for a, p in zip(arrays, placements)]
-                # the transfer is only *done* when the bytes have landed —
-                # blocking HERE (off the consumer thread when overlapped) is
-                # what makes stall_ms mean "transfer not hidden"
-                for o in out:
-                    o.block_until_ready()
-                handle._box[0] = out
-                nbytes = sum(int(getattr(o, "nbytes", 0)) for o in out)
-            except BaseException as e:  # surfaces at the consumer's wait()
-                handle._box[1] = e
-                nbytes = 0
+            injector, transient, retry_policy, rmetrics = _resil()
+            retries, backoff_ms = retry_policy()
+            attempt = 0
+            nbytes = 0
+            while True:
+                try:
+                    out = self._transfer_once(kind, arrays, placements, tag,
+                                              seq)
+                    handle._box[0] = out
+                    nbytes = sum(int(getattr(o, "nbytes", 0)) for o in out)
+                    break
+                except BaseException as e:
+                    if attempt < retries and transient(e):
+                        # bounded retry-with-backoff: transient transfer
+                        # faults (flaky host link, injected) are eaten here
+                        attempt += 1
+                        with self._lock:
+                            self._stats["retries"] += 1
+                        _lane_fam().inc(("retries",))
+                        rmetrics.inc("retries")
+                        time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1e3)
+                        continue
+                    err = StreamTransferError(kind, tag, names, e)
+                    handle._box[1] = err  # surfaces at the consumer's wait()
+                    self._failure = err   # ...and at every later interaction
+                    break
             ms = (time.perf_counter() - t0) * 1e3
             with self._lock:
                 self._stats[f"{kind}_bytes"] += nbytes

@@ -83,6 +83,10 @@ def _register_builtin_providers() -> None:
     # latency-hiding offload executor; per-step-object counters live on
     # ShardedTrainStep.stream_stats()
     family("offload_stream", ("metric",))
+    # fault-tolerant runtime (distributed.resilience): saves + hidden vs
+    # stalled save ms, transfer retries, skipped NaN steps, restores,
+    # preemptions, torn checkpoints, injected faults
+    family("resilience", ("metric",))
 
 
 _register_builtin_providers()

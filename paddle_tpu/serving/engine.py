@@ -65,6 +65,12 @@ class _Request:
 _ENGINE_NO = itertools.count(1)
 
 
+def _injector():
+    from ..distributed.resilience.faults import injector
+
+    return injector()
+
+
 def _np_dtype(dt: str) -> np.dtype:
     try:
         return np.dtype(dt)
@@ -435,6 +441,13 @@ class ServingEngine(EngineBase):
         t_exec = time.monotonic()
         for r in batch:
             self.metrics.observe_queue_wait((t_exec - r.t_submit) * 1e3)
+        # chaos site: a scripted batch fault at an exact executed-batch
+        # index (PT_FAULTS="batch_fault@batch=3") — exercises the
+        # isolation contract (only THIS batch's futures fail, the queue
+        # keeps draining) without real hardware faults
+        self._batch_no = getattr(self, "_batch_no", -1) + 1
+        _injector().check("batch_fault", engine=self.name,
+                          batch=self._batch_no)
         # a runner fault propagates to _worker's batch-failure handler
         with profiler.RecordEvent(
                 f"serving::batch[{self.name} b{bucket_b} n{n}]",

@@ -36,6 +36,12 @@ __all__ = ["GenerationConfig", "GenerationEngine"]
 _GEN_NO = itertools.count(1)
 
 
+def _injector():
+    from ..distributed.resilience.faults import injector
+
+    return injector()
+
+
 class GenerationConfig:
     """Slot arena + prompt bucket shape declaration."""
 
@@ -358,6 +364,12 @@ class GenerationEngine(EngineBase):
                 # slot at max_len is finished before decode in
                 # _maybe_finish, so the clamp never fires for active slots)
                 lengths[i] = min(s.length, self.max_len - 1)
+        # chaos site: scripted decode fault at an exact decode-step index
+        # (PT_FAULTS="decode_fault@step=2") — the in-flight requests fail,
+        # their slots release, queued prompts keep being admitted
+        self._decode_no = getattr(self, "_decode_no", -1) + 1
+        _injector().check("decode_fault", engine=self.name,
+                          step=self._decode_no)
         with profiler.RecordEvent(
                 f"serving::decode[{self.name} n{len(active)}]", "Serving"):
             nxt, self._k, self._v = self._decode(

@@ -1,0 +1,598 @@
+"""Fault-tolerant training runtime (ISSUE-6): crash-consistent commit
+protocol, async checkpointing, preemption-safe resume, deterministic fault
+injection, retry policy, NaN-step skipping, and the checkpoint-story lint.
+
+The cross-process halves of the acceptance — SIGTERM-killing a real
+training subprocess and resuming on a DIFFERENT XLA device count — run in
+tools/ci.sh's resilience gate; here the same machinery is exercised
+in-process (request_preemption is the same flag the SIGTERM handler sets).
+"""
+import os
+import time
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+import paddle_tpu.nn as nn
+from jax.sharding import PartitionSpec as P
+from paddle_tpu.distributed import resilience as rz
+from paddle_tpu.distributed.resilience import commit as cm
+from paddle_tpu.distributed.resilience import metrics as rm
+from paddle_tpu.distributed.resilience.faults import FaultInjector, _parse_env
+
+
+def _np(t):
+    return np.asarray(t.data)
+
+
+def _params(net):
+    return {k: np.asarray(_np(v)).copy() for k, v in net.state_dict().items()}
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    """No armed rule or preemption flag may leak across tests."""
+    yield
+    rz.injector().clear()
+    rz.clear_preemption()
+    rz.uninstall_preemption_handler()
+
+
+# -- commit protocol ----------------------------------------------------------
+
+@pytest.mark.slow
+def test_async_save_commit_and_verify(tmp_path):
+    paddle.seed(0)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    net(paddle.randn([2, 8])).sum().backward()
+    opt.step()
+    opt.clear_grad()
+    with rz.AsyncCheckpointer(str(tmp_path), model=net, optimizer=opt,
+                              keep=3) as ck:
+        h = ck.save_async(step=0, epoch=0, sync=True)
+        assert h.done() and h.error is None
+        mani = cm.verify(h.path)  # re-hash every file against the manifest
+    assert mani["format"] == 2
+    assert mani["meta"]["step"] == 0
+    assert set(mani["checksums"])  # HashingWriter checksums present
+    assert cm.read_latest(str(tmp_path)) == "step_00000000"
+    # no staging leftovers after a clean commit
+    assert not [n for n in os.listdir(tmp_path) if n.startswith(".staging")]
+
+
+@pytest.mark.parametrize("phase", ["shards", "pre_manifest", "pre_rename",
+                                   "pre_latest"])
+def test_crash_mid_save_never_clobbers_latest(tmp_path, phase):
+    """The headline atomicity guarantee: a save that dies at ANY phase of
+    the protocol leaves LATEST on the previous complete checkpoint."""
+    paddle.seed(1)
+    net = nn.Linear(4, 4)
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, keep=3)
+    ck.save_async(step=0, sync=True)
+    before = cm.verify(os.path.join(str(tmp_path), "step_00000000"))
+    with rz.inject("crash_mid_save", phase=phase):
+        h = ck.save_async(step=1)
+        with pytest.raises(rz.InjectedFault):
+            h.wait()
+        with pytest.raises(rz.InjectedFault):
+            h.wait()  # sticky: EVERY later wait re-raises
+    ck.close()
+    assert cm.read_latest(str(tmp_path)) == "step_00000000"
+    meta = rz.resume(str(tmp_path), model=net)
+    assert meta["step"] == 0 and meta["tag"] == "step_00000000"
+    # the survivor is byte-identical to its pre-crash self
+    after = cm.verify(os.path.join(str(tmp_path), "step_00000000"))
+    assert after["checksums"] == before["checksums"]
+
+
+def test_failed_save_does_not_wedge_the_writer(tmp_path):
+    """After a mid-save crash the SAME checkpointer commits the next save
+    (its stale staging dir is recycled, the writer thread survives)."""
+    net = nn.Linear(4, 4)
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, keep=3)
+    with rz.inject("crash_mid_save", phase="pre_manifest"):
+        with pytest.raises(rz.InjectedFault):
+            ck.save_async(step=0, sync=True)
+    h = ck.save_async(step=1, sync=True)
+    assert h.error is None
+    assert cm.read_latest(str(tmp_path)) == "step_00000001"
+    ck.close()
+
+
+@pytest.mark.slow
+def test_torn_checkpoint_skipped_on_resume(tmp_path):
+    """Checksum-failing newest checkpoint (bit rot / torn rename) is
+    counted and skipped; resume lands on the previous complete one."""
+    paddle.seed(2)
+    net = nn.Linear(4, 4)
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, keep=3)
+    ck.save_async(step=0, sync=True)
+    w0 = _params(net)
+    net.weight.data = net.weight.data + 1.0
+    h = ck.save_async(step=1, sync=True)
+    ck.close()
+    # flip bytes in one shard of the newest checkpoint
+    victim = next(f for f in sorted(os.listdir(h.path))
+                  if f.endswith(".npy"))
+    with open(os.path.join(h.path, victim), "r+b") as f:
+        f.seek(90)
+        f.write(b"\xff\xff\xff\xff")
+    torn0 = rm.get("torn_checkpoints")
+    with pytest.warns(UserWarning, match="skipping step_00000001"):
+        meta = rz.resume(str(tmp_path), model=net)
+    assert meta["tag"] == "step_00000000"
+    assert rm.get("torn_checkpoints") == torn0 + 1
+    np.testing.assert_array_equal(_np(net.weight), w0["weight"])
+
+
+@pytest.mark.slow
+def test_retention_keeps_last_k(tmp_path):
+    net = nn.Linear(2, 2)
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, keep=2)
+    for s in range(4):
+        ck.save_async(step=s, sync=True)
+    ck.close()
+    assert cm.list_checkpoints(str(tmp_path)) == ["step_00000002",
+                                                  "step_00000003"]
+    assert cm.read_latest(str(tmp_path)) == "step_00000003"
+
+
+def test_gc_staging_removes_foreign_leftovers(tmp_path):
+    """A crashed OTHER process's staging dir is garbage on the next
+    launch; the live process's own in-flight staging survives."""
+    foreign = os.path.join(str(tmp_path), ".staging-step_00000009-99999")
+    mine = os.path.join(str(tmp_path),
+                        f".staging-step_00000008-{os.getpid()}")
+    os.makedirs(foreign)
+    os.makedirs(mine)
+    assert cm.gc_staging(str(tmp_path)) == 1
+    assert not os.path.isdir(foreign)
+    assert os.path.isdir(mine)
+
+
+# -- save/resume state round-trip ---------------------------------------------
+
+def test_resume_restores_model_optimizer_rng(tmp_path):
+    paddle.seed(3)
+    net = nn.Linear(8, 4)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    for _ in range(3):
+        net(paddle.randn([4, 8])).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, optimizer=opt)
+    ck.save_async(step=2, epoch=1, extra={"note": "hi"}, sync=True)
+    ck.close()
+    saved_w = _params(net)
+    from paddle_tpu.framework import random as random_mod
+
+    saved_rng = random_mod.get_rng_state()
+    saved_acc = {k: np.asarray(v).copy()
+                 for k, v in opt._accumulators[id(opt._parameter_list[0])]
+                 .items()}
+
+    paddle.seed(99)  # scramble everything the resume must restore
+    net2 = nn.Linear(8, 4)
+    opt2 = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                  parameters=net2.parameters())
+    meta = rz.resume(str(tmp_path), model=net2, optimizer=opt2)
+    assert meta["step"] == 2 and meta["epoch"] == 1
+    assert meta["extra"]["note"] == "hi"
+    for k, v in _params(net2).items():
+        np.testing.assert_array_equal(v, saved_w[k])
+    assert opt2._global_step == opt._global_step
+    acc2 = opt2._accumulators[id(opt2._parameter_list[0])]
+    for k, v in saved_acc.items():
+        np.testing.assert_array_equal(np.asarray(acc2[k]), v)
+    assert random_mod.get_rng_state() == saved_rng
+
+
+def test_resume_onto_different_sharding(tmp_path):
+    """The changed-device-count path: save with weights sharded sdp=8,
+    resume into a replicated target — same manifest reassembly as a
+    different device count (ci.sh proves the cross-process version)."""
+    import jax
+
+    paddle.seed(4)
+    env1 = dist.init_mesh(sharding=8)
+    net = nn.Linear(16, 8)
+    net.weight.data = jax.device_put(net.weight.data,
+                                     env1.sharding_for(P("sdp", None)))
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net)
+    ck.save_async(step=0, sync=True)
+    ck.close()
+    ref = _params(net)
+    dist.reset_mesh()
+
+    paddle.seed(5)
+    net2 = nn.Linear(16, 8)  # replicated single-device layout
+    meta = rz.resume(str(tmp_path), model=net2)
+    assert meta is not None and meta["devices"] == 8
+    for k, v in _params(net2).items():
+        np.testing.assert_array_equal(v, ref[k])
+
+
+def test_resume_empty_root_returns_none(tmp_path):
+    assert rz.resume(str(tmp_path), model=nn.Linear(2, 2)) is None
+    assert rz.latest_checkpoint(str(tmp_path)) is None
+
+
+def test_backpressure_single_save_in_flight(tmp_path):
+    net = nn.Linear(64, 64)
+    ck = rz.AsyncCheckpointer(str(tmp_path), model=net, keep=4)
+    h0 = ck.save_async(step=0)
+    h1 = ck.save_async(step=1)  # must first wait out save 0
+    ck.wait()
+    assert h0.done() and h1.done() and h1.error is None
+    ck.close()
+    assert cm.list_checkpoints(str(tmp_path)) == ["step_00000000",
+                                                  "step_00000001"]
+
+
+# -- fault injector + retry ---------------------------------------------------
+
+def test_injector_env_spec_matching_and_times():
+    inj = FaultInjector()
+    _parse_env("transfer@seq=3&times=2,slow_transfer@seq=1&ms=5,"
+               "nan_step@step=7", inj)
+    assert inj.check("transfer", seq=1) is None  # no match, no fire
+    with pytest.raises(rz.InjectedFault):
+        inj.check("transfer", seq=3)
+    with pytest.raises(rz.InjectedFault):
+        inj.check("transfer", seq=3)
+    inj.check("transfer", seq=3)  # times=2 exhausted: no-op now
+    assert inj.fired("transfer") == 2
+    t0 = time.perf_counter()
+    inj.check("slow_transfer", seq=1)  # sleeps, does not raise
+    assert (time.perf_counter() - t0) >= 0.004
+    assert not inj.peek("nan_step", step=6)
+    assert inj.peek("nan_step", step=7)
+    assert not inj.peek("nan_step", step=7)  # consumed
+
+
+def test_injector_malformed_env_rule_skipped():
+    inj = FaultInjector()
+    with pytest.warns(UserWarning, match="malformed"):
+        _parse_env("transfer@times=notanint,ok_kind@x=1", inj)
+    with pytest.raises(rz.InjectedFault):
+        inj.check("ok_kind", x=1)  # the well-formed rule still armed
+
+
+def test_with_retries_bounded_backoff():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise rz.InjectedFault("transfer", {}, transient=True)
+        return "ok"
+
+    r0 = rm.get("retries")
+    assert rz.with_retries(flaky, retries=2, backoff_ms=1) == "ok"
+    assert calls["n"] == 3
+    assert rm.get("retries") == r0 + 2
+    # a non-transient error is never retried
+    calls["n"] = 0
+
+    def hard():
+        calls["n"] += 1
+        raise ValueError("programming error")
+
+    with pytest.raises(ValueError):
+        rz.with_retries(hard, retries=5, backoff_ms=1)
+    assert calls["n"] == 1
+
+
+def test_stream_lane_retries_transient_transfer(monkeypatch):
+    import jax
+    from paddle_tpu.jit.offload_stream import StreamLane
+
+    monkeypatch.setenv("PT_TRANSFER_RETRIES", "2")
+    monkeypatch.setenv("PT_TRANSFER_BACKOFF_MS", "1")
+    lane = StreamLane(overlap=True)
+    arrs = [np.ones((4, 4), np.float32)]
+    dev = jax.devices()[0]
+    with rz.inject("transfer", times=1):  # one failure, then clean
+        h = lane.submit("h2d", arrs, dev, tag="g0", names=("w",))
+        out = h.wait()
+    assert np.asarray(out[0]).sum() == 16
+    assert lane.stats()["retries"] >= 1
+    lane.close()
+
+
+def test_stream_lane_failure_named_and_sticky(monkeypatch):
+    import jax
+    from paddle_tpu.jit.offload_stream import StreamLane, StreamTransferError
+
+    monkeypatch.setenv("PT_TRANSFER_RETRIES", "0")
+    lane = StreamLane(overlap=True)
+    dev = jax.devices()[0]
+    with rz.inject("transfer", times=-1):
+        h = lane.submit("h2d", [np.ones(3, np.float32)], dev,
+                        tag="layer7", names=("w7", "b7"))
+        with pytest.raises(StreamTransferError) as ei:
+            h.wait()
+        msg = str(ei.value)
+        assert "layer7" in msg and "w7" in msg and "kind=h2d" in msg
+        assert isinstance(ei.value.__cause__, rz.InjectedFault)
+        with pytest.raises(StreamTransferError):
+            h.wait()  # raises on EVERY subsequent call, not only the first
+        with pytest.raises(StreamTransferError):
+            lane.submit("h2d", [np.ones(3, np.float32)], dev)  # poisoned
+    lane.close()
+
+
+# -- NaN-step skip ------------------------------------------------------------
+
+def _toy_fit_model(lr=0.01):
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=lr,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    return model
+
+
+class _ToyDataset(paddle.io.Dataset):
+    def __init__(self, n=32):
+        rng = np.random.default_rng(0)
+        self.x = rng.standard_normal((n, 8)).astype("float32")
+        w = rng.standard_normal((8,)).astype("float32")
+        self.y = (self.x @ w > 0).astype("int64")
+
+    def __getitem__(self, i):
+        return self.x[i], self.y[i]
+
+    def __len__(self):
+        return len(self.x)
+
+
+def test_nan_inf_skip_action_raises_nan_step_skipped():
+    from paddle_tpu.core.tensor import NanStepSkipped, _check_nan_inf
+
+    paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+    try:
+        bad = paddle.to_tensor(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(NanStepSkipped):
+            _check_nan_inf("toy_op", [bad.data])
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "raise"})
+
+
+def test_fit_skips_injected_nan_step_and_continues():
+    """nan_step fault under action='skip': the poisoned step is dropped
+    whole (no update), counted, and the epoch finishes."""
+    paddle.seed(7)
+    model = _toy_fit_model()
+    ds = _ToyDataset(32)
+    paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+    skipped0 = rm.get("skipped_steps")
+    try:
+        with rz.inject("nan_step", step=1), warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            model.fit(ds, epochs=1, batch_size=8, shuffle=False, verbose=0)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "raise"})
+    assert rm.get("skipped_steps") == skipped0 + 1
+
+
+# -- preemption-safe fit + resume --------------------------------------------
+
+class _PreemptAt(paddle.callbacks.Callback):
+    """Raise the preemption flag after global step N — in-process twin of
+    the SIGTERM the ci.sh gate delivers to a real subprocess."""
+
+    def __init__(self, at):
+        self.at = at
+        self.seen = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.seen == self.at:
+            rz.request_preemption()
+        self.seen += 1
+
+
+@pytest.mark.slow
+def test_fit_preempt_commit_resume_bit_equal(tmp_path):
+    """The kill-and-resume acceptance, in-process: preempt mid-epoch,
+    final sync commit, resume=True replays the remaining batches — final
+    weights BIT-equal to the uninterrupted run, >=1 preemption committed,
+    0 torn checkpoints."""
+    ds = _ToyDataset(48)
+    fit_kw = dict(epochs=1, batch_size=8, shuffle=False, verbose=0)
+
+    paddle.seed(11)
+    ref = _toy_fit_model()
+    ref.fit(ds, **fit_kw)
+    ref_w = _params(ref.network)
+
+    root = str(tmp_path / "ck")
+    pre0, torn0 = rm.get("preemptions"), rm.get("torn_checkpoints")
+    paddle.seed(11)
+    m2 = _toy_fit_model()
+    m2.fit(ds, callbacks=[_PreemptAt(2)], checkpoint_every=2,
+           checkpoint_dir=root, **fit_kw)
+    assert rm.get("preemptions") == pre0 + 1
+    meta = cm.load_manifest(os.path.join(root, cm.read_latest(root)))["meta"]
+    assert meta["reason"] == "preempt" and meta["step"] == 2
+    interrupted_w = _params(m2.network)
+
+    # fresh model+optimizer (a relaunch), resume from the committed step
+    rz.clear_preemption()
+    paddle.seed(99)
+    m3 = _toy_fit_model()
+    m3.fit(ds, resume=True, checkpoint_every=2, checkpoint_dir=root,
+           **fit_kw)
+    final_w = _params(m3.network)
+    assert any(not np.array_equal(interrupted_w[k], ref_w[k])
+               for k in ref_w), "preemption did not actually cut the run"
+    for k in ref_w:
+        np.testing.assert_array_equal(final_w[k], ref_w[k])
+    assert rm.get("torn_checkpoints") == torn0
+
+
+@pytest.mark.slow
+def test_fit_preempt_resume_bit_equal_shuffled(tmp_path):
+    """Resume with shuffle=True: the resumed epoch redraws the ORIGINAL
+    epoch's permutation (saves carry the epoch-begin rng state), so the
+    stitched run is still bit-equal — not a run over duplicate/missed
+    batches from a fresh permutation."""
+    ds = _ToyDataset(48)
+    fit_kw = dict(epochs=1, batch_size=8, shuffle=True, verbose=0)
+
+    paddle.seed(21)
+    ref = _toy_fit_model()
+    ref.fit(ds, **fit_kw)
+    ref_w = _params(ref.network)
+
+    root = str(tmp_path / "ck")
+    paddle.seed(21)
+    m2 = _toy_fit_model()
+    m2.fit(ds, callbacks=[_PreemptAt(2)], checkpoint_every=2,
+           checkpoint_dir=root, **fit_kw)
+
+    rz.clear_preemption()
+    paddle.seed(99)  # a relaunch: different init rng, state comes from disk
+    m3 = _toy_fit_model()
+    m3.fit(ds, resume=True, checkpoint_every=2, checkpoint_dir=root,
+           **fit_kw)
+    final_w = _params(m3.network)
+    for k in ref_w:
+        np.testing.assert_array_equal(final_w[k], ref_w[k])
+
+
+def test_preemption_flag_consumed_by_fit(tmp_path):
+    """fit consumes the preemption it commits: a LATER fit in the same
+    process runs to completion instead of stopping after its first step."""
+    ds = _ToyDataset(32)
+    paddle.seed(3)
+    m = _toy_fit_model()
+    m.fit(ds, callbacks=[_PreemptAt(1)], checkpoint_every=2,
+          checkpoint_dir=str(tmp_path / "a"), epochs=1, batch_size=8,
+          shuffle=False, verbose=0)
+    assert not rz.preempted()  # consumed by the preempt commit
+    root2 = str(tmp_path / "b")
+    m2 = _toy_fit_model()
+    m2.fit(ds, checkpoint_every=2, checkpoint_dir=root2, epochs=1,
+           batch_size=8, shuffle=False, verbose=0)
+    meta = cm.load_manifest(os.path.join(root2, cm.read_latest(root2)))["meta"]
+    assert meta.get("reason") != "preempt"
+    assert meta["step"] == 3  # all 4 steps ran; last periodic save at gs=3
+
+
+def test_nan_skip_drops_whole_accumulation_window():
+    """A NaN-skip mid-accumulation-window drops the WINDOW: no optimizer
+    update is built from the partial, mis-scaled remainder. Bit-equal to
+    training on the unpoisoned window only."""
+    ds = _ToyDataset(32)  # 4 steps of 8 -> two accumulate(2) windows
+
+    class _Tail(paddle.io.Dataset):  # window 2's batches only
+        def __getitem__(self, i):
+            return ds[16 + i]
+
+        def __len__(self):
+            return 16
+
+    tail = _Tail()
+    paddle.set_flags({"FLAGS_check_nan_inf_action": "skip"})
+    try:
+        paddle.seed(5)
+        poisoned = _toy_fit_model()
+        with rz.inject("nan_step", step=0), warnings.catch_warnings():
+            warnings.simplefilter("ignore", RuntimeWarning)
+            poisoned.fit(ds, epochs=1, batch_size=8, shuffle=False,
+                         verbose=0, accumulate_grad_batches=2)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf_action": "raise"})
+    paddle.seed(5)
+    ref = _toy_fit_model()
+    ref.fit(tail, epochs=1, batch_size=8, shuffle=False, verbose=0,
+            accumulate_grad_batches=2)
+    pw, rw = _params(poisoned.network), _params(ref.network)
+    for k in rw:
+        np.testing.assert_array_equal(pw[k], rw[k])
+
+
+def test_preemption_handler_install_flag_clear():
+    import signal
+
+    assert rz.install_preemption_handler()
+    assert not rz.preempted()
+    os.kill(os.getpid(), signal.SIGTERM)  # handled: sets the flag only
+    t0 = time.monotonic()
+    while not rz.preempted() and time.monotonic() - t0 < 5:
+        time.sleep(0.01)
+    assert rz.preempted()
+    rz.clear_preemption()
+    assert not rz.preempted()
+    rz.uninstall_preemption_handler()
+
+
+# -- plain distributed.checkpoint satellite -----------------------------------
+
+def test_save_state_dict_atomic_with_checksums(tmp_path):
+    from paddle_tpu.distributed.checkpoint import CheckpointCorrupt
+
+    paddle.seed(6)
+    path = os.path.join(str(tmp_path), "ck")
+    net = nn.Linear(4, 4)
+    dist.save_state_dict(net.state_dict(), path)
+    import json
+
+    mani = json.load(open(os.path.join(path, "manifest.r0.json")))
+    assert mani["format"] == 2
+    for entry in mani["entries"].values():
+        assert all(sh.get("sha256") for sh in entry["shards"])
+    # no tmp leftovers: every file landed via os.replace
+    assert not [f for f in os.listdir(path) if ".tmp-" in f]
+    # torn shard detected at load...
+    victim = next(f for f in sorted(os.listdir(path)) if f.endswith(".npy"))
+    with open(os.path.join(path, victim), "r+b") as f:
+        f.seek(-4, os.SEEK_END)  # flip DATA bytes (header must stay valid)
+        f.write(b"\x5a\x5a\x5a\x5a")
+    net2 = nn.Linear(4, 4)
+    with pytest.raises(CheckpointCorrupt):
+        dist.load_state_dict(net2.state_dict(), path)
+    # ...and verify=False remains the escape hatch
+    dist.load_state_dict(net2.state_dict(), path, verify=False)
+
+
+# -- lint + observability -----------------------------------------------------
+
+def test_checkpoint_story_lint(tmp_path):
+    from paddle_tpu import analysis
+
+    class _OffloadStep:
+        offload = True
+
+    class _ResidentStep:
+        offload = False
+
+    (d,) = analysis.checkpoint_story_check(_OffloadStep())
+    assert d.code == "RS002" and d.severity == "warning"
+    (d,) = analysis.checkpoint_story_check(_ResidentStep())
+    assert d.code == "RS003" and d.severity == "info"
+    step = _OffloadStep()
+    rz.AsyncCheckpointer(str(tmp_path)).attach(step)
+    (d,) = analysis.checkpoint_story_check(step)
+    assert d.code == "RS001" and d.severity == "info"
+
+
+def test_resilience_family_in_observability_snapshot(tmp_path):
+    from paddle_tpu import observability as obs
+
+    net = nn.Linear(2, 2)
+    with rz.AsyncCheckpointer(str(tmp_path), model=net) as ck:
+        ck.save_async(step=0, sync=True)
+    snap = obs.snapshot()
+    vals = snap["resilience"]["values"]
+    assert vals["saves"] >= 1
+    assert vals["hidden_save_ms"] + vals["save_stall_ms"] > 0
+    assert rm.get("saves") >= 1

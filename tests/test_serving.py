@@ -366,3 +366,66 @@ def test_generation_bad_prompt_isolated(gen_engine):
     out = good.result(timeout=300)
     assert len(out) == 9 + 3
     assert out[9:].tolist() == [(9 + i) % 8 for i in range(len(out) - 9)]
+
+
+# -- chaos (ISSUE-6 fault-injection harness against the engines) --------------
+
+def test_serving_queue_drains_after_repeated_batch_faults():
+    """Repeated injected batch faults: every faulted batch fails ONLY its
+    own futures, later traffic still serves, and the queue depth drains to
+    zero — no leaked futures, no dead worker."""
+    from paddle_tpu.distributed.resilience.faults import InjectedFault, injector
+
+    eng = _slow_engine(delay_s=0.0, max_batch_wait_ms=0.0)
+    eng.start()
+    x = np.zeros(4, np.float32)
+    inj = injector()
+    # batches 0, 2 and 4 die; everything else executes
+    rules = [inj.arm("batch_fault", engine=eng.name, batch=b)
+             for b in (0, 2, 4)]
+    try:
+        futs = [eng.submit([x]) for _ in range(16)]
+        done = fwait(futs, timeout=60)
+        assert not done.not_done, "leaked futures after injected faults"
+        failed = [f for f in futs if f.exception() is not None]
+        ok = [f for f in futs if f.exception() is None]
+        assert failed and ok, (len(failed), len(ok))
+        for f in failed:
+            assert isinstance(f.exception(), InjectedFault)
+        t0 = time.monotonic()
+        while eng.queue_depth() > 0 and time.monotonic() - t0 < 10:
+            time.sleep(0.005)
+        assert eng.queue_depth() == 0
+        assert eng.metrics.counter("batch_failures") == 3
+        # the engine still serves after the chaos
+        eng.submit([x]).result(timeout=30)
+    finally:
+        for r in rules:
+            inj.disarm(r)
+        eng.close()
+
+
+@pytest.mark.slow  # shared decode executable: run in full by tools/ci.sh's serving gate
+def test_generation_decode_fault_releases_slots(gen_engine):
+    """A decode-batch fault mid-flight fails exactly the in-flight
+    requests, releases their slots, and the next prompt decodes clean."""
+    from paddle_tpu.distributed.resilience.faults import InjectedFault, injector
+
+    eng, _model, pattern = gen_engine
+    inj = injector()
+    rule = inj.arm("decode_fault", engine=eng.name)  # next decode step dies
+    try:
+        doomed = [eng.submit(pattern[:9].astype("int64"), max_new_tokens=4),
+                  eng.submit(pattern[:11].astype("int64"), max_new_tokens=4)]
+        for f in doomed:
+            with pytest.raises(InjectedFault):
+                f.result(timeout=300)
+    finally:
+        inj.disarm(rule)
+    t0 = time.monotonic()
+    while eng.stats()["active_slots"] and time.monotonic() - t0 < 30:
+        time.sleep(0.01)
+    assert eng.stats()["active_slots"] == 0  # slots released, not leaked
+    out = eng.submit(pattern[:9].astype("int64"),
+                     max_new_tokens=3).result(timeout=300)
+    assert out[9:].tolist() == [(9 + i) % 8 for i in range(len(out) - 9)]

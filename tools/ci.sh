@@ -59,9 +59,12 @@ assert pc["warm_fresh_xla_compiles"] == 0, pc
 sc = last["detail"]["stream_capacity"]
 assert sc["overlap_efficiency"] > 0, sc       # transfers actually hidden
 assert sc["losses_bit_equal"] is True, sc     # hiding changed no bits
+cs = last["detail"]["checkpoint_stall"]       # ISSUE-6 acceptance: async
+assert cs["stall_ratio"] is not None, cs      # save stall < 25% of the
+assert cs["stall_ratio"] < 0.25, cs           # synchronous save time
 print("perf gate OK:", {k: last["detail"][k]
                         for k in ("warm_path", "persistent_cache",
-                                  "stream_capacity")})
+                                  "stream_capacity", "checkpoint_stall")})
 PY
 
 echo "== observability gate (telemetry snapshot from the bench smoke) =="
@@ -85,6 +88,16 @@ print("observability gate OK:", {"steps": tl["steps"],
                                  "phases": sorted(tl["phases"]),
                                  "overhead_us": probe})
 PY
+
+echo "== resilience gate (commit protocol + kill-and-resume drill) =="
+# the full resilience file (crash-mid-save injection, torn-checkpoint
+# detection, in-process preempt/resume), then the cross-process half:
+# a REAL kill -TERM of a training subprocess mid-run, resumed on a
+# CHANGED XLA device count — stitched losses must match the
+# uninterrupted run (the ISSUE-6 kill-and-resume acceptance)
+JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+python tools/resilience_drill.py || exit 1
 
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log

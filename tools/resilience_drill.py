@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Kill-and-resume drill for tools/ci.sh's resilience gate (ISSUE-6).
+
+Orchestrates three subprocesses of the SAME deterministic ``Model.fit``:
+
+  ref      the uninterrupted run                          (2 XLA devices)
+  victim   ``checkpoint_every=2``, delivered a real
+           ``SIGTERM`` by THIS process once >=1 async
+           commit has landed on disk                      (2 XLA devices)
+  resume   ``fit(resume=True)`` from the committed
+           checkpoint, on a CHANGED device count          (4 XLA devices)
+
+and asserts the ISSUE-6 acceptance: the victim exits 0 after a final
+preempt-reason commit (>=1 ``preemptions`` counted, 0 torn checkpoints),
+and victim+resume per-step losses concatenate to the uninterrupted run's
+loss sequence (allclose) despite the device-count change.
+
+The in-process halves (commit atomicity, crash-mid-save injection,
+re-sharding) live in tests/test_resilience.py; this drill is the
+cross-process SIGTERM half that a pytest process cannot deliver to itself
+without also killing the test runner.
+"""
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+EPOCHS = 2
+BATCH = 8
+N_SAMPLES = 128  # 16 steps/epoch, 32 total
+SEED = 11
+VICTIM_STEP_SLEEP_S = 0.12  # widen the SIGTERM window; math is unchanged
+
+
+def _run_child(mode: str, ckpt: str, out: str) -> None:
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.resilience import metrics as rm
+
+    class ToyDataset(paddle.io.Dataset):
+        def __init__(self, n):
+            rng = np.random.default_rng(0)
+            self.x = rng.standard_normal((n, 8)).astype("float32")
+            w = rng.standard_normal((8,)).astype("float32")
+            self.y = (self.x @ w > 0).astype("int64")
+
+        def __getitem__(self, i):
+            return self.x[i], self.y[i]
+
+        def __len__(self):
+            return len(self.x)
+
+    losses = []
+
+    class Recorder(paddle.callbacks.Callback):
+        def on_train_batch_end(self, step, logs=None):
+            losses.append(float(np.asarray(logs["loss"])))
+            if mode == "victim":
+                time.sleep(VICTIM_STEP_SLEEP_S)
+
+    # resume gets a DIFFERENT seed: its fresh weights/optimizer must be
+    # fully overwritten by the restore for the loss tail to line up
+    paddle.seed(SEED if mode != "resume" else 99)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+    model = paddle.Model(net)
+    model.prepare(
+        optimizer=paddle.optimizer.Adam(learning_rate=0.01,
+                                        parameters=net.parameters()),
+        loss=nn.CrossEntropyLoss())
+    fit_kw = dict(epochs=EPOCHS, batch_size=BATCH, shuffle=False, verbose=0,
+                  callbacks=[Recorder()])
+    if mode != "ref":
+        fit_kw.update(checkpoint_every=2, checkpoint_dir=ckpt,
+                      resume=(mode == "resume"))
+    model.fit(ToyDataset(N_SAMPLES), **fit_kw)
+
+    record = {"mode": mode, "devices": len(__import__("jax").devices()),
+              "losses": losses,
+              "preemptions": rm.get("preemptions"),
+              "torn_checkpoints": rm.get("torn_checkpoints"),
+              "saves": rm.get("saves"), "restores": rm.get("restores")}
+    with open(out, "w") as f:
+        json.dump(record, f)
+
+
+def _spawn(mode: str, ckpt: str, out: str, devices: int) -> subprocess.Popen:
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (f"--xla_force_host_platform_device_count={devices} "
+                        + env.get("XLA_FLAGS", "")).strip()
+    return subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--child", mode,
+         "--ckpt", ckpt, "--out", out],
+        env=env, cwd=root)
+
+
+def _read(out: str) -> dict:
+    with open(out) as f:
+        return json.load(f)
+
+
+def main() -> int:
+    import numpy as np
+
+    work = tempfile.mkdtemp(prefix="pt_resilience_drill_")
+    ckpt = os.path.join(work, "ckpt")
+    outs = {m: os.path.join(work, f"{m}.json") for m in
+            ("ref", "victim", "resume")}
+
+    print("[drill] ref run (uninterrupted, 2 devices)")
+    assert _spawn("ref", ckpt, outs["ref"], devices=2).wait() == 0, \
+        "ref run failed"
+
+    print("[drill] victim run (checkpoint_every=2, 2 devices) ...")
+    victim = _spawn("victim", ckpt, outs["victim"], devices=2)
+    latest = os.path.join(ckpt, "LATEST")
+    t0 = time.time()
+    while not os.path.exists(latest):
+        if victim.poll() is not None:
+            print("[drill] FAIL: victim finished before any commit "
+                  f"(rc={victim.returncode})")
+            return 1
+        if time.time() - t0 > 120:
+            victim.kill()
+            print("[drill] FAIL: no committed checkpoint within 120s")
+            return 1
+        time.sleep(0.05)
+    print(f"[drill] first commit landed after {time.time() - t0:.1f}s "
+          "-> kill -TERM")
+    victim.send_signal(signal.SIGTERM)
+    rc = victim.wait(timeout=120)
+    assert rc == 0, f"victim did not exit cleanly after SIGTERM (rc={rc})"
+
+    ref, vic = _read(outs["ref"]), _read(outs["victim"])
+    assert vic["preemptions"] >= 1, vic
+    assert vic["torn_checkpoints"] == 0, vic
+    assert 0 < len(vic["losses"]) < len(ref["losses"]), \
+        f"SIGTERM did not cut the run mid-flight: {len(vic['losses'])} " \
+        f"of {len(ref['losses'])} steps"
+    # commit-protocol layout, read directly (the parent process does not
+    # import jax): LATEST names the tag, tag/manifest.json carries meta
+    with open(os.path.join(ckpt, "LATEST")) as f:
+        tag = json.load(f)["tag"]
+    with open(os.path.join(ckpt, tag, "manifest.json")) as f:
+        meta = json.load(f)["meta"]
+    assert meta["reason"] == "preempt", meta
+    assert meta["step"] == len(vic["losses"]) - 1, \
+        f"commit step {meta['step']} != last trained step " \
+        f"{len(vic['losses']) - 1}"
+
+    print("[drill] resume run (resume=True, CHANGED device count: 4)")
+    assert _spawn("resume", ckpt, outs["resume"], devices=4).wait() == 0, \
+        "resume run failed"
+    res = _read(outs["resume"])
+    assert res["devices"] == 4 and vic["devices"] == 2, (vic, res)
+    assert res["restores"] >= 1, res
+    assert res["torn_checkpoints"] == 0, res
+
+    stitched = vic["losses"] + res["losses"]
+    assert len(stitched) == len(ref["losses"]), \
+        f"step count mismatch: {len(vic['losses'])}+{len(res['losses'])} " \
+        f"!= {len(ref['losses'])}"
+    np.testing.assert_allclose(stitched, ref["losses"], rtol=1e-6, atol=1e-8,
+                               err_msg="resumed loss tail diverged from the "
+                                       "uninterrupted run")
+    bit_equal = stitched == ref["losses"]
+    print(json.dumps({
+        "resilience_drill": "OK", "steps": len(ref["losses"]),
+        "victim_steps": len(vic["losses"]), "resume_steps": len(res["losses"]),
+        "preempt_commit_step": meta["step"], "preemptions": vic["preemptions"],
+        "torn_checkpoints": 0, "devices": [vic["devices"], res["devices"]],
+        "losses_bit_equal": bool(bit_equal),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", choices=("ref", "victim", "resume"))
+    ap.add_argument("--ckpt")
+    ap.add_argument("--out")
+    args = ap.parse_args()
+    if args.child:
+        _run_child(args.child, args.ckpt, args.out)
+        sys.exit(0)
+    sys.exit(main())
