@@ -94,52 +94,32 @@ def _measure(cfg, batch, seq, iters, optimizer_cls=None,
 
 
 def _device_op_table(step, args, top=12):
-    """Real TPU timeline for ONE compiled step via jax.profiler (XPlane →
-    chrome trace): top fused-op spans grouped by name, plus the scan
-    (while) totals — the evidence behind the README MFU budget. Works
-    through the axon tunnel (device events land in the trace)."""
-    import glob
-    import gzip
-    import tempfile
+    """Real device timeline for ONE compiled step via the observability
+    XPlane ingestion (``trace.capture_steps``): top device-attributed op
+    spans + correlated step/device time — the evidence behind the README
+    MFU budget, the same parser ``snapshot()['device_trace']`` feeds.
+    Works on CPU (hlo events on the executor threads) and TPU (device
+    pids), through the axon tunnel included."""
+    from paddle_tpu.observability import trace as otrace
 
-    import jax
-
-    d = tempfile.mkdtemp(prefix="pt_prof_")
-    jax.profiler.start_trace(d)
-    loss = step(*args)
-    float(loss)
-    jax.profiler.stop_trace()
-    files = glob.glob(os.path.join(d, "plugins/profile/*/*.trace.json.gz"))
-    if not files:
-        raise RuntimeError("no trace produced")
-    with gzip.open(files[0]) as fh:
-        tr = json.load(fh)
-    events = [e for e in tr["traceEvents"] if e.get("ph") == "X"]
-    pids = {e["pid"]: e["args"].get("name", "")
-            for e in tr["traceEvents"]
-            if e.get("ph") == "M" and e.get("name") == "process_name"}
-    dev = [e for e in events if "TPU" in pids.get(e.get("pid"), "")]
-    agg, cnt = {}, {}
-    whiles = {}
-    step_us = 0.0
-    for e in dev:
-        n = e["name"]
-        if "jit_" in n or n.isdigit():  # whole-module / program group spans
-            step_us = max(step_us, e["dur"])
-            continue
-        if n.startswith("while."):
-            whiles[n] = whiles.get(n, 0.0) + e["dur"]
-            continue
-        agg[n] = agg.get(n, 0.0) + e["dur"]
-        cnt[n] = cnt.get(n, 0) + 1
-    rows = sorted(agg.items(), key=lambda kv: -kv[1])[:top]
+    with otrace.capture_steps() as cap:
+        loss = step(*args)
+        float(loss)
+    if cap.error:
+        raise RuntimeError(cap.error)
+    cor = cap.result
+    dev = cor.summary()["device_compute_us"]
+    rows = cor.op_table
     return {
-        "step_ms": round(step_us / 1e3, 1),
-        "scans_ms": {k: round(v / 1e3, 1)
-                     for k, v in sorted(whiles.items(),
-                                        key=lambda kv: -kv[1])},
-        "top_ops": [{"op": n, "calls": cnt[n], "total_ms": round(us / 1e3, 2)}
-                    for n, us in rows],
+        "step_ms": round(dev["per_step_avg"] / 1e3, 2),
+        "steps_correlated": cor.steps_correlated,
+        "overlap_efficiency": cor.overlap_efficiency(),
+        "scans_ms": {r["op"]: round(r["total_us"] / 1e3, 1)
+                     for r in rows if str(r["op"]).startswith("while")},
+        "top_ops": [{"op": r["op"], "calls": r["calls"],
+                     "total_ms": round(r["total_us"] / 1e3, 2)}
+                    for r in rows if not str(r["op"]).startswith("while")
+                    ][:top],
     }
 
 
@@ -725,7 +705,27 @@ def _measure_warm_path(cfg, batch, seq, iters=4, accum=4):
     float(loss)
     per_win = (time.perf_counter() - t0) / iters
     fused_dt = per_win / accum
+    # XPlane probe: two traced plain steps so this recipe's telemetry dump
+    # carries the device_trace digest (top-k device op table, correlated
+    # step device time) — ISSUE-7's "bench telemetry gains the op table"
+    device_row = None
+    try:
+        from paddle_tpu.observability import trace as otrace
+
+        with otrace.capture_steps() as cap:
+            for _ in range(2):
+                float(step(ids, ids))
+        if cap.result is not None and cap.result.op_table:
+            s = cap.result.summary(top=4)
+            device_row = {
+                "steps_correlated": s["steps_correlated"],
+                "device_us_avg": s["device_compute_us"]["per_step_avg"],
+                "top_op": s["op_table"][0]["op"],
+            }
+    except Exception:
+        pass  # device tracing must never sink the bench
     return {
+        "device_trace": device_row,
         "plain_step_time_s": round(plain_dt, 4),
         "prefetch_accum_step_time_s": round(fused_dt, 4),
         "accumulate_steps": accum,

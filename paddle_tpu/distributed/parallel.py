@@ -859,7 +859,7 @@ class ShardedTrainStep:
                     params, states, frozen_arrays, lr, step_no,
                     random_mod.next_key(), *arrays)
             if tl.detailed:
-                with tl.phase("device_compute"):
+                with tl.phase("device_block"):
                     jax.block_until_ready(loss)
             for p, a in zip(self.train_params, new_p):
                 p.data = a
@@ -1049,7 +1049,7 @@ class ShardedAccumulateStep:
                     params, states, frozen_arrays, lr, step_no,
                     random_mod.next_key(), *arrays)
             if tl.detailed:
-                with tl.phase("device_compute"):
+                with tl.phase("device_block"):
                     jax.block_until_ready(loss)
             for p, a in zip(self.train_params, new_p):
                 p.data = a

@@ -16,7 +16,7 @@ from typing import Optional
 from . import metrics
 
 __all__ = ["install_preemption_handler", "uninstall_preemption_handler",
-           "preempted", "clear_preemption", "Preempted"]
+           "preempted", "clear_preemption", "on_preemption", "Preempted"]
 
 
 class Preempted(RuntimeError):
@@ -27,11 +27,39 @@ class Preempted(RuntimeError):
 _FLAG = threading.Event()
 _PREV: dict = {}
 _LOCK = threading.Lock()
+_CALLBACKS: list = []
+
+
+def on_preemption(cb) -> None:
+    """Register a callback fired when the preemption flag is set (the
+    flight recorder's bundle dump rides the same signal the checkpoint
+    commit does). Callbacks run in the handler context — they must be
+    quick and must never raise (failures are swallowed)."""
+    with _LOCK:
+        if cb not in _CALLBACKS:
+            _CALLBACKS.append(cb)
+
+
+def off_preemption(cb) -> None:
+    with _LOCK:
+        if cb in _CALLBACKS:
+            _CALLBACKS.remove(cb)
+
+
+def _fire_callbacks() -> None:
+    with _LOCK:
+        cbs = list(_CALLBACKS)
+    for cb in cbs:
+        try:
+            cb()
+        except Exception:
+            pass
 
 
 def _handler(signum, frame):
     _FLAG.set()
     metrics.inc("preempt_signals")
+    _fire_callbacks()
 
 
 def install_preemption_handler(signals=(signal.SIGTERM,)) -> bool:
@@ -72,3 +100,4 @@ def request_preemption() -> None:
     SIGTERM handler sets."""
     _FLAG.set()
     metrics.inc("preempt_signals")
+    _fire_callbacks()

@@ -141,7 +141,9 @@ class Model:
             if update and self._optimizer is not None:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
-        with tl.phase("device_compute"):
+        # host BLOCKING on device results (loss/metric readback) — host
+        # time, not device time; XPlane correlation owns device_compute_us
+        with tl.phase("device_block"):
             metrics = []
             for m in self._metrics:
                 metric_outs = m.compute(*(to_list(outputs) + labels))
@@ -272,6 +274,16 @@ class Model:
         step-in-epoch, rng and optimizer state included) and fast-forwards
         the loader to the first unseen batch."""
         assert train_data is not None, "train_data must be given"
+        try:
+            # flight recorder: every trained step lands in the bounded
+            # ring; anomalies (regression/stall/fault burst), SIGQUIT and
+            # preemption auto-dump a pd_dump diagnostic bundle. Ring-append
+            # cost per step; must never block training.
+            from ..observability.trace import flight_recorder
+
+            flight_recorder()
+        except Exception:
+            pass
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
                                    drop_last=drop_last)
         eval_loader = self._make_loader(eval_data, batch_size, False, num_workers)

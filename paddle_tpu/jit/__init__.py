@@ -370,7 +370,7 @@ class TrainStep:
                     params, states, frozen_arrays, lr, step_no,
                     random_mod.next_key(), *arrays)
             if tl.detailed:
-                with tl.phase("device_compute"):
+                with tl.phase("device_block"):
                     jax.block_until_ready(loss)
             for p, a in zip(self.train_params, new_p):
                 p.data = a
@@ -495,7 +495,7 @@ class AccumulateStep:
                     params, states, frozen_arrays, lr, step_no,
                     random_mod.next_key(), *arrays)
             if tl.detailed:
-                with tl.phase("device_compute"):
+                with tl.phase("device_block"):
                     jax.block_until_ready(loss)
             for p, a in zip(self.train_params, new_p):
                 p.data = a

@@ -125,6 +125,20 @@ def plan_stream_groups(nbytes_list: Sequence[int],
     return groups
 
 
+def _flight_event(kind: str, **data) -> None:
+    """Land a lane event in the flight recorder's ring WHEN one exists
+    (never creates one — training runs without a recorder pay only an
+    attribute read). Telemetry must never mask the event it records."""
+    try:
+        from ..observability.trace import flight
+
+        rec = flight._RECORDER
+        if rec is not None:
+            rec.record_event(kind, **data)
+    except Exception:
+        pass
+
+
 class _TransferHandle:
     """One in-flight group transfer; ``wait()`` blocks the consumer and
     charges the blocked time to the lane's ``stall_ms``."""
@@ -280,11 +294,15 @@ class StreamLane:
                             self._stats["retries"] += 1
                         _lane_fam().inc(("retries",))
                         rmetrics.inc("retries")
+                        _flight_event("stream_retry", direction=kind, group=tag,
+                                      attempt=attempt)
                         time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1e3)
                         continue
                     err = StreamTransferError(kind, tag, names, e)
                     handle._box[1] = err  # surfaces at the consumer's wait()
                     self._failure = err   # ...and at every later interaction
+                    _flight_event("stream_error", direction=kind, group=tag,
+                                  error=str(e)[:120])
                     break
             ms = (time.perf_counter() - t0) * 1e3
             with self._lock:

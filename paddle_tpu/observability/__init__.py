@@ -28,8 +28,8 @@ from __future__ import annotations
 import os
 
 from .registry import (  # noqa: F401
-    CounterFamily, Hub, LatencyWindow, MetricsRegistry, family, gauge, hub,
-    register_provider, register_registry,
+    CounterFamily, Histogram, Hub, LatencyWindow, MetricsRegistry, family,
+    gauge, histogram, hub, register_provider, register_registry,
 )
 from .timeline import StepTimeline, timeline  # noqa: F401
 from .exposition import (  # noqa: F401
@@ -38,9 +38,9 @@ from .exposition import (  # noqa: F401
 )
 
 __all__ = [
-    "CounterFamily", "Hub", "LatencyWindow", "MetricsRegistry",
-    "StepTimeline", "family", "gauge", "hub", "register_provider",
-    "register_registry", "timeline",
+    "CounterFamily", "Histogram", "Hub", "LatencyWindow", "MetricsRegistry",
+    "StepTimeline", "family", "gauge", "histogram", "hub",
+    "register_provider", "register_registry", "timeline", "trace",
     "dump", "prometheus_text", "render_snapshot", "report", "serve",
     "snapshot", "stop_serving",
 ]
@@ -69,9 +69,23 @@ def _register_builtin_providers() -> None:
                 "tracked_keys": len(auditor._sigs) + len(auditor._attr_keys),
                 "by_label": by_label}
 
+    def _device_trace():
+        from .trace import device_trace_provider
+
+        return device_trace_provider()
+
+    def _request_trace():
+        from .trace import tracer
+
+        return tracer().snapshot()
+
     register_provider("persistent_cache", _persistent_cache)
     register_provider("retrace_events", _retrace_events)
     register_provider("step_timeline", lambda: timeline().summary())
+    # device-truth tracing (observability.trace): the last XPlane
+    # correlation digest + the request tracer's ring counters
+    register_provider("device_trace", _device_trace)
+    register_provider("request_trace", _request_trace)
     # counter families the wired call sites feed — created here so every
     # snapshot carries the full schema even before the first event
     family("trace_cache", ("site", "event"))
@@ -87,9 +101,20 @@ def _register_builtin_providers() -> None:
     # stalled save ms, transfer retries, skipped NaN steps, restores,
     # preemptions, torn checkpoints, injected faults
     family("resilience", ("metric",))
+    # flight recorder (observability.trace.flight): anomalies, dumps
+    family("flight_recorder", ("event",))
+    # native Prometheus histogram families (the external-scrape shapes):
+    # request latency + queue wait (fed by every MetricsRegistry) and
+    # per-step wall time (fed by StepTimeline) — created here so the
+    # exposition carries the schema before the first observation
+    histogram("request_latency_ms")
+    histogram("queue_wait_ms")
+    histogram("step_time_ms")
 
 
 _register_builtin_providers()
+
+from . import trace  # noqa: E402,F401  (device-truth tracing subpackage)
 
 # PT_METRICS_PORT: opt-in exposition endpoint at import ("" / unset = off)
 _port = os.environ.get("PT_METRICS_PORT", "").strip()

@@ -74,6 +74,19 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             lines.append(_offload_stream_table(body))
             lines.append("")
             continue
+        if fam == "device_trace" and isinstance(body, dict) \
+                and body.get("op_table"):
+            lines.append(_device_trace_table(body))
+            lines.append("")
+            continue
+        if fam == "registries" and isinstance(body, dict):
+            lines.append(_registries_table(body))
+            lines.append("")
+            continue
+        if isinstance(body, dict) and body.get("type") == "histogram":
+            lines.append(_histogram_table(body))
+            lines.append("")
+            continue
         rows: list = []
         _flat("", body, rows)
         for key, val in rows:
@@ -90,7 +103,13 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
 def _timeline_table(body: Dict[str, Any]) -> str:
     lines = [f"  steps={body.get('steps')}  "
              f"avg={body.get('step_total_ms', {}).get('avg')}ms  "
-             f"detailed={body.get('detailed')}"]
+             f"detailed={body.get('detailed')}  "
+             f"device_source={body.get('device_source')}"]
+    dev = body.get("device_compute_us")
+    if dev:
+        lines.append(
+            f"  device_compute (XPlane)   avg={dev.get('avg')}us  "
+            f"last={dev.get('last')}us  over {dev.get('count')} steps")
     phases = body.get("phases", {})
     for name in sorted(phases, key=lambda n: -phases[n].get("total_ms", 0)):
         row = phases[name]
@@ -121,6 +140,71 @@ def _offload_stream_table(body: Dict[str, Any]) -> str:
         lines.append(f"  {'hidden_ms':<24} {round(hidden, 3)}")
         lines.append(f"  {'overlap_efficiency':<24} {round(hidden / t, 4)}")
     return "\n".join(lines) if lines else "  (no transfers yet)"
+
+
+def _histogram_table(body: Dict[str, Any]) -> str:
+    """Compact one-per-bucket view: cumulative counts de-cumulated into a
+    sparkline-ish table."""
+    buckets = body.get("buckets", {})
+    lines = [f"  count={body.get('count')}  sum={body.get('sum')}  "
+             f"avg={body.get('avg')}"]
+    prev = 0
+    peak = max([v - p for v, p in zip(
+        buckets.values(), [0] + list(buckets.values())[:-1])] or [1]) or 1
+    for le, cum in buckets.items():
+        n = cum - prev
+        prev = cum
+        if n:
+            bar = "#" * max(1, round(10 * n / peak))
+            lines.append(f"  le={le:<10} {n:>8}  {bar}")
+    return "\n".join(lines)
+
+
+def _slot_bar(frac: float, width: int = 10) -> str:
+    filled = max(0, min(width, round(frac * width)))
+    return "#" * filled + "." * (width - filled)
+
+
+def _registries_table(body: Dict[str, Any]) -> str:
+    """Per-engine registry rows; a GenerationEngine's ``slot_occupancy``
+    gauge renders as a compact per-slot utilization bar (the pd_top
+    occupancy view)."""
+    lines = []
+    for name in sorted(body):
+        reg = body[name]
+        lines.append(f"  [{name}]")
+        if not isinstance(reg, dict):
+            lines.append(f"    {reg}")
+            continue
+        occ = reg.get("slot_occupancy")
+        rows: list = []
+        _flat("", {k: v for k, v in reg.items() if k != "slot_occupancy"},
+              rows)
+        for key, val in rows:
+            if isinstance(val, float):
+                val = round(val, 4)
+            lines.append(f"    {key:<42} {val}")
+        if isinstance(occ, dict) and occ.get("slots"):
+            frac = occ.get("busy_frac") or {}
+            parts = [f"{s}[{_slot_bar(float(frac.get(str(s), frac.get(s, 0.0)) or 0.0))}]"
+                     for s in range(int(occ["slots"]))]
+            lines.append(
+                f"    slots: {' '.join(parts)}  active "
+                f"{occ.get('active')}/{occ.get('slots')}  "
+                f"residencies={occ.get('residencies')}")
+    return "\n".join(lines) if lines else "  (none)"
+
+
+def _device_trace_table(body: Dict[str, Any]) -> str:
+    """Top-k device-attributed op table from the last XPlane correlation."""
+    lines = [f"  steps_correlated={body.get('steps_correlated')}  "
+             f"device_total_us={body.get('device_compute_us', {}).get('total')}  "
+             f"overlap_efficiency={body.get('overlap_efficiency')}"]
+    for row in (body.get("op_table") or [])[:12]:
+        lines.append(f"  {str(row.get('op'))[:36]:<38}"
+                     f"calls={row.get('calls'):>5}  "
+                     f"total={row.get('total_us')}us")
+    return "\n".join(lines)
 
 
 def report() -> str:
@@ -162,20 +246,30 @@ def _emit_tree(lines, base: str, obj, labels=None):
 def prometheus_text() -> str:
     """Text exposition (format 0.0.4) of the current snapshot. Counter
     families emit from their live label tuples (never re-split from the
-    display keys, so '|' inside a label value stays intact); provider
-    trees flatten numeric leaves."""
+    display keys, so '|' inside a label value stays intact); histograms
+    emit natively (``_bucket{le=...}``/``_sum``/``_count`` — the
+    aggregatable shape); provider trees flatten numeric leaves."""
     h = hub()
     families = h.families()
+    histograms = h.histograms()
     snap = h.snapshot()
     lines: list = []
     for fam in sorted(snap):
         name = _metric_name(fam)
         live = families.get(fam)
+        hist = histograms.get(fam)
         if live is not None:
             lines.append(f"# TYPE pt_{name}_total counter")
             for key, val in live.items():
                 labels = dict(zip(live.label_names, key)) if key else None
                 _emit_sample(lines, f"{name}_total", val, labels)
+        elif hist is not None:
+            lines.append(f"# TYPE pt_{name} histogram")
+            for le, cum in hist.items():
+                _emit_sample(lines, f"{name}_bucket", cum, {"le": str(le)})
+            hs = hist.snapshot()
+            _emit_sample(lines, f"{name}_sum", hs["sum"])
+            _emit_sample(lines, f"{name}_count", hs["count"])
         else:
             lines.append(f"# TYPE pt_{name} gauge")
             _emit_tree(lines, name, snap[fam])

@@ -9,6 +9,10 @@ emits, and each subsystem registers its island into it:
   is now a thin alias): per-engine QPS / latency windows / occupancy;
 - ``CounterFamily``: labeled monotonic counters (``nan_inf_events`` by
   (op, dtype), ``collectives`` by op, ``trace_cache`` by site/event);
+- ``Histogram``: fixed-bucket distributions with native Prometheus
+  histogram exposition (``request_latency_ms``, ``queue_wait_ms``,
+  ``step_time_ms`` — the external-scrape shapes percentile windows
+  cannot aggregate across processes);
 - providers: snapshot-time callables for state that already lives
   elsewhere (``jit.persistent_cache.stats()``, ``analysis.retrace``
   summaries, the ``StepTimeline``) — zero steady-state cost;
@@ -20,6 +24,7 @@ provider snapshots, exposition) happens at read time.
 """
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 import weakref
@@ -28,8 +33,8 @@ from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
-__all__ = ["LatencyWindow", "MetricsRegistry", "CounterFamily", "Hub",
-           "hub", "family", "gauge", "register_provider",
+__all__ = ["LatencyWindow", "MetricsRegistry", "CounterFamily", "Histogram",
+           "Hub", "hub", "family", "gauge", "histogram", "register_provider",
            "register_registry"]
 
 
@@ -86,6 +91,11 @@ class MetricsRegistry:
         self._done_ts: deque = deque()
         self._gauges: Dict[str, Callable[[], float]] = {}
         self._t0 = time.monotonic()
+        # process-wide histogram twins, resolved lazily ONCE (resolving
+        # through the hub per observation would put its global lock on
+        # every engine's completion path)
+        self._hist_latency: Optional["Histogram"] = None
+        self._hist_queue_wait: Optional["Histogram"] = None
 
     # -- writes ---------------------------------------------------------------
     def inc(self, name: str, n: int = 1) -> None:
@@ -95,10 +105,21 @@ class MetricsRegistry:
     def observe_latency(self, ms: float) -> None:
         with self._lock:
             self._latency.observe(ms)
+        # the process-wide histogram family rides along: monotonic bucket
+        # counts an external Prometheus stack can aggregate across engines
+        # and processes (the percentile window above cannot)
+        h = self._hist_latency
+        if h is None:
+            h = self._hist_latency = _HUB.histogram("request_latency_ms")
+        h.observe(ms)
 
     def observe_queue_wait(self, ms: float) -> None:
         with self._lock:
             self._queue_wait.observe(ms)
+        h = self._hist_queue_wait
+        if h is None:
+            h = self._hist_queue_wait = _HUB.histogram("queue_wait_ms")
+        h.observe(ms)
 
     def observe_occupancy(self, frac: float) -> None:
         with self._lock:
@@ -221,6 +242,76 @@ class CounterFamily:
             self._values.clear()
 
 
+# default latency-shaped bounds (ms): sub-ms serving hits through
+# multi-second cold compiles, 13 buckets + +Inf
+DEFAULT_BUCKETS_MS = (1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0, 250.0,
+                      500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class Histogram:
+    """Fixed-bucket distribution with native Prometheus histogram
+    exposition (``<name>_bucket{le=...}`` / ``_sum`` / ``_count``).
+
+    Unlike ``LatencyWindow`` (recent-window percentiles, honest but not
+    aggregatable), bucket counts are monotonic and mergeable across
+    processes — the shape an external scrape stack needs. ``observe`` is
+    one lock + one bisect + two adds.
+    """
+
+    def __init__(self, name: str, buckets: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.name = name
+        self.bounds: Tuple[float, ...] = tuple(sorted(float(b)
+                                                      for b in buckets))
+        if not self.bounds:
+            raise ValueError(f"histogram {name!r}: need at least one bucket")
+        self._lock = threading.Lock()
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
+        self._sum = 0.0
+        self._n = 0
+
+    def observe(self, v: float) -> None:
+        idx = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self._counts[idx] += 1
+            self._sum += v
+            self._n += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._n
+
+    def items(self):
+        """Cumulative (le, count) pairs ending with ("+Inf", total) — the
+        Prometheus exposition contract."""
+        with self._lock:
+            counts = list(self._counts)
+        out, cum = [], 0
+        for le, c in zip(self.bounds, counts):
+            cum += c
+            out.append((le, cum))
+        out.append(("+Inf", cum + counts[-1]))
+        return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            counts, s, n = list(self._counts), self._sum, self._n
+        cum, buckets = 0, {}
+        for le, c in zip(self.bounds, counts):
+            cum += c
+            buckets[str(le)] = cum
+        buckets["+Inf"] = cum + counts[-1]
+        return {"type": "histogram", "buckets": buckets,
+                "sum": round(s, 3), "count": n,
+                "avg": round(s / n, 3) if n else 0.0}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts = [0] * (len(self.bounds) + 1)
+            self._sum = 0.0
+            self._n = 0
+
+
 class Hub:
     """The process-wide telemetry hub: every family lives (or is reachable)
     here, and ``snapshot()`` is the one JSON of all of them."""
@@ -228,6 +319,7 @@ class Hub:
     def __init__(self):
         self._lock = threading.Lock()
         self._families: Dict[str, CounterFamily] = {}
+        self._histograms: Dict[str, Histogram] = {}
         self._providers: Dict[str, Callable[[], Any]] = {}
         self._gauges: Dict[str, Callable[[], float]] = {}
         # registries belong to their owners (engines); weak values so a
@@ -256,6 +348,24 @@ class Hub:
                         f"{tuple(label_names)}")
             return fam
 
+    def histogram(self, name: str,
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """Get-or-create a bucketed histogram (idempotent). Omitting
+        ``buckets`` fetches whatever exists; a conflicting non-default
+        bucket schema is a wiring bug and raises at the call site."""
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name, buckets if buckets is not None
+                              else DEFAULT_BUCKETS_MS)
+                self._histograms[name] = h
+            elif buckets is not None and \
+                    tuple(sorted(float(b) for b in buckets)) != h.bounds:
+                raise ValueError(
+                    f"observability histogram {name!r} already registered "
+                    f"with buckets {h.bounds}")
+            return h
+
     def register_provider(self, name: str, fn: Callable[[], Any]) -> None:
         """A snapshot-time callable for state owned elsewhere (cache stats,
         retrace summaries, the step timeline). Zero steady-state cost."""
@@ -278,18 +388,27 @@ class Hub:
         with self._lock:
             return dict(self._families)
 
+    def histograms(self) -> Dict[str, Histogram]:
+        """The live Histogram objects (the Prometheus emitter's source of
+        native ``_bucket``/``_sum``/``_count`` samples)."""
+        with self._lock:
+            return dict(self._histograms)
+
     def snapshot(self) -> Dict[str, Any]:
         """One JSON-able dict of every registered family/provider/gauge.
         Provider or gauge failures degrade to an error string — a telemetry
         read must never raise into the caller."""
         with self._lock:
             families = dict(self._families)
+            histograms = dict(self._histograms)
             providers = dict(self._providers)
             gauges = dict(self._gauges)
             registries = dict(self._registries)
         out: Dict[str, Any] = {}
         for name, fam in families.items():
             out[name] = fam.snapshot()
+        for name, h in histograms.items():
+            out[name] = h.snapshot()
         for name, fn in providers.items():
             try:
                 out[name] = fn()
@@ -314,10 +433,12 @@ class Hub:
         return out
 
     def reset(self) -> None:
-        """Zero the hub-owned families (providers/registries are owned by
-        their subsystems and reset there). Test hygiene, not a hot path."""
+        """Zero the hub-owned families/histograms (providers/registries are
+        owned by their subsystems and reset there). Test hygiene, not a hot
+        path."""
         with self._lock:
-            families = list(self._families.values())
+            families = list(self._families.values()) + \
+                list(self._histograms.values())
         for fam in families:
             fam.reset()
 
@@ -331,6 +452,10 @@ def hub() -> Hub:
 
 def family(name: str, label_names: Sequence[str] = ()) -> CounterFamily:
     return _HUB.family(name, label_names)
+
+
+def histogram(name: str, buckets: Optional[Sequence[float]] = None) -> Histogram:
+    return _HUB.histogram(name, buckets)
 
 
 def gauge(name: str, fn: Callable[[], float]) -> None:

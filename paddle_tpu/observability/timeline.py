@@ -3,31 +3,45 @@
 Reference role: profiler_statistic.py's per-step breakdown tables over
 host_tracer.cc spans. TPU-native translation: the compiled step makes the
 device timeline XLA's business, so the host-side question becomes a
-four-phase split per step:
+per-step phase split:
 
 - ``data_wait``      blocked on the loader / prefetcher for the next batch
 - ``host_dispatch``  python + dispatch until the compiled step call returns
                      (async under jax: the device keeps computing after)
-- ``device_compute`` blocking on the step's outputs — recorded only in
-                     *detailed* mode (a Profiler is active or
+- ``device_block``   host *blocking* on the step's outputs — recorded only
+                     in *detailed* mode (a Profiler is active or
                      ``timeline().detail(True)``), because the block itself
-                     would serialize the async pipeline the warm path won
+                     would serialize the async pipeline. This is HOST time,
+                     not device time: an upper bound that also contains
+                     dispatch slack. Real device time comes from XPlane
+                     correlation (below).
 - ``compile``        cold builds: trace + XLA compile + first execution
 - ``stream_wait``    offload-path steps only: blocked on the streaming
                      lane (a group transfer not yet hidden behind compute)
+
+Device truth: while an ``observability.trace.capture_steps()`` window is
+open, every step/phase bracket also emits a ``jax.profiler``
+TraceAnnotation (``pt_step#<n>`` / ``pt_phase#<name>``) into the XPlane
+capture; the post-capture correlation ingests per-step *device* time back
+here (``ingest_device_steps``), so ``summary()`` reports
+``device_compute_us`` measured by XLA's own tracer — in every mode, not
+just detailed — with ``device_source`` naming where the number came from
+(``"xplane"`` vs the ``device_block`` host proxy).
 
 Producers: ``jit.TrainStep`` / ``AccumulateStep`` / ``ShardedTrainStep`` /
 ``ShardedAccumulateStep`` wrap their calls, ``hapi.Model.fit`` wraps its
 epoch loop. Each phase is aggregated (count/total/max/last — a few adds
 per step) and, while a ``profiler.Profiler`` is recording, emitted as a
 ``RecordEvent`` span named ``step:<phase>`` so the chrome-trace export
-shows the full warm path next to op and user spans.
+shows the warm path next to user/op spans. Completed steps additionally
+feed any registered observers (the flight recorder's ring) and the
+``step_time_ms`` histogram.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 __all__ = ["StepTimeline", "timeline"]
 
@@ -50,14 +64,22 @@ class _PhaseAgg:
 
 
 class _PhaseCtx:
-    __slots__ = ("_tl", "_name", "_t0")
+    __slots__ = ("_tl", "_name", "_t0", "_span")
 
     def __init__(self, tl: "StepTimeline", name: str):
         self._tl = tl
         self._name = name
         self._t0 = None
+        self._span = None
 
     def __enter__(self):
+        annot = self._tl._annot
+        if annot is not None:
+            try:
+                self._span = annot(f"pt_phase#{self._name}")
+                self._span.__enter__()
+            except Exception:
+                self._span = None
         self._t0 = time.perf_counter()
         return self
 
@@ -65,6 +87,12 @@ class _PhaseCtx:
         self._tl.record(self._name,
                         (time.perf_counter() - self._t0) * 1e3,
                         t0=self._t0)
+        if self._span is not None:
+            try:
+                self._span.__exit__(None, None, None)
+            except Exception:
+                pass
+            self._span = None
         return False
 
 
@@ -100,11 +128,25 @@ class StepTimeline:
         self._lock = threading.Lock()
         self._phases: Dict[str, _PhaseAgg] = {}
         self._steps = 0
+        self._begun = 0  # step brackets opened (capture-annotation index)
         self._step_total = _PhaseAgg()
         self._detail = False
+        # XPlane-correlated device time per step (ingest_device_steps);
+        # None source until a capture window delivers real device numbers
+        self._device = _PhaseAgg()
+        self._device_source: Optional[str] = None
         # last completed step's phase spans, (name, rel_ms, dur_ms) in
         # record order — the "ordered" assertion surface for tests/pd_top
         self._last_step: List[Tuple[str, float, float]] = []
+        # while an observability.trace capture window is open, step/phase
+        # brackets also emit jax.profiler TraceAnnotations; one attribute
+        # read per bracket when disarmed
+        self._annot: Optional[Callable] = None
+        # completed-step observers (the flight recorder): fn(ms, phases)
+        self._observers: List[Callable] = []
+        # step_time_ms histogram, resolved lazily once (not per step —
+        # the hub lookup takes a process-global lock)
+        self._step_hist = None
         # step bracketing is PER THREAD (depth, open-step span list, t0):
         # two loops stepping concurrently must not nest into each other;
         # the aggregates above stay shared under the lock
@@ -112,8 +154,8 @@ class StepTimeline:
 
     # -- configuration --------------------------------------------------------
     def detail(self, on: bool = True) -> "StepTimeline":
-        """Force detailed mode (device_compute blocking) regardless of the
-        profiler state."""
+        """Force detailed mode (the ``device_block`` host-side block)
+        regardless of the profiler state."""
         self._detail = bool(on)
         return self
 
@@ -127,6 +169,28 @@ class StepTimeline:
             return profiler.is_recording()
         except Exception:
             return False
+
+    def _arm_annotations(self, factory: Callable) -> None:
+        """Capture window open: ``factory(name)`` returns a context manager
+        (``jax.profiler.TraceAnnotation``) emitted around every step and
+        phase bracket so the XPlane artifact carries correlation anchors."""
+        self._annot = factory
+
+    def _disarm_annotations(self) -> None:
+        self._annot = None
+
+    def add_observer(self, fn: Callable) -> None:
+        """``fn(wall_ms, phases)`` after every completed (non-cancelled)
+        step; ``phases`` is the ordered [(name, rel_ms, dur_ms)] list.
+        Observer failures are swallowed — telemetry never sinks a step."""
+        with self._lock:
+            if fn not in self._observers:
+                self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable) -> None:
+        with self._lock:
+            if fn in self._observers:
+                self._observers.remove(fn)
 
     # -- recording ------------------------------------------------------------
     def step(self) -> _StepCtx:
@@ -148,6 +212,17 @@ class StepTimeline:
                 cur.append((name, (t0 - self._tls.t0) * 1e3, ms))
         self._maybe_span(name, ms, t0)
 
+    def ingest_device_steps(self, per_step_us, source: str = "xplane") -> None:
+        """Land XPlane-correlated per-step device-compute times (us). The
+        aggregates surface in ``summary()["device_compute_us"]`` with
+        ``device_source`` naming the provenance — the replacement for the
+        host-block proxy in ALL modes."""
+        with self._lock:
+            for us in per_step_us:
+                self._device.add(float(us))
+            if per_step_us:
+                self._device_source = source
+
     def _maybe_span(self, name: str, ms: float, t0: Optional[float]) -> None:
         """Emit a host-tracer span while a Profiler is recording, so the
         chrome trace shows step phases next to op and user spans."""
@@ -168,6 +243,21 @@ class StepTimeline:
         if depth == 0:  # the outermost bracket owns the step
             ts.cur = []
             ts.t0 = t0
+            annot = self._annot
+            if annot is not None:
+                with self._lock:
+                    n = self._begun
+                    self._begun += 1
+                try:
+                    span = annot(f"pt_step#{n}")
+                    span.__enter__()
+                    ts.span = span
+                except Exception:
+                    ts.span = None
+            else:
+                with self._lock:
+                    self._begun += 1
+                ts.span = None
         return t0
 
     def _end_step(self, t0: float, cancelled: bool = False) -> None:
@@ -177,6 +267,12 @@ class StepTimeline:
         if ts.depth > 0:
             return
         cur, ts.cur = getattr(ts, "cur", None), None
+        span, ts.span = getattr(ts, "span", None), None
+        if span is not None:
+            try:
+                span.__exit__(None, None, None)
+            except Exception:
+                pass
         if cancelled:
             return
         with self._lock:
@@ -184,12 +280,28 @@ class StepTimeline:
             self._step_total.add(ms)
             if cur is not None:
                 self._last_step = cur
+            observers = list(self._observers)
         self._maybe_span("total", ms, t0)
+        try:
+            h = self._step_hist
+            if h is None:
+                from .registry import histogram
+
+                h = self._step_hist = histogram("step_time_ms")
+            h.observe(ms)
+        except Exception:
+            pass
+        for fn in observers:
+            try:
+                fn(ms, cur or [])
+            except Exception:
+                pass
 
     # -- reads ----------------------------------------------------------------
     def summary(self) -> Dict:
         """JSON-able aggregate: per-phase count/total/avg/max/last, step
-        count, and the last step's ordered phase list."""
+        count, the last step's ordered phase list, and — when an XPlane
+        capture has correlated — real per-step device time."""
         with self._lock:
             phases = {
                 name: {
@@ -201,7 +313,7 @@ class StepTimeline:
                 }
                 for name, a in self._phases.items()
             }
-            return {
+            out = {
                 "steps": self._steps,
                 "step_total_ms": {
                     "avg": round(self._step_total.total_ms /
@@ -218,6 +330,24 @@ class StepTimeline:
                 ],
                 "detailed": self.detailed,
             }
+            # device-time provenance: "xplane" = real device events from a
+            # trace capture; "host_block" = only the detailed-mode blocking
+            # proxy exists (an upper bound, NOT device time); None = neither
+            if self._device.count:
+                d = self._device
+                out["device_compute_us"] = {
+                    "count": d.count,
+                    "total": round(d.total_ms, 1),
+                    "avg": round(d.total_ms / d.count, 1),
+                    "max": round(d.max_ms, 1),
+                    "last": round(d.last_ms, 1),
+                }
+                out["device_source"] = self._device_source
+            elif "device_block" in phases:
+                out["device_source"] = "host_block"
+            else:
+                out["device_source"] = None
+            return out
 
     def table(self, time_unit: str = "ms") -> str:
         """Human summary table (profiler_statistic.py shape)."""
@@ -237,13 +367,21 @@ class StepTimeline:
                 f"{name[:19]:<20}{row['count']:>8}"
                 f"{row['total_ms'] / div:>14.3f}{row['avg_ms'] / div:>12.3f}"
                 f"{row['max_ms'] / div:>12.3f}{row['last_ms'] / div:>12.3f}")
+        dev = s.get("device_compute_us")
+        if dev:
+            lines.append(
+                f"device_compute (XPlane): avg {dev['avg']}us over "
+                f"{dev['count']} correlated steps")
         return "\n".join(lines)
 
     def reset(self) -> None:
         with self._lock:
             self._phases.clear()
             self._steps = 0
+            self._begun = 0
             self._step_total = _PhaseAgg()
+            self._device = _PhaseAgg()
+            self._device_source = None
             self._last_step = []
         self._tls.cur = None
         self._tls.depth = 0

@@ -1,0 +1,38 @@
+"""paddle_tpu.observability.trace — device-truth tracing.
+
+Three layers on top of the PR-4 telemetry hub (see docs/observability.md,
+"Device-truth tracing"):
+
+- **XPlane ingestion** (``capture_steps`` / ``xplane``): capture a
+  ``jax.profiler`` trace around a step window, parse the artifact,
+  correlate device events back to StepTimeline steps/phases — real
+  ``device_compute_us`` (every mode), a top-k device op table, and
+  host/device overlap efficiency;
+- **request-scoped tracing** (``tracer()``): a propagated trace ID per
+  serving request (admission -> queue -> coalesce -> execute / prefill ->
+  decode -> completion) plus the GenerationEngine slot-occupancy track,
+  exported as chrome-trace/Perfetto JSON;
+- **flight recorder** (``flight_recorder()``): a bounded ring of recent
+  step timelines + runtime events with an anomaly detector
+  (regression/stall/burst) that auto-dumps a ``pd_dump`` diagnostic
+  bundle on trigger, SIGQUIT, or preemption.
+"""
+from __future__ import annotations
+
+from .capture import (  # noqa: F401
+    StepTraceCapture, capture_steps, device_trace_provider, last_correlation,
+)
+from .flight import FlightRecorder, dump_bundle, flight_recorder  # noqa: F401
+from .request_trace import RequestTracer, tracer  # noqa: F401
+from .xplane import (  # noqa: F401
+    CorrelatedTrace, correlate, correlate_logdir, find_trace_artifacts,
+    load_trace_file,
+)
+
+__all__ = [
+    "StepTraceCapture", "capture_steps", "last_correlation",
+    "device_trace_provider", "CorrelatedTrace", "correlate",
+    "correlate_logdir", "find_trace_artifacts", "load_trace_file",
+    "RequestTracer", "tracer", "FlightRecorder", "flight_recorder",
+    "dump_bundle",
+]

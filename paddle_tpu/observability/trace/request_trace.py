@@ -1,0 +1,206 @@
+"""Request-scoped tracing for the serving engines.
+
+Every admitted serving request gets a process-unique trace ID that
+propagates through its whole life: admission -> queue -> batch coalesce ->
+execution (ServingEngine) / prefill -> decode -> completion
+(GenerationEngine). Spans are recorded retroactively from the engines'
+own timestamps (zero extra clock reads on the hot path beyond what the
+metrics already take) into a bounded ring, and exported as chrome-trace /
+Perfetto JSON next to the profiler's host spans:
+
+- one Perfetto *thread* row per request (its spans read left to right:
+  queue, coalesce, execute / prefill, decode);
+- one ``slots:<engine>`` process with a row per KV slot — the
+  GenerationEngine occupancy timeline (each residency span carries the
+  owning trace ID and token count).
+
+Cost per request: a few dict appends under one lock. The ring bounds
+memory (finished traces beyond ``capacity`` drop oldest-first and are
+counted), so the tracer is always-on — no sampling knob to forget.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+__all__ = ["RequestTracer", "tracer"]
+
+
+def _us(t_monotonic: float) -> float:
+    return t_monotonic * 1e6
+
+
+class _Trace:
+    __slots__ = ("trace_id", "engine", "kind", "t0", "spans", "done",
+                 "ok", "meta")
+
+    def __init__(self, trace_id, engine, kind, t0, meta):
+        self.trace_id = trace_id
+        self.engine = engine
+        self.kind = kind
+        self.t0 = t0
+        self.spans: List[Dict] = []
+        self.done = False
+        self.ok: Optional[bool] = None
+        self.meta = meta
+
+
+class RequestTracer:
+    """Process-wide request-span collector (one instance via ``tracer()``)."""
+
+    def __init__(self, capacity: int = 2048, slot_capacity: int = 1024):
+        self._lock = threading.Lock()
+        self._seq = itertools.count(1)
+        self._live: Dict[str, _Trace] = {}
+        self._done: deque = deque(maxlen=capacity)
+        self._slots: deque = deque(maxlen=slot_capacity)
+        self._counts = {"started": 0, "finished": 0, "failed": 0,
+                        "spans": 0, "slot_spans": 0}
+
+    # -- recording ------------------------------------------------------------
+    def start(self, engine: str, kind: str = "request",
+              t0: Optional[float] = None, **meta) -> str:
+        """Open a trace; returns its ID (carried by the request object)."""
+        trace_id = f"{os.getpid():x}-{next(self._seq):x}"
+        tr = _Trace(trace_id, engine, kind,
+                    time.monotonic() if t0 is None else t0, meta)
+        with self._lock:
+            self._live[trace_id] = tr
+            self._counts["started"] += 1
+        return trace_id
+
+    def span(self, trace_id: Optional[str], name: str, t0: float, t1: float,
+             **args) -> None:
+        """Record one span [t0, t1) (``time.monotonic`` seconds — the
+        engines' native timestamps). Unknown/None IDs are ignored so call
+        sites never need their own guards."""
+        if trace_id is None:
+            return
+        with self._lock:
+            tr = self._live.get(trace_id)
+            if tr is None:
+                return
+            tr.spans.append({"name": name, "t0": t0,
+                             "dur_us": max(_us(t1 - t0), 0.0), "args": args})
+            self._counts["spans"] += 1
+
+    def finish(self, trace_id: Optional[str], ok: bool = True,
+               **args) -> None:
+        if trace_id is None:
+            return
+        with self._lock:
+            tr = self._live.pop(trace_id, None)
+            if tr is None:
+                return
+            tr.done = True
+            tr.ok = ok
+            if args:
+                tr.meta.update(args)
+            self._done.append(tr)
+            self._counts["finished"] += 1
+            if not ok:
+                self._counts["failed"] += 1
+
+    def slot_span(self, engine: str, slot: int, t0: float, t1: float,
+                  trace_id: Optional[str], **args) -> None:
+        """One KV-slot residency (admit -> release) on the occupancy
+        track."""
+        with self._lock:
+            self._slots.append({"engine": engine, "slot": int(slot),
+                                "t0": t0, "dur_us": max(_us(t1 - t0), 0.0),
+                                "trace_id": trace_id, "args": args})
+            self._counts["slot_spans"] += 1
+
+    # -- reads ----------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {**self._counts, "live": len(self._live),
+                    "ring": len(self._done), "slot_ring": len(self._slots)}
+
+    def traces(self, engine: Optional[str] = None) -> List[Dict]:
+        """Finished traces (oldest first), JSON-able."""
+        with self._lock:
+            done = list(self._done)
+        out = []
+        for tr in done:
+            if engine is not None and tr.engine != engine:
+                continue
+            out.append({"trace_id": tr.trace_id, "engine": tr.engine,
+                        "kind": tr.kind, "ok": tr.ok, "meta": dict(tr.meta),
+                        "spans": [dict(s) for s in tr.spans]})
+        return out
+
+    def chrome_events(self) -> List[Dict]:
+        """Chrome-trace events: a pid per engine, a tid per request (its
+        spans form one row), plus a ``slots:<engine>`` pid with a row per
+        slot. Every span's args carry the trace ID — Perfetto's query/
+        highlight key."""
+        with self._lock:
+            done = list(self._done)
+            slots = list(self._slots)
+        events: List[Dict] = []
+        pids: Dict[str, int] = {}
+
+        def pid_of(label: str) -> int:
+            if label not in pids:
+                pids[label] = 1000 + len(pids)
+                events.append({"ph": "M", "pid": pids[label],
+                               "name": "process_name",
+                               "args": {"name": label}})
+            return pids[label]
+
+        for i, tr in enumerate(done):
+            pid = pid_of(f"requests:{tr.engine}")
+            tid = i + 1
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"req {tr.trace_id}"}})
+            for s in tr.spans:
+                events.append({
+                    "ph": "X", "pid": pid, "tid": tid, "name": s["name"],
+                    "ts": _us(s["t0"]), "dur": s["dur_us"],
+                    "cat": tr.kind,
+                    "args": {"trace_id": tr.trace_id, "ok": tr.ok,
+                             **s["args"]},
+                })
+        for s in slots:
+            pid = pid_of(f"slots:{s['engine']}")
+            events.append({
+                "ph": "X", "pid": pid, "tid": s["slot"] + 1,
+                "name": f"slot{s['slot']}",
+                "ts": _us(s["t0"]), "dur": s["dur_us"], "cat": "slot",
+                "args": {"trace_id": s["trace_id"], **s["args"]},
+            })
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        """Write the request + slot tracks as chrome-trace JSON (load in
+        Perfetto/chrome://tracing next to the profiler's span export)."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"displayTimeUnit": "ms",
+                       "traceEvents": self.chrome_events()}, f)
+        return path
+
+    def reset(self) -> None:
+        with self._lock:
+            self._live.clear()
+            self._done.clear()
+            self._slots.clear()
+            for k in self._counts:
+                self._counts[k] = 0
+
+
+_TRACER = RequestTracer()
+
+
+def tracer() -> RequestTracer:
+    """The process-wide request tracer every serving engine feeds."""
+    return _TRACER

@@ -1,0 +1,273 @@
+"""XPlane/trace-artifact ingestion: device truth for the step timeline.
+
+``jax.profiler.start_trace`` writes an XPlane protobuf AND a pre-rendered
+chrome-trace next to it (``plugins/profile/<ts>/*.trace.json.gz``) — the
+same merged host+device view the reference's chrometracing_logger.cc
+produces. The protobuf needs the tensorflow profiler proto stack (not a
+dependency here); the chrome JSON carries everything this layer needs:
+
+- host threads with our ``pt_step#<n>`` / ``pt_phase#<name>``
+  TraceAnnotation spans (emitted by ``StepTimeline`` while a capture
+  window is armed — the correlation anchors);
+- device execution events: XLA op spans carrying ``args.hlo_op`` /
+  ``args.hlo_module`` (CPU backend: on the ``tf_XLAEigen`` executor
+  threads; TPU backend: on ``/device:TPU:*`` process lines).
+
+``correlate`` assigns device events to step windows by time containment
+(host and device share the trace clock), unions overlapping intervals per
+thread so nested/fused spans never double-count, and splits each step's
+device time into *exposed* (overlapping a ``device_block``/``stream_wait``
+host span — the host was waiting for it) vs *hidden* (overlapped by
+useful host work) — the device-truth ``overlap_efficiency``.
+"""
+from __future__ import annotations
+
+import bisect
+import glob
+import gzip
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["find_trace_artifacts", "load_trace_file", "correlate",
+           "correlate_logdir", "CorrelatedTrace"]
+
+STEP_PREFIX = "pt_step#"
+PHASE_PREFIX = "pt_phase#"
+# blocking host phases: device time under these was NOT hidden behind
+# useful host work (stall, not overlap)
+_BLOCKING_PHASES = ("device_block", "stream_wait", "data_wait")
+# whole-program group spans (bench heuristic): these CONTAIN the op spans
+# and must not be summed next to them
+_MODULE_MARKERS = ("jit_",)
+
+
+def find_trace_artifacts(logdir: str) -> List[str]:
+    """The ``*.trace.json.gz`` files under a capture logdir, newest
+    first (one per host per capture)."""
+    pats = [os.path.join(logdir, "plugins", "profile", "*", "*.trace.json.gz"),
+            os.path.join(logdir, "*.trace.json.gz")]
+    files: List[str] = []
+    for p in pats:
+        files.extend(glob.glob(p))
+    return sorted(set(files), key=lambda f: os.path.getmtime(f), reverse=True)
+
+
+def load_trace_file(path: str) -> Dict[str, Any]:
+    """Parse one chrome-trace artifact (.json or .json.gz)."""
+    if path.endswith(".gz"):
+        with gzip.open(path, "rt") as f:
+            return json.load(f)
+    with open(path) as f:
+        return json.load(f)
+
+
+def _overlap_us(intervals: List[Tuple[float, float]],
+                windows: Sequence[Tuple[float, float]]) -> float:
+    """Covered time of ``intervals`` that falls inside any window (both
+    lists are clipped unions, so no double counting)."""
+    total = 0.0
+    for t0, t1 in intervals:
+        for w0, w1 in windows:
+            lo, hi = max(t0, w0), min(t1, w1)
+            if hi > lo:
+                total += hi - lo
+    return total
+
+
+class CorrelatedTrace:
+    """The parsed + correlated view of one capture: per-step device time,
+    per-phase attribution, and the device op table."""
+
+    def __init__(self, steps: List[Dict], op_table: List[Dict],
+                 unattributed_device_us: float, device_threads: List[str],
+                 source: Optional[str] = None):
+        self.steps = steps
+        self.op_table = op_table
+        self.unattributed_device_us = unattributed_device_us
+        self.device_threads = device_threads
+        self.source = source
+
+    @property
+    def steps_correlated(self) -> int:
+        return sum(1 for s in self.steps if s["device_us"] > 0)
+
+    def device_us_per_step(self) -> List[float]:
+        return [s["device_us"] for s in self.steps]
+
+    def overlap_efficiency(self) -> Optional[float]:
+        total = sum(s["device_us"] for s in self.steps)
+        if total <= 0:
+            return None
+        hidden = sum(s["hidden_us"] for s in self.steps)
+        return round(hidden / total, 4)
+
+    def summary(self, top: int = 20) -> Dict[str, Any]:
+        """JSON-able digest — the hub's ``device_trace`` provider payload
+        and the bench ``device_op_table`` shape."""
+        dev = [s["device_us"] for s in self.steps if s["device_us"] > 0]
+        return {
+            "source": self.source,
+            "steps_seen": len(self.steps),
+            "steps_correlated": self.steps_correlated,
+            "device_compute_us": {
+                "total": round(sum(dev), 1),
+                "per_step_avg": round(sum(dev) / len(dev), 1) if dev else 0.0,
+                "last": round(dev[-1], 1) if dev else 0.0,
+            },
+            "overlap_efficiency": self.overlap_efficiency(),
+            "unattributed_device_us": round(self.unattributed_device_us, 1),
+            "device_threads": self.device_threads[:8],
+            "op_table": self.op_table[:top],
+            "steps": [
+                {k: (round(v, 1) if isinstance(v, float) else v)
+                 for k, v in s.items() if k != "window"}
+                for s in self.steps[:64]
+            ],
+        }
+
+
+def _is_device_event(ev: Dict, dev_pids: frozenset) -> bool:
+    args = ev.get("args")
+    if isinstance(args, dict) and "hlo_op" in args:
+        return True
+    if ev.get("pid") in dev_pids:
+        name = ev.get("name", "")
+        # skip whole-module group spans: they contain the op spans
+        if any(m in name for m in _MODULE_MARKERS) or name.isdigit():
+            return False
+        return True
+    return False
+
+
+def correlate(trace: Dict[str, Any],
+              source: Optional[str] = None) -> CorrelatedTrace:
+    """Correlate one chrome-trace dict: device events -> ``pt_step#`` /
+    ``pt_phase#`` windows by time containment."""
+    events = [e for e in trace.get("traceEvents", []) if e.get("ph") == "X"]
+    # process/thread name maps (metadata events)
+    pid_names: Dict[Any, str] = {}
+    tid_names: Dict[Tuple, str] = {}
+    for e in trace.get("traceEvents", []):
+        if e.get("ph") != "M":
+            continue
+        if e.get("name") == "process_name":
+            pid_names[e.get("pid")] = e.get("args", {}).get("name", "")
+        elif e.get("name") == "thread_name":
+            tid_names[(e.get("pid"), e.get("tid"))] = \
+                e.get("args", {}).get("name", "")
+    # device process lines (TPU/GPU captures put device timelines in their
+    # own pid; CPU captures only have hlo_op events on executor threads)
+    dev_pids = frozenset(p for p, n in pid_names.items()
+                         if "/device:" in n and "CPU" not in n)
+
+    steps: List[Dict] = []
+    phase_spans: List[Tuple[str, float, float]] = []  # (name, t0, t1)
+    device_evs: List[Dict] = []
+    for e in events:
+        name = e.get("name", "")
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        if name.startswith(STEP_PREFIX):
+            try:
+                idx = int(name[len(STEP_PREFIX):])
+            except ValueError:
+                continue
+            steps.append({"step": idx, "window": (ts, ts + dur),
+                          "wall_us": dur})
+        elif name.startswith(PHASE_PREFIX):
+            phase_spans.append((name[len(PHASE_PREFIX):], ts, ts + dur))
+        elif dur > 0.01 and _is_device_event(e, dev_pids):
+            device_evs.append(e)
+    steps.sort(key=lambda s: s["window"][0])
+
+    # op table: aggregate device events by op name (leaf hlo spans)
+    agg: Dict[Tuple[str, str], List[float]] = {}
+    for e in device_evs:
+        args = e.get("args") or {}
+        key = (e.get("name", "?"), str(args.get("hlo_module", "")))
+        row = agg.setdefault(key, [0, 0.0])
+        row[0] += 1
+        row[1] += float(e.get("dur", 0.0))
+    op_table = [
+        {"op": op, "module": mod, "calls": c,
+         "total_us": round(us, 1), "avg_us": round(us / c, 1)}
+        for (op, mod), (c, us) in
+        sorted(agg.items(), key=lambda kv: -kv[1][1])
+    ]
+
+    # per-step attribution: device work is dispatched in step order, so an
+    # event belongs to the LAST step whose window opened before it started
+    # — this also catches the async spill (param/optimizer updates still
+    # executing after the host unblocked on the loss and moved on). Only
+    # events before the first window stay unattributed. Per-tid interval
+    # unions prevent nested fused spans from double-counting.
+    per_step_tid: Dict[int, Dict[Any, List[Tuple[float, float]]]] = {}
+    unattributed = 0.0
+    windows = [s["window"] for s in steps]
+    starts = [w0 for (w0, _w1) in windows]
+    for e in device_evs:
+        ts, dur = float(e.get("ts", 0.0)), float(e.get("dur", 0.0))
+        hit = bisect.bisect_right(starts, ts) - 1
+        if hit < 0:
+            unattributed += dur
+            continue
+        tid = (e.get("pid"), e.get("tid"))
+        per_step_tid.setdefault(hit, {}).setdefault(tid, []).append(
+            (ts, ts + dur))
+
+    for i, s in enumerate(steps):
+        w0, w1 = s["window"]
+        by_tid = per_step_tid.get(i, {})
+        # union per thread, then sum across threads (parallel device
+        # threads legitimately add)
+        merged: Dict[Any, List[Tuple[float, float]]] = {}
+        dev_us = 0.0
+        for tid, ivs in by_tid.items():
+            ivs.sort()
+            out: List[Tuple[float, float]] = []
+            for t0, t1 in ivs:
+                if out and t0 <= out[-1][1]:
+                    out[-1] = (out[-1][0], max(out[-1][1], t1))
+                else:
+                    out.append((t0, t1))
+            merged[tid] = out
+            dev_us += sum(t1 - t0 for t0, t1 in out)
+        # phase attribution + hidden/exposed split inside this window
+        my_phases = [(n, max(t0, w0), min(t1, w1))
+                     for (n, t0, t1) in phase_spans
+                     if t0 < w1 and t1 > w0]
+        phases: Dict[str, Dict[str, float]] = {}
+        blocking: List[Tuple[float, float]] = []
+        for name, t0, t1 in my_phases:
+            row = phases.setdefault(name, {"ms": 0.0, "device_us": 0.0})
+            row["ms"] += (t1 - t0) / 1e3
+            for ivs in merged.values():
+                row["device_us"] += _overlap_us(ivs, [(t0, t1)])
+            if name in _BLOCKING_PHASES:
+                blocking.append((t0, t1))
+        exposed = 0.0
+        for ivs in merged.values():
+            exposed += _overlap_us(ivs, blocking)
+        s["device_us"] = dev_us
+        s["exposed_us"] = exposed
+        s["hidden_us"] = max(dev_us - exposed, 0.0)
+        s["phases"] = {n: {"ms": round(r["ms"], 3),
+                           "device_us": round(r["device_us"], 1)}
+                       for n, r in phases.items()}
+
+    dev_threads = sorted({
+        tid_names.get((e.get("pid"), e.get("tid")),
+                      f"pid{e.get('pid')}/tid{e.get('tid')}")
+        for e in device_evs})
+    return CorrelatedTrace(steps, op_table, unattributed, dev_threads,
+                           source=source)
+
+
+def correlate_logdir(logdir: str) -> CorrelatedTrace:
+    """Parse + correlate the newest trace artifact under ``logdir``."""
+    files = find_trace_artifacts(logdir)
+    if not files:
+        raise FileNotFoundError(
+            f"no *.trace.json.gz under {logdir!r} — did the capture run "
+            "(jax.profiler trace) and stop cleanly?")
+    return correlate(load_trace_file(files[0]), source=files[0])

@@ -16,6 +16,15 @@ __all__ = ["EngineBase", "QueueFull", "DeadlineExceeded", "EngineClosed",
            "BadRequest"]
 
 
+def _tracer():
+    """The process-wide request tracer (observability.trace): every
+    admitted request gets a propagated trace ID, spans recorded from the
+    engines' own timestamps."""
+    from ..observability.trace.request_trace import tracer
+
+    return tracer()
+
+
 class QueueFull(RuntimeError):
     """Admission control: the bounded request queue is at capacity."""
 
@@ -84,6 +93,8 @@ class EngineBase:
                     r = self._queue.popleft()
                     if not r.future.done():
                         r.future.set_exception(EngineClosed("engine closed"))
+                    _tracer().finish(getattr(r, "trace", None), ok=False,
+                                     error="EngineClosed")
             self._cond.notify_all()
         if self._thread is not None:
             self._thread.join(timeout=self._close_timeout

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .base import (BadRequest, DeadlineExceeded, EngineBase, EngineClosed,
-                   QueueFull)
+                   QueueFull, _tracer)
 from .buckets import BucketSpec
 
 __all__ = ["ServingConfig", "ServingEngine", "QueueFull", "DeadlineExceeded",
@@ -52,7 +52,7 @@ class ServingConfig:
 
 
 class _Request:
-    __slots__ = ("arrays", "key", "future", "t_submit", "deadline")
+    __slots__ = ("arrays", "key", "future", "t_submit", "deadline", "trace")
 
     def __init__(self, arrays, key, future, t_submit, deadline):
         self.arrays = arrays
@@ -60,6 +60,7 @@ class _Request:
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline
+        self.trace = None  # request-scoped trace id (observability.trace)
 
 
 _ENGINE_NO = itertools.count(1)
@@ -302,7 +303,17 @@ class ServingEngine(EngineBase):
         deadline = None if deadline_ms is None \
             else t_submit + deadline_ms / 1000.0
         req = _Request(arrays, key, fut, t_submit, deadline)
-        self._enqueue(req, self.config.max_queue)
+        # request-scoped trace: one ID from admission to completion; the
+        # admission span is the validation/enqueue work just done
+        tr = _tracer()
+        req.trace = tr.start(self.name, kind="serve",
+                             deadline_ms=deadline_ms)
+        tr.span(req.trace, "admission", t_submit, time.monotonic())
+        try:
+            self._enqueue(req, self.config.max_queue)
+        except Exception as e:  # QueueFull/EngineClosed backpressure
+            tr.finish(req.trace, ok=False, error=type(e).__name__)
+            raise
         return fut
 
     def _validate(self, inputs) -> Tuple[List[np.ndarray], Tuple]:
@@ -338,6 +349,7 @@ class ServingEngine(EngineBase):
     def _fail(self, req: _Request, exc: Exception):
         if not req.future.done():
             req.future.set_exception(exc)
+        _tracer().finish(req.trace, ok=False, error=type(exc).__name__)
 
     def _shed_expired_locked(self, now: Optional[float] = None) -> None:
         if now is None:
@@ -383,7 +395,8 @@ class ServingEngine(EngineBase):
             batch = [seed]
             key = seed.key
             limit = self.buckets.max_batch
-            t_close = time.monotonic() + cfg.max_batch_wait_ms / 1000.0
+            t_open = time.monotonic()  # coalesce window opens (trace spans)
+            t_close = t_open + cfg.max_batch_wait_ms / 1000.0
             while len(batch) < limit:
                 self._collect_matching_locked(batch, key, limit)
                 if len(batch) >= limit:
@@ -392,23 +405,24 @@ class ServingEngine(EngineBase):
                 if rem <= 0 or (self._closed and not self._queue):
                     break
                 self._cond.wait(rem)
-            return batch, key
+            return batch, key, t_open
 
     def _worker(self):
         while True:
             item = self._next_batch()
             if item is None:
                 return
-            batch, key = item
+            batch, key, t_open = item
             try:
-                self._execute(batch, key)
+                self._execute(batch, key, t_open)
             except Exception as e:  # never kill the loop: fail the batch
                 for r in batch:
                     self._fail(r, e)
                 self.metrics.inc("errors_total", len(batch))
                 self.metrics.inc("batch_failures")
 
-    def _execute(self, batch: List[_Request], key: Tuple):
+    def _execute(self, batch: List[_Request], key: Tuple,
+                 t_open: Optional[float] = None):
         from .. import profiler
 
         # last deadline check: a request may have expired while the batch
@@ -439,8 +453,16 @@ class ServingEngine(EngineBase):
                                            bucket_b)
                   for i in range(len(self._specs))]
         t_exec = time.monotonic()
+        tr = _tracer()
         for r in batch:
             self.metrics.observe_queue_wait((t_exec - r.t_submit) * 1e3)
+            # queue = waiting for a coalesce window to pick this request
+            # up; batch_coalesce = riding the open window until execution
+            t_mid = min(max(r.t_submit, t_open if t_open is not None
+                            else t_exec), t_exec)
+            tr.span(r.trace, "queue", r.t_submit, t_mid)
+            tr.span(r.trace, "batch_coalesce", t_mid, t_exec,
+                    bucket=bucket_b)
         # chaos site: a scripted batch fault at an exact executed-batch
         # index (PT_FAULTS="batch_fault@batch=3") — exercises the
         # isolation contract (only THIS batch's futures fail, the queue
@@ -458,6 +480,10 @@ class ServingEngine(EngineBase):
             if not r.future.done():
                 r.future.set_result([o[i] for o in outs])
             self.metrics.observe_latency((t_done - r.t_submit) * 1e3)
+            tr.span(r.trace, "execute", t_exec, t_done, bucket=bucket_b,
+                    batch=n)
+            tr.finish(r.trace, ok=True,
+                      latency_ms=round((t_done - r.t_submit) * 1e3, 3))
         self.metrics.inc("responses_total", n)
         self.metrics.inc("batches_total")
         self.metrics.observe_occupancy(n / bucket_b)

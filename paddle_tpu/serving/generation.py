@@ -24,12 +24,13 @@ from __future__ import annotations
 import itertools
 import math
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .base import BadRequest, EngineBase
+from .base import BadRequest, EngineBase, _tracer
 
 __all__ = ["GenerationConfig", "GenerationEngine"]
 
@@ -60,7 +61,7 @@ class GenerationConfig:
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
-                 "generated")
+                 "generated", "trace", "t_decode0")
 
     def __init__(self, prompt, max_new_tokens, future, t_submit):
         self.prompt = prompt
@@ -68,15 +69,18 @@ class _GenRequest:
         self.future = future
         self.t_submit = t_submit
         self.generated: List[int] = []
+        self.trace = None      # request-scoped trace id
+        self.t_decode0 = None  # decode-phase start (prefill done)
 
 
 class _Slot:
-    __slots__ = ("req", "length", "last_token")
+    __slots__ = ("req", "length", "last_token", "t0")
 
     def __init__(self):
         self.req: Optional[_GenRequest] = None
         self.length = 0
         self.last_token = 0
+        self.t0 = 0.0  # residency start (occupancy track)
 
 
 def _extract_gpt_params(model):
@@ -220,6 +224,13 @@ class GenerationEngine(EngineBase):
             donate_argnums=(0,) if donate else ())
 
         self._slots = [_Slot() for _ in range(S)]
+        # slot-occupancy history: (slot, t0, t1, tokens) per residency —
+        # the timeline track behind the pd_top occupancy view and the
+        # chrome-trace slots:<engine> process
+        self._slot_hist: deque = deque(maxlen=512)
+        self._residencies = 0
+        self._t_start = time.monotonic()
+        self.metrics.gauge("slot_occupancy", self.slot_occupancy)
 
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16) -> "Future":
@@ -256,7 +267,16 @@ class GenerationEngine(EngineBase):
             return fut
         req = _GenRequest(prompt.astype(np.int64), int(max_new_tokens), fut,
                           time.monotonic())
-        self._enqueue(req, self.config.max_queue)
+        tr = _tracer()
+        req.trace = tr.start(self.name, kind="generate",
+                             prompt_len=len(prompt),
+                             max_new_tokens=int(max_new_tokens))
+        tr.span(req.trace, "admission", req.t_submit, time.monotonic())
+        try:
+            self._enqueue(req, self.config.max_queue)
+        except Exception as e:  # QueueFull/EngineClosed backpressure
+            tr.finish(req.trace, ok=False, error=type(e).__name__)
+            raise
         return fut
 
     def _prefill_bucket(self, n: int) -> Optional[int]:
@@ -288,6 +308,8 @@ class GenerationEngine(EngineBase):
                 except Exception as e:  # isolate: fail this prompt only
                     if not req.future.done():
                         req.future.set_exception(e)
+                    _tracer().finish(req.trace, ok=False,
+                                     error=type(e).__name__)
                     self.metrics.inc("errors_total")
                     slot = self._slots[free]
                     slot.req, slot.length, slot.last_token = None, 0, 0
@@ -304,11 +326,16 @@ class GenerationEngine(EngineBase):
             try:
                 self._decode_once(active)
             except Exception as e:  # decode fault: fail the in-flight batch
+                now = time.monotonic()
                 for i in active:
                     s = self._slots[i]
-                    if s.req is not None and not s.req.future.done():
-                        s.req.future.set_exception(e)
-                    s.req, s.length, s.last_token = None, 0, 0
+                    if s.req is not None:
+                        if not s.req.future.done():
+                            s.req.future.set_exception(e)
+                        self._release_slot(i, now, failed=True,
+                                           error=type(e).__name__)
+                    else:
+                        s.req, s.length, s.last_token = None, 0, 0
                 self.metrics.inc("errors_total", len(active))
                 self.metrics.inc("batch_failures")
 
@@ -325,6 +352,7 @@ class GenerationEngine(EngineBase):
         padded = np.zeros((1, bucket), dtype=np.int64)
         padded[0, :p] = req.prompt
         t0 = time.monotonic()
+        _tracer().span(req.trace, "queue", req.t_submit, t0)
         from ..core import autograd
 
         with autograd.no_grad():
@@ -343,11 +371,16 @@ class GenerationEngine(EngineBase):
         first = int(np.asarray(jnp.argmax(logits)))
         self.metrics.inc("prefills_total")
         self.metrics.observe_queue_wait((t0 - req.t_submit) * 1e3)
+        t1 = time.monotonic()
+        _tracer().span(req.trace, "prefill", t0, t1, bucket=bucket,
+                       prompt_len=p, slot=slot_no)
+        req.t_decode0 = t1
 
         s = self._slots[slot_no]
         s.req = req
         s.length = p
         s.last_token = first
+        s.t0 = t1  # slot residency opens (occupancy track)
         req.generated.append(first)
         self._maybe_finish(slot_no)
 
@@ -398,14 +431,61 @@ class GenerationEngine(EngineBase):
                                np.asarray(req.generated, dtype=np.int64)])
         if not req.future.done():
             req.future.set_result(full)
-        self.metrics.observe_latency((time.monotonic() - req.t_submit) * 1e3)
+        now = time.monotonic()
+        self.metrics.observe_latency((now - req.t_submit) * 1e3)
         self.metrics.inc("responses_total")
         self.metrics.mark_done()
+        self._release_slot(slot_no, now, failed=False)
+
+    def _release_slot(self, slot_no: int, now: float, failed: bool,
+                      error: Optional[str] = None):
+        """Close the residency: decode span + completion on the request's
+        trace, one span on the slot-occupancy track, history row for the
+        pd_top occupancy view."""
+        s = self._slots[slot_no]
+        req = s.req
+        if req is not None:
+            tr = _tracer()
+            tokens = len(req.generated)
+            if req.t_decode0 is not None:
+                tr.span(req.trace, "decode", req.t_decode0, now,
+                        tokens=tokens, slot=slot_no)
+            tr.finish(req.trace, ok=not failed, error=error,
+                      latency_ms=round((now - req.t_submit) * 1e3, 3))
+            t0 = s.t0 or now
+            tr.slot_span(self.name, slot_no, t0, now, req.trace,
+                         tokens=tokens)
+            self._slot_hist.append((slot_no, t0, now, tokens))
+            self._residencies += 1
         s.req = None
         s.length = 0
         s.last_token = 0
+        s.t0 = 0.0
 
     # -- observability --------------------------------------------------------
+    def slot_occupancy(self, window_s: float = 60.0) -> Dict[str, Any]:
+        """Per-slot busy fraction over the recent window (history + live
+        residencies) — the compact occupancy view pd_top renders."""
+        now = time.monotonic()
+        horizon = max(now - window_s, self._t_start)
+        span = max(now - horizon, 1e-6)
+        busy = {i: 0.0 for i in range(self.config.max_slots)}
+        for slot, t0, t1, _tokens in list(self._slot_hist):
+            lo, hi = max(t0, horizon), min(t1, now)
+            if hi > lo:
+                busy[slot] = busy.get(slot, 0.0) + (hi - lo)
+        for i, s in enumerate(self._slots):
+            if s.req is not None and s.t0:
+                busy[i] = busy.get(i, 0.0) + (now - max(s.t0, horizon))
+        return {
+            "slots": self.config.max_slots,
+            "active": len(self._active()),
+            "busy_frac": {str(i): round(min(b / span, 1.0), 4)
+                          for i, b in busy.items()},
+            "residencies": self._residencies,
+            "window_s": round(span, 1),
+        }
+
     def stats(self) -> Dict[str, Any]:
         snap = self._stats_base()
         snap["max_slots"] = self.config.max_slots

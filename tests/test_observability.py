@@ -127,8 +127,9 @@ def test_registry_families_and_labeled_counters():
 
 def test_step_timeline_phases_ordered_for_jitted_fit(tmp_path):
     """One jitted Model.fit epoch: data_wait / host_dispatch /
-    device_compute per step, ordered, and exported as chrome-trace spans
-    next to user spans (the ISSUE-4 acceptance view)."""
+    device_block per step, ordered, and exported as chrome-trace spans
+    next to user spans (the ISSUE-4 acceptance view; ISSUE-7 renamed the
+    host-block phase device_block — it is host time, not device time)."""
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as popt
     from paddle_tpu.io import TensorDataset
@@ -148,12 +149,16 @@ def test_step_timeline_phases_ordered_for_jitted_fit(tmp_path):
     prof.stop()
     s = tl.summary()
     assert s["steps"] == 2  # 8 samples / batch 4
-    for phase in ("data_wait", "host_dispatch", "device_compute"):
+    for phase in ("data_wait", "host_dispatch", "device_block"):
         assert s["phases"][phase]["count"] == 2, s["phases"]
     order = [p["phase"] for p in s["last_step"]]
-    assert order == ["data_wait", "host_dispatch", "device_compute"]
+    assert order == ["data_wait", "host_dispatch", "device_block"]
     rel = [p["rel_ms"] for p in s["last_step"]]
     assert rel == sorted(rel)  # recorded in wall-clock order
+    # no XPlane capture ran: the block value must be LABELLED as the
+    # host-side proxy, never silently reported as device time
+    assert s["device_source"] == "host_block"
+    assert "device_compute_us" not in s
     # chrome trace carries BOTH user spans and step phases
     out = str(tmp_path / "trace.json")
     prof._export_chrome(out)
@@ -161,13 +166,13 @@ def test_step_timeline_phases_ordered_for_jitted_fit(tmp_path):
         names = {ev["name"] for ev in json.load(f)["traceEvents"]}
     assert "user_span" in names
     assert {"step:data_wait", "step:host_dispatch",
-            "step:device_compute", "step:total"} <= names
+            "step:device_block", "step:total"} <= names
     assert tl.table()  # human summary renders
 
 
 def test_step_timeline_trainstep_compile_then_warm():
     """TrainStep cold call lands in the compile phase, warm calls in
-    host_dispatch; detailed mode adds the device_compute block."""
+    host_dispatch; detailed mode adds the device_block host block."""
     import paddle_tpu.nn as nn
     import paddle_tpu.optimizer as popt
     from paddle_tpu import jit
@@ -191,10 +196,10 @@ def test_step_timeline_trainstep_compile_then_warm():
     assert s["steps"] == 2
     assert s["phases"]["compile"]["count"] == 1
     assert s["phases"]["host_dispatch"]["count"] == 1
-    assert s["phases"]["device_compute"]["count"] == 2
+    assert s["phases"]["device_block"]["count"] == 2
     assert tc.get(("train_step", "build")) == builds0 + 1
     order = [p["phase"] for p in s["last_step"]]
-    assert order == ["host_dispatch", "device_compute"]
+    assert order == ["host_dispatch", "device_block"]
 
 
 def test_prefetcher_family_and_gauge():

@@ -1,0 +1,414 @@
+"""Device-truth tracing (ISSUE-7): XPlane ingestion + correlation,
+request-scoped serving traces, flight recorder + pd_dump bundles,
+histogram exposition. The heavy real-capture tests are slow-marked for
+tier-1 wall clock but run IN FULL by tools/ci.sh's tracing gate (which
+also runs tools/trace_drill.py — the three acceptance asserts)."""
+import gzip
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import observability as obs
+from paddle_tpu.observability import trace as otrace
+from paddle_tpu.observability.timeline import StepTimeline
+
+
+# -- XPlane parse + correlation (synthetic artifact: exact math) ---------------
+
+def _synthetic_trace():
+    """Two steps; step 0: one 100us hlo op fully inside a device_block
+    phase (exposed), step 1: one 80us op outside any blocking phase
+    (hidden) + a 20us op spilling past the window (attributed to step 1),
+    plus one pre-window op (unattributed) and module-group noise."""
+    E = []
+    E.append({"ph": "M", "pid": 7, "name": "process_name",
+              "args": {"name": "/host:CPU"}})
+    E.append({"ph": "M", "pid": 7, "tid": 2, "name": "thread_name",
+              "args": {"name": "tf_XLAEigen/2"}})
+
+    def x(name, ts, dur, tid=1, args=None):
+        e = {"ph": "X", "pid": 7, "tid": tid, "name": name,
+             "ts": ts, "dur": dur}
+        if args:
+            e["args"] = args
+        E.append(e)
+
+    hlo = {"hlo_op": "fusion.1", "hlo_module": "jit_step"}
+    x("before", 500, 30, tid=2, args=hlo)              # pre-window: unattributed
+    x("pt_step#0", 1000, 1000)
+    x("pt_phase#host_dispatch", 1000, 300)
+    x("pt_phase#device_block", 1300, 600)
+    x("fusion.1", 1400, 100, tid=2, args=hlo)          # exposed (in block)
+    x("pt_step#1", 2500, 1000)
+    x("pt_phase#host_dispatch", 2500, 400)
+    x("fusion.2", 2600, 80, tid=2,
+      args={"hlo_op": "fusion.2", "hlo_module": "jit_step"})  # hidden
+    x("fusion.2", 3600, 20, tid=2,
+      args={"hlo_op": "fusion.2", "hlo_module": "jit_step"})  # spill -> step 1
+    x("jit_step", 2600, 900, tid=2)                    # module group: skipped
+    return {"displayTimeUnit": "ms", "traceEvents": E}
+
+
+def test_synthetic_trace_parse_and_correlate(tmp_path):
+    d = tmp_path / "plugins" / "profile" / "2026_01_01"
+    d.mkdir(parents=True)
+    with gzip.open(str(d / "host.trace.json.gz"), "wt") as f:
+        json.dump(_synthetic_trace(), f)
+    cor = otrace.correlate_logdir(str(tmp_path))
+    assert cor.source and cor.source.endswith(".trace.json.gz")
+    assert len(cor.steps) == 2 and cor.steps_correlated == 2
+    s0, s1 = cor.steps
+    assert s0["step"] == 0 and s0["device_us"] == pytest.approx(100)
+    assert s0["exposed_us"] == pytest.approx(100)   # inside device_block
+    assert s0["hidden_us"] == pytest.approx(0)
+    assert s0["phases"]["device_block"]["device_us"] == pytest.approx(100)
+    assert s1["device_us"] == pytest.approx(100)    # 80 in-window + 20 spill
+    assert s1["hidden_us"] == pytest.approx(100)    # no blocking phase
+    assert cor.unattributed_device_us == pytest.approx(30)
+    assert cor.overlap_efficiency() == pytest.approx(0.5)
+    ops = {r["op"]: r for r in cor.op_table}
+    assert ops["fusion.2"]["calls"] == 2
+    assert ops["fusion.2"]["total_us"] == pytest.approx(100)
+    assert "jit_step" not in ops  # module-group span never double-counts
+    # summary is JSON-able and carries the op table + digest
+    json.dumps(cor.summary())
+
+
+def test_find_trace_artifacts_empty(tmp_path):
+    assert otrace.find_trace_artifacts(str(tmp_path)) == []
+    with pytest.raises(FileNotFoundError):
+        otrace.correlate_logdir(str(tmp_path))
+
+
+# -- real CPU capture (heavy: runs jax.profiler) -------------------------------
+
+@pytest.mark.slow  # tier-1 wall clock; run in full by the ci.sh tracing gate
+def test_capture_real_cpu_trace_correlates():
+    """The ISSUE-7 acceptance shape: a CPU-run traced window reports
+    device_compute_us from XPlane correlation (not host-block), phases
+    attributed, >= 1 device op — and it lands in snapshot()/pd_top."""
+    import paddle_tpu.nn as nn
+    import paddle_tpu.optimizer as popt
+    from paddle_tpu import jit
+
+    tl = obs.timeline()
+    tl.reset()
+    net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 1))
+    opt = popt.Adam(learning_rate=0.01, parameters=net.parameters())
+    step = jit.TrainStep(net, lambda m, x, y: ((m(x) - y) ** 2).mean(), opt)
+    x = paddle.to_tensor(np.ones((4, 8), np.float32))
+    y = paddle.to_tensor(np.zeros((4, 1), np.float32))
+    step(x, y)  # compile outside the window
+    with otrace.capture_steps() as cap:
+        for _ in range(3):
+            float(step(x, y))
+    assert cap.error is None, cap.error
+    cor = cap.result
+    assert cor.steps_correlated >= 2, cor.summary()
+    assert cor.op_table, "no device-attributed ops"
+    assert any("host_dispatch" in s["phases"] for s in cor.steps)
+    s = tl.summary()
+    assert s["device_source"] == "xplane"
+    assert s["device_compute_us"]["count"] >= 2
+    assert s["device_compute_us"]["avg"] > 0
+    # hub provider + renderer carry the digest
+    snap = obs.snapshot()
+    assert snap["device_trace"]["op_table"], snap["device_trace"]
+    assert snap["device_trace"]["captures"] >= 1
+    out = obs.render_snapshot(snap)
+    assert "device_trace" in out and "steps_correlated" in out
+    # capture_steps is reentrant-safe: a second window still correlates
+    with otrace.capture_steps() as cap2:
+        float(step(x, y))
+    assert cap2.error is None and cap2.result is not None
+
+
+# -- request-scoped tracing ----------------------------------------------------
+
+def test_request_tracer_api_and_export(tmp_path):
+    tr = otrace.RequestTracer(capacity=8)
+    t0 = time.monotonic()
+    tid = tr.start("eng", kind="serve", n=1)
+    tr.span(tid, "admission", t0, t0 + 0.001)
+    tr.span(tid, "queue", t0 + 0.001, t0 + 0.002)
+    tr.finish(tid, ok=True)
+    tr.slot_span("eng", 0, t0, t0 + 0.01, tid, tokens=3)
+    # unknown ids are ignored, never raise
+    tr.span("nope", "x", t0, t0)
+    tr.finish(None)
+    snap = tr.snapshot()
+    assert snap["started"] == snap["finished"] == 1
+    assert snap["slot_spans"] == 1
+    path = tr.export_chrome(str(tmp_path / "req.json"))
+    d = json.load(open(path))
+    xs = [e for e in d["traceEvents"] if e.get("ph") == "X"]
+    assert {e["name"] for e in xs} == {"admission", "queue", "slot0"}
+    assert all(e["args"]["trace_id"] == tid for e in xs)
+    procs = {e["args"]["name"] for e in d["traceEvents"]
+             if e.get("ph") == "M" and e.get("name") == "process_name"}
+    assert procs == {"requests:eng", "slots:eng"}
+
+
+def test_serving_trace_id_propagation():
+    """Multi-request ServingEngine run: every request's admission ->
+    queue -> batch_coalesce -> execute spans share ONE trace id."""
+    from paddle_tpu import serving
+    from paddle_tpu.observability.trace import tracer
+
+    eng = serving.ServingEngine(
+        lambda a: a + 1.0, buckets=serving.BucketSpec(batch_sizes=(1, 4)),
+        input_specs=[((4,), "float32")], name="trace_prop")
+    with eng:
+        futs = [eng.submit([np.full(4, i, np.float32)]) for i in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+    traces = tracer().traces(engine="trace_prop")
+    assert len(traces) == 6
+    for t in traces:
+        assert t["ok"] is True
+        names = [s["name"] for s in t["spans"]]
+        assert {"admission", "queue", "batch_coalesce", "execute"} \
+            <= set(names), names
+        # spans are in wall order and the queue ends where coalesce begins
+        t0s = [s["t0"] for s in t["spans"]]
+        assert t0s == sorted(t0s)
+    # distinct requests, distinct ids
+    assert len({t["trace_id"] for t in traces}) == 6
+    assert "latency_ms" in traces[0]["meta"]
+
+
+def test_serving_trace_failures_finish():
+    """Backpressure and shed requests finish their traces as failed —
+    no live-trace leak."""
+    from paddle_tpu import serving
+    from paddle_tpu.observability.trace import tracer
+
+    tr = tracer()
+    before = tr.snapshot()
+    eng = serving.ServingEngine(
+        lambda a: a, buckets=serving.BucketSpec(batch_sizes=(1,)),
+        input_specs=[((2,), "float32")],
+        config=serving.ServingConfig(max_queue=1, warmup_on_start=False),
+        name="trace_fail")
+    # closed engine: the enqueue raises and the trace is finished failed
+    eng._closed = True
+    with pytest.raises(serving.EngineClosed):
+        eng.submit([np.ones(2, np.float32)])
+    after = tr.snapshot()
+    assert after["failed"] >= before["failed"] + 1
+    assert after["live"] == before["live"]
+
+
+@pytest.mark.slow  # GPT fixture is heavy; ci.sh tracing gate runs it
+def test_generation_trace_and_slot_occupancy():
+    """GenerationEngine: prefill/decode spans share the request's trace
+    id, the slot-occupancy track records residencies, and pd_top renders
+    the compact occupancy view (the PR-4 carried item)."""
+    from paddle_tpu import serving
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.observability.trace import tracer
+
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dtype="float32")
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    eng = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=48,
+                                        prefill_buckets=(16,)),
+        name="trace_gen")
+    with eng:
+        prompt = np.arange(5).astype("int64")
+        futs = [eng.submit(prompt, max_new_tokens=4) for _ in range(3)]
+        for f in futs:
+            assert len(f.result(timeout=300)) == 9
+        occ = eng.slot_occupancy()
+    traces = tracer().traces(engine="trace_gen")
+    assert len(traces) == 3
+    for t in traces:
+        names = [s["name"] for s in t["spans"]]
+        assert {"admission", "queue", "prefill", "decode"} <= set(names)
+        decode = next(s for s in t["spans"] if s["name"] == "decode")
+        assert decode["args"]["tokens"] == 4
+    assert occ["slots"] == 2 and occ["residencies"] == 3
+    assert any(v > 0 for v in occ["busy_frac"].values())
+    # slot track in the chrome export carries the owning trace ids
+    evs = tracer().chrome_events()
+    slot_pids = {e["pid"] for e in evs
+                 if e.get("ph") == "M" and e.get("name") == "process_name"
+                 and e["args"]["name"] == "slots:trace_gen"}
+    slot_evs = [e for e in evs if e.get("cat") == "slot"
+                and e["pid"] in slot_pids]
+    assert len(slot_evs) >= 3
+    ids = {t["trace_id"] for t in traces}
+    assert {e["args"]["trace_id"] for e in slot_evs} <= ids
+    # engine stats + hub registry + renderer carry the occupancy view
+    assert "slot_occupancy" in eng.metrics.snapshot()
+    out = obs.render_snapshot(obs.snapshot())
+    assert "slots:" in out and "active" in out
+
+
+# -- flight recorder -----------------------------------------------------------
+
+def _feed_steps(tl, n, ms=0.002):
+    for _ in range(n):
+        with tl.step():
+            time.sleep(ms)
+
+
+def test_flight_recorder_regression_trigger_and_bundle(tmp_path):
+    """A step-time regression vs the rolling baseline trips the detector
+    and auto-dumps a complete, parseable bundle (manifest written last)."""
+    tl = StepTimeline()
+    rec = otrace.FlightRecorder(min_steps=4, regress_factor=3.0,
+                                dump_dir=str(tmp_path),
+                                min_dump_interval_s=0.0,
+                                timeline_obj=tl).attach()
+    _feed_steps(tl, 8)
+    with tl.step():
+        time.sleep(0.05)
+    snap = rec.snapshot()
+    reasons = [a["reason"] for a in snap["anomalies"]]
+    assert any(r.startswith("step_regression") for r in reasons), reasons
+    assert snap["dumps"], "anomaly did not dump"
+    bundle = snap["dumps"][0]["path"]
+    man = json.load(open(os.path.join(bundle, "MANIFEST.json")))
+    for name in ("snapshot.json", "flight_ring.json", "config.json"):
+        assert name in man["files"] and "error" not in man["files"][name]
+        json.load(open(os.path.join(bundle, name)))
+    ring = json.load(open(os.path.join(bundle, "flight_ring.json")))
+    assert ring["steps_recorded"] == 9
+    assert max(r["ms"] for r in ring["ring"]) >= 40
+    cfg = json.load(open(os.path.join(bundle, "config.json")))
+    assert cfg.get("jax") and cfg.get("backend")
+    rec.detach()
+
+
+def test_flight_recorder_stall_compile_and_rate_limit(tmp_path):
+    tl = StepTimeline()
+    rec = otrace.FlightRecorder(min_steps=4, dump_dir=str(tmp_path),
+                                auto_dump=False, stall_frac=0.5,
+                                timeline_obj=tl).attach()
+    _feed_steps(tl, 6)
+    # a compile step is EXPECTED to be slow: no regression anomaly
+    with tl.step():
+        with tl.phase("compile"):
+            time.sleep(0.05)
+    assert not any(a["reason"].startswith("step_regression")
+                   for a in rec.snapshot()["anomalies"])
+    # a stream_wait-dominated step is a stall spike (the 50ms jump
+    # clears the min_regress_ms=25 absolute floor; baseline stalls ~0)
+    with tl.step():
+        with tl.phase("stream_wait"):
+            time.sleep(0.05)
+    reasons = [a["reason"] for a in rec.snapshot()["anomalies"]]
+    assert any(r.startswith("stall_spike") for r in reasons), reasons
+    assert rec.snapshot()["dumps"] == []  # auto_dump off records only
+    # rate limiting: max_dumps bounds explicit dumps too (unless forced)
+    rec.max_dumps = 1
+    assert rec.dump("one") is not None
+    assert rec.dump("two") is None
+    assert rec.dump("forced", force=True) is not None
+    rec.detach()
+
+
+def test_flight_recorder_fault_burst_and_events(tmp_path):
+    from paddle_tpu.distributed.resilience import metrics as rmetrics
+
+    tl = StepTimeline()
+    rec = otrace.FlightRecorder(min_steps=2, burst_n=3, auto_dump=False,
+                                dump_dir=str(tmp_path),
+                                timeline_obj=tl).attach()
+    _feed_steps(tl, 3)  # establish the counter baseline
+    rmetrics.inc("retries", 3)  # a retry burst within one ring window
+    _feed_steps(tl, 1)
+    reasons = [a["reason"] for a in rec.snapshot()["anomalies"]]
+    assert any(r.startswith("fault_burst") for r in reasons), reasons
+    rec.record_event("stream_retry", direction="h2d", group=0)
+    assert rec.snapshot()["events"][-1]["kind"] == "stream_retry"
+    rec.detach()
+
+
+def test_preemption_fires_flight_callbacks():
+    from paddle_tpu.distributed.resilience import preempt
+
+    fired = []
+    cb = lambda: fired.append(1)  # noqa: E731
+    preempt.on_preemption(cb)
+    try:
+        preempt.request_preemption()
+        assert fired == [1]
+    finally:
+        preempt.off_preemption(cb)
+        preempt.clear_preemption()
+
+
+def test_pd_dump_cli_roundtrip(tmp_path, capsys):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "pd_dump", os.path.join(os.path.dirname(__file__), "..", "tools",
+                                "pd_dump.py"))
+    pd_dump = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(pd_dump)
+    assert pd_dump.main(["--out", str(tmp_path), "--reason", "test",
+                         "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "snapshot.json" in out["manifest"]["files"]
+    snap = json.load(open(os.path.join(out["path"], "snapshot.json")))
+    assert "step_timeline" in snap
+
+
+# -- histograms (the PR-4 carried exposition item) -----------------------------
+
+def test_histogram_native_prometheus_exposition():
+    import re
+
+    h = obs.histogram("step_time_ms")
+    n0 = h.count
+    tl = obs.timeline()
+    with tl.step():
+        pass
+    assert h.count == n0 + 1  # every completed step observes
+    obs.histogram("request_latency_ms").observe(12.0)
+    obs.histogram("queue_wait_ms").observe(3.0)
+    text = obs.prometheus_text()
+    assert "# TYPE pt_step_time_ms histogram" in text
+    assert 'pt_step_time_ms_bucket{le="+Inf"}' in text
+    assert "pt_step_time_ms_sum" in text and "pt_step_time_ms_count" in text
+    assert 'pt_request_latency_ms_bucket{le="25.0"}' in text
+    # the whole exposition still line-parses
+    line_re = re.compile(
+        r"^(# (TYPE|HELP) .*|pt_[A-Za-z0-9_]+(\{[^}]*\})? -?[0-9eE.+-]+|"
+        r"pt_[A-Za-z0-9_]+\{le=\"[^\"]+\"\} [0-9]+)$")
+    for line in text.strip().splitlines():
+        assert line_re.match(line), f"unparseable exposition line: {line!r}"
+    # snapshot carries the typed family; cumulative buckets are monotonic
+    snap = obs.snapshot()["step_time_ms"]
+    assert snap["type"] == "histogram"
+    vals = list(snap["buckets"].values())
+    assert vals == sorted(vals)
+    assert snap["buckets"]["+Inf"] == snap["count"]
+
+
+def test_histogram_bucket_math_and_conflict():
+    from paddle_tpu.observability.registry import Histogram
+
+    h = Histogram("t", buckets=(1.0, 10.0))
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["buckets"] == {"1.0": 1, "10.0": 2, "+Inf": 3}
+    assert snap["sum"] == pytest.approx(55.5)
+    assert h.items()[-1] == ("+Inf", 3)
+    # boundary lands in its own bucket (le semantics)
+    h2 = Histogram("t2", buckets=(1.0,))
+    h2.observe(1.0)
+    assert h2.snapshot()["buckets"]["1.0"] == 1
+    obs.histogram("t_conflict", buckets=(1, 2))
+    with pytest.raises(ValueError):
+        obs.histogram("t_conflict", buckets=(3, 4))

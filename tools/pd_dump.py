@@ -1,0 +1,55 @@
+#!/usr/bin/env python
+"""pd_dump: write a paddle_tpu diagnostic bundle (the flight-recorder
+dump, on demand).
+
+    python tools/pd_dump.py                      # bundle under ./flight_dumps
+    python tools/pd_dump.py --out /tmp/diag      # custom root
+    python tools/pd_dump.py --reason oncall      # tag the bundle
+
+The bundle directory contains ``snapshot.json`` (the full observability
+hub), ``flight_ring.json`` (recent step timelines + events, when a
+recorder is live in this process), ``request_trace.json`` (serving
+request/slot chrome-trace), ``device_trace.json`` (last XPlane
+correlation), ``config.json`` (versions/backend/devices/PT_* env) and —
+written LAST — ``MANIFEST.json``: a bundle with a manifest is complete.
+
+The same bundle is written automatically by the flight recorder on
+anomaly triggers, SIGQUIT, and preemption (docs/observability.md).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pd_dump", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--out", default=None,
+                    help="bundle root (default: $PT_FLIGHT_DIR or "
+                         "./flight_dumps)")
+    ap.add_argument("--reason", default="manual")
+    ap.add_argument("--json", action="store_true",
+                    help="print the manifest JSON instead of the path")
+    args = ap.parse_args(argv)
+
+    from paddle_tpu.observability.trace import flight
+    ring = None
+    if flight._RECORDER is not None:
+        ring = flight._RECORDER.snapshot()
+    path = flight.dump_bundle(args.out, args.reason, ring=ring)
+    if args.json:
+        with open(os.path.join(path, "MANIFEST.json")) as f:
+            print(json.dumps({"path": path, "manifest": json.load(f)}))
+    else:
+        print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
