@@ -16,7 +16,7 @@ from ..framework import get_device, set_device  # noqa: F401
 from . import memory  # noqa: F401
 from .memory import (  # noqa: F401
     memory_allocated, max_memory_allocated, memory_reserved, memory_stats,
-    empty_cache,
+    reset_max_memory_allocated, empty_cache,
 )
 
 _CUSTOM: Dict[str, "CustomDeviceRuntime"] = {}

@@ -3,6 +3,12 @@ StatRegistry + python/paddle/device/cuda/__init__.py memory_allocated /
 max_memory_allocated). TPU-native: PJRT owns the allocator, so stats come from
 `Device.memory_stats()` (live HBM) plus a host-side registry of live
 jax.Arrays for per-process accounting on backends without PJRT stats (CPU).
+
+Every read degrades gracefully: `memory_stats()` ALWAYS returns a dict
+carrying `bytes_in_use` and `peak_bytes_in_use` (an empty-stats backend
+yields the live-array fallback, a partial-stats backend is normalized), so
+consumers never KeyError on a backend change. The telemetry layer consumes
+this module through `observability.memory.MemoryMonitor`.
 """
 from __future__ import annotations
 
@@ -11,9 +17,11 @@ from typing import Dict, Optional
 import jax
 
 __all__ = ["memory_allocated", "max_memory_allocated", "memory_reserved",
-           "memory_stats", "empty_cache"]
+           "memory_stats", "reset_max_memory_allocated", "empty_cache"]
 
-_PEAK: Dict[int, int] = {}
+_PEAK: Dict[int, int] = {}        # process-sampled high watermark per device
+_PEAK_FLOOR: Dict[int, int] = {}  # allocator peak at the last reset (masked:
+#                                   PJRT peaks are monotonic, resets are not)
 
 
 def _device(device=None):
@@ -32,20 +40,34 @@ def _device(device=None):
 
 
 def memory_stats(device=None) -> dict:
-    """Raw PJRT stats dict (bytes_in_use, peak_bytes_in_use, ...) or a
-    live-array fallback on backends that expose none."""
+    """Raw PJRT stats dict, normalized to always carry ``bytes_in_use``
+    and ``peak_bytes_in_use`` (ints); backends that expose none (or a
+    partial dict) degrade to the live-array fallback / filled defaults
+    instead of KeyError'ing their consumers."""
     dev = _device(device)
     try:
         stats = dev.memory_stats()
     except Exception:
         stats = None
     if stats:
-        return dict(stats)
+        out = dict(stats)
+        try:
+            in_use = int(out.get("bytes_in_use", 0))
+        except (TypeError, ValueError):
+            in_use = 0
+        out["bytes_in_use"] = in_use
+        try:
+            out["peak_bytes_in_use"] = int(
+                out.get("peak_bytes_in_use", in_use))
+        except (TypeError, ValueError):
+            out["peak_bytes_in_use"] = in_use
+        return out
     total = sum(
         arr.nbytes for arr in jax.live_arrays()
-        if dev in getattr(arr, "devices", lambda: set())())
-    return {"bytes_in_use": total,
-            "peak_bytes_in_use": max(total, _PEAK.get(dev.id, 0))}
+        if not getattr(arr, "is_deleted", lambda: False)()
+        and dev in getattr(arr, "devices", lambda: set())())
+    return {"bytes_in_use": int(total),
+            "peak_bytes_in_use": max(int(total), _PEAK.get(dev.id, 0))}
 
 
 def memory_allocated(device=None) -> int:
@@ -59,10 +81,37 @@ def memory_allocated(device=None) -> int:
 
 
 def max_memory_allocated(device=None) -> int:
+    """High watermark since process start — or since the last
+    ``reset_max_memory_allocated(device)``."""
     stats = memory_stats(device)
     dev = _device(device)
     peak = int(stats.get("peak_bytes_in_use", 0))
-    return max(peak, _PEAK.get(dev.id, 0))
+    floor = _PEAK_FLOOR.get(dev.id, 0)
+    if peak <= floor:
+        # the allocator's (monotonic) peak predates the reset: masked; the
+        # process-sampled watermark below carries the post-reset truth
+        peak = 0
+    return max(peak, _PEAK.get(dev.id, 0),
+               int(stats.get("bytes_in_use", 0)))
+
+
+def reset_max_memory_allocated(device=None) -> None:
+    """Restart the high watermark at the CURRENT allocation (reference
+    device/cuda reset_max_memory_allocated). PJRT's own peak counter is
+    monotonic, so the pre-reset peak is masked rather than cleared — a
+    later ``max_memory_allocated`` reports only highs reached after this
+    call (seeded with the current ``bytes_in_use``)."""
+    dev = _device(device)
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    stats = stats or {}
+    in_use = int(stats.get("bytes_in_use", 0))
+    if not stats:
+        in_use = int(memory_stats(dev)["bytes_in_use"])
+    _PEAK[dev.id] = in_use
+    _PEAK_FLOOR[dev.id] = int(stats.get("peak_bytes_in_use", 0))
 
 
 def memory_reserved(device=None) -> int:

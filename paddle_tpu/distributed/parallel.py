@@ -795,7 +795,10 @@ class ShardedTrainStep:
         return new_params
 
     def _call_offload(self, arrays, tl):
+        from ..jit import _memobs
+
         opt = self.optimizer
+        mo = _memobs()
         cold = self._jitted is None
         if cold:
             self._jitted = self._build_offload(arrays)
@@ -803,13 +806,19 @@ class ShardedTrainStep:
         params = [p.data for p in self.train_params]
         frozen_arrays = [t.data for t in self.frozen]
         with tl.phase("compile" if cold else "host_dispatch"):
-            loss, grads = jit_fwd(params, frozen_arrays,
-                                  random_mod.next_key(), *arrays)
-            new_params = self._stream_update(grads, tl)
+            with mo.oom_guard("sharded_train_step",
+                              label="ShardedTrainStep[offload]",
+                              step=opt._global_step):
+                loss, grads = jit_fwd(params, frozen_arrays,
+                                      random_mod.next_key(), *arrays)
+                new_params = self._stream_update(grads, tl)
         del grads
         for p, a in zip(self.train_params, new_params):
             p.data = a
         opt._global_step += 1
+        if cold:
+            mo.maybe_record_drift(self, arrays, "ShardedTrainStep[offload]",
+                                  jit_fwd)
         return Tensor(loss)
 
     def stream_stats(self):
@@ -854,10 +863,20 @@ class ShardedTrainStep:
             frozen_arrays = [t.data for t in self.frozen]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            key = random_mod.next_key()
+            from ..jit import _memobs
+
+            mo = _memobs()
+            drift_args = mo.struct_args(
+                (params, states, frozen_arrays, lr, step_no, key)
+                + tuple(arrays)) if cold and mo.drift_enabled() else None
             with tl.phase("compile" if cold else "host_dispatch"):
-                loss, new_p, new_s = self._jitted(
-                    params, states, frozen_arrays, lr, step_no,
-                    random_mod.next_key(), *arrays)
+                with mo.oom_guard("sharded_train_step",
+                                  label="ShardedTrainStep",
+                                  step=opt._global_step):
+                    loss, new_p, new_s = self._jitted(
+                        params, states, frozen_arrays, lr, step_no,
+                        key, *arrays)
             if tl.detailed:
                 with tl.phase("device_block"):
                     jax.block_until_ready(loss)
@@ -866,6 +885,9 @@ class ShardedTrainStep:
             for p, s in zip(self.train_params, new_s):
                 opt._accumulators[id(p)] = s
             opt._global_step += 1
+            if cold:
+                mo.maybe_record_drift(self, arrays, "ShardedTrainStep",
+                                      self._jitted, drift_args)
         return Tensor(loss)
 
 
@@ -944,17 +966,24 @@ class ShardedAccumulateStep:
             extra_meta=("offload_accum", k, self.average, self.remat))
 
     def _call_offload(self, arrays, tl):
+        from ..jit import _memobs
+
         outer = self._step
         opt = self.optimizer
+        mo = _memobs()
         cold = self._jitted is None
         if cold:
             self._jitted = self._build_offload(arrays)
         params = [p.data for p in self.train_params]
         frozen_arrays = [t.data for t in self.frozen]
         with tl.phase("compile" if cold else "host_dispatch"):
-            loss, grads = self._jitted(params, frozen_arrays,
-                                       random_mod.next_key(), *arrays)
-            new_params = outer._stream_update(grads, tl)
+            with mo.oom_guard("sharded_accumulate",
+                              label=f"ShardedTrainStep.accumulate"
+                                    f"({self.steps})[offload]",
+                              step=opt._global_step):
+                loss, grads = self._jitted(params, frozen_arrays,
+                                           random_mod.next_key(), *arrays)
+                new_params = outer._stream_update(grads, tl)
         del grads
         for p, a in zip(self.train_params, new_params):
             p.data = a
@@ -1044,10 +1073,20 @@ class ShardedAccumulateStep:
             frozen_arrays = [t.data for t in self.frozen]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            key = random_mod.next_key()
+            from ..jit import _memobs
+
+            mo = _memobs()
+            drift_args = mo.struct_args(
+                (params, states, frozen_arrays, lr, step_no, key)
+                + tuple(arrays)) if cold and mo.drift_enabled() else None
+            label = f"ShardedTrainStep.accumulate({self.steps})"
             with tl.phase("compile" if cold else "host_dispatch"):
-                loss, new_p, new_s = self._jitted(
-                    params, states, frozen_arrays, lr, step_no,
-                    random_mod.next_key(), *arrays)
+                with mo.oom_guard("sharded_accumulate", label=label,
+                                  step=opt._global_step):
+                    loss, new_p, new_s = self._jitted(
+                        params, states, frozen_arrays, lr, step_no,
+                        key, *arrays)
             if tl.detailed:
                 with tl.phase("device_block"):
                     jax.block_until_ready(loss)
@@ -1056,6 +1095,9 @@ class ShardedAccumulateStep:
             for p, s in zip(self.train_params, new_s):
                 opt._accumulators[id(p)] = s
             opt._global_step += 1
+            if cold:
+                mo.maybe_record_drift(self, arrays, label, self._jitted,
+                                      drift_args)
         return Tensor(loss)
 
     def batch_sharding(self, arr) -> NamedSharding:

@@ -21,6 +21,14 @@ Sites consult ``check(kind, **ids)`` (raises ``InjectedFault``, sleeps, or
 and returns True — for faults the site must *produce* rather than raise,
 e.g. a NaN loss). An unmatched call is a few dict reads — the injector is
 always safe to leave wired in production code paths.
+
+Kinds wired today: ``transfer`` / ``slow_transfer`` (StreamLane),
+``crash_mid_save`` (checkpoint commit), ``nan_step`` (fit),
+``batch_fault`` / ``decode_fault`` (serving engines), and ``oom``
+(``observability.memory.oom_guard`` sites in every compiled train step,
+fit, and both serving engines: ``PT_FAULTS="oom@step=N"`` raises a
+RESOURCE_EXHAUSTED-shaped ``InjectedOOM`` that walks the full OOM-
+forensics path — memory report, flight bundle, then the crash).
 """
 from __future__ import annotations
 

@@ -57,6 +57,16 @@ def _nan_skip_exc():
     return NanStepSkipped
 
 
+def _oom_guard(site, **ids):
+    """Memory-truth bracket (observability.memory): the deterministic
+    ``oom`` fault site (``PT_FAULTS="oom@step=N"``) plus forensics — a
+    RESOURCE_EXHAUSTED inside dumps the flight bundle with the memory
+    report BEFORE the crash unwinds the loop."""
+    from ..observability.memory import oom_guard
+
+    return oom_guard(site, **ids)
+
+
 def _auto_device_prefetch(loader, device_sharding):
     """fit(prefetch_to_device=None) default: a DistributedBatchSampler-
     driven DataLoader on an active multi-device mesh prefetches to the
@@ -282,6 +292,11 @@ class Model:
             from ..observability.trace import flight_recorder
 
             flight_recorder()
+            # memory truth: per-step watermark stamps into the monitor's
+            # history (and, via the recorder's ring, into every bundle)
+            from ..observability.memory import memory_monitor
+
+            memory_monitor()
         except Exception:
             pass
         loader = self._make_loader(train_data, batch_size, shuffle, num_workers,
@@ -478,9 +493,11 @@ class Model:
                         else step
                     try:
                         self._check_nan_step_fault(gstep)
-                        outs = self.train_batch(
-                            inputs, labels, update=update and not nan_window,
-                            _loss_scale=1.0 / accumulate_grad_batches)
+                        with _oom_guard("fit", step=gstep):
+                            outs = self.train_batch(
+                                inputs, labels,
+                                update=update and not nan_window,
+                                _loss_scale=1.0 / accumulate_grad_batches)
                     except _nan_skip_exc() as e:
                         # skip-and-continue: the poisoned step is dropped
                         # whole (grads cleared, no optimizer update) and

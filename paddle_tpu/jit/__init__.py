@@ -66,6 +66,20 @@ def _obs():
     return _OBS
 
 
+_MEMOBS = None  # lazily bound observability.memory (drift + OOM forensics)
+
+
+def _memobs():
+    """The memory-truth module every compiled step consults: an unarmed
+    OOM-guard peek per call, drift recording only on cold builds."""
+    global _MEMOBS
+    if _MEMOBS is None:
+        from ..observability import memory as _m
+
+        _MEMOBS = _m
+    return _MEMOBS
+
+
 def _audit_instance_label(kind: str) -> str:
     """Per-instance audit label ("TrainStep#2"): two train steps with
     different batch shapes must not pool signatures in one bucket — that
@@ -362,13 +376,20 @@ class TrainStep:
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
             arrays = [b.data if isinstance(b, Tensor) else jnp.asarray(b) for b in batch]
+            key = random_mod.next_key()
+            mo = _memobs()
+            drift_args = mo.struct_args(
+                (params, states, frozen_arrays, lr, step_no, key)
+                + tuple(arrays)) if cold and mo.drift_enabled() else None
             # cold call = trace + XLA compile + first run; warm = async
             # dispatch (a warm retrace from signature drift lands here too —
             # analysis.retrace names it)
             with tl.phase("compile" if cold else "host_dispatch"):
-                loss, new_p, new_s = self._jitted(
-                    params, states, frozen_arrays, lr, step_no,
-                    random_mod.next_key(), *arrays)
+                with mo.oom_guard("train_step", label="TrainStep",
+                                  step=opt._global_step):
+                    loss, new_p, new_s = self._jitted(
+                        params, states, frozen_arrays, lr, step_no,
+                        key, *arrays)
             if tl.detailed:
                 with tl.phase("device_block"):
                     jax.block_until_ready(loss)
@@ -377,6 +398,9 @@ class TrainStep:
             for p, s in zip(self.train_params, new_s):
                 opt._accumulators[id(p)] = s
             opt._global_step += 1
+            if cold:
+                mo.maybe_record_drift(self, arrays, "TrainStep",
+                                      self._jitted, drift_args)
         return Tensor(loss)
 
 
@@ -490,10 +514,18 @@ class AccumulateStep:
             frozen_arrays = [t.data for t in self.frozen]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
             step_no = jnp.asarray(opt._global_step + 1, jnp.int32)
+            key = random_mod.next_key()
+            mo = _memobs()
+            drift_args = mo.struct_args(
+                (params, states, frozen_arrays, lr, step_no, key)
+                + tuple(arrays)) if cold and mo.drift_enabled() else None
+            label = f"TrainStep.accumulate({self.steps})"
             with tl.phase("compile" if cold else "host_dispatch"):
-                loss, new_p, new_s = self._jitted(
-                    params, states, frozen_arrays, lr, step_no,
-                    random_mod.next_key(), *arrays)
+                with mo.oom_guard("accumulate", label=label,
+                                  step=opt._global_step):
+                    loss, new_p, new_s = self._jitted(
+                        params, states, frozen_arrays, lr, step_no,
+                        key, *arrays)
             if tl.detailed:
                 with tl.phase("device_block"):
                     jax.block_until_ready(loss)
@@ -502,6 +534,9 @@ class AccumulateStep:
             for p, s in zip(self.train_params, new_s):
                 opt._accumulators[id(p)] = s
             opt._global_step += 1
+            if cold:
+                mo.maybe_record_drift(self, arrays, label, self._jitted,
+                                      drift_args)
         return Tensor(loss)
 
 

@@ -143,12 +143,14 @@ class _TransferHandle:
     """One in-flight group transfer; ``wait()`` blocks the consumer and
     charges the blocked time to the lane's ``stall_ms``."""
 
-    __slots__ = ("_event", "_box", "_lane")
+    __slots__ = ("_event", "_box", "_lane", "_nbytes", "_unstaged")
 
     def __init__(self, lane):
         self._event = threading.Event()
         self._box: list = [None, None]  # result, exception
         self._lane = lane
+        self._nbytes = 0      # staged bytes this handle accounts for
+        self._unstaged = False  # staging decrement already applied
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -180,6 +182,8 @@ class StreamLane:
     compute / total transfer time.
     """
 
+    _LANE_NO = [0]
+
     def __init__(self, overlap: bool = True, depth: int = 2):
         self.overlap = bool(overlap)
         self.depth = int(depth)
@@ -187,6 +191,18 @@ class StreamLane:
         self._stats = {"h2d_bytes": 0, "d2h_bytes": 0, "transfer_ms": 0.0,
                        "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0,
                        "retries": 0}
+        self._staging_bytes = 0  # bytes of submissions not yet landed
+        # memory truth: the lane's staging working set (the two-group cap
+        # the offload estimator models) rides in the `memory` provider
+        try:
+            from ..observability.memory import register_component
+
+            StreamLane._LANE_NO[0] += 1
+            register_component(
+                f"stream_lane#{StreamLane._LANE_NO[0]}:staging",
+                type(self).staging_bytes, owner=self)
+        except Exception:
+            pass
         self.events: List[tuple] = []  # (kind, tag) in submission order
         self._q: "queue.Queue" = queue.Queue(maxsize=self.depth)
         self._thread: Optional[threading.Thread] = None
@@ -211,9 +227,11 @@ class StreamLane:
         handle = _TransferHandle(self)
         if not isinstance(placements, (list, tuple)):
             placements = [placements] * len(arrays)
+        handle._nbytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
         with self._lock:
             self.events.append((kind, tag))
             self._stats["in_flight_sum"] += self._q.qsize()
+            self._staging_bytes += handle._nbytes
             seq = self._seq
             self._seq += 1
         if not self.overlap:
@@ -230,8 +248,19 @@ class StreamLane:
             # were blocked in put() — our job could be sitting in a queue no
             # thread reads. Fail it here; idempotent vs the worker's drain.
             handle._box[1] = self._failure
+            self._unstage(handle)
             handle._event.set()
         return handle
+
+    def _unstage(self, handle) -> None:
+        """Release ``handle``'s staging-byte accounting exactly once —
+        called from whichever path completes the job (normal run, the
+        poisoned-queue drain, or the submit-side orphan rescue), which can
+        race each other."""
+        with self._lock:
+            if not handle._unstaged:
+                handle._unstaged = True
+                self._staging_bytes -= handle._nbytes
 
     def _worker(self):
         while True:
@@ -250,6 +279,7 @@ class StreamLane:
                     if job is None:
                         break
                     job[3]._box[1] = self._failure
+                    self._unstage(job[3])
                     job[3]._event.set()
                 with self._lock:
                     self._thread = None
@@ -320,6 +350,7 @@ class StreamLane:
             if serialized:
                 fam.inc(("stall_ms",), ms)
         finally:
+            self._unstage(handle)
             # the consumer may already be blocked in wait(): it must wake
             # even if the telemetry above throws on this worker thread
             handle._event.set()
@@ -329,10 +360,17 @@ class StreamLane:
             self._stats["stall_ms"] += ms
         _lane_fam().inc(("stall_ms",), ms)
 
+    def staging_bytes(self) -> int:
+        """Bytes of submitted-but-not-landed transfers — the lane's live
+        staging working set (capped at ~two groups by the ring depth)."""
+        with self._lock:
+            return max(self._staging_bytes, 0)
+
     # -- reads ----------------------------------------------------------------
     def stats(self) -> dict:
         with self._lock:
             s = dict(self._stats)
+        s["staging_bytes"] = max(self._staging_bytes, 0)
         s["overlap"] = self.overlap
         s["hidden_ms"] = max(s["transfer_ms"] - s["stall_ms"], 0.0)
         s["overlap_efficiency"] = round(

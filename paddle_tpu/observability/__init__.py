@@ -40,7 +40,7 @@ from .exposition import (  # noqa: F401
 __all__ = [
     "CounterFamily", "Histogram", "Hub", "LatencyWindow", "MetricsRegistry",
     "StepTimeline", "family", "gauge", "histogram", "hub",
-    "register_provider", "register_registry", "timeline", "trace",
+    "register_provider", "register_registry", "timeline", "trace", "memory",
     "dump", "prometheus_text", "render_snapshot", "report", "serve",
     "snapshot", "stop_serving",
 ]
@@ -79,6 +79,16 @@ def _register_builtin_providers() -> None:
 
         return tracer().snapshot()
 
+    def _memory():
+        from .memory import memory_monitor
+
+        return memory_monitor().snapshot()
+
+    def _memory_drift():
+        from .memory import drift_snapshot
+
+        return drift_snapshot()
+
     register_provider("persistent_cache", _persistent_cache)
     register_provider("retrace_events", _retrace_events)
     register_provider("step_timeline", lambda: timeline().summary())
@@ -86,6 +96,11 @@ def _register_builtin_providers() -> None:
     # correlation digest + the request tracer's ring counters
     register_provider("device_trace", _device_trace)
     register_provider("request_trace", _request_trace)
+    # device-truth memory (observability.memory): per-device allocator
+    # stats + watermarks + component gauges, and the estimator-drift
+    # validation rows (predicted vs XLA vs measured)
+    register_provider("memory", _memory)
+    register_provider("memory_drift", _memory_drift)
     # counter families the wired call sites feed — created here so every
     # snapshot carries the full schema even before the first event
     family("trace_cache", ("site", "event"))
@@ -103,6 +118,8 @@ def _register_builtin_providers() -> None:
     family("resilience", ("metric",))
     # flight recorder (observability.trace.flight): anomalies, dumps
     family("flight_recorder", ("event",))
+    # memory-truth events (observability.memory): oom reports, pressure
+    family("memory_events", ("event",))
     # native Prometheus histogram families (the external-scrape shapes):
     # request latency + queue wait (fed by every MetricsRegistry) and
     # per-step wall time (fed by StepTimeline) — created here so the
@@ -115,6 +132,7 @@ def _register_builtin_providers() -> None:
 _register_builtin_providers()
 
 from . import trace  # noqa: E402,F401  (device-truth tracing subpackage)
+from . import memory  # noqa: E402,F401  (memory-truth: monitor/drift/OOM)
 
 # PT_METRICS_PORT: opt-in exposition endpoint at import ("" / unset = off)
 _port = os.environ.get("PT_METRICS_PORT", "").strip()

@@ -79,6 +79,16 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             lines.append(_device_trace_table(body))
             lines.append("")
             continue
+        if fam == "memory" and isinstance(body, dict) \
+                and "devices" in body:
+            lines.append(_memory_table(body))
+            lines.append("")
+            continue
+        if fam == "memory_drift" and isinstance(body, dict) \
+                and "records" in body:
+            lines.append(_memory_drift_table(body))
+            lines.append("")
+            continue
         if fam == "registries" and isinstance(body, dict):
             lines.append(_registries_table(body))
             lines.append("")
@@ -193,6 +203,69 @@ def _registries_table(body: Dict[str, Any]) -> str:
                 f"{occ.get('active')}/{occ.get('slots')}  "
                 f"residencies={occ.get('residencies')}")
     return "\n".join(lines) if lines else "  (none)"
+
+
+def _fmt_bytes(n) -> str:
+    try:
+        n = float(n)
+    except (TypeError, ValueError):
+        return str(n)
+    for unit in ("B", "KB", "MB", "GB"):
+        if abs(n) < 1024 or unit == "GB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}GB"
+
+
+def _memory_table(body: Dict[str, Any]) -> str:
+    """Per-device in-use/watermark bars (scaled to the device limit where
+    the backend reports one, else to the watermark), host RSS, and the
+    registered component gauges — the pd_top memory panel."""
+    lines = []
+    for key in sorted(body.get("devices", {})):
+        row = body["devices"][key]
+        use = row.get("bytes_in_use", 0)
+        wm = row.get("watermark_bytes", use)
+        scale = row.get("limit_bytes") or wm or 1
+        bar = _slot_bar(min(use / scale, 1.0), width=16)
+        lines.append(
+            f"  {key:<10} [{bar}] in_use={_fmt_bytes(use):>9}  "
+            f"watermark={_fmt_bytes(wm):>9}"
+            + (f"  limit={_fmt_bytes(row['limit_bytes'])}"
+               if row.get("limit_bytes") else "")
+            + f"  ({row.get('source')})")
+    host = body.get("host", {})
+    if host:
+        lines.append(
+            f"  {'host':<10} rss={_fmt_bytes(host.get('rss_bytes'))}  "
+            f"peak={_fmt_bytes(host.get('peak_rss_bytes'))}")
+    comps = body.get("components", {})
+    for name in sorted(comps):
+        lines.append(f"  {name:<44} {_fmt_bytes(comps[name])}")
+    hist = body.get("watermark_history") or []
+    if hist:
+        last = hist[-1]
+        lines.append(
+            f"  steps_sampled={body.get('steps_sampled')}  last_step: "
+            f"in_use={_fmt_bytes(last.get('in_use'))} "
+            f"wm={_fmt_bytes(last.get('watermark'))} "
+            f"host={_fmt_bytes(last.get('host_rss'))}")
+    return "\n".join(lines) if lines else "  (no devices)"
+
+
+def _memory_drift_table(body: Dict[str, Any]) -> str:
+    """Predicted-vs-XLA/measured drift rows (the estimator validation)."""
+    head = (f"  records={body.get('count')}  bound={body.get('bound')}  "
+            f"within_bound={body.get('within_bound', 'n/a')}")
+    lines = [head]
+    for r in (body.get("records") or [])[-6:]:
+        ratio = r.get("ratio")
+        lines.append(
+            f"  {str(r.get('label'))[:34]:<36}"
+            f"pred={_fmt_bytes(r.get('predicted_bytes')):>9}  "
+            f"xla={_fmt_bytes(r.get('xla_peak_bytes')):>9}  "
+            f"drift={ratio if ratio is not None else '-'}")
+    return "\n".join(lines)
 
 
 def _device_trace_table(body: Dict[str, Any]) -> str:

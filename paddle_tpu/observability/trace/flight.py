@@ -12,8 +12,17 @@ writes a ``pd_dump`` bundle the moment an anomaly trips:
 - ``flight_ring.json``   the step ring + runtime events + anomaly log
 - ``request_trace.json`` request/slot chrome-trace (serving processes)
 - ``device_trace.json``  last XPlane correlation digest (if captured)
+- ``memory_report.json`` memory truth: monitor snapshot + watermark
+  history, top live buffers by shape/dtype/sharding, drift records, and
+  the OOM context when one was reported (observability.memory)
 - ``config.json``        versions, backend, devices, PT_* env, argv
 - ``MANIFEST.json``      written LAST (the parseable-bundle contract)
+
+Every ring step carries a ``mem`` stamp (device bytes in use / watermark /
+host RSS) so a bundle's last-N-steps view answers "where was the memory
+going" as well as "where was the time going". Serving engines land their
+executed batches / decode steps in the events ring (``serving_step``)
+with the same stamps.
 
 Detectors (each arms only once enough baseline exists):
 
@@ -28,7 +37,11 @@ Detectors (each arms only once enough baseline exists):
 - **burst**: ``nan_inf_events`` + resilience ``retries``/
   ``skipped_steps`` grow by >= ``burst_n`` within the last
   ``burst_window`` steps (a slow drip over thousands of steps never
-  fires; three in a tight window does).
+  fires; three in a tight window does);
+- **memory pressure**: device bytes-in-use grew by >= ``mem_growth_bytes``
+  across the baseline window AND rose in >= 80% of its steps (leak
+  suspicion — a steady plateau or a one-step spike-and-release never
+  fires; sustained growth dumps the bundle BEFORE the eventual OOM).
 
 Triggers are rate-limited (``min_dump_interval_s``, ``max_dumps``);
 SIGQUIT and preemption dumps bypass the limit — an operator asking gets
@@ -110,6 +123,12 @@ def dump_bundle(out_dir: Optional[str] = None, reason: str = "manual",
             _write("device_trace.json", cor.summary())
     except Exception as e:
         files["device_trace.json"] = {"error": str(e)[:200]}
+    try:
+        from ..memory import build_memory_report
+
+        _write("memory_report.json", build_memory_report())
+    except Exception as e:
+        files["memory_report.json"] = {"error": str(e)[:200]}
     _write("config.json", _config_digest())
     # manifest LAST: its presence certifies the bundle is complete
     manifest = {"reason": reason, "time_utc": _utcstamp(),
@@ -157,9 +176,10 @@ class FlightRecorder:
                  min_steps: int = 8, regress_factor: float = 3.0,
                  min_regress_ms: float = 25.0, stall_frac: float = 0.6,
                  burst_n: int = 3, burst_window: int = 8,
+                 mem_growth_bytes: int = 64 << 20,
                  dump_dir: Optional[str] = None, auto_dump: bool = True,
                  min_dump_interval_s: float = 60.0, max_dumps: int = 3,
-                 timeline_obj=None):
+                 timeline_obj=None, mem_stamp_fn=None):
         self.capacity = int(capacity)
         self.baseline = int(baseline)
         self.min_steps = int(min_steps)
@@ -168,6 +188,10 @@ class FlightRecorder:
         self.stall_frac = float(stall_frac)
         self.burst_n = int(burst_n)
         self.burst_window = int(burst_window)
+        self.mem_growth_bytes = int(mem_growth_bytes)
+        # memory stamper: observability.memory.step_stamp by default;
+        # tests inject a deterministic one
+        self._mem_stamp_fn = mem_stamp_fn
         self.dump_dir = dump_dir
         self.auto_dump = bool(auto_dump)
         self.min_dump_interval_s = float(min_dump_interval_s)
@@ -251,10 +275,27 @@ class FlightRecorder:
             pass
         return out
 
+    def _mem_stamp(self) -> Optional[Dict[str, float]]:
+        """Per-step memory stamp (device in-use / watermark / host RSS):
+        the default stamper is the throttled monitor read; any failure
+        degrades to no stamp, never a broken step."""
+        try:
+            fn = self._mem_stamp_fn
+            if fn is None:
+                from ..memory import step_stamp
+
+                fn = self._mem_stamp_fn = step_stamp
+            return fn()
+        except Exception:
+            return None
+
     def _on_step(self, wall_ms: float, phases) -> None:
         rec = {"t": time.time(), "ms": round(wall_ms, 3),
                "phases": {n: round(d, 3) for (n, _rel, d) in phases},
                "counters": self._sample_counters()}
+        mem = self._mem_stamp()
+        if mem is not None:
+            rec["mem"] = mem
         with self._lock:
             prior = list(self._ring)
             self._ring.append(rec)
@@ -268,6 +309,17 @@ class FlightRecorder:
         with self._lock:
             self._events.append({"t": time.time(), "kind": kind, **data})
         self._fam.inc(("event:" + kind,))
+
+    def record_serving_step(self, engine: str, kind: str, ms: float,
+                            n: int) -> None:
+        """One executed serving batch / decode step into the events ring
+        (the PR-7 carried ROADMAP item: serving lands in the ring
+        automatically), memory-stamped like a train step."""
+        data = {"engine": engine, "op": kind, "ms": round(ms, 3), "n": n}
+        mem = self._mem_stamp()
+        if mem is not None:
+            data["mem"] = mem
+        self.record_event("serving_step", **data)
 
     # -- detection ------------------------------------------------------------
     def _detect(self, rec: Dict, prior: List[Dict]) -> List[str]:
@@ -305,6 +357,29 @@ class FlightRecorder:
                         for k in ("nan_inf", "retries", "skipped_steps"))
             if burst >= self.burst_n:
                 reasons.append(f"fault_burst:+{burst:g}")
+        # memory pressure = sustained device-bytes growth across the
+        # baseline window (leak suspicion): total growth over the
+        # threshold AND rising in >= 80% of the window's steps — a
+        # plateau, or one spike-and-release, never fires
+        mem = rec.get("mem")
+        if mem is not None and len(window) >= self.min_steps:
+            series = [r["mem"]["in_use"] for r in prior[-self.baseline:]
+                      if r.get("mem")] + [mem["in_use"]]
+            if len(series) > self.min_steps:
+                growth = series[-1] - series[0]
+                pairs = list(zip(series, series[1:]))
+                rising = sum(1 for a, b in pairs if b >= a)
+                strict = sum(1 for a, b in pairs if b > a)
+                # >= 3 strict rises: equal pairs are common (the 50 ms
+                # stamp throttle repeats stamps across fast steps), so the
+                # rising gate alone is near-vacuous — one or two isolated
+                # jumps settling into plateaus (a resident working set
+                # landing) are not a leak signature; a leak keeps stepping
+                if growth >= self.mem_growth_bytes and strict >= 3 and \
+                        rising >= 0.8 * len(pairs):
+                    reasons.append(
+                        f"memory_pressure:+{growth / 1e6:.0f}MB_over_"
+                        f"{len(series) - 1}steps")
         return reasons
 
     # -- triggering -----------------------------------------------------------
@@ -355,6 +430,7 @@ class FlightRecorder:
                     "regress_factor": self.regress_factor,
                     "min_regress_ms": self.min_regress_ms,
                     "stall_frac": self.stall_frac, "burst_n": self.burst_n,
+                    "mem_growth_bytes": self.mem_growth_bytes,
                 },
             }
 

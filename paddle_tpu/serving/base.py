@@ -25,6 +25,14 @@ def _tracer():
     return tracer()
 
 
+def _oom_guard(site, label=None, **ids):
+    """Memory-truth OOM bracket (observability.memory): injected-fault
+    site + RESOURCE_EXHAUSTED forensics around device execution."""
+    from ..observability.memory import oom_guard
+
+    return oom_guard(site, label=label, **ids)
+
+
 class QueueFull(RuntimeError):
     """Admission control: the bounded request queue is at capacity."""
 
@@ -63,6 +71,22 @@ class EngineBase:
         self._start_lock = threading.Lock()
         self._closed = False
         self._thread: Optional[threading.Thread] = None
+        self._flight_rec = None  # lazily-resolved process flight recorder
+
+    def _flight(self):
+        """The process flight recorder (created on first use) so executed
+        batches/decode steps land in its ring automatically — None when
+        the observability stack is unavailable."""
+        rec = self._flight_rec
+        if rec is None:
+            try:
+                from ..observability.trace.flight import flight_recorder
+
+                rec = flight_recorder()
+            except Exception:
+                rec = False
+            self._flight_rec = rec
+        return rec or None
 
     # -- hooks ----------------------------------------------------------------
     def _on_start(self) -> None:

@@ -32,7 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .base import (BadRequest, DeadlineExceeded, EngineBase, EngineClosed,
-                   QueueFull, _tracer)
+                   QueueFull, _oom_guard, _tracer)
 from .buckets import BucketSpec
 
 __all__ = ["ServingConfig", "ServingEngine", "QueueFull", "DeadlineExceeded",
@@ -141,6 +141,28 @@ class ServingEngine(EngineBase):
         self._runner_factory = self._make_runner_factory(target)
         self._compiled: Dict[Tuple, Callable] = {}
         self._warmed = False
+        # memory truth: this engine's executable footprint (padded input
+        # working set per warmed bucket) rides in the `memory` provider
+        try:
+            from ..observability.memory import register_component
+
+            register_component(f"serving:{self.name}:executables",
+                               type(self)._executable_footprint_bytes,
+                               owner=self)
+        except Exception:
+            pass
+
+    def _executable_footprint_bytes(self) -> int:
+        """Padded input-buffer bytes across warmed buckets — the working
+        set the engine's executables hold (weights are the model's own)."""
+        total = 0
+        for (bucket_b, key) in list(self._compiled):
+            for dt, shape in key:
+                n = bucket_b
+                for d in shape:
+                    n *= int(d)
+                total += n * _np_dtype(dt).itemsize
+        return total
 
     # -- target plumbing ------------------------------------------------------
     @staticmethod
@@ -470,12 +492,20 @@ class ServingEngine(EngineBase):
         self._batch_no = getattr(self, "_batch_no", -1) + 1
         _injector().check("batch_fault", engine=self.name,
                           batch=self._batch_no)
-        # a runner fault propagates to _worker's batch-failure handler
+        # a runner fault propagates to _worker's batch-failure handler;
+        # RESOURCE_EXHAUSTED additionally leaves a memory-forensics bundle
+        # (PT_FAULTS="oom@site=serving" drills the path)
         with profiler.RecordEvent(
                 f"serving::batch[{self.name} b{bucket_b} n{n}]",
                 "Serving"):
-            outs = runner(inputs)
+            with _oom_guard("serving", label=self._label(bucket_b, key),
+                            engine=self.name, batch=self._batch_no):
+                outs = runner(inputs)
         t_done = time.monotonic()
+        fr = self._flight()
+        if fr is not None:  # serving batches land in the flight ring
+            fr.record_serving_step(self.name, "batch",
+                                   (t_done - t_exec) * 1e3, n)
         for i, r in enumerate(batch):
             if not r.future.done():
                 r.future.set_result([o[i] for o in outs])

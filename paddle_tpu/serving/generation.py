@@ -30,7 +30,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .base import BadRequest, EngineBase, _tracer
+from .base import BadRequest, EngineBase, _oom_guard, _tracer
 
 __all__ = ["GenerationConfig", "GenerationEngine"]
 
@@ -224,6 +224,15 @@ class GenerationEngine(EngineBase):
             donate_argnums=(0,) if donate else ())
 
         self._slots = [_Slot() for _ in range(S)]
+        # memory truth: the slot arena's K/V bytes ride in the `memory`
+        # provider (the one fixed-shape buffer continuous batching holds)
+        try:
+            from ..observability.memory import register_component
+
+            register_component(f"serving:{self.name}:kv_arena",
+                               type(self)._kv_arena_bytes, owner=self)
+        except Exception:
+            pass
         # slot-occupancy history: (slot, t0, t1, tokens) per residency —
         # the timeline track behind the pd_top occupancy view and the
         # chrome-trace slots:<engine> process
@@ -231,6 +240,11 @@ class GenerationEngine(EngineBase):
         self._residencies = 0
         self._t_start = time.monotonic()
         self.metrics.gauge("slot_occupancy", self.slot_occupancy)
+
+    def _kv_arena_bytes(self) -> int:
+        """Bytes held by the fixed-shape slot K/V arena (all layers)."""
+        return sum(int(c.nbytes) for c in self._k) + \
+            sum(int(c.nbytes) for c in self._v)
 
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16) -> "Future":
@@ -403,11 +417,19 @@ class GenerationEngine(EngineBase):
         self._decode_no = getattr(self, "_decode_no", -1) + 1
         _injector().check("decode_fault", engine=self.name,
                           step=self._decode_no)
+        t_dec = time.monotonic()
         with profiler.RecordEvent(
                 f"serving::decode[{self.name} n{len(active)}]", "Serving"):
-            nxt, self._k, self._v = self._decode(
-                self._params, self._k, self._v, tokens, lengths)
+            with _oom_guard("generation", label=f"serving:{self.name}:decode",
+                            engine=self.name, step=self._decode_no):
+                nxt, self._k, self._v = self._decode(
+                    self._params, self._k, self._v, tokens, lengths)
         nxt = np.asarray(nxt)
+        fr = self._flight()
+        if fr is not None:  # decode steps land in the flight ring
+            fr.record_serving_step(self.name, "decode",
+                                   (time.monotonic() - t_dec) * 1e3,
+                                   len(active))
         self.metrics.inc("decode_steps")
         self.metrics.inc("tokens_total", len(active))
         self.metrics.observe_occupancy(len(active) / S)

@@ -102,6 +102,39 @@ print("observability gate OK:", {"steps": tl["steps"],
                                  "overhead_us": probe})
 PY
 
+echo "== memory-truth gate (ISSUE-8: memory family + drift bound + OOM drill) =="
+# the bench smoke's telemetry dump must carry the `memory` family (per-
+# device watermarks, host RSS) and a populated `memory_drift` provider
+# whose predicted-vs-XLA ratio sits inside the CI bound — the estimator
+# validation that makes it a trusted planner input
+python - <<'PY' || exit 1
+import json
+snap = json.load(open("bench_artifacts/telemetry_warm_path.json"))
+mem = snap["memory"]
+assert mem["devices"], mem
+for key, row in mem["devices"].items():
+    assert row.get("watermark_bytes", 0) > 0, (key, row)
+    assert "bytes_in_use" in row, (key, row)
+assert mem["host"]["rss_bytes"] > 0, mem["host"]
+drift = snap["memory_drift"]
+assert drift["count"] >= 1, drift
+assert drift.get("within_bound") is True, drift
+lo, hi = drift["bound"]
+assert lo <= drift["last_ratio"] <= hi, drift
+wp = snap["bench"]["warm_path"].get("memory") or {}
+assert wp.get("drift_ratio") is not None, wp   # measured-vs-predicted row
+print("memory gate OK:", {"devices": sorted(mem["devices"]),
+                          "last_ratio": drift["last_ratio"],
+                          "records": drift["count"],
+                          "warm_path_memory": wp})
+PY
+# full memory-truth test file (slow legs included), then the injected-OOM
+# forensics drill: PT_FAULTS="oom@step=N" must leave a complete parseable
+# bundle whose memory report names the top live buffers
+JAX_PLATFORMS=cpu python -m pytest tests/test_memory_truth.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/mem_drill.py || exit 1
+
 echo "== device-truth tracing gate (ISSUE-7: capture/serving-trace/flight drills + full test file) =="
 # XPlane parse round-trips, trace-ID propagation, flight-recorder
 # trigger->bundle — the heavy capture tests are slow-marked for tier-1
