@@ -417,7 +417,7 @@ def _measure_stream_ab(cfg, batch, seq, iters=3):
     if batch % ndev:
         batch = ndev * max(1, batch // ndev)
 
-    def one(overlap):
+    def one(overlap, eager=True):
         paddle.seed(0)
         dist.reset_mesh()
         dist.init_mesh(dp=ndev)
@@ -429,6 +429,7 @@ def _measure_stream_ab(cfg, batch, seq, iters=3):
         step = dist.ShardedTrainStep(model,
                                      lambda m, x, y: m(x, labels=y), o)
         step._stream_overlap = overlap
+        step._stream_eager = eager
         ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
         losses = [float(step(ids, ids))]  # compile + step 1
         t0 = time.perf_counter()
@@ -441,7 +442,12 @@ def _measure_stream_ab(cfg, batch, seq, iters=3):
         return dt, losses, stats, groups
 
     ser_dt, ser_losses, _ser_stats, groups = one(False)
-    ov_dt, ov_losses, ov_stats, _ = one(True)
+    # PR-5 carried A/B: the drain-at-boundary walk (eager=False) vs the
+    # cross-step pipeline fill (default: final uploads handed to the next
+    # dispatch as futures, so the next step's group-0 grad download is
+    # submitted during fwd+bwd)
+    drain_dt, drain_losses, _drain_stats, _ = one(True, eager=False)
+    ov_dt, ov_losses, ov_stats, _ = one(True, eager=True)
     steps_total = iters + 1
     return {
         "serialized_step_time_s": round(ser_dt, 4),
@@ -450,7 +456,11 @@ def _measure_stream_ab(cfg, batch, seq, iters=3):
         # the two gate-critical entries stay inside _scalar_row's first-8
         # window so a size-capped headline still carries them
         "overlap_efficiency": ov_stats["overlap_efficiency"],
-        "losses_bit_equal": bool(np.array_equal(ser_losses, ov_losses)),
+        "losses_bit_equal": bool(np.array_equal(ser_losses, ov_losses)
+                                 and np.array_equal(ov_losses, drain_losses)),
+        "boundary_drain_step_time_s": round(drain_dt, 4),
+        "fill_overlap_speedup": round(drain_dt / ov_dt, 3) if ov_dt else None,
+        "pinned_staging": bool(ov_stats.get("pinned_staging")),
         "stream_groups": groups,
         "transfer_ms_per_step": round(
             ov_stats["transfer_ms"] / steps_total, 2),
@@ -841,6 +851,137 @@ def _measure_checkpoint_stall(cfg, batch, seq, saves=4, steps_per_save=4):
     }
 
 
+def _measure_autoplan(n_top=3, iters=4, batch=16, seq=64):
+    """ISSUE-10 tentpole acceptance: predicted-vs-measured ranking
+    fidelity of the cost-model planner on the 8-device CPU dryrun mesh
+    (the MULTICHIP_r05 config space). ``plan()`` ranks the full candidate
+    space for the bench tiny-Llama shape; the top-``n_top`` picks plus
+    the median- and worst-ranked feasible candidates are then REALLY
+    trained for a few steps each through ``apply_plan`` (the same
+    ShardedTrainStep / group_sharded / accumulate path production uses)
+    and the measured step times are compared against the predictions:
+
+    - ``top_vs_best_ratio``: top pick's measured time over the best
+      measured time (acceptance: <= 1.25);
+    - ``beats_median``: top pick strictly faster than the median
+      measured candidate;
+    - ``rank_corr``: Spearman correlation of predicted vs measured
+      ranks over the measured set.
+    """
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu.distributed.auto_parallel import planner
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    ndev = len(jax.devices())
+    hbm = float(os.environ.get("PT_AUTOPLAN_HBM", 9.5e9))
+    cfg = LlamaConfig.tiny()
+    paddle.seed(0)
+    dist.reset_mesh()
+    probe = LlamaForCausalLM(cfg)
+    cands = dist.plan(probe, n_devices=ndev, hbm_bytes=hbm,
+                      batch=batch, seq=seq)
+    assert cands and cands[0].feasible, "plan() returned no feasible config"
+    del probe
+
+    def _executable(cand):
+        # the triaged jax-0.4.37 limit: ring/Ulysses cp needs a partial-
+        # auto shard_map when any other axis is live — those configs score
+        # fine but cannot RUN here (they lower on newer jax / TPU rounds)
+        mesh = cand.config["mesh"]
+        if mesh["cp"] > 1 and not hasattr(jax, "shard_map"):
+            others = 1
+            for ax, d in mesh.items():
+                if ax != "cp":
+                    others *= d
+            if others > 1:
+                return False
+        return True
+
+    exe = [(i, c) for i, c in enumerate(cands) if _executable(c)]
+    env_skipped = len(cands) - len(exe)
+    # measured set: the top picks + the median- and worst-ranked feasible
+    # candidates (a spread the median/ratio acceptance is meaningful
+    # over). The median position is pushed OUT of the measured top
+    # cluster when the executable list is small — comparing the top pick
+    # against a near-tied sibling would turn the gate into a coin flip
+    median_pos = min(max(len(exe) // 2, n_top), len(exe) - 1)
+    idxs = sorted({*range(min(n_top, len(exe))),
+                   median_pos, len(exe) - 1})
+    loss_fn = lambda m, x, y: m(x, labels=y)  # noqa: E731
+
+    rows = []
+    for pos in idxs:
+        rank, cand = exe[pos]
+        paddle.seed(0)
+        dist.reset_mesh()
+        model = LlamaForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=model.parameters())
+        _env, step = planner.apply_plan(model, o, cand, loss_fn)
+        ids = paddle.randint(0, cfg.vocab_size, [batch, seq])
+        float(step(ids, ids))  # compile
+        float(step(ids, ids))  # warm
+        best = 1e9
+        for _ in range(3):  # best-of-3 windows defeats scheduler noise
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = step(ids, ids)
+            float(loss)
+            best = min(best, (time.perf_counter() - t0) / iters)
+        rows.append({"rank": rank, "config": cand.describe(),
+                     "predicted_ms": round(cand.predicted_step_s * 1e3, 3),
+                     "measured_ms": round(best * 1e3, 3),
+                     "predicted_peak_mb": round(
+                         cand.predicted_peak_bytes / 1e6, 2)})
+        dist.reset_mesh()
+
+    def _spearman(xs, ys):
+        rx = np.argsort(np.argsort(xs)).astype(float)
+        ry = np.argsort(np.argsort(ys)).astype(float)
+        if rx.std() == 0 or ry.std() == 0:
+            return None
+        return float(np.corrcoef(rx, ry)[0, 1])
+
+    measured = [r["measured_ms"] for r in rows]
+    predicted = [r["predicted_ms"] for r in rows]
+    top_ms = rows[0]["measured_ms"]
+    best_ms = min(measured)
+    # "beats the median candidate" = the MEDIAN-RANKED candidate's own
+    # measured time (the acceptance's wording) — NOT the sample median of
+    # the measured set, which the top-3 cluster dominates (noise between
+    # near-tied top picks must not flip the gate)
+    median_rank = exe[median_pos][0]
+    median_ms = next(r["measured_ms"] for r in rows
+                     if r["rank"] == median_rank)
+    # a one-candidate space has no median to beat — report None, never a
+    # tautological False
+    beats = None if median_pos == 0 else bool(top_ms < median_ms)
+    corr = _spearman(predicted, measured)
+    out = {
+        "top_vs_best_ratio": round(top_ms / best_ms, 4) if best_ms else None,
+        "beats_median": beats,
+        "rank_corr": round(corr, 4) if corr is not None else None,
+        "top_is_feasible": bool(cands[0].feasible),
+        "candidates_total": len(cands),
+        "n_devices": ndev,
+        "top_measured_ms": top_ms,
+        "top_predicted_ms": rows[0]["predicted_ms"],
+        "median_candidate_ms": median_ms,
+        "env_skipped": env_skipped,
+        "top_config": exe[0][1].describe(),
+        "hbm_gb": round(hbm / 1e9, 2),
+        "batch": batch, "seq": seq,
+        "measured": rows,
+        "top8": [c.to_dict() for c in cands[:8]],
+        "mode": "plan() over the MULTICHIP config space; top/median/worst "
+                "feasible candidates trained via apply_plan",
+    }
+    return out
+
+
 def _telemetry_overhead_probe(n=20000):
     """Micro-benchmark of the observability hot path (the ISSUE-4 overhead
     acceptance): per-increment cost of a labeled counter and per-step cost
@@ -1102,6 +1243,19 @@ def _run_one(name: str):
         _note_recipe(name, out)
         print("BENCH_RESULT " + json.dumps(out))
         return
+    if name == "autoplan":
+        # the ranking-fidelity leg runs on the 8-device CPU host mesh (the
+        # MULTICHIP dryrun topology) regardless of the parent's platform —
+        # pin the backend BEFORE any jax device use, like the cpuref leg
+        os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+            " --xla_force_host_platform_device_count=8"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        out = _measure_autoplan()
+        _note_recipe(name, out)
+        print("BENCH_RESULT " + json.dumps(out))
+        return
     if name == "checkpoint_stall":
         import jax
 
@@ -1172,9 +1326,26 @@ def _note_recipe(name, out):
 
         _BENCH_ROWS[name] = _compact(out) if isinstance(out, dict) else out
         obs.register_provider("bench", lambda: dict(_BENCH_ROWS))
+        if name == "autoplan" and isinstance(out, dict):
+            # ranking-fidelity provider (ISSUE-10 acceptance: reported in
+            # the telemetry dump, not just the headline). Registered HERE
+            # so the PARENT process — whose later dumps overwrite a
+            # spawned child's telemetry file — carries it too.
+            ap = {
+                "fidelity": {k: out.get(k) for k in (
+                    "top_vs_best_ratio", "beats_median", "rank_corr",
+                    "top_config", "candidates_total", "top_measured_ms",
+                    "top_predicted_ms", "env_skipped")},
+                "measured": out.get("measured") or [],
+                "top8": out.get("top8") or [],
+            }
+            obs.register_provider("autoplan", lambda: ap)
         obs.dump(os.path.join("bench_artifacts", f"telemetry_{name}.json"))
     except Exception:
         pass  # telemetry must never sink the bench
+
+
+_LIVE_PROCS = set()  # in-flight _spawn children; the watchdog reaps them
 
 
 def _spawn(name: str, timeout=1200, env=None):
@@ -1192,19 +1363,29 @@ def _spawn(name: str, timeout=1200, env=None):
     if env:
         child_env = dict(os.environ)
         child_env.update(env)
-    r = subprocess.run([sys.executable, os.path.abspath(__file__),
-                        "--config", name], capture_output=True, text=True,
-                       timeout=timeout, env=child_env)
-    for line in r.stdout.splitlines():
+    p = subprocess.Popen([sys.executable, os.path.abspath(__file__),
+                          "--config", name], stdout=subprocess.PIPE,
+                         stderr=subprocess.PIPE, text=True, env=child_env)
+    _LIVE_PROCS.add(p)
+    try:
+        try:
+            out, err = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.kill()
+            p.communicate()
+            raise
+    finally:
+        _LIVE_PROCS.discard(p)
+    for line in out.splitlines():
         if line.startswith("BENCH_RESULT "):
             return json.loads(line[len("BENCH_RESULT "):])
-    raise RuntimeError(f"bench config {name} failed:\n{r.stderr[-2000:]}")
+    raise RuntimeError(f"bench config {name} failed:\n{err[-2000:]}")
 
 
 # keys too large for the driver-parsed line (r4's parse failure was an
 # oversized single line); they live in the artifact file instead
 _HEAVY_KEYS = ("device_op_table", "op_table", "losses_tpu", "losses_cpu",
-               "dispatch_probe", "cold", "warm")
+               "dispatch_probe", "cold", "warm", "measured", "top8")
 
 # -- wall-clock contract ------------------------------------------------------
 # the r05 blackout was rc=124 with NOTHING on stdout: one leg overran the
@@ -1224,6 +1405,73 @@ def _arm_budget():
     budget = float(os.environ.get("BENCH_BUDGET_S", "1500"))
     if budget > 0:
         _DEADLINE = time.monotonic() + budget
+        _start_watchdog(budget)
+
+
+def _start_watchdog(budget: float):
+    """Blackout round-3 defense: the r05 round died rc=124 with
+    parsed=null DESPITE the atexit/SIGTERM re-print, because ``timeout
+    -k 10``'s follow-up SIGKILL landed before the handler finished — a
+    Python signal handler only runs when the MAIN thread surfaces from
+    native code, and a main thread pinned inside an XLA compile never
+    does. This thread needs no cooperation: it emits the most recent
+    headline and exits 0 with margin to spare BEFORE the external
+    window closes, headline-last contract intact."""
+    import threading
+
+    margin = min(45.0, max(budget * 0.15, 5.0))
+    fire_at = _DEADLINE - margin
+
+    def watch():
+        while True:
+            rem = fire_at - time.monotonic()
+            if rem <= 0:
+                break
+            time.sleep(min(rem, 5.0))
+        # deliberate trade-off: a leg still running here has overrun the
+        # budget every other leg respected (skip-and-note at rem<90) —
+        # truncating it keeps every COMPLETED leg's row (the headline
+        # re-emits after each leg) where the external SIGKILL would leave
+        # rc=124 and possibly nothing. Exit 0 only when the flagship
+        # value actually landed; a stub-only run is still a failure.
+        # Reap in-flight recipe children first: os._exit would orphan
+        # them to keep burning CPU (and rewriting artifacts) under
+        # whatever the harness runs next.
+        for p in list(_LIVE_PROCS):
+            try:
+                p.kill()
+            except Exception:
+                pass
+        if _LAST_HEADLINE is not None:
+            # print(), not os.write: this is an ordinary thread, and the
+            # TextIOWrapper lock serializes against a main thread caught
+            # mid-_emit — a raw fd write could land INSIDE its buffered
+            # flush and corrupt the last-line contract (the signal-handler
+            # path keeps os.write, where reentrancy is the hazard instead)
+            print("\n" + _LAST_HEADLINE, flush=True)
+        try:
+            ok = json.loads(_LAST_HEADLINE)["value"] is not None
+        except Exception:
+            ok = False
+        os._exit(0 if ok else 1)
+
+    threading.Thread(target=watch, daemon=True,
+                     name="bench-watchdog").start()
+
+
+def _prior_headline():
+    """Startup read-back of the on-disk headline (satellite of the same
+    blackout): a prior round interrupted hard enough to lose stdout still
+    surfaces its last parseable result in THIS round's starting stub."""
+    try:
+        with open(os.path.join("bench_artifacts", "headline.json")) as f:
+            row = json.loads(f.read())
+        if isinstance(row, dict) and row.get("value") is not None:
+            return {"value": row.get("value"),
+                    "vs_baseline": row.get("vs_baseline")}
+    except Exception:
+        pass
+    return None
 
 
 def _remaining_s():
@@ -1356,10 +1604,14 @@ def main():
 
     _arm_budget()
     _install_exit_headline()
+    prior = _prior_headline()  # read BEFORE the stub emit overwrites it
+    stub = {"status": "starting"}
+    if prior:
+        stub["prior_round"] = prior
     # FIRST line of output: parseable immediately, value filled in later
     _emit(json.dumps({"metric": "llama_pretrain_mfu", "value": None,
                       "unit": "%", "vs_baseline": None,
-                      "detail": {"status": "starting"}}))
+                      "detail": stub}))
     full = "--full" in sys.argv or \
         os.environ.get("BENCH_FULL", "") in ("1", "true")
     on_tpu = jax.devices()[0].platform != "cpu"
@@ -1372,6 +1624,8 @@ def main():
         for key, fn in (
                 ("warm_path", lambda: _measure_warm_path(
                     LlamaConfig.tiny(), batch=2, seq=64, iters=3, accum=4)),
+                # own process: the fidelity leg needs the 8-device host mesh
+                ("autoplan", lambda: _spawn("autoplan", timeout=600)),
                 ("stream_capacity", lambda: _measure_stream_ab(
                     LlamaConfig.tiny(), batch=2, seq=64, iters=3)),
                 ("checkpoint_stall", lambda: _measure_checkpoint_stall(
@@ -1440,6 +1694,9 @@ def main():
     leg("serving", lambda: detail.__setitem__("serving", _spawn("serving")))
     leg("warm_path",
         lambda: detail.__setitem__("warm_path", _spawn("warm_path")))
+    leg("autoplan",
+        lambda: detail.__setitem__("autoplan", _spawn("autoplan",
+                                                      timeout=600)))
     leg("stream_capacity",
         lambda: detail.__setitem__("stream_capacity",
                                    _spawn("stream_capacity")))

@@ -14,7 +14,11 @@ from __future__ import annotations
 import time
 from typing import Dict, List, Optional
 
-__all__ = ["CostModel"]
+from . import comm  # noqa: F401
+from .comm import LinkModel, link_model_for, calibrate_from_counters  # noqa: F401
+
+__all__ = ["CostModel", "comm", "LinkModel", "link_model_for",
+           "calibrate_from_counters"]
 
 
 class CostModel:
@@ -151,6 +155,17 @@ class CostModel:
         from .. import analysis as A
 
         return A.estimate_peak(A.capture(target, *args)).to_dict()
+
+    def plan_parallel(self, model, n_devices=None, hbm_bytes=None,
+                      batch: int = 8, seq: int = 128, **kw):
+        """The auto-parallel planner through the CostModel surface
+        (reference cost_model.py serves the planner; ours delegates to
+        ``distributed.auto_parallel.plan`` — same cost tables, see
+        ``cost_model.comm``)."""
+        from ..distributed.auto_parallel.planner import plan
+
+        return plan(model, n_devices=n_devices, hbm_bytes=hbm_bytes,
+                    batch=batch, seq=seq, **kw)
 
     def get_static_op_time(self, op_name: str, forward: bool = True,
                            dtype: str = "float32") -> dict:

@@ -48,4 +48,5 @@ class ParallelEnv:
 from . import auto_parallel  # noqa: F401,E402
 from .auto_parallel import (  # noqa: F401,E402
     shard_tensor, shard_op, ProcessMesh, Engine, propose_mesh, complete_specs,
+    PlanCandidate, apply_plan, plan,
 )

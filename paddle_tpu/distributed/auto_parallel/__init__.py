@@ -122,3 +122,5 @@ def shard_op(op_fn, dist_attr=None, out_shard_specs=None):
 
 from .completion import complete_specs  # noqa: E402,F401
 from .engine import Engine, propose_mesh  # noqa: E402,F401
+from .planner import (PlanCandidate, apply_plan, plan,  # noqa: E402,F401
+                      profile_model, score_config)

@@ -240,6 +240,8 @@ class Engine:
         self._step = None
         self._prepared = False
         self.proposed_specs: Dict[str, Optional[tuple]] = {}
+        self.plan_candidates = None   # ranked PlanCandidates (auto_plan)
+        self.applied_plan = None      # the PlanCandidate prepare() applied
 
     # -- planning + completion ----------------------------------------------
     def _ensure_mesh(self):
@@ -264,14 +266,56 @@ class Engine:
         return self.loss(out, batch[-1])
 
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train",
-                sample_batch=None):
+                sample_batch=None, auto_plan=False, hbm_bytes=None,
+                topology=None, plan_kwargs=None):
         """Plan the mesh (if absent), complete parameter shardings from any
-        user shard_tensor seeds, and compile the train step lazily."""
-        env = self._ensure_mesh()
+        user shard_tensor seeds, and compile the train step lazily.
+
+        ``auto_plan=True`` runs the cost-model planner (``planner.plan``)
+        over the full config space — mesh axes x accumulate(k) x remat x
+        offload/ZeRO — and APPLIES the top feasible pick: the mesh is
+        installed, ``group_sharded_parallel`` wraps the optimizer when the
+        plan says ZeRO/offload, and ``_ensure_step`` builds the fused
+        ``accumulate(k)``/remat step the plan chose. The ranked list stays
+        on ``self.plan_candidates`` for inspection; the applied pick on
+        ``self.applied_plan``."""
+        if auto_plan:
+            env = self._auto_plan(sample_batch, hbm_bytes, topology,
+                                  plan_kwargs or {})
+        else:
+            env = self._ensure_mesh()
         if sample_batch is not None:
             self._complete(env, sample_batch)
         self._prepared = True
         return self
+
+    def _auto_plan(self, sample_batch, hbm_bytes, topology, plan_kwargs):
+        import jax
+
+        from .planner import install_plan, plan as plan_fn
+
+        self.plan_candidates = plan_fn(
+            self.model, n_devices=len(jax.devices()), hbm_bytes=hbm_bytes,
+            sample_batch=sample_batch, optimizer=self.optimizer,
+            loss_fn=self._loss_fn if self.loss is not None else None,
+            topology=topology, **plan_kwargs)
+        best = self.plan_candidates[0]
+        if not best.feasible:
+            # plan() falls back to infeasible candidates (bytes-ranked)
+            # when nothing fits; applying one would just move the failure
+            # to a runtime RESOURCE_EXHAUSTED — refuse at prepare() time,
+            # where the budget problem is actionable
+            raise ValueError(
+                f"Engine.prepare(auto_plan=True): no candidate fits the "
+                f"HBM budget (closest: {best.describe()} needs "
+                f"~{best.predicted_peak_bytes / 1e9:.2f} GB/device); add "
+                f"devices, raise hbm_bytes if the budget was pessimistic, "
+                f"or pin a config by hand (init_mesh + "
+                f"group_sharded_parallel) to attempt it anyway")
+        self.applied_plan = best
+        env, self.model, self.optimizer = install_plan(
+            self.model, self.optimizer, best)
+        return env
 
     def _complete(self, env, sample_batch):
         from ...jit import _Binder
@@ -319,6 +363,10 @@ class Engine:
                 self.prepare(sample_batch=batch)
             self._step = ShardedTrainStep(self.model, self._loss_fn,
                                           self.optimizer)
+            if self.applied_plan is not None:
+                from .planner import wrap_plan_step
+
+                self._step = wrap_plan_step(self._step, self.applied_plan)
         return self._step
 
     def fit(self, train_data, epochs=1, batch_size=32, steps_per_epoch=None,

@@ -1,0 +1,635 @@
+"""Cost-model-driven auto-parallel planner: ``plan(model, chips, hbm)``.
+
+Reference: python/paddle/distributed/auto_parallel/planner.py +
+cost_model.py (survey §(e)) — the semi-automatic SPMD planner that picks
+mesh degrees so nobody hand-tunes them at production scale. TPU-native
+rebuild, closing ROADMAP direction 3 with the instrumentation earlier
+PRs validated:
+
+- the COMPUTE term prices each candidate from real jaxpr FLOP counts
+  (``analysis.program``'s walker over one captured fwd+bwd);
+- the COLLECTIVE term prices per-op bytes-on-wire against a per-link
+  bandwidth/latency table (``cost_model.comm``, seeded from the PR-4
+  collective counters and bench measurements, overridable per topology);
+- the FEASIBILITY gate reuses the live-range HBM estimator family
+  (within ~8% of XLA, continuously validated by the PR-8
+  ``memory_drift`` CI bound) — the activation term comes from a
+  live-range sweep of the captured program and the whole estimate is
+  scaled by the measured drift ratio, so infeasible plans are pruned
+  before ranking, not discovered by an OOM.
+
+The search space is exactly what this repo executes (MULTICHIP_r05):
+mesh shapes over dp/mp/pp/cp/ep/sharding (divisor-constrained by
+heads/layers/experts) x ``accumulate(k)`` x remat on/off x
+offload/``os_g``. ``plan()`` returns ranked ``PlanCandidate``s whose
+``config`` dicts feed ``group_sharded_parallel`` /
+``fleet.pipeline_configs`` directly; ``apply_plan`` builds the step.
+"""
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...cost_model.comm import (LinkModel, all_gather_factor,
+                                all_to_all_factor, link_model_for,
+                                reduce_scatter_factor, ring_factor)
+
+__all__ = ["ModelProfile", "PlanCandidate", "profile_model",
+           "enumerate_candidates", "score_config", "plan", "apply_plan",
+           "normalize_config"]
+
+AXES = ("dp", "mp", "pp", "cp", "ep", "sharding")
+
+# fp32 state words per parameter ELEMENT (dtype-independent, unlike the
+# engine's bytes-per-param-byte table which assumed bf16 params)
+_OPT_STATE_WORDS = {"adamw": 2.0, "adam": 2.0, "momentum": 1.0, "sgd": 0.0,
+                    "adafactor": 0.05}
+
+
+# ---------------------------------------------------------------------------
+# model profiling: one abstract capture, everything else is arithmetic
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ModelProfile:
+    """Everything the scoring model needs, measured once per ``plan()``:
+    static shape facts plus a real fwd+bwd capture (FLOPs from the
+    analysis walker, activation working set from the live-range sweep)."""
+
+    param_elems: int
+    param_bytes: int              # model-dtype bytes
+    dtype_size: int
+    num_heads: int
+    num_kv_heads: int
+    num_layers: int
+    num_experts: int
+    hidden: int
+    batch: int
+    seq: int
+    flops_per_step: float         # fwd+bwd at (batch, seq), unsharded
+    act_bytes: int                # live-range transient peak beyond
+    # params+grads at (batch, seq), unsharded, no remat
+    label: str = "model"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {k: getattr(self, k) for k in (
+            "param_elems", "param_bytes", "num_heads", "num_kv_heads",
+            "num_layers", "num_experts", "hidden", "batch", "seq",
+            "flops_per_step", "act_bytes", "label")}
+
+
+def _default_loss_fn(model, *batch):
+    if len(batch) >= 2 and hasattr(model, "config"):
+        return model(batch[0], labels=batch[1])
+    return model(*batch)
+
+
+def _synth_batch(model, batch: int, seq: int):
+    cfg = getattr(model, "config", None)
+    vocab = int(getattr(cfg, "vocab_size", 0) or 0)
+    if vocab <= 0:
+        raise ValueError(
+            "plan/profile_model: pass sample_batch= for models without a "
+            "config.vocab_size (only causal-LM batches can be synthesized)")
+    ids = jnp.zeros((batch, seq), jnp.int32)
+    return (ids, ids)
+
+
+def _capture_fwd_bwd(model, loss_fn, batch_arrays):
+    """ClosedJaxpr of value_and_grad(loss) over the trainable params —
+    abstract trace only, nothing runs on device, and the training run's
+    random stream is left untouched."""
+    from ...core import autograd
+    from ...core.tensor import Tensor
+    from ...framework import random as random_mod
+    from ...jit import _Binder
+
+    named = list(model.named_parameters())
+    train = [p for _, p in named if not p.stop_gradient]
+    frozen = [p for _, p in named if p.stop_gradient] + \
+        [b for _, b in getattr(model, "named_buffers", lambda: [])()]
+    train_arrays = [p.data for p in train]
+    frozen_arrays = [t.data for t in frozen]
+
+    def fwd_bwd(param_arrays, fr_arrays, *batch):
+        def loss_of(pa):
+            ts = train + frozen
+            with _Binder(ts) as b:
+                b.bind(list(pa) + list(fr_arrays))
+                with autograd.no_grad():
+                    loss = loss_fn(model, *[Tensor(a) for a in batch])
+            return loss.data.astype(jnp.float32)
+
+        return jax.value_and_grad(loss_of)(tuple(param_arrays))
+
+    gen = random_mod.default_generator()
+    saved = gen.get_state()
+    try:
+        closed = jax.make_jaxpr(fwd_bwd)(train_arrays, frozen_arrays,
+                                         *batch_arrays)
+    finally:
+        gen.set_state(saved)
+    return closed, train_arrays
+
+
+def profile_model(model, batch: int = 8, seq: int = 128,
+                  sample_batch: Optional[Sequence] = None,
+                  loss_fn: Optional[Callable] = None) -> ModelProfile:
+    """Measure the planner's inputs from one abstract fwd+bwd capture."""
+    from ...analysis.memory import estimate_peak_jaxpr
+    from ...analysis.program import Program, _data_of
+
+    loss_fn = loss_fn or _default_loss_fn
+    if sample_batch is not None:
+        arrays = [_data_of(b) for b in sample_batch]
+        if getattr(arrays[0], "ndim", 0) >= 1:
+            batch = int(arrays[0].shape[0])
+        if getattr(arrays[0], "ndim", 0) >= 2:
+            seq = int(arrays[0].shape[1])
+    else:
+        arrays = list(_synth_batch(model, batch, seq))
+    closed, train_arrays = _capture_fwd_bwd(model, loss_fn, arrays)
+    prog = Program(closed, label=type(model).__name__)
+    open_jaxpr = closed.jaxpr if hasattr(closed, "jaxpr") else closed
+    est = estimate_peak_jaxpr(open_jaxpr)
+    param_bytes = sum(int(a.nbytes) for a in train_arrays)
+    param_elems = sum(int(a.size) for a in train_arrays)
+    batch_bytes = sum(int(getattr(a, "nbytes", 0)) for a in arrays)
+    # peak = resident params (+batch) + grads-as-outputs + live transients;
+    # strip the params/grads so the activation term can be resharded
+    # per-candidate independently of the weight terms
+    act = max(int(est.peak_bytes) - 2 * param_bytes - batch_bytes,
+              param_bytes // 8, 1)
+    cfg = getattr(model, "config", None)
+    return ModelProfile(
+        param_elems=param_elems, param_bytes=param_bytes,
+        dtype_size=max(param_bytes // max(param_elems, 1), 1),
+        num_heads=int(getattr(cfg, "num_attention_heads", 0) or 0),
+        num_kv_heads=int(getattr(cfg, "num_key_value_heads", 0) or 0),
+        num_layers=int(getattr(cfg, "num_hidden_layers", 0) or 0),
+        num_experts=int(getattr(cfg, "num_experts", 0) or 0),
+        hidden=int(getattr(cfg, "hidden_size", 0) or 0),
+        batch=batch, seq=seq,
+        flops_per_step=float(prog.total_flops()),
+        act_bytes=act, label=type(model).__name__)
+
+
+# ---------------------------------------------------------------------------
+# candidate configs
+# ---------------------------------------------------------------------------
+
+def normalize_config(raw: Dict[str, Any], batch: Optional[int] = None
+                     ) -> Dict[str, Any]:
+    """Canonical config dict from a loose one (e.g. a MULTICHIP_r05 matrix
+    entry ``{"dp": 2, "mp": 2, "cp": 2}`` or ``{"sharding": 4, "dp": 2,
+    "level": "os_g"}``). Keys outside the mesh axes pass through."""
+    mesh = {ax: int(raw.get(ax, 1) or 1) for ax in AXES}
+    level = raw.get("level")
+    if level not in (None, "os", "os_g", "p_g_os"):
+        raise ValueError(f"bad sharding level {level!r}")
+    if mesh["sharding"] > 1 and level is None:
+        level = "os_g"  # a sharding axis without a level means ZeRO-2
+    k = int(raw.get("accumulate_steps", 1) or 1)
+    cfg = {
+        "mesh": mesh,
+        "level": level,
+        "offload": bool(raw.get("offload", False)),
+        "accumulate_steps": k,
+        "remat": bool(raw.get("remat", False)),
+    }
+    if batch:
+        cfg["micro_batch_size"] = max(batch // k, 1)
+    return cfg
+
+
+from .engine import _divisors  # noqa: E402  (one divisor scan, one home)
+
+
+def enumerate_candidates(n_devices: int, profile: ModelProfile, *,
+                         batch: Optional[int] = None,
+                         accumulate: Sequence[int] = (1, 2, 4),
+                         remat: Sequence[bool] = (False, True),
+                         levels: Sequence[Optional[str]] = (None, "os_g",
+                                                            "p_g_os"),
+                         offload: Sequence[bool] = (False, True),
+                         cp_degrees: Sequence[int] = (1, 2),
+                         pp_degrees: Sequence[int] = (1,),
+                         max_candidates: int = 1024
+                         ) -> List[Dict[str, Any]]:
+    """Every config this repo's executors can run on ``n_devices``:
+
+    - mp constrained by attention-head (and kv-head) divisibility;
+    - cp by sequence divisibility; ep by expert divisibility (and only
+      for MoE models); pp by layer divisibility (default OFF — the plain
+      GSPMD step replicates over an idle pp axis, so pp rides the
+      LayerDesc pipeline path and is scored on request, not proposed);
+    - the leftover degree lands on the data axes: plain ``dp`` without a
+      ZeRO level, the ``sharding`` axis (plus dp/sharding splits) with
+      one; offload only composes with a ZeRO level;
+    - ``accumulate(k)`` only where the global batch splits into k
+      microbatches that still divide the data degree.
+    """
+    batch = batch or profile.batch
+    heads, kv = profile.num_heads, profile.num_kv_heads
+    seq, layers, experts = profile.seq, profile.num_layers, \
+        profile.num_experts
+    meshes: List[Dict[str, int]] = []
+    for mp in _divisors(n_devices):
+        if heads and heads % mp:
+            continue
+        if kv and kv % mp:
+            continue
+        rest_mp = n_devices // mp
+        for pp in pp_degrees:
+            if rest_mp % pp or (layers and layers % pp) or pp < 1:
+                continue
+            rest_pp = rest_mp // pp
+            for cp in cp_degrees:
+                if rest_pp % cp or (seq and seq % cp) or cp < 1:
+                    continue
+                rest_cp = rest_pp // cp
+                eps = [1] if experts <= 0 else [
+                    e for e in _divisors(rest_cp) if experts % e == 0]
+                for ep in eps:
+                    data = rest_cp // ep
+                    base = {"dp": 1, "mp": mp, "pp": pp, "cp": cp,
+                            "ep": ep, "sharding": 1}
+                    meshes.append(dict(base, dp=data))
+                    if data > 1:
+                        meshes.append(dict(base, sharding=data))
+                    if data >= 4 and data % 2 == 0:
+                        # a dp/sharding split must preserve the product
+                        # (data=5 would silently shrink the mesh to 4)
+                        meshes.append(dict(base, dp=2, sharding=data // 2))
+    seen = set()
+    configs: List[Dict[str, Any]] = []
+    for mesh in meshes:
+        data = mesh["dp"] * mesh["sharding"]
+        if batch % data:
+            continue
+        for level in levels:
+            if mesh["sharding"] > 1 and level is None:
+                continue  # a sharding axis requires a ZeRO level
+            if mesh["sharding"] == 1 and level is not None:
+                continue  # ZeRO without a sharding axis is inert here
+            for off in offload:
+                if off and level is None:
+                    continue  # offload rides group_sharded_parallel
+                for k in accumulate:
+                    if k < 1 or batch % k or (batch // k) % data:
+                        continue
+                    for rm in remat:
+                        cfg = normalize_config(
+                            dict(mesh, level=level, offload=off,
+                                 accumulate_steps=k, remat=rm),
+                            batch=batch)
+                        key = _config_key(cfg)
+                        if key not in seen:
+                            seen.add(key)
+                            configs.append(cfg)
+                        if len(configs) >= max_candidates:
+                            return configs
+    return configs
+
+
+def _config_key(cfg: Dict[str, Any]) -> str:
+    mesh = cfg["mesh"]
+    return json.dumps({
+        "mesh": {ax: mesh[ax] for ax in AXES},
+        "level": cfg.get("level"), "offload": bool(cfg.get("offload")),
+        "k": int(cfg.get("accumulate_steps", 1)),
+        "remat": bool(cfg.get("remat"))}, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# scoring
+# ---------------------------------------------------------------------------
+
+@dataclass
+class PlanCandidate:
+    """One scored config: predicted step time + peak HBM + the config
+    dicts the executors consume."""
+
+    config: Dict[str, Any]
+    predicted_step_s: float
+    predicted_peak_bytes: int
+    feasible: bool
+    breakdown: Dict[str, float] = field(default_factory=dict)
+
+    @property
+    def mesh(self) -> Dict[str, int]:
+        """``init_mesh(**cand.mesh)`` kwargs (only the used axes)."""
+        return {ax: d for ax, d in self.config["mesh"].items() if d > 1} \
+            or {"dp": 1}
+
+    def group_sharded_kwargs(self) -> Optional[Dict[str, Any]]:
+        """kwargs for ``group_sharded_parallel`` (None when no ZeRO)."""
+        if self.config.get("level") is None:
+            return None
+        return {"level": self.config["level"],
+                "offload": bool(self.config.get("offload"))}
+
+    def pipeline_configs(self) -> Dict[str, int]:
+        """The ``fleet.pipeline_configs`` dict this plan implies."""
+        k = int(self.config.get("accumulate_steps", 1))
+        return {"accumulate_steps": k,
+                "micro_batch_size": int(self.config.get(
+                    "micro_batch_size", 1))}
+
+    def describe(self) -> str:
+        used = ",".join(f"{ax}{d}" for ax, d in self.config["mesh"].items()
+                        if d > 1) or "dp1"
+        bits = [used]
+        if self.config.get("level"):
+            bits.append(self.config["level"])
+        if self.config.get("offload"):
+            bits.append("offload")
+        if self.config.get("accumulate_steps", 1) > 1:
+            bits.append(f"k{self.config['accumulate_steps']}")
+        if self.config.get("remat"):
+            bits.append("remat")
+        return "+".join(bits)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"config": self.config, "describe": self.describe(),
+                "predicted_step_s": self.predicted_step_s,
+                "predicted_peak_bytes": self.predicted_peak_bytes,
+                "predicted_peak_gb": round(
+                    self.predicted_peak_bytes / 1e9, 3),
+                "feasible": self.feasible, "breakdown": self.breakdown}
+
+
+def _drift_ratio() -> float:
+    """Measured predicted/XLA ratio of the live-range estimator family
+    (PR-8 ``memory_drift``), clamped to its CI bound; 1.0 when no drift
+    record exists yet."""
+    try:
+        from ...observability.memory import drift_snapshot
+
+        r = drift_snapshot().get("last_ratio")
+        if r:
+            return float(min(max(float(r), 0.5), 2.0))
+    except Exception:
+        pass
+    return 1.0
+
+
+def _predict_peak_bytes(profile: ModelProfile, cfg: Dict[str, Any],
+                        opt_words: float, drift_ratio: float
+                        ) -> Tuple[int, Dict[str, float]]:
+    """Per-device peak-HBM model: the live-range activation measurement
+    resharded per-candidate + analytic weight/grad/state terms, divided
+    by the measured estimator drift so the gate tracks XLA, not the
+    estimator's bias."""
+    mesh = cfg["mesh"]
+    mp, pp, cp, ep = mesh["mp"], mesh["pp"], mesh["cp"], mesh["ep"]
+    data = mesh["dp"] * mesh["sharding"]
+    sdp = mesh["sharding"]
+    level = cfg.get("level")
+    k = int(cfg.get("accumulate_steps", 1))
+    pb = profile.param_bytes
+    wdeg = mp * max(ep, 1) * (sdp if level == "p_g_os" else 1) * pp
+    gdeg = mp * max(ep, 1) * (sdp if level in ("os_g", "p_g_os") else 1) * pp
+    sdeg = mp * max(ep, 1) * (sdp if level is not None else 1) * pp
+    weights = pb / wdeg
+    grads = pb / gdeg
+    state = opt_words * 4.0 * profile.param_elems / sdeg
+    # activations: batch shards over the data axes, sequence over cp,
+    # layers over pp; mp shards the fat intermediates but not the
+    # residual stream (sqrt as the in-between); accumulate(k) runs 1/k of
+    # the batch per microbatch; remat holds ~boundary residuals only
+    acts = profile.act_bytes / (data * cp * pp * k) / math.sqrt(max(mp, 1))
+    if cfg.get("remat"):
+        acts *= 0.35
+    accum_buf = (4.0 * profile.param_elems / gdeg) if k > 1 else 0.0
+    staging = 0.0
+    if cfg.get("offload"):
+        # host-parked master/state: nothing resident but the lane's
+        # two-group staging working set (PR-5 two-group model)
+        state = 0.0
+        group = min(2 ** 23, pb / max(wdeg, 1))
+        staging = 2.0 * 2.0 * group
+    peak = (weights + grads + state + acts + accum_buf + staging)
+    peak = peak / max(drift_ratio, 1e-6)
+    breakdown = {"weights": weights, "grads": grads, "state": state,
+                 "acts": acts, "accum_buf": accum_buf, "staging": staging,
+                 "drift_ratio": drift_ratio}
+    return int(peak), breakdown
+
+
+def _predict_step_s(profile: ModelProfile, cfg: Dict[str, Any],
+                    link: LinkModel) -> Tuple[float, Dict[str, float]]:
+    """Step-time model: compute (jaxpr FLOPs over the device pool, remat
+    recompute and the pipeline bubble charged) + collective streams
+    priced per link (mp activation all-reduces, cp ring hops, ep
+    all-to-alls per layer per microbatch; one grad reduce(-scatter) per
+    step) + the offload stream's exposed transfer."""
+    mesh = cfg["mesh"]
+    mp, pp, cp, ep = mesh["mp"], mesh["pp"], mesh["cp"], mesh["ep"]
+    data = mesh["dp"] * mesh["sharding"]
+    sdp = mesh["sharding"]
+    level = cfg.get("level")
+    k = int(cfg.get("accumulate_steps", 1))
+    layers = max(profile.num_layers, 1)
+    world = data * mp * pp * cp * ep
+    flops = profile.flops_per_step * (4.0 / 3.0 if cfg.get("remat") else 1.0)
+    bubble = (2.0 * pp + pp - 1) / (2.0 * pp) if pp > 1 else 1.0
+    compute = flops / (world * link.peak_flops) * bubble
+    coll = 0.0
+    lat = link.coll_latency_s
+    bw = link.ici_bytes_per_s
+    # per-replica activation traffic proxy: the live-range working set
+    # sharded onto this candidate's data/cp axes
+    act_local = profile.act_bytes / max(data * cp, 1)
+    if mp > 1:
+        coll += 2.0 * act_local * ring_factor(mp) / bw
+        coll += 4.0 * layers * lat * k
+    if cp > 1:
+        coll += act_local * ring_factor(cp) / bw
+        coll += layers * (cp - 1) * lat * k
+    if ep > 1:
+        coll += 2.0 * act_local * all_to_all_factor(ep) / bw
+        coll += 2.0 * layers * lat * k
+    if pp > 1:
+        boundary = profile.batch * profile.seq * profile.hidden * \
+            profile.dtype_size / max(data * cp, 1)
+        coll += 2.0 * boundary * (pp - 1) / bw + 2.0 * pp * lat * k
+    # gradients reduce over every data-carrying axis (dp and sharding
+    # alike — under os_g/p_g_os the reduce is a scatter to the state
+    # shard, priced by the factor below)
+    grad_deg = data
+    if grad_deg > 1:
+        gb = profile.param_bytes / (mp * max(ep, 1))
+        factor = reduce_scatter_factor(grad_deg) \
+            if level in ("os_g", "p_g_os") else ring_factor(grad_deg)
+        coll += gb * factor / bw + lat
+    # parameter all-gathers: a ZeRO level computes the update at the
+    # state shard, so the os/os_g levels gather the NEW replicated params
+    # once per step; p_g_os keeps params sharded but re-gathers them at
+    # use — fwd AND bwd (the known ZeRO-3 bandwidth tax, which is why
+    # os_g outranks p_g_os at flagship scale on ICI while p_g_os wins on
+    # byte-cheap host meshes)
+    if sdp > 1:
+        gather = profile.param_bytes / (mp * max(ep, 1)) * \
+            all_gather_factor(sdp) / bw
+        coll += (2.0 if level == "p_g_os" else 1.0) * gather + lat
+    # optimizer-update memory traffic (~4 f32 reads + 2 writes per
+    # element at the update's placement): sharded state shrinks it under
+    # every ZeRO level, and only p_g_os also writes the new params
+    # sharded — the term that separates the levels on byte-cheap links
+    state_deg = mp * max(ep, 1) * pp * (sdp if level else 1)
+    write_deg = mp * max(ep, 1) * pp * (sdp if level == "p_g_os" else 1)
+    update_s = profile.param_elems * (16.0 / state_deg + 8.0 / write_deg) \
+        / link.hbm_bytes_per_s
+    # fused accumulate is ONE executable per window, but each scanned
+    # microbatch still pays a (small) scheduling charge — keeps k>1 from
+    # tying with k=1 when nothing else separates them
+    dispatch = link.dispatch_s * (1.0 + 0.1 * (k - 1))
+    off = 0.0
+    if cfg.get("offload"):
+        wdeg = mp * max(ep, 1) * (sdp if level == "p_g_os" else 1)
+        moved = 2.0 * profile.param_bytes / max(wdeg, 1)  # grads down + up
+        off = moved / link.host_bytes_per_s * (1.0 - link.host_hidden_frac)
+        dispatch += 4 * link.dispatch_s  # per-group host update walk
+    total = compute + coll + dispatch + off + update_s
+    return total, {"compute_s": compute, "collective_s": coll,
+                   "dispatch_s": dispatch, "offload_s": off,
+                   "update_s": update_s, "bubble": bubble}
+
+
+def _opt_words(optimizer) -> float:
+    if isinstance(optimizer, (int, float)) and not isinstance(optimizer,
+                                                              bool):
+        return float(optimizer)  # pre-resolved words-per-element
+    name = optimizer if isinstance(optimizer, str) else \
+        type(optimizer).__name__
+    return _OPT_STATE_WORDS.get(name.lower(), 2.0)
+
+
+def score_config(profile: ModelProfile, config: Dict[str, Any], *,
+                 link: Optional[LinkModel] = None,
+                 hbm_bytes: Optional[float] = None,
+                 optimizer: Any = "adamw",
+                 drift_ratio: Optional[float] = None,
+                 headroom: float = 0.9) -> PlanCandidate:
+    """Score ONE config (loose dicts accepted — every MULTICHIP_r05
+    matrix entry round-trips through here)."""
+    cfg = normalize_config(dict(config), batch=profile.batch) \
+        if "mesh" not in config else config
+    link = link or link_model_for()
+    if hbm_bytes is None:
+        from .engine import usable_hbm_bytes
+
+        hbm_bytes = usable_hbm_bytes()
+    ratio = _drift_ratio() if drift_ratio is None else drift_ratio
+    peak, mem_break = _predict_peak_bytes(profile, cfg, _opt_words(optimizer),
+                                          ratio)
+    step_s, time_break = _predict_step_s(profile, cfg, link)
+    feasible = peak <= headroom * float(hbm_bytes)
+    return PlanCandidate(
+        config=cfg, predicted_step_s=step_s, predicted_peak_bytes=peak,
+        feasible=feasible,
+        breakdown=dict(time_break, **{f"mem_{k}": v
+                                      for k, v in mem_break.items()}))
+
+
+def plan(model, n_devices: Optional[int] = None,
+         hbm_bytes: Optional[float] = None, batch: int = 8, seq: int = 128,
+         *, sample_batch: Optional[Sequence] = None,
+         loss_fn: Optional[Callable] = None, optimizer: Any = "adamw",
+         topology: Optional[str] = None, link: Optional[LinkModel] = None,
+         include_infeasible: bool = False, top_k: Optional[int] = None,
+         **enum_kw) -> List[PlanCandidate]:
+    """Rank every feasible parallel config for ``model`` on ``n_devices``
+    chips with ``hbm_bytes`` per-device memory.
+
+    Returns ``PlanCandidate``s sorted by predicted step time (ties broken
+    by the canonical config key, so ranking is deterministic). HBM-
+    infeasible candidates are pruned; pass ``include_infeasible=True`` to
+    get them appended (flagged, ranked by predicted bytes) for
+    diagnostics. ``plan()[0]`` is the pick ``Engine.prepare(
+    auto_plan=True)`` applies.
+    """
+    if n_devices is None:
+        n_devices = len(jax.devices())
+    if hbm_bytes is None:
+        from .engine import usable_hbm_bytes
+
+        hbm_bytes = usable_hbm_bytes()
+    profile = profile_model(model, batch=batch, seq=seq,
+                            sample_batch=sample_batch, loss_fn=loss_fn)
+    link = link or link_model_for(topology)
+    ratio = _drift_ratio()
+    opt_words = _opt_words(optimizer)
+    configs = enumerate_candidates(n_devices, profile,
+                                   batch=profile.batch, **enum_kw)
+    if not configs:
+        raise ValueError(
+            f"plan: no candidate config covers {n_devices} devices at "
+            f"batch={profile.batch} (check head/seq/batch divisibility)")
+    cands = [score_config(profile, c, link=link, hbm_bytes=hbm_bytes,
+                          optimizer=opt_words, drift_ratio=ratio)
+             for c in configs]
+    feasible = sorted([c for c in cands if c.feasible],
+                      key=lambda c: (c.predicted_step_s,
+                                     _config_key(c.config)))
+    out = feasible
+    if include_infeasible or not feasible:
+        rest = sorted([c for c in cands if not c.feasible],
+                      key=lambda c: (c.predicted_peak_bytes,
+                                     _config_key(c.config)))
+        if not feasible:
+            import warnings
+
+            warnings.warn(
+                f"plan: no candidate fits "
+                f"{float(hbm_bytes) / 1e9:.2f} GB/device (closest needs "
+                f"~{rest[0].predicted_peak_bytes / 1e9:.2f} GB); returning "
+                f"infeasible candidates ranked by predicted bytes — "
+                f"expect OOM unless the budget was pessimistic")
+        out = feasible + rest
+    return out[:top_k] if top_k else out
+
+
+def install_plan(model, optimizer, cand: PlanCandidate, devices=None):
+    """The state-installing half of applying a candidate: put the mesh up
+    and wrap the optimizer in the plan's ZeRO level/offload. Returns
+    ``(env, model, optimizer)``. ``Engine.prepare(auto_plan=True)`` uses
+    this half alone (its step is built later, after completion)."""
+    from ..mesh import init_mesh
+    from ..sharding import group_sharded_parallel
+
+    env = init_mesh(**cand.mesh, devices=devices)
+    gsk = cand.group_sharded_kwargs()
+    if gsk is not None:
+        model, optimizer = group_sharded_parallel(model, optimizer, **gsk)
+    return env, model, optimizer
+
+
+def wrap_plan_step(step, cand: PlanCandidate):
+    """Apply the candidate's execution shape to a built ShardedTrainStep:
+    the fused ``accumulate(k)`` window and/or remat (``accumulate(1,
+    remat=True)`` is the remat-only form)."""
+    k = int(cand.config.get("accumulate_steps", 1))
+    remat = bool(cand.config.get("remat"))
+    return step.accumulate(k, remat=remat) if (k > 1 or remat) else step
+
+
+def apply_plan(model, optimizer, cand: PlanCandidate, loss_fn: Callable,
+               devices=None):
+    """Materialize one candidate end to end: install the mesh, apply the
+    ZeRO level/offload, build the compiled step (fused ``accumulate(k)``
+    / remat included). Returns ``(env, step)`` — call the step with the
+    FULL global batch."""
+    from ..parallel import ShardedTrainStep
+
+    env, model, optimizer = install_plan(model, optimizer, cand,
+                                         devices=devices)
+    step = ShardedTrainStep(model, loss_fn, optimizer, env=env)
+    return env, wrap_plan_step(step, cand)

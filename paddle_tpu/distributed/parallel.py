@@ -239,6 +239,19 @@ class ShardedTrainStep:
             self._stream_overlap = _os.environ.get(
                 "PT_OFFLOAD_OVERLAP", "1").strip().lower() not in (
                 "0", "false", "off")
+            # cross-step pipeline fill (PR-5 carried item): hand the final
+            # param uploads to the next dispatch as jax futures instead of
+            # draining the lane at the step boundary, so the NEXT step's
+            # group-0 grad download is submitted while the current step's
+            # fwd+bwd executes. Trade-off: taken futures cannot be
+            # re-issued, so a transient fault surfacing in the LANDING
+            # phase of a taken upload fails sticky instead of retrying
+            # (fail-stop + checkpoint resume, the PR-6 outer story);
+            # PT_OFFLOAD_EAGER_UPLOAD=0 restores the boundary drain and
+            # with it maximal in-lane retry coverage for flaky links.
+            self._stream_eager = _os.environ.get(
+                "PT_OFFLOAD_EAGER_UPLOAD", "1").strip().lower() not in (
+                "0", "false", "off")
             self._stream = None  # (groups, per-group upd execs, clip, lane)
             return
         # place optimizer state at its (possibly ZeRO-sharded) placement
@@ -786,10 +799,18 @@ class ShardedTrainStep:
                 opt._accumulators[id(self.train_params[i])] = s
             ups[gi] = lane.submit(
                 "h2d", new_p, [self._param_sh[i] for i in idx], tag=gi)
+        # drain: with the cross-step fill enabled, take each upload as
+        # soon as it is ISSUED (jax futures) — the next step's fwd+bwd
+        # dispatch consumes them and the runtime sequences the landing,
+        # so the host reaches the next group-0 grad download while the
+        # device is still inside fwd+bwd. wait() (the serialized twin and
+        # the kill-switch path) blocks until the bytes have landed.
+        eager = self._stream_overlap and getattr(self, "_stream_eager", False)
         new_params = [None] * len(self.train_params)
         for gi, idx in enumerate(groups):
             with tl.phase("stream_wait"):
-                fresh = ups[gi].wait()
+                fresh = ups[gi].wait_dispatched() if eager \
+                    else ups[gi].wait()
             for i, a in zip(idx, fresh):
                 new_params[i] = a
         return new_params

@@ -77,6 +77,39 @@ def _resil():
     return _RESIL
 
 
+_PINNED_PROBE = [False, None]  # (probed, sharding-or-None), process-wide
+
+
+def _probe_pinned_host():
+    """Capability probe: a working ``pinned_host`` memory-kind placement
+    on the default accelerator, verified by an actual 1-element
+    round-trip (some jax builds LIST the memory kind but cannot place
+    into it). CPU backends return None — everything is host RAM there
+    and tier-1 must stay byte-identical on the direct path."""
+    if _PINNED_PROBE[0]:
+        return _PINNED_PROBE[1]
+    sh = None
+    try:
+        from ..distributed.meta_parallel.stage_stack import _memory_sharding
+
+        cand = _memory_sharding("pinned_host")
+        if cand is not None:
+            probe = jax.device_put(np.zeros((1,), np.float32), cand)
+            probe.block_until_ready()
+            jax.device_put(probe, jax.devices()[0]).block_until_ready()
+            sh = cand
+    except Exception:
+        sh = None
+    _PINNED_PROBE[0] = True
+    _PINNED_PROBE[1] = sh
+    return sh
+
+
+def pinned_host_supported() -> bool:
+    """Does this backend expose a usable pinned_host staging space?"""
+    return _probe_pinned_host() is not None
+
+
 class StreamTransferError(RuntimeError):
     """A lane transfer failed after its retry budget. Carries the failing
     direction, stream-group tag and parameter names so the raise at the
@@ -143,10 +176,15 @@ class _TransferHandle:
     """One in-flight group transfer; ``wait()`` blocks the consumer and
     charges the blocked time to the lane's ``stall_ms``."""
 
-    __slots__ = ("_event", "_box", "_lane", "_nbytes", "_unstaged")
+    __slots__ = ("_event", "_box", "_lane", "_nbytes", "_unstaged",
+                 "_dispatched", "_dispatch_taken")
 
     def __init__(self, lane):
         self._event = threading.Event()
+        self._dispatched = threading.Event()  # transfers ISSUED (results
+        # exist as jax futures) even though bytes may still be in flight
+        self._dispatch_taken = False  # a consumer HOLDS the issued
+        # futures (set under the lane lock by wait_dispatched)
         self._box: list = [None, None]  # result, exception
         self._lane = lane
         self._nbytes = 0      # staged bytes this handle accounts for
@@ -159,6 +197,59 @@ class _TransferHandle:
         if not self._event.is_set():
             t0 = time.perf_counter()
             self._event.wait()
+            self._lane._note_stall((time.perf_counter() - t0) * 1e3)
+        if self._box[1] is not None:
+            raise self._box[1]
+        return self._box[0]
+
+    def _set_dispatched(self, out) -> None:
+        with self._lane._lock:
+            self._box[0] = out
+        self._dispatched.set()
+
+    def _unpublish_for_retry(self) -> bool:
+        """Worker-side half of the retry handshake: withdraw the issued
+        futures so the retry can republish. Returns False when a
+        consumer already took them — then retrying is unsafe (their
+        arrays could not be replaced) and the caller must fail sticky."""
+        with self._lane._lock:
+            if self._dispatch_taken:
+                return False
+            self._box[0] = None
+            self._dispatched.clear()
+            return True
+
+    def wait_dispatched(self):
+        """Return the transfer's result arrays as soon as they are ISSUED
+        (jax async futures) instead of landed — the cross-step pipeline
+        fill: a consumer handing these straight to the next dispatched
+        executable lets the runtime sequence the landing while the host
+        races ahead to submit the next step's group-0 grad download. A
+        transfer that fails after issue surfaces at the next lane
+        interaction (the PR-6 sticky-failure contract), not here."""
+        t0 = None
+        while True:
+            if self._event.is_set():
+                break  # terminal: landed or failed-for-good
+            if self._dispatched.is_set():
+                taken = None
+                with self._lane._lock:
+                    if self._box[0] is not None:
+                        # taking the futures forecloses any later retry
+                        # (the worker's _unpublish_for_retry checks this
+                        # under the same lock)
+                        self._dispatch_taken = True
+                        taken = self._box[0]
+                if taken is not None:
+                    if t0 is not None:  # _note_stall takes the lane lock
+                        self._lane._note_stall(
+                            (time.perf_counter() - t0) * 1e3)
+                    return taken
+                continue  # republish in flight (a retry withdrew them)
+            if t0 is None:
+                t0 = time.perf_counter()
+            self._dispatched.wait(0.05)
+        if t0 is not None:
             self._lane._note_stall((time.perf_counter() - t0) * 1e3)
         if self._box[1] is not None:
             raise self._box[1]
@@ -184,13 +275,22 @@ class StreamLane:
 
     _LANE_NO = [0]
 
-    def __init__(self, overlap: bool = True, depth: int = 2):
+    def __init__(self, overlap: bool = True, depth: int = 2,
+                 pinned_staging: Optional[bool] = None):
+        import os as _os
+
         self.overlap = bool(overlap)
         self.depth = int(depth)
+        if pinned_staging is None:
+            pinned_staging = _os.environ.get(
+                "PT_OFFLOAD_PINNED_STAGING", "1").strip().lower() not in (
+                "0", "false", "off")
+        self._pinned_sh = _probe_pinned_host() if pinned_staging else None
+        self.pinned_staging = self._pinned_sh is not None
         self._lock = threading.Lock()
         self._stats = {"h2d_bytes": 0, "d2h_bytes": 0, "transfer_ms": 0.0,
                        "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0,
-                       "retries": 0}
+                       "retries": 0, "pinned_staged": 0}
         self._staging_bytes = 0  # bytes of submissions not yet landed
         # memory truth: the lane's staging working set (the two-group cap
         # the offload estimator models) rides in the `memory` provider
@@ -285,20 +385,47 @@ class StreamLane:
                     self._thread = None
                 return
 
-    def _transfer_once(self, kind, arrays, placements, tag, seq):
+    def _transfer_once(self, kind, arrays, placements, tag, seq, handle):
         injector, _transient, _policy, _rm = _resil()
         inj = injector()
         inj.check("slow_transfer", seq=seq, kind=kind, group=tag)
         inj.check("transfer", seq=seq, kind=kind, group=tag)
+        if kind == "h2d":
+            arrays = self._stage_pinned(arrays)
         out = [jax.device_put(a, p) if p is not None
                else jax.device_put(a)
                for a, p in zip(arrays, placements)]
+        # results exist as async futures NOW: a wait_dispatched() consumer
+        # may take them and keep pipelining across the step boundary
+        handle._set_dispatched(out)
         # the transfer is only *done* when the bytes have landed —
         # blocking HERE (off the consumer thread when overlapped) is
         # what makes stall_ms mean "transfer not hidden"
         for o in out:
             o.block_until_ready()
         return out
+
+    def _stage_pinned(self, arrays):
+        """Bounce h2d source buffers living on the CPU *backend* through
+        the accelerator's pinned_host memory space when this jax exposes
+        one (the reference TaskFlow keeps its staging buffers pinned so
+        the device DMA engine uploads without an intermediate pageable
+        copy). Probed once; backends without the memory kind — CPU tier-1
+        included — take the direct path untouched."""
+        if not self.pinned_staging or self._pinned_sh is None:
+            return arrays
+        staged = []
+        for a in arrays:
+            try:
+                on_cpu = all(d.platform == "cpu" for d in a.devices())
+            except Exception:
+                on_cpu = False
+            staged.append(jax.device_put(a, self._pinned_sh)
+                          if on_cpu else a)
+        with self._lock:
+            self._stats["pinned_staged"] += len(
+                [1 for s, a in zip(staged, arrays) if s is not a])
+        return staged
 
     def _run_job(self, kind, arrays, placements, handle, tag, names, seq,
                  serialized=False):
@@ -311,14 +438,21 @@ class StreamLane:
             while True:
                 try:
                     out = self._transfer_once(kind, arrays, placements, tag,
-                                              seq)
+                                              seq, handle)
                     handle._box[0] = out
                     nbytes = sum(int(getattr(o, "nbytes", 0)) for o in out)
                     break
                 except BaseException as e:
-                    if attempt < retries and transient(e):
+                    if attempt < retries and transient(e) \
+                            and handle._unpublish_for_retry():
                         # bounded retry-with-backoff: transient transfer
-                        # faults (flaky host link, injected) are eaten here
+                        # faults (flaky host link, injected) are eaten
+                        # here — including landing-phase failures, AS LONG
+                        # AS no wait_dispatched() consumer already holds
+                        # the failed attempt's futures (those could not be
+                        # replaced; _unpublish_for_retry refuses and we
+                        # fail sticky — fail-stop beats a silently-
+                        # poisoned pipeline)
                         attempt += 1
                         with self._lock:
                             self._stats["retries"] += 1
@@ -372,6 +506,7 @@ class StreamLane:
             s = dict(self._stats)
         s["staging_bytes"] = max(self._staging_bytes, 0)
         s["overlap"] = self.overlap
+        s["pinned_staging"] = self.pinned_staging
         s["hidden_ms"] = max(s["transfer_ms"] - s["stall_ms"], 0.0)
         s["overlap_efficiency"] = round(
             s["hidden_ms"] / s["transfer_ms"], 4) if s["transfer_ms"] else 0.0

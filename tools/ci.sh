@@ -39,7 +39,7 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_offload_executor.py -q \
 rm -f /tmp/_bench_smoke.log
 # stale telemetry must not satisfy the observability gate below
 rm -f bench_artifacts/telemetry_*.json
-timeout -k 10 700 env JAX_PLATFORMS=cpu BENCH_BUDGET_S=600 \
+timeout -k 10 1000 env JAX_PLATFORMS=cpu BENCH_BUDGET_S=900 \
     python bench.py > /tmp/_bench_smoke.log 2>/tmp/_bench_smoke.err || {
         echo "bench smoke failed"; tail -20 /tmp/_bench_smoke.err; exit 1; }
 python - <<'PY' || exit 1
@@ -62,9 +62,16 @@ assert sc["losses_bit_equal"] is True, sc     # hiding changed no bits
 cs = last["detail"]["checkpoint_stall"]       # ISSUE-6 acceptance: async
 assert cs["stall_ratio"] is not None, cs      # save stall < 25% of the
 assert cs["stall_ratio"] < 0.25, cs           # synchronous save time
+ap = last["detail"]["autoplan"]               # ISSUE-10 acceptance: the
+assert ap["top_is_feasible"] is True, ap      # planner's top pick runs,
+assert ap["top_vs_best_ratio"] is not None and \
+    ap["top_vs_best_ratio"] <= 1.25, ap       # is within 1.25x of the
+assert ap["beats_median"] is True, ap         # best measured candidate,
+                                              # and beats the median
 print("perf gate OK:", {k: last["detail"][k]
                         for k in ("warm_path", "persistent_cache",
-                                  "stream_capacity", "checkpoint_stall")})
+                                  "stream_capacity", "checkpoint_stall",
+                                  "autoplan")})
 PY
 
 echo "== observability gate (telemetry snapshot from the bench smoke) =="
@@ -147,6 +154,47 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q \
 # slow-transfer regression trips the flight recorder into a complete
 # parseable pd_dump bundle
 JAX_PLATFORMS=cpu python tools/trace_drill.py || exit 1
+
+echo "== planner gate (ISSUE-10: cost-model auto-parallel planner) =="
+# the full planner test file (enumeration divisibility, HBM pruning,
+# deterministic ranking, MULTICHIP_r05 round-trip, Engine auto_plan) plus
+# the blackout-round-3 bench contract tests (SIGTERM'd smoke leaves a
+# parseable last line; the budget watchdog self-emits)
+JAX_PLATFORMS=cpu python -m pytest tests/test_planner.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python -m pytest tests/test_fixes_r6.py -q -k bench \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+# smoke plan() on the bench tiny-Llama shape: a non-empty ranked list
+# whose top pick is feasible (the autoplan headline row is asserted by
+# the perf gate above)
+JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+paddle.seed(0)
+cands = dist.plan(LlamaForCausalLM(LlamaConfig.tiny()), n_devices=8,
+                  hbm_bytes=9.5e9, batch=16, seq=64)
+assert cands, "plan() returned an empty ranked list"
+assert cands[0].feasible, cands[0].to_dict()
+assert cands[0].predicted_step_s > 0
+print("planner gate OK:", {"candidates": len(cands),
+                           "top": cands[0].describe(),
+                           "predicted_ms": round(
+                               cands[0].predicted_step_s * 1e3, 2)})
+PY
+# the smoke's telemetry dump must carry the ranking-fidelity provider
+# (predicted-vs-measured rank correlation — the acceptance asks for it in
+# the headline AND the telemetry dump)
+python - <<'PY' || exit 1
+import json
+snap = json.load(open("bench_artifacts/telemetry_autoplan.json"))
+fid = snap["autoplan"]["fidelity"]
+assert fid["rank_corr"] is not None, fid
+assert fid["top_vs_best_ratio"] is not None, fid
+assert snap["autoplan"]["measured"], "per-candidate measurements missing"
+print("autoplan telemetry OK:", fid)
+PY
 
 echo "== resilience gate (commit protocol + kill-and-resume drill) =="
 # the full resilience file (crash-mid-save injection, torn-checkpoint
