@@ -5,6 +5,7 @@ replaced by ONE jax.sharding.Mesh over ICI/DCN; collectives are XLA ops; the
 launcher bootstraps jax.distributed instead of exchanging NCCL unique ids.
 """
 from . import fleet  # noqa: F401
+from .fleet import ElasticFleet, FleetPolicy, elastic_fit  # noqa: F401
 from .mesh import init_mesh, auto_mesh, get_mesh_env, MeshEnv, reset_mesh  # noqa: F401
 from .collective import (  # noqa: F401
     ReduceOp, Group, new_group, get_group, is_initialized, init_parallel_env,

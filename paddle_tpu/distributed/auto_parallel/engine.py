@@ -39,7 +39,9 @@ def usable_hbm_bytes(device=None) -> float:
     calibration — VERDICT r3 weak #4)."""
     import jax
 
-    dev = jax.devices()[0] if device is None else device
+    # local: under jax.distributed, devices()[0] can belong to another
+    # process and expose no stats to this one
+    dev = jax.local_devices()[0] if device is None else device
     try:
         stats = dev.memory_stats() or {}
     except Exception:

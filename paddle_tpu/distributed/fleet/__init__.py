@@ -15,6 +15,10 @@ from ..meta_parallel import (  # noqa: F401
 from ..utils_recompute import recompute  # noqa: F401
 from . import elastic  # noqa: F401,E402
 from .elastic import ElasticManager, ElasticStatus  # noqa: F401,E402
+from . import runtime  # noqa: F401,E402
+from .runtime import (  # noqa: F401,E402
+    ElasticFleet, FleetPolicy, FleetPhase, FleetStateMachine,
+    FleetWorkerContext, FleetFenced, elastic_fit)
 from . import data_generator  # noqa: F401,E402
 from .data_generator import (  # noqa: F401,E402
     DataGenerator, MultiSlotDataGenerator, MultiSlotStringDataGenerator,

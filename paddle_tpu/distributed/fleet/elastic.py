@@ -38,6 +38,9 @@ class ElasticManager:
         self.max_np = max_np if max_np is not None else world_size
         self.interval = heartbeat_interval
         self.timeout = timeout
+        self.beat_failures = 0       # beats lost after the retry budget
+        self.last_beat_t: Optional[float] = None
+        self._warned = False
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._on_scale: Optional[Callable[[List[int]], None]] = None
@@ -55,12 +58,42 @@ class ElasticManager:
         return self
 
     def _beat(self):
-        self.store.set(f"elastic/worker/{self.rank}",
-                       json.dumps({"ts": time.time()}))
+        """One heartbeat, hardened: a transient TCPStore hiccup (server
+        busy, dropped connection) is retried with bounded backoff
+        (``resilience.retry``) instead of killing the daemon thread —
+        which would get this perfectly healthy worker evicted as dead.
+        The ``heartbeat_stall`` fault site makes the stall-vs-evict
+        grace window deterministically drillable
+        (``PT_FAULTS="heartbeat_stall@rank=1&ms=800"``)."""
+        from ..resilience.faults import injector
+        from ..resilience.retry import with_retries
+
+        injector().check("heartbeat_stall", rank=self.rank)
+        with_retries(
+            lambda: self.store.set(f"elastic/worker/{self.rank}",
+                                   json.dumps({"ts": time.time()})),
+            what="heartbeat")
+        self.last_beat_t = time.time()
 
     def _loop(self):
         while not self._stop.is_set():
-            self._beat()
+            try:
+                self._beat()
+            except Exception as e:
+                # even past the retry budget the daemon stays alive and
+                # tries again next interval: a heartbeat gap is for the
+                # SUPERVISOR's grace window to judge, never a reason for
+                # the worker to silently stop reporting
+                self.beat_failures += 1
+                if not self._warned:
+                    self._warned = True
+                    import warnings
+
+                    warnings.warn(
+                        f"ElasticManager[rank={self.rank}]: heartbeat "
+                        f"failed past the retry budget "
+                        f"({type(e).__name__}: {e}); daemon keeps "
+                        f"retrying", RuntimeWarning, stacklevel=2)
             self._stop.wait(self.interval)
 
     def alive_workers(self) -> List[int]:
@@ -115,6 +148,13 @@ class ElasticManager:
 
 class ElasticController:
     """The end-to-end elastic loop: spawn → watch → restart at new world size.
+
+    LEGACY scope note: this is the minimal re-exec loop (used by
+    run/controllers.py and pinned by test_elastic_drill) — restart on any
+    non-zero exit, no fencing, no budget backoff, no jax.distributed
+    wiring. New work belongs in ``fleet.runtime.ElasticFleet``, the full
+    coordinator-led runtime (fence/drain protocol, planner re-plan,
+    fleet-wide resume, `fleet` provider + forensics) that supersedes it.
 
     Reference manager.py:130 + launch.py elastic mode: the etcd watcher
     notices a dead node and relaunches training with the survivors; training

@@ -246,7 +246,9 @@ class AsyncCheckpointer:
         import jax
         import jax.numpy as jnp
 
-        cpu = jax.devices("cpu")[0]
+        cpu = jax.local_devices(backend="cpu")[0]  # local: under
+        # jax.distributed, devices()[0] can belong to ANOTHER process
+        # and a device_put onto it raises (non-addressable)
         plan: List = []
 
         def snap(sd):
@@ -481,7 +483,9 @@ def resume(root: str, model=None, optimizer=None, step=None,
         # as optimizer.set_state_dict)
         optimizer._state_version = getattr(optimizer, "_state_version", 0) + 1
     if step is not None and getattr(step, "_master", None) is not None:
-        cpu = jax.devices("cpu")[0]
+        cpu = jax.local_devices(backend="cpu")[0]  # local: under
+        # jax.distributed, devices()[0] can belong to ANOTHER process
+        # and a device_put onto it raises (non-addressable)
         for i in range(len(step._master)):
             key = f"master.__p{i}__"
             if key in entries:

@@ -24,11 +24,20 @@ always safe to leave wired in production code paths.
 
 Kinds wired today: ``transfer`` / ``slow_transfer`` (StreamLane),
 ``crash_mid_save`` (checkpoint commit), ``nan_step`` (fit),
-``batch_fault`` / ``decode_fault`` (serving engines), and ``oom``
+``batch_fault`` / ``decode_fault`` (serving engines), ``oom``
 (``observability.memory.oom_guard`` sites in every compiled train step,
 fit, and both serving engines: ``PT_FAULTS="oom@step=N"`` raises a
 RESOURCE_EXHAUSTED-shaped ``InjectedOOM`` that walks the full OOM-
-forensics path — memory report, flight bundle, then the crash).
+forensics path — memory report, flight bundle, then the crash), and the
+process-level fleet kinds (``fleet/runtime.py``):
+
+- ``worker_crash@rank=r&step=n`` — hard ``os._exit`` of one worker at
+  an exact global step (the elastic drill's node failure);
+- ``coordinator_lost`` — the supervisor's control-plane store dies;
+  workers must detect it and exit cleanly instead of orphaning;
+- ``heartbeat_stall@rank=r&ms=MS`` — stalls one worker's heartbeat
+  daemon (``ElasticManager._beat``) so the eviction grace window is
+  drillable: a stall under ``heartbeat_timeout`` must never evict.
 """
 from __future__ import annotations
 

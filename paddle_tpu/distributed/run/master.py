@@ -191,18 +191,36 @@ class Master:
                 if st.add(f"{prefix}/claim/{rank}", 1) == 1:
                     break  # skip slots explicit-rank peers claimed
         st.set(f"{prefix}/{rank}", value.encode())
+        # arrival record (value + wall clock) so a barrier timeout can say
+        # WHO arrived and when — the same membership table the fleet
+        # provider renders (membership_table below). The add-counter makes
+        # it probe-able: TCPStore.get blocks on absent keys, and a peer
+        # that died between its claim and this write must degrade the
+        # table to "claimed, no record", not hang the diagnostic.
+        st.set(f"{prefix}/arrived/{rank}",
+               json.dumps({"value": value, "ts": time.time()}).encode())
+        st.add(f"{prefix}/arrived/{rank}/published", 1)
         n = st.add(f"{prefix}/n", 1)
         if n > size:
             raise RuntimeError(
                 f"sync_peers: {n} peers joined '{prefix}' but size={size} — "
                 f"duplicate rank or stale prefix (pass a fresh job id)")
-        st.wait([f"{prefix}/{r}" for r in range(size)])
+        # barrier: poll the claim counters (non-blocking add(0) probes)
+        # under OUR deadline instead of st.wait, which blocks server-side
+        # for the store's own timeout and can only say "timed out" — a
+        # stuck gang deserves to know which ranks are missing
         deadline = time.time() + timeout
-        while st.add(f"{prefix}/n", 0) < size:  # all joins acknowledged
+        while True:
+            missing = [r for r in range(size)
+                       if st.add(f"{prefix}/claim/{r}", 0) < 1]
+            if not missing and st.add(f"{prefix}/n", 0) >= size:
+                break  # all joins acknowledged
             if time.time() > deadline:
                 raise TimeoutError(
-                    f"sync_peers: only {st.add(f'{prefix}/n', 0)}/{size} "
-                    f"peers joined '{prefix}' within {timeout}s")
+                    f"sync_peers: barrier on '{prefix}' timed out after "
+                    f"{timeout:.0f}s — "
+                    + describe_membership(
+                        membership_table(st, prefix, size)))
             time.sleep(0.05)
         peers = [st.get(f"{prefix}/{r}").decode() for r in range(size)]
         return peers, rank
@@ -220,6 +238,57 @@ class Master:
             except Exception:
                 pass
             self.store = None
+
+
+def membership_table(store, prefix: str, size: int) -> List[dict]:
+    """Who has arrived at a ``sync_peers`` barrier: one row per expected
+    rank — ``{"rank", "present", "value", "ts", "age_s"}`` — read through
+    non-blocking claim-counter probes (``TCPStore.get`` blocks on absent
+    keys by design). ``sync_peers`` raises this table on barrier timeout
+    and the fleet hub provider renders the same shape for live gangs."""
+    now = time.time()
+    rows: List[dict] = []
+    for r in range(size):
+        row = {"rank": r, "present": False, "value": None, "ts": None,
+               "age_s": None}
+        try:
+            if store.add(f"{prefix}/claim/{r}", 0) >= 1:
+                row["present"] = True
+                # probe before get: the record is written AFTER the claim,
+                # so a peer that died in between has a claim but no record
+                # — a blocking get here would hang the very diagnostic
+                # that should name it
+                if store.add(f"{prefix}/arrived/{r}/published", 0) >= 1:
+                    try:
+                        rec = json.loads(store.get(f"{prefix}/arrived/{r}"))
+                        row["value"] = rec.get("value")
+                        row["ts"] = rec.get("ts")
+                        if row["ts"] is not None:
+                            row["age_s"] = round(now - float(row["ts"]), 1)
+                    except Exception:
+                        pass
+        except Exception:
+            row["present"] = None  # store unreachable: unknown
+        rows.append(row)
+    return rows
+
+
+def describe_membership(rows: List[dict]) -> str:
+    """One line an operator can act on: which ranks arrived (name +
+    last-seen age) and which are still missing."""
+    arrived = [r for r in rows if r["present"]]
+    missing = [r["rank"] for r in rows if not r["present"]]
+
+    def _one(r):
+        tag = str(r["value"] or "?")
+        return f"{r['rank']} ({tag}" + (
+            f", seen {r['age_s']}s ago)" if r["age_s"] is not None else ")")
+
+    return (f"arrived {len(arrived)}/{len(rows)}: "
+            f"[{', '.join(_one(r) for r in arrived) or '-'}]; "
+            f"missing ranks: {missing or '-'} — check those nodes' "
+            f"launchers/logs (wrong --master, crashed before rendezvous, "
+            f"or blocked network)")
 
 
 def node_payload(nproc: int, coordinator_port: Optional[int] = None) -> str:

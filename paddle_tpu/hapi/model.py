@@ -282,7 +282,11 @@ class Model:
         whatever device count the relaunch has. ``resume=True`` restores
         the newest verified checkpoint under ``checkpoint_dir`` (epoch,
         step-in-epoch, rng and optimizer state included) and fast-forwards
-        the loader to the first unseen batch."""
+        the loader to the first unseen batch. ``resume`` also accepts a
+        PATH: restore from that directory while new saves keep landing in
+        ``checkpoint_dir`` — the elastic fleet uses this to resume every
+        rank from the fleet-wide newest commit after a membership change
+        (each rank checkpoints into its own dir)."""
         assert train_data is not None, "train_data must be given"
         try:
             # flight recorder: every trained step lands in the bounded
@@ -340,7 +344,13 @@ class Model:
             ckpt_ctx = {"ck": ck, "every": max(int(checkpoint_every), 1),
                         "global_step": 0, "skip_steps": 0, "preempted": False}
             if resume:
-                meta = ck.resume()
+                if isinstance(resume, str):
+                    # resume FROM another root (the fleet's authoritative
+                    # dir) while saving INTO checkpoint_dir
+                    meta = rz.resume(resume, model=self.network,
+                                     optimizer=self._optimizer)
+                else:
+                    meta = ck.resume()
                 if meta is not None:
                     start_epoch = int(meta.get("epoch") or 0)
                     ckpt_ctx["global_step"] = int(meta["step"]) + 1

@@ -82,6 +82,12 @@ def dump_bundle(out_dir: Optional[str] = None, reason: str = "manual",
 
     root = out_dir or os.environ.get("PT_FLIGHT_DIR") or \
         os.path.join(tempfile.gettempdir(), "pt_flight_dumps")
+    # fleet processes bundle under PT_FLIGHT_DIR/rank<r>/ so concurrent
+    # workers never clobber (or interleave into) each other's dumps; the
+    # fleet provider links the per-rank paths in its snapshot
+    fleet_rank = os.environ.get("PT_FLEET_RANK")
+    if out_dir is None and fleet_rank is not None:
+        root = os.path.join(root, f"rank{fleet_rank}")
     path = os.path.join(
         root, f"pd_dump_{_utcstamp()}_{os.getpid()}_"
         f"{''.join(c if c.isalnum() else '_' for c in reason)[:32]}")

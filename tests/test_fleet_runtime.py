@@ -1,0 +1,459 @@
+"""Elastic multi-host fleet runtime (ISSUE-11): the recovery state
+machine in isolation, the hardened heartbeat daemon, sync_peers barrier
+diagnostics, per-rank flight dirs, and the supervisor's failure paths
+(restart-budget exhaustion with a forensic bundle, coordinator-lost
+clean worker exit). The end-to-end 4-process ``jax.distributed`` drill
+lives in ``tools/resilience_drill.py --fleet`` (ci.sh elastic gate)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.runtime import (
+    EXIT_COORD_LOST, EXIT_FENCED, BlockShardedDataset, ElasticFleet,
+    FleetPhase, FleetPolicy, FleetStateMachine, pick_resume_dir)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _policy(**kw):
+    base = dict(min_world=2, max_restarts=2, heartbeat_timeout=5.0,
+                backoff_base_s=0.1, start_timeout_s=30.0)
+    base.update(kw)
+    return FleetPolicy(**base)
+
+
+# ---------------------------------------------------------------------------
+# pure state machine
+# ---------------------------------------------------------------------------
+
+class TestFleetStateMachine:
+    def test_membership_join_and_hold(self):
+        sm = FleetStateMachine(3, _policy(), now=0.0)
+        assert sm.phase is FleetPhase.LAUNCHING
+        for r in range(3):
+            sm.heartbeat(r, 0.2)
+        assert sm.phase is FleetPhase.RUNNING
+        act = sm.observe(1.0, {r: None for r in range(3)})
+        assert act.kind == "hold"
+        assert sm.ranks_alive(1.0) == [0, 1, 2]
+        joins = [e for e in sm.timeline if e["event"] == "join"]
+        assert sorted(e["rank"] for e in joins) == [0, 1, 2]
+
+    def test_stale_heartbeat_evicts_and_fences(self):
+        sm = FleetStateMachine(2, _policy(), now=0.0)
+        sm.heartbeat(0, 0.0)
+        sm.heartbeat(1, 0.0)
+        sm.heartbeat(0, 6.0)  # rank 1 silent past the 5s window
+        act = sm.observe(6.0, {0: None, 1: None})
+        assert act.kind == "fence" and act.dead == [1]
+        ev = [e for e in sm.timeline if e["event"] == "evict"]
+        assert ev and ev[0]["rank"] == 1 and ev[0]["cause"] == "stale"
+
+    def test_stall_under_grace_never_evicts(self):
+        """The no-false-evict contract: silence SHORTER than
+        heartbeat_timeout holds, it does not fence."""
+        sm = FleetStateMachine(2, _policy(heartbeat_timeout=5.0), now=0.0)
+        sm.heartbeat(0, 0.0)
+        sm.heartbeat(1, 0.0)
+        act = sm.observe(4.9, {0: None, 1: None})  # 4.9s stall < 5s
+        assert act.kind == "hold"
+        assert sm.stale_ranks(4.9) == []
+        # the stalled rank recovers: still no fence, no evict event
+        sm.heartbeat(0, 4.95)
+        sm.heartbeat(1, 4.95)
+        act = sm.observe(6.0, {0: None, 1: None})
+        assert act.kind == "hold"
+        assert not [e for e in sm.timeline if e["event"] == "evict"]
+
+    def test_flap_is_recorded_not_duplicated(self):
+        sm = FleetStateMachine(2, _policy(), now=0.0)
+        sm.heartbeat(0, 0.0)
+        sm.heartbeat(1, 0.0)
+        sm.heartbeat(0, 6.0)
+        assert sm.observe(6.0, {0: None, 1: None}).kind == "fence"
+        # re-reading the SAME old beat must not resurrect the rank
+        sm.heartbeat(1, 0.0)
+        assert 1 in sm._evicted
+        assert not [e for e in sm.timeline if e["event"] == "flap"]
+        # a genuinely fresh beat records one flap
+        sm.heartbeat(1, 6.5)
+        flaps = [e for e in sm.timeline if e["event"] == "flap"]
+        assert len(flaps) == 1 and flaps[0]["rank"] == 1
+
+    def test_crash_fence_drain_restart_cycle(self):
+        sm = FleetStateMachine(4, _policy(), now=0.0)
+        for r in range(4):
+            sm.heartbeat(r, 0.1)
+        act = sm.observe(1.0, {0: None, 1: None, 2: 43, 3: None})
+        assert act.kind == "fence" and act.dead == [2]
+        ev = [e for e in sm.timeline if e["event"] == "evict"]
+        assert ev[0]["rank"] == 2 and ev[0]["cause"] == "crash"
+        # drain: hold until every worker exited (survivors leave FENCED)
+        act = sm.observe(2.0, {0: EXIT_FENCED, 1: EXIT_FENCED, 2: 43,
+                               3: None})
+        assert act.kind == "hold"
+        act = sm.observe(3.0, {0: EXIT_FENCED, 1: EXIT_FENCED, 2: 43,
+                               3: EXIT_FENCED})
+        assert act.kind == "restart" and act.world == 3
+        assert act.backoff_s == pytest.approx(0.1)
+        sm.restarted(4.0, 3)
+        assert sm.gen == 1 and sm.restarts == 1 and sm.world == 3
+        for r in range(3):
+            sm.heartbeat(r, 4.1)
+        assert sm.observe(5.0, {0: 0, 1: 0, 2: 0}).kind == "complete"
+        events = [e["event"] for e in sm.timeline]
+        assert events.count("fence") == 1
+        assert events.count("restart") == 1
+        assert events[-1] == "complete"
+
+    def test_backoff_grows_exponentially_and_caps(self):
+        p = _policy(backoff_base_s=0.5, backoff_max_s=2.0)
+        assert p.backoff_s(1) == pytest.approx(0.5)
+        assert p.backoff_s(2) == pytest.approx(1.0)
+        assert p.backoff_s(3) == pytest.approx(2.0)
+        assert p.backoff_s(9) == pytest.approx(2.0)  # capped
+
+    def test_restart_budget_exhaustion_fails(self):
+        sm = FleetStateMachine(3, _policy(min_world=1, max_restarts=1),
+                               now=0.0)
+        for r in range(3):
+            sm.heartbeat(r, 0.1)
+        assert sm.observe(1.0, {0: None, 1: 9, 2: None}).kind == "fence"
+        act = sm.observe(2.0, {0: EXIT_FENCED, 1: 9, 2: EXIT_FENCED})
+        assert act.kind == "restart"
+        sm.restarted(3.0, 2)
+        for r in range(2):
+            sm.heartbeat(r, 3.1)
+        assert sm.observe(4.0, {0: 9, 1: None}).kind == "fence"
+        act = sm.observe(5.0, {0: 9, 1: EXIT_FENCED})
+        assert act.kind == "fail" and "budget" in act.reason
+        assert sm.phase is FleetPhase.FAILED
+
+    def test_below_min_world_fails(self):
+        sm = FleetStateMachine(3, _policy(min_world=3), now=0.0)
+        for r in range(3):
+            sm.heartbeat(r, 0.1)
+        assert sm.observe(1.0, {0: None, 1: 9, 2: None}).kind == "fence"
+        act = sm.observe(2.0, {0: EXIT_FENCED, 1: 9, 2: EXIT_FENCED})
+        assert act.kind == "fail" and "min_world" in act.reason
+
+    def test_launch_timeout_fails_naming_missing_ranks(self):
+        sm = FleetStateMachine(3, _policy(start_timeout_s=10.0), now=0.0)
+        sm.heartbeat(0, 1.0)  # ranks 1, 2 never register
+        act = sm.observe(11.0, {r: None for r in range(3)})
+        assert act.kind == "fail"
+        assert "[1, 2]" in act.reason
+
+    def test_snapshot_shape_for_provider(self):
+        sm = FleetStateMachine(2, _policy(), now=0.0)
+        sm.heartbeat(0, 0.1)
+        snap = sm.snapshot()
+        assert snap["phase"] == "launching" and snap["world"] == 2
+        assert snap["restarts"] == 0
+        assert snap["timeline"][0]["event"] == "join"
+        json.dumps(snap)  # provider output must be JSON-clean
+
+
+# ---------------------------------------------------------------------------
+# hardened heartbeat daemon (satellite 1)
+# ---------------------------------------------------------------------------
+
+class _FlakyStore:
+    """set() fails the first N calls per key-write; everything is
+    recorded so the test can assert the retry path ran."""
+
+    def __init__(self, fail_first: int = 0, fail_forever: bool = False):
+        self.fail_first = fail_first
+        self.fail_forever = fail_forever
+        self.sets = 0
+        self.failures = 0
+        self.values = {}
+        self.counters = {}
+
+    def set(self, key, value):
+        self.sets += 1
+        if self.fail_forever or self.failures < self.fail_first:
+            self.failures += 1
+            raise RuntimeError("injected transient store error")
+        self.values[key] = value
+
+    def add(self, key, amount=1):
+        self.counters[key] = self.counters.get(key, 0) + amount
+        return self.counters[key]
+
+    def get(self, key):
+        return self.values[key]
+
+
+class TestHardenedHeartbeat:
+    def test_transient_store_error_is_retried(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        store = _FlakyStore(fail_first=1)
+        m = ElasticManager(store, rank=0, world_size=1,
+                           heartbeat_interval=0.05)
+        m._beat()  # first attempt fails, retry lands
+        assert store.failures == 1
+        assert "elastic/worker/0" in store.values
+        assert m.beat_failures == 0 and m.last_beat_t is not None
+
+    def test_daemon_survives_persistent_failure(self):
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+        store = _FlakyStore(fail_forever=True)
+        m = ElasticManager(store, rank=0, world_size=1,
+                           heartbeat_interval=0.02)
+        with pytest.warns(RuntimeWarning, match="heartbeat"):
+            m._thread = threading.Thread(target=m._loop, daemon=True)
+            m._thread.start()
+            deadline = time.time() + 5
+            while m.beat_failures < 2 and time.time() < deadline:
+                time.sleep(0.02)
+        assert m.beat_failures >= 2, "daemon died instead of retrying"
+        assert m._thread.is_alive()
+        m.exit()
+
+    def test_heartbeat_stall_under_grace_no_false_evict(self):
+        """A stalled daemon (injected ``heartbeat_stall``) shorter than
+        the eviction window keeps the worker in alive_workers."""
+        from paddle_tpu.distributed.fleet.elastic import ElasticManager
+        from paddle_tpu.distributed.resilience.faults import inject
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True, world_size=1)
+        try:
+            m = ElasticManager(store, rank=0, world_size=1,
+                               heartbeat_interval=0.05, timeout=2.0)
+            with inject("heartbeat_stall", rank=0, sleep_ms=300):
+                m.register()
+                time.sleep(0.5)  # the stall elapses inside the window
+                assert 0 in m.alive_workers(), \
+                    "stall under the grace window must not evict"
+            m.exit()
+        finally:
+            store.close()
+
+
+# ---------------------------------------------------------------------------
+# sync_peers barrier diagnostics (satellite 2)
+# ---------------------------------------------------------------------------
+
+class TestSyncPeersDiagnostics:
+    def test_timeout_names_arrived_and_missing_ranks(self):
+        from paddle_tpu.distributed.run.master import Master, \
+            membership_table
+
+        main = Master(endpoint=None, print_hint=False)
+        peer = Master(endpoint=main.endpoint, print_hint=False)
+        errs = {}
+
+        def join(name, master, key):
+            try:
+                master.sync_peers("/job", name, size=3, timeout=2.0)
+            except Exception as e:
+                errs[key] = e
+
+        ta = threading.Thread(target=join, args=("nodeA", main, "a"))
+        tb = threading.Thread(target=join, args=("nodeB", peer, "b"))
+        ta.start()
+        tb.start()
+        ta.join(timeout=30)
+        tb.join(timeout=30)
+        try:
+            assert set(errs) == {"a", "b"}, errs
+            for e in errs.values():
+                assert isinstance(e, TimeoutError), e
+                msg = str(e)
+                assert "arrived 2/3" in msg, msg
+                assert "nodeA" in msg and "nodeB" in msg, msg
+                assert "missing ranks: [2]" in msg, msg
+            rows = membership_table(main.store, "/job", 3)
+            assert [r["present"] for r in rows] == [True, True, False]
+            assert rows[0]["value"] == "nodeA"
+            assert rows[1]["value"] == "nodeB"
+            assert rows[0]["age_s"] is not None
+        finally:
+            peer.stop()
+            main.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-rank flight dirs (satellite 6)
+# ---------------------------------------------------------------------------
+
+def test_flight_bundles_land_in_per_rank_dirs(tmp_path, monkeypatch):
+    from paddle_tpu.observability.trace.flight import dump_bundle
+
+    monkeypatch.setenv("PT_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("PT_FLEET_RANK", "3")
+    path = dump_bundle(reason="unit")
+    assert path.startswith(str(tmp_path / "rank3")), path
+    assert os.path.exists(os.path.join(path, "MANIFEST.json"))
+    # an explicit out_dir wins over the env (tooling contract unchanged)
+    explicit = dump_bundle(out_dir=str(tmp_path / "direct"), reason="unit")
+    assert explicit.startswith(str(tmp_path / "direct")), explicit
+
+
+# ---------------------------------------------------------------------------
+# resume-dir election + dataset sharding
+# ---------------------------------------------------------------------------
+
+def test_pick_resume_dir_elects_max_step_then_lowest_rank(tmp_path):
+    def commit(rank, step, latest=True):
+        d = tmp_path / f"rank{rank}" / f"step_{step:08d}"
+        d.mkdir(parents=True, exist_ok=True)
+        (d / "manifest.json").write_text(
+            json.dumps({"meta": {"step": step}, "entries": {}}))
+        if latest:
+            (tmp_path / f"rank{rank}" / "LATEST").write_text(
+                json.dumps({"tag": f"step_{step:08d}"}))
+
+    assert pick_resume_dir(str(tmp_path)) is None
+    commit(0, 5)
+    commit(1, 7)
+    commit(2, 7)
+    picked = pick_resume_dir(str(tmp_path))
+    assert picked == str(tmp_path / "rank1"), picked  # max step, low rank
+    # a dir with a broken LATEST and no committed step dir is skipped
+    (tmp_path / "rank3").mkdir()
+    (tmp_path / "rank3" / "LATEST").write_text("{broken")
+    assert pick_resume_dir(str(tmp_path)) == str(tmp_path / "rank1")
+    # a broken LATEST over an INTACT committed dir degrades to it (the
+    # commit-protocol read_latest fallback): that rank still holds the
+    # fleet-wide newest commit and must win the election
+    commit(4, 9, latest=False)
+    (tmp_path / "rank4" / "LATEST").write_text("{torn")
+    assert pick_resume_dir(str(tmp_path)) == str(tmp_path / "rank4")
+
+
+def test_block_sharded_dataset_reassembles_global_batch():
+    data = list(range(48))
+    world4 = [BlockShardedDataset(data, 12, r, 4) for r in range(4)]
+    world1 = BlockShardedDataset(data, 12, 0, 1)
+    for step in range(4):
+        mine = [world1[step * 12 + i] for i in range(12)]
+        theirs = []
+        for r in range(4):
+            theirs += [world4[r][step * 3 + i] for i in range(3)]
+        assert mine == theirs == data[step * 12:(step + 1) * 12]
+    with pytest.raises(ValueError, match="divide"):
+        BlockShardedDataset(data, 10, 0, 4)
+
+
+# ---------------------------------------------------------------------------
+# supervisor failure paths (process-spawning: slow-marked for tier-1;
+# the ci.sh elastic gate runs the full file)
+# ---------------------------------------------------------------------------
+
+_BUDGET_WORKER = textwrap.dedent("""
+    import os, sys, time
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.distributed.fleet.runtime import FleetWorkerContext
+
+    ctx = FleetWorkerContext.from_env()
+    ctx.register()
+    if ctx.rank == 0:
+        time.sleep(0.5)
+        ctx.exit(75, reason="drained")   # EXIT_FENCED-style exit
+    sys.exit(9)                          # the repeat offender
+""")
+
+
+@pytest.mark.slow
+def test_restart_budget_exhaustion_leaves_forensic_bundle(tmp_path):
+    """A gang that keeps dying: the supervisor burns its bounded restart
+    budget and FAILS LOUDLY — phase=failed plus a complete
+    (manifest-last) fleet_forensics bundle naming the exits."""
+    script = tmp_path / "worker.py"
+    script.write_text(_BUDGET_WORKER.format(repo=REPO))
+    fleet = ElasticFleet(
+        [sys.executable, str(script)], np=2,
+        policy=_policy(min_world=1, max_restarts=1, backoff_base_s=0.05,
+                       drain_timeout_s=10.0),
+        log_dir=str(tmp_path / "logs"),
+        flight_root=str(tmp_path / "flight"),
+        extra_env={"JAX_PLATFORMS": "cpu"})
+    try:
+        report = fleet.run(timeout=180)
+    finally:
+        fleet.close()
+    assert report["phase"] == "failed", report
+    assert report["restarts"] == 1
+    assert "budget" in report["reason"]
+    path = report.get("forensics")
+    assert path and os.path.isdir(path), report
+    manifest = json.load(open(os.path.join(path, "MANIFEST.json")))
+    assert "fleet_report.json" in manifest["files"]
+    assert "worker_log_tails.json" in manifest["files"]
+    dumped = json.load(open(os.path.join(path, "fleet_report.json")))
+    evs = [e["event"] for e in dumped["timeline"]]
+    assert evs[-1] == "fail"
+    assert evs.count("restart") == 1 and evs.count("fence") == 2
+
+
+_COORD_LOST_WORKER = textwrap.dedent("""
+    import sys, time
+    sys.path.insert(0, {repo!r})
+    from paddle_tpu.distributed.fleet.runtime import FleetWorkerContext
+
+    ctx = FleetWorkerContext.from_env()
+    ctx.register()
+    print("registered", flush=True)
+    for _ in range(600):          # ~2 min upper bound, exit() cuts it
+        ctx.fenced()              # store probes notice a dead coordinator
+        time.sleep(0.2)
+    sys.exit(5)                   # watchdog never fired: orphan — FAIL
+""")
+
+
+@pytest.mark.slow
+def test_coordinator_lost_triggers_clean_worker_exit(tmp_path):
+    """Kill the control-plane store under a live worker: the worker must
+    notice within a few probes and exit with EXIT_COORD_LOST instead of
+    orphaning itself under a dead coordinator."""
+    from paddle_tpu.distributed.store import TCPStore
+
+    script = tmp_path / "worker.py"
+    script.write_text(_COORD_LOST_WORKER.format(repo=REPO))
+    store = TCPStore(is_master=True, world_size=1)
+    env = dict(os.environ)
+    env.update({"PT_FLEET_ENDPOINT": f"127.0.0.1:{store.port}",
+                "PT_FLEET_WORLD": "2", "PT_FLEET_RANK": "0",
+                "PT_FLEET_GEN": "0", "JAX_PLATFORMS": "cpu"})
+    proc = subprocess.Popen([sys.executable, str(script)], env=env,
+                            stdout=subprocess.PIPE, text=True)
+    try:
+        # wait for registration (first line), then yank the coordinator
+        line = proc.stdout.readline()
+        assert "registered" in line, line
+        store.close()
+        rc = proc.wait(timeout=60)
+        assert rc == EXIT_COORD_LOST, \
+            f"worker exited rc={rc}, wanted clean EXIT_COORD_LOST"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_fleet_provider_registered_in_hub():
+    """Constructing a supervisor registers the ``fleet`` provider: the
+    hub snapshot carries the membership timeline without a run."""
+    from paddle_tpu import observability
+
+    fleet = ElasticFleet([sys.executable, "-c", "pass"], np=2,
+                         policy=_policy())
+    try:
+        snap = observability.snapshot()["fleet"]
+        assert snap["phase"] == "launching"
+        assert snap["policy"]["max_restarts"] == 2
+        assert "timeline" in snap and "recoveries" in snap
+        assert "worker_exits" in snap and "flight_bundles" in snap
+    finally:
+        fleet.close()

@@ -206,6 +206,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_resilience.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 python tools/resilience_drill.py || exit 1
 
+echo "== elastic gate (ISSUE-11: multi-process fleet runtime) =="
+# the recovery state machine + hardened heartbeats + sync_peers
+# diagnostics + supervisor failure paths (slow process legs included),
+# then the end-to-end drill: a REAL 4-process jax.distributed fleet
+# survives an injected worker_crash — fence, bounded restart at
+# world=3, planner-selected new config, checkpoint-resumed completion,
+# 0 torn checkpoints, membership timeline records eviction + restart
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_runtime.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+python tools/resilience_drill.py --fleet || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
