@@ -147,6 +147,12 @@ class MetricsRegistry:
         with self._lock:
             return self._counters.get(name, 0)
 
+    def latency_percentile(self, q: int = 95) -> float:
+        """One recent-window latency percentile (ms) — cheap enough for a
+        router's per-dispatch load probe (no gauges, no counters copy)."""
+        with self._lock:
+            return self._latency.percentiles((q,))[f"p{q}"]
+
     def qps(self) -> float:
         """Completions per second over the sliding window (or since start
         when the process is younger than the window)."""

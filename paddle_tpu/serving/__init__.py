@@ -8,13 +8,19 @@ pre-declared shape buckets, and every bucket is AOT-warmed so steady-state
 traffic executes warm XLA programs only (asserted via
 ``analysis.retrace``).
 
-Three layers:
+The serving tier, bottom up:
 - ``ServingEngine`` (+ ``BucketSpec``, ``ServingConfig``): generic batched
   inference over an ``inference.Predictor``, ``nn.Layer``, or array fn —
   admission control, deadlines, per-request error isolation;
 - ``GenerationEngine`` (+ ``GenerationConfig``): continuous-batching
-  causal-LM decode — slot-based fixed-shape KV cache, finished sequences
-  release their slot, queued prompts join mid-flight;
+  causal-LM decode over a **paged KV cache** (``paged_kv``: block-pool
+  allocator, ref-counted copy-on-write pages, prefix-cache reuse of
+  shared system prompts), with optional draft-model **speculative
+  decoding** (``speculative``) and deadline-aware slot joining;
+- ``ReplicaRouter`` (+ ``RouterConfig``): N engine replicas behind an
+  admission-controlled front door — per-tenant quotas, load-aware
+  dispatch from real queue/KV-headroom/p95 state, prefix-affinity
+  placement, fault fencing;
 - ``MetricsRegistry``: QPS, latency percentiles, batch occupancy, queue
   depth, compile-cache hits/misses, exposed via ``engine.stats()`` and
   ``profiler.RecordEvent`` spans.
@@ -28,10 +34,18 @@ from .engine import (  # noqa: F401
 )
 from .generation import GenerationConfig, GenerationEngine  # noqa: F401
 from .metrics import LatencyWindow, MetricsRegistry  # noqa: F401
+from .paged_kv import (  # noqa: F401
+    PageAllocator, PagedKVPool, PoolExhausted, PrefixCache, token_blocks,
+)
+from .router import ReplicaRouter, RouterConfig, TenantQuotaExceeded  # noqa: F401
+from .speculative import greedy_accept, rejection_sample  # noqa: F401
 
 __all__ = [
     "BucketSpec", "ServingConfig", "ServingEngine",
     "GenerationConfig", "GenerationEngine",
+    "ReplicaRouter", "RouterConfig", "TenantQuotaExceeded",
+    "PageAllocator", "PrefixCache", "PagedKVPool", "PoolExhausted",
+    "token_blocks", "greedy_accept", "rejection_sample",
     "MetricsRegistry", "LatencyWindow",
     "QueueFull", "DeadlineExceeded", "EngineClosed", "BadRequest",
 ]

@@ -1,21 +1,29 @@
-"""Continuous batching for causal-LM generation (slot-based KV cache).
+"""Continuous batching for causal-LM generation (paged KV cache).
 
 The static-batch decode loop (``GPTForCausalLM.generate``) holds the whole
 batch until its slowest sequence finishes, and its KV cache grows one token
 per step — a new XLA program per step. Serving inverts both decisions:
 
-- the KV cache is a fixed-shape slot arena ``[slots, max_len, heads, dim]``
-  per layer, so ONE decode executable serves every step (zero retraces);
+- the KV cache is a fixed-size **page pool** ``[num_pages, page_len, heads,
+  dim]`` per layer (``serving.paged_kv``): each sequence holds a page
+  *table* instead of a ``max_seq_len`` slot row, requests sharing a system
+  prompt share its ref-counted pages through the **prefix cache** (no
+  re-prefill), and admission is bounded by pool pages, not worst-case slot
+  length;
 - each sequence owns a slot only while it is generating — a finished
-  sequence releases its slot and a queued prompt joins mid-flight at the
-  next step boundary (the vLLM/Orca-style continuous-batching contract).
-
-Prefill reuses ``models.gpt``'s KV-cache forward (``use_cache=True``) on
-the user's model, padded to a small set of prompt buckets; the per-layer
-K/V it returns is copied into the slot arena. The decode step re-reads the
-SAME model weights (no duplication of math: qkv/out/fc projections, pre-LN,
-tied embedding head — the GPT-2 recipe) but runs them at fixed shapes with
-per-slot length masks, compiled once.
+  sequence releases its slot (and pages) and a queued prompt joins
+  mid-flight at the next step boundary; slot-join order is
+  **deadline-aware** (earliest deadline first; expired requests shed
+  before prefill);
+- prefill, decode, and speculative verify are ONE executable family: a
+  fixed-shape **window step** that embeds ``W`` tokens per slot, writes
+  their K/V through the page tables, attends length-masked against the
+  gathered pages, and returns the greedy argmax at every window position.
+  ``W=1`` is classic decode; ``W=k+1`` scores a draft model's ``k``
+  proposals in one call (speculative decoding — emitted tokens are always
+  the target model's own argmaxes, so the output is token-for-token the
+  greedy path); ``W=bucket`` prefills a prompt suffix. Every ``W`` comes
+  from a closed set, so steady state never retraces.
 
 Greedy decoding (matching ``generate``'s argmax contract).
 """
@@ -30,11 +38,20 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .base import BadRequest, EngineBase, _oom_guard, _tracer
+from .base import (BadRequest, DeadlineExceeded, EngineBase, _oom_guard,
+                   _tracer)
+from .paged_kv import PagedKVPool, PoolExhausted, token_blocks
+from .speculative import greedy_accept
 
 __all__ = ["GenerationConfig", "GenerationEngine"]
 
 _GEN_NO = itertools.count(1)
+
+# EDF fairness bound: a request WITHOUT a deadline is ordered as if due
+# this long after arrival, so sustained deadline-bearing traffic can
+# delay it by at most the horizon — never starve it. Ordering only;
+# shedding still applies to explicit deadlines alone.
+_EDF_DEFAULT_HORIZON_S = 300.0
 
 
 def _injector():
@@ -44,12 +61,14 @@ def _injector():
 
 
 class GenerationConfig:
-    """Slot arena + prompt bucket shape declaration."""
+    """Page pool + prompt bucket + speculative-decode declaration."""
 
     def __init__(self, max_slots: int = 4, max_seq_len: Optional[int] = None,
                  prefill_buckets: Tuple[int, ...] = (16, 32, 64, 128),
                  max_queue: int = 256, eos_token_id: Optional[int] = None,
-                 donate_cache: bool = True):
+                 donate_cache: bool = True, page_len: int = 16,
+                 num_pages: Optional[int] = None, prefix_cache: bool = True,
+                 draft_model=None, spec_tokens: int = 4):
         self.max_slots = int(max_slots)
         self.max_seq_len = max_seq_len  # None: model max_position_embeddings
         self.prefill_buckets = tuple(sorted({int(b)
@@ -57,30 +76,52 @@ class GenerationConfig:
         self.max_queue = int(max_queue)
         self.eos_token_id = eos_token_id
         self.donate_cache = donate_cache
+        self.page_len = int(page_len)
+        # None: slots' worst case + a couple of cached prefixes' worth
+        self.num_pages = num_pages
+        self.prefix_cache = bool(prefix_cache)
+        self.draft_model = draft_model       # GPTForCausalLM or None
+        self.spec_tokens = int(spec_tokens)  # draft proposals per round
 
 
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
-                 "generated", "trace", "t_decode0")
+                 "generated", "trace", "t_decode0", "deadline",
+                 "blocks", "total_blocks")
 
-    def __init__(self, prompt, max_new_tokens, future, t_submit):
+    def __init__(self, prompt, max_new_tokens, future, t_submit,
+                 deadline=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.future = future
         self.t_submit = t_submit
+        self.deadline = deadline  # absolute monotonic seconds, or None
         self.generated: List[int] = []
         self.trace = None      # request-scoped trace id
         self.t_decode0 = None  # decode-phase start (prefill done)
+        # immutable paging facts, computed ONCE at submit (the admission
+        # scan runs under the engine lock and must stay cheap)
+        self.blocks: List[Tuple[int, ...]] = []  # full prompt token-blocks
+        self.total_blocks = 0                    # worst-case pages
+
+    def edf_key(self) -> Tuple[float, float]:
+        eff = self.deadline if self.deadline is not None \
+            else self.t_submit + _EDF_DEFAULT_HORIZON_S
+        return (eff, self.t_submit)
 
 
 class _Slot:
-    __slots__ = ("req", "length", "last_token", "t0")
+    __slots__ = ("req", "length", "last_token", "t0", "table", "blocks",
+                 "shared")
 
-    def __init__(self):
+    def __init__(self, n_blocks: int):
         self.req: Optional[_GenRequest] = None
         self.length = 0
         self.last_token = 0
         self.t0 = 0.0  # residency start (occupancy track)
+        self.table = np.zeros(n_blocks, dtype=np.int32)  # page ids (0=scratch)
+        self.blocks = 0   # allocated entries of `table`
+        self.shared = 0   # leading entries borrowed from the prefix cache
 
 
 def _extract_gpt_params(model):
@@ -109,10 +150,13 @@ def _extract_gpt_params(model):
     }
 
 
-def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool):
-    """One fixed-shape executable: token+position embed, per-layer pre-LN
-    attention against the slot arena (length-masked), MLP, tied head,
-    greedy argmax. Cache buffers are donated so XLA updates in place."""
+def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool,
+                       label: str):
+    """One fixed-shape SLOT-ARENA executable: token+position embed,
+    per-layer pre-LN attention against ``[S, max_len, nh, hd]`` caches
+    (length-masked), MLP, tied head, greedy argmax. The draft model's
+    decode path — small enough that a dense per-slot arena beats paging
+    overhead. Cache buffers are donated so XLA updates in place."""
     import jax
     import jax.numpy as jnp
 
@@ -129,17 +173,19 @@ def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool):
     def step(params, k_caches, v_caches, tokens, lengths):
         # tokens/lengths: [slots] int32; caches: per-layer [S, max_len, nh, hd]
         S = max_slots
-        x = params["embed"][tokens] + params["pos"][lengths]       # [S, h]
+        pos_idx = jnp.minimum(lengths, params["pos"].shape[0] - 1)
+        x = params["embed"][tokens] + params["pos"][pos_idx]        # [S, h]
         pos = jnp.arange(max_len)
         mask = pos[None, :] <= lengths[:, None]                    # [S, L]
         slot_idx = jnp.arange(S)
+        wr = jnp.minimum(lengths, max_len - 1)
         new_k, new_v = [], []
         for p, kc, vc in zip(params["layers"], k_caches, v_caches):
             h1 = ln(x, p["ln1_w"], p["ln1_b"])
             qkv = (h1 @ p["qkv_w"] + p["qkv_b"]).reshape(S, 3, nh, hd)
             q, k1, v1 = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            kc = kc.at[slot_idx, lengths].set(k1)
-            vc = vc.at[slot_idx, lengths].set(v1)
+            kc = kc.at[slot_idx, wr].set(k1)
+            vc = vc.at[slot_idx, wr].set(v1)
             logits = jnp.einsum("shd,sLhd->shL", q, kc)
             logits = logits.astype(jnp.float32) * scale
             logits = jnp.where(mask[:, None, :], logits, -1e30)
@@ -157,8 +203,89 @@ def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool):
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, new_k, new_v
 
-    donate_argnums = (1, 2) if donate else ()
-    return jax.jit(step, donate_argnums=donate_argnums)
+    from ..jit import persistent_cache
+
+    return persistent_cache.cached_jit(
+        step, donate_argnums=(1, 2) if donate else (), label=label)
+
+
+def _build_window_step(cfg, max_slots: int, n_blocks: int, page_len: int,
+                       window: int, donate: bool, label: str):
+    """The PAGED executable family: embed ``W = window`` tokens per slot
+    at positions ``lengths + [0..W)``, write their K/V through the page
+    tables into the pool arenas, attend each window token causally against
+    the gathered pages, and return the greedy argmax at every window
+    position.
+
+    One shape serves three roles — W=1 is the decode step, W=k+1 scores a
+    draft model's k proposals (speculative verify), W=bucket prefills a
+    prompt suffix (cold prefill is the zero-prefix special case). Rows
+    whose page table is all-zero write only the scratch page, so a prefill
+    call touches exactly one request's pages.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    nh = cfg.num_attention_heads
+    hd = cfg.hidden_size // nh
+    eps = cfg.layer_norm_epsilon
+    scale = 1.0 / math.sqrt(hd)
+    S, B, W, PL = max_slots, n_blocks, window, page_len
+    L = B * PL  # gathered context length per slot
+
+    def ln(x, w, b):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mean) * jax.lax.rsqrt(var + eps) * w + b
+
+    def step(params, k_arenas, v_arenas, tables, tokens, lengths):
+        # tables: [S, B] page ids; tokens: [S, W]; lengths: [S] (int32)
+        P = k_arenas[0].shape[0]
+        pos = lengths[:, None] + jnp.arange(W)                     # [S, W]
+        pos_idx = jnp.minimum(pos, params["pos"].shape[0] - 1)
+        x = params["embed"][tokens] + params["pos"][pos_idx]       # [S, W, h]
+        j = jnp.arange(L)
+        mask = j[None, None, :] <= pos[:, :, None]                 # [S, W, L]
+        # write positions: page-table lookup of each window token's block;
+        # blocks past the table (or past a request's allocation: table
+        # entry 0) land in the scratch page — never another slot's pages
+        blk = pos // PL
+        pidx = jnp.take_along_axis(tables, jnp.minimum(blk, B - 1), axis=1)
+        pidx = jnp.where(blk < B, pidx, 0)                         # [S, W]
+        flat = (pidx * PL + pos % PL).reshape(-1)                  # [S*W]
+        new_k, new_v = [], []
+        for p, kc, vc in zip(params["layers"], k_arenas, v_arenas):
+            h1 = ln(x, p["ln1_w"], p["ln1_b"])
+            qkv = (h1 @ p["qkv_w"] + p["qkv_b"]).reshape(S, W, 3, nh, hd)
+            q, k1, v1 = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kc = kc.reshape(P * PL, nh, hd).at[flat].set(
+                k1.reshape(S * W, nh, hd)).reshape(P, PL, nh, hd)
+            vc = vc.reshape(P * PL, nh, hd).at[flat].set(
+                v1.reshape(S * W, nh, hd)).reshape(P, PL, nh, hd)
+            kk = kc[tables].reshape(S, L, nh, hd)
+            vv = vc[tables].reshape(S, L, nh, hd)
+            logits = jnp.einsum("swhd,sLhd->swhL", q, kk)
+            logits = logits.astype(jnp.float32) * scale
+            logits = jnp.where(mask[:, :, None, :], logits, -1e30)
+            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+            ctx = jnp.einsum("swhL,sLhd->swhd", probs, vv)
+            ctx = ctx.reshape(S, W, nh * hd)
+            x = x + (ctx @ p["out_w"] + p["out_b"])
+            h2 = ln(x, p["ln2_w"], p["ln2_b"])
+            m = jax.nn.gelu(h2 @ p["fc_in_w"] + p["fc_in_b"],
+                            approximate=True)
+            x = x + (m @ p["fc_out_w"] + p["fc_out_b"])
+            new_k.append(kc)
+            new_v.append(vc)
+        xf = ln(x, params["lnf_w"], params["lnf_b"])
+        logits = xf @ params["embed"].T                        # [S, W, vocab]
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, new_k, new_v
+
+    from ..jit import persistent_cache
+
+    return persistent_cache.cached_jit(
+        step, donate_argnums=(1, 2) if donate else (), label=label)
 
 
 class GenerationEngine(EngineBase):
@@ -168,22 +295,27 @@ class GenerationEngine(EngineBase):
 
         eng = GenerationEngine(model, GenerationConfig(max_slots=4))
         eng.start()
-        fut = eng.submit(prompt_ids, max_new_tokens=8)
+        fut = eng.submit(prompt_ids, max_new_tokens=8, deadline_ms=None)
         full = fut.result()          # np.int64 [len(prompt) + generated]
         eng.stats()
         eng.close()
 
     Requests queue under admission control (``QueueFull`` beyond
-    ``max_queue``); a prompt joins the decode batch as soon as a slot frees
-    — it never waits for the running sequences to finish.
+    ``max_queue``); a prompt joins the decode batch as soon as a slot AND
+    enough KV pages free — it never waits for the running sequences to
+    finish. Slot-join order is earliest-deadline-first; requests that
+    expire while queued are shed with ``DeadlineExceeded`` before any
+    device time is spent. With ``prefix_cache`` on, a prompt whose leading
+    page-blocks are already cached reuses those pages and prefills only
+    its suffix. With a ``draft_model``, each decode round proposes
+    ``spec_tokens`` draft tokens and verifies them in one window-step call
+    — output stays token-for-token the target model's greedy path.
     """
 
     _close_timeout = 60.0  # an in-flight decode batch may take a while
 
     def __init__(self, model, config: Optional[GenerationConfig] = None,
                  name: Optional[str] = None):
-        import jax.numpy as jnp
-
         self.config = config or GenerationConfig()
         super().__init__(name or f"gen#{next(_GEN_NO)}")
 
@@ -205,34 +337,84 @@ class GenerationEngine(EngineBase):
         nh = mcfg.num_attention_heads
         hd = mcfg.hidden_size // nh
         S = self.config.max_slots
-        self._k = [jnp.zeros((S, self.max_len, nh, hd), dtype)
-                   for _ in range(mcfg.num_hidden_layers)]
-        self._v = [jnp.zeros((S, self.max_len, nh, hd), dtype)
-                   for _ in range(mcfg.num_hidden_layers)]
+        pl = self.config.page_len
+        self._pl = pl
+        self._n_blocks = B = -(-self.max_len // pl)  # ceil
+        num_pages = self.config.num_pages
+        if num_pages is None:
+            # every slot's worst case + two cached prefixes' worth + scratch
+            num_pages = S * B + 2 * B + 1
+        self._pool = PagedKVPool(mcfg.num_hidden_layers, num_pages, pl,
+                                 nh, hd, dtype,
+                                 prefix_cache=self.config.prefix_cache)
 
         import jax
 
         donate = self.config.donate_cache and jax.default_backend() != "cpu"
-        from .. import jit as jit_mod
+        self._donate = donate
+        self._mcfg = mcfg
+        self._windows: Dict[int, Any] = {}  # W -> compiled window step
 
-        self._decode = jit_mod._maybe_audit(
-            f"serving:{self.name}:decode",
-            _build_decode_step(mcfg, S, self.max_len, donate))
-        self._insert = jax.jit(
-            lambda cache, kv, slot: jax.lax.dynamic_update_slice(
-                cache, kv, (slot, 0, 0, 0)),
-            donate_argnums=(0,) if donate else ())
+        # -- speculative decoding (draft model) --------------------------------
+        self.spec_k = 0
+        if self.config.draft_model is not None:
+            import jax.numpy as jnp
 
-        self._slots = [_Slot() for _ in range(S)]
-        # memory truth: the slot arena's K/V bytes ride in the `memory`
-        # provider (the one fixed-shape buffer continuous batching holds)
+            dm = self.config.draft_model
+            dm.eval()
+            dcfg = dm.config
+            if dcfg.vocab_size != mcfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{mcfg.vocab_size}")
+            if dcfg.max_position_embeddings < self.max_len:
+                raise ValueError(
+                    f"draft position table ({dcfg.max_position_embeddings}) "
+                    f"shorter than max_seq_len {self.max_len}")
+            self.spec_k = max(1, self.config.spec_tokens)
+            self._draft = dm
+            self._dparams = _extract_gpt_params(dm)
+            dnh = dcfg.num_attention_heads
+            dhd = dcfg.hidden_size // dnh
+            ddtype = self._dparams["embed"].dtype
+            dlen = B * pl
+            self._dk = [jnp.zeros((S, dlen, dnh, dhd), ddtype)
+                        for _ in range(dcfg.num_hidden_layers)]
+            self._dv = [jnp.zeros((S, dlen, dnh, dhd), ddtype)
+                        for _ in range(dcfg.num_hidden_layers)]
+            from .. import jit as jit_mod
+
+            dlabel = f"serving:{self.name}:draft_decode"
+            self._draft_step = jit_mod._maybe_audit(
+                dlabel, _build_decode_step(dcfg, S, dlen, donate,
+                                           label=dlabel))
+            ilabel = f"serving:{self.name}:draft_insert"
+            self._dinsert = jit_mod._maybe_audit(
+                ilabel, jit_mod.persistent_cache.cached_jit(
+                    lambda cache, kv, slot: jax.lax.dynamic_update_slice(
+                        cache, kv, (slot, 0, 0, 0)),
+                    donate_argnums=(0,) if donate else (), label=ilabel))
+
+        self._slots = [_Slot(B) for _ in range(S)]
+        # memory truth: the page pool's K/V bytes (plus the draft model's
+        # slot arena) ride in the `memory` provider — the fixed device
+        # buffers continuous batching holds
         try:
             from ..observability.memory import register_component
 
-            register_component(f"serving:{self.name}:kv_arena",
-                               type(self)._kv_arena_bytes, owner=self)
+            register_component(f"serving:{self.name}:kv_pages",
+                               type(self)._kv_pool_bytes, owner=self)
         except Exception:
             pass
+        # hub families: prefix-cache and speculative-decode truth for the
+        # process-wide /metrics surface (per-engine labels)
+        try:
+            from ..observability import family
+
+            self._fam_prefix = family("prefix_cache", ("engine", "event"))
+            self._fam_spec = family("speculative", ("engine", "event"))
+        except Exception:
+            self._fam_prefix = self._fam_spec = None
         # slot-occupancy history: (slot, t0, t1, tokens) per residency —
         # the timeline track behind the pd_top occupancy view and the
         # chrome-trace slots:<engine> process
@@ -240,16 +422,71 @@ class GenerationEngine(EngineBase):
         self._residencies = 0
         self._t_start = time.monotonic()
         self.metrics.gauge("slot_occupancy", self.slot_occupancy)
+        self.metrics.gauge("kv_headroom", self.kv_headroom)
 
-    def _kv_arena_bytes(self) -> int:
-        """Bytes held by the fixed-shape slot K/V arena (all layers)."""
-        return sum(int(c.nbytes) for c in self._k) + \
-            sum(int(c.nbytes) for c in self._v)
+    # -- executables ----------------------------------------------------------
+    def _window(self, W: int):
+        """The compiled window step for window size ``W`` (built once per
+        size; sizes come from the closed set {1, spec_k+1} ∪ buckets, so
+        steady state never retraces)."""
+        fn = self._windows.get(W)
+        if fn is None:
+            from .. import jit as jit_mod
+
+            label = f"serving:{self.name}:window{W}"
+            fn = jit_mod._maybe_audit(
+                label, _build_window_step(self._mcfg, self.config.max_slots,
+                                          self._n_blocks, self._pl, W,
+                                          self._donate, label=label))
+            self._windows[W] = fn
+        return fn
+
+    def warmup(self):
+        """Compile the whole steady-state executable set up front (decode,
+        speculative verify, every prefill bucket, draft steps) against the
+        scratch page — a warm replica restarting under the persistent
+        cache loads them all from disk with zero fresh XLA compiles."""
+        import jax.numpy as jnp
+
+        S, B = self.config.max_slots, self._n_blocks
+        tables = jnp.zeros((S, B), jnp.int32)
+        lengths = jnp.zeros(S, jnp.int32)
+        sizes = [1] + ([self.spec_k + 1] if self.spec_k else []) + \
+            [b for b in self.config.prefill_buckets]
+        for W in sorted(set(sizes)):
+            tokens = jnp.zeros((S, W), jnp.int32)
+            _n, self._pool.k, self._pool.v = self._window(W)(
+                self._params, self._pool.k, self._pool.v, tables, tokens,
+                lengths)
+        if self.spec_k:
+            toks = jnp.zeros(S, jnp.int32)
+            _n, self._dk, self._dv = self._draft_step(
+                self._dparams, self._dk, self._dv, toks, lengths)
+            # the draft PREFILL path too (its per-bucket insert
+            # executables + the draft forward's op set) — slot 0's
+            # garbage rows are overwritten at the first real admit
+            for b in self.config.prefill_buckets:
+                self._draft_prefill(0, np.zeros(b, dtype=np.int64))
+        self.metrics.inc("warmup_runs")
+        return self
+
+    def _kv_pool_bytes(self) -> int:
+        """Bytes held by the paged K/V pool (all layers), plus the draft
+        model's slot arena when speculative decoding is on."""
+        n = self._pool.bytes()
+        if self.spec_k:
+            n += sum(int(c.nbytes) for c in self._dk) + \
+                sum(int(c.nbytes) for c in self._dv)
+        return n
 
     # -- submission -----------------------------------------------------------
-    def submit(self, prompt_ids, max_new_tokens: int = 16) -> "Future":
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               deadline_ms: Optional[float] = None) -> "Future":
         """Queue one prompt (1-D int array). The future resolves to the
-        full sequence (prompt + generated) as a 1-D np.int64 array."""
+        full sequence (prompt + generated) as a 1-D np.int64 array. A
+        ``deadline_ms`` bounds QUEUE time: expired requests are shed with
+        ``DeadlineExceeded`` before prefill, and queued requests join
+        slots earliest-deadline-first."""
         self.metrics.inc("requests_total")
         fut: Future = Future()
         prompt = np.asarray(prompt_ids)
@@ -271,20 +508,36 @@ class GenerationEngine(EngineBase):
                 f"bucket {self.config.prefill_buckets[-1]}"))
             return fut
         if len(prompt) + max_new_tokens > self.max_len:
-            # don't silently truncate: the slot arena cannot hold the asked-
-            # for continuation (len(out) == len(prompt) + max_new_tokens is
-            # part of the contract)
+            # the model's position table (max_seq_len) cannot address the
+            # asked-for continuation (len(out) == len(prompt) +
+            # max_new_tokens is part of the contract)
             self.metrics.inc("errors_total")
             fut.set_exception(BadRequest(
                 f"prompt ({len(prompt)}) + max_new_tokens "
                 f"({max_new_tokens}) exceeds max_seq_len {self.max_len}"))
             return fut
+        needed = -(-(len(prompt) + max_new_tokens) // self._pl)
+        if needed > self._pool.allocator.usable_pages:
+            # paged admission bound: POOL capacity, not slot length — a
+            # request that could never hold enough pages is rejected; one
+            # that merely has to wait for pages stays queued
+            self.metrics.inc("errors_total")
+            fut.set_exception(BadRequest(
+                f"request needs {needed} KV pages; the pool holds "
+                f"{self._pool.allocator.usable_pages}"))
+            return fut
+        t_submit = time.monotonic()
+        deadline = None if deadline_ms is None \
+            else t_submit + deadline_ms / 1000.0
         req = _GenRequest(prompt.astype(np.int64), int(max_new_tokens), fut,
-                          time.monotonic())
+                          t_submit, deadline)
+        req.blocks = token_blocks(req.prompt, self._pl)
+        req.total_blocks = needed
         tr = _tracer()
         req.trace = tr.start(self.name, kind="generate",
                              prompt_len=len(prompt),
-                             max_new_tokens=int(max_new_tokens))
+                             max_new_tokens=int(max_new_tokens),
+                             deadline_ms=deadline_ms)
         tr.span(req.trace, "admission", req.t_submit, time.monotonic())
         try:
             self._enqueue(req, self.config.max_queue)
@@ -299,35 +552,94 @@ class GenerationEngine(EngineBase):
                 return b if b <= self.max_len else None
         return None
 
+    # -- router probes --------------------------------------------------------
+    def kv_headroom(self) -> float:
+        """Free fraction of the KV page pool (load-aware dispatch input)."""
+        a = self._pool.allocator
+        return round(a.free_pages / max(a.usable_pages, 1), 4)
+
+    def prefix_match_tokens(self, prompt_ids, blocks=None) -> int:
+        """Tokens of ``prompt_ids`` whose KV pages this engine already
+        caches (prefix-affinity probe; takes no refs, bumps no LRU). A
+        caller probing several replicas may pass the precomputed
+        ``token_blocks(prompt, page_len, limit=(p-1)//page_len)``."""
+        trie = self._pool.trie
+        if trie is None:
+            return 0
+        if blocks is None:
+            prompt = np.asarray(prompt_ids).reshape(-1)
+            blocks = token_blocks(prompt, self._pl,
+                                  limit=(len(prompt) - 1) // self._pl)
+        return trie.match_len(blocks) * self._pl
+
     # -- the continuous-batching loop -----------------------------------------
     def _active(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.req is not None]
 
+    def _blocks_needed(self, req: _GenRequest) -> int:
+        """Pages a request must be able to allocate at join time (worst
+        case, minus what the prefix cache already holds). Block tuples are
+        precomputed at submit — only the trie walk runs here."""
+        trie = self._pool.trie
+        if trie is None:
+            return req.total_blocks
+        m = trie.match_len(req.blocks[: (len(req.prompt) - 1) // self._pl])
+        return req.total_blocks - m
+
+    def _next_request(self) -> Optional[_GenRequest]:
+        """Shed expired queued requests, then pick the earliest-deadline
+        queued request whose KV pages can be allocated right now."""
+        now = time.monotonic()
+        shed: List[_GenRequest] = []
+        picked: Optional[_GenRequest] = None
+        with self._cond:
+            for r in list(self._queue):
+                if r.deadline is not None and now > r.deadline:
+                    self._queue.remove(r)
+                    shed.append(r)
+            order = sorted(self._queue, key=_GenRequest.edf_key)
+            for r in order:
+                if self._pool.can_allocate(self._blocks_needed(r)):
+                    self._queue.remove(r)
+                    picked = r
+                    break
+        for r in shed:  # outside the lock: future callbacks may re-submit
+            self.metrics.inc("shed_total")
+            if not r.future.done():
+                r.future.set_exception(DeadlineExceeded(
+                    "deadline expired while queued"))
+            _tracer().finish(r.trace, ok=False, error="DeadlineExceeded")
+        return picked
+
     def _worker(self):
         while True:
-            # admit queued prompts into free slots (join mid-flight)
-            admitted = True
-            while admitted:
-                admitted = False
+            # admit queued prompts into free slots (join mid-flight,
+            # earliest deadline first, bounded by KV page headroom)
+            while True:
                 free = next((i for i, s in enumerate(self._slots)
                              if s.req is None), None)
                 if free is None:
                     break
-                with self._cond:
-                    req = self._queue.popleft() if self._queue else None
+                req = self._next_request()
                 if req is None:
                     break
                 try:
                     self._admit(free, req)
+                except PoolExhausted:
+                    # transient: pages freed by in-flight releases will
+                    # cover it — requeue at the front, decode meanwhile
+                    with self._cond:
+                        self._queue.appendleft(req)
+                    break
                 except Exception as e:  # isolate: fail this prompt only
                     if not req.future.done():
                         req.future.set_exception(e)
                     _tracer().finish(req.trace, ok=False,
                                      error=type(e).__name__)
                     self.metrics.inc("errors_total")
+                    self._release_pages(self._slots[free])
                     slot = self._slots[free]
                     slot.req, slot.length, slot.last_token = None, 0, 0
-                admitted = True
             active = self._active()
             if not active:
                 with self._cond:
@@ -349,68 +661,144 @@ class GenerationEngine(EngineBase):
                         self._release_slot(i, now, failed=True,
                                            error=type(e).__name__)
                     else:
+                        self._release_pages(s)
                         s.req, s.length, s.last_token = None, 0, 0
                 self.metrics.inc("errors_total", len(active))
                 self.metrics.inc("batch_failures")
 
     def _admit(self, slot_no: int, req: _GenRequest):
-        """Prefill the prompt through the model's own KV-cache forward and
-        land its K/V in the slot arena; the first generated token comes from
-        the prefill logits (matching ``generate``'s contract)."""
+        """Join a prompt: borrow its cached prefix pages, allocate private
+        pages for the rest, prefill ONLY the uncached suffix through the
+        window step, and adopt its full prompt blocks into the prefix
+        cache. The first generated token is the window's argmax at the
+        last real prompt position (matching ``generate``'s contract)."""
         import jax.numpy as jnp
 
-        from ..core.tensor import Tensor
-
         p = len(req.prompt)
-        bucket = self._prefill_bucket(p)
-        padded = np.zeros((1, bucket), dtype=np.int64)
-        padded[0, :p] = req.prompt
+        pl = self._pl
+        total_blocks = req.total_blocks
         t0 = time.monotonic()
+        s = self._slots[slot_no]
+        s.table[:] = 0
+        # prefix reuse: longest cached chain of full prompt blocks, capped
+        # so at least one suffix token remains to produce the first logits
+        shared_pages: List[int] = []
+        trie = self._pool.trie
+        all_blocks = req.blocks
+        if trie is not None:
+            shared_pages = trie.match(all_blocks[: (p - 1) // pl], pl,
+                                      self._pool.allocator)
+        m = len(shared_pages)
+        try:
+            private = self._pool.allocate(total_blocks - m)
+        except PoolExhausted:
+            for pg in shared_pages:
+                self._pool.allocator.release(pg)
+            raise
+        # the queue span lands only once the join is certain — a
+        # PoolExhausted requeue above must not double-record queue time
         _tracer().span(req.trace, "queue", req.t_submit, t0)
-        from ..core import autograd
-
-        with autograd.no_grad():
-            hidden, caches = self.model.gpt(Tensor(jnp.asarray(padded)),
-                                            use_cache=True)
-        # per-layer K/V [1, bucket, nh, hd] -> arena rows (tail is garbage
-        # from padded positions; decode masks j <= length so it is never
-        # read before being overwritten)
-        slot = np.int32(slot_no)
-        for li, (k, v) in enumerate(caches):
-            self._k[li] = self._insert(self._k[li], k.data, slot)
-            self._v[li] = self._insert(self._v[li], v.data, slot)
-        # first token: argmax of the tied-head logits at the last REAL
-        # prompt position (hidden[:, p-1])
-        logits = hidden.data[0, p - 1, :] @ self._params["embed"].T
-        first = int(np.asarray(jnp.argmax(logits)))
+        s.table[:m] = shared_pages
+        s.table[m:total_blocks] = private
+        s.blocks, s.shared = total_blocks, m
+        # COW hook: every block the decode path will write must be
+        # exclusively ours. By construction they already are (the trie
+        # shares FULL prompt blocks only), so this is a no-op guard — but
+        # a future partial-block sharing scheme lands here.
+        for bi in range(p // pl, total_blocks):
+            pg, copied = self._pool.ensure_writable(int(s.table[bi]))
+            if copied:
+                s.table[bi] = pg
+        # suffix prefill: one window-step call, this slot's pages only
+        start = m * pl
+        suffix = req.prompt[start:p]
+        W = self._prefill_bucket(len(suffix))
+        S, B = self.config.max_slots, self._n_blocks
+        tokens = np.zeros((S, W), dtype=np.int32)
+        tokens[slot_no, :len(suffix)] = suffix
+        lengths = np.zeros(S, dtype=np.int32)
+        lengths[slot_no] = start
+        tables = np.zeros((S, B), dtype=np.int32)
+        tables[slot_no] = s.table
+        with _oom_guard("generation", label=f"serving:{self.name}:prefill",
+                        engine=self.name, bucket=W):
+            nxt, self._pool.k, self._pool.v = self._window(W)(
+                self._params, self._pool.k, self._pool.v,
+                jnp.asarray(tables), jnp.asarray(tokens),
+                jnp.asarray(lengths))
+        first = int(np.asarray(nxt)[slot_no, len(suffix) - 1])
+        # draft model prefills the WHOLE prompt through its own forward
+        # (the draft is small; its dense slot arena has no prefix cache)
+        if self.spec_k:
+            self._draft_prefill(slot_no, req.prompt)
+        # adopt this prompt's full blocks into the prefix cache so the
+        # next same-prefix request skips their prefill
+        if trie is not None:
+            fp = p // pl
+            trie.insert(all_blocks[:fp], [int(x) for x in s.table[:fp]],
+                        self._pool.allocator)
+            self.metrics.inc("prefix_hit_tokens", m * pl)
+            if self._fam_prefix is not None:
+                self._fam_prefix.inc((self.name, "lookup_tokens"), p)
+                self._fam_prefix.inc((self.name, "hit_tokens"), m * pl)
+        self.metrics.inc("prompt_tokens_total", p)
         self.metrics.inc("prefills_total")
+        if m:
+            self.metrics.inc("prefix_hits")
         self.metrics.observe_queue_wait((t0 - req.t_submit) * 1e3)
         t1 = time.monotonic()
-        _tracer().span(req.trace, "prefill", t0, t1, bucket=bucket,
-                       prompt_len=p, slot=slot_no)
+        _tracer().span(req.trace, "prefill", t0, t1, bucket=W,
+                       prompt_len=p, slot=slot_no, prefix_blocks=m)
         req.t_decode0 = t1
 
-        s = self._slots[slot_no]
         s.req = req
         s.length = p
         s.last_token = first
         s.t0 = t1  # slot residency opens (occupancy track)
         req.generated.append(first)
-        self._maybe_finish(slot_no)
+        self._emit_finish_check(slot_no)
+
+    def _draft_prefill(self, slot_no: int, prompt: np.ndarray):
+        """Land the draft model's K/V for the whole prompt in its slot
+        arena (the draft proposes from position ``len(prompt)`` on)."""
+        import jax.numpy as jnp
+
+        from ..core import autograd
+        from ..core.tensor import Tensor
+
+        p = len(prompt)
+        bucket = self._prefill_bucket(p)
+        padded = np.zeros((1, bucket), dtype=np.int64)
+        padded[0, :p] = prompt
+        with autograd.no_grad():
+            _h, caches = self._draft.gpt(Tensor(jnp.asarray(padded)),
+                                         use_cache=True)
+        slot = np.int32(slot_no)
+        for li, (k, v) in enumerate(caches):
+            self._dk[li] = self._dinsert(self._dk[li], k.data, slot)
+            self._dv[li] = self._dinsert(self._dv[li], v.data, slot)
 
     def _decode_once(self, active: List[int]):
+        """One decode round. Without a draft model this is the classic
+        W=1 step (one token per active slot). With one, the draft
+        proposes ``k`` tokens per slot (k dense decode steps), the target
+        scores all k+1 window positions in ONE verify call, and each slot
+        advances by its accepted run plus the target's own next token —
+        emitted tokens are target argmaxes, so greedy output is unchanged.
+        """
         from .. import profiler
 
-        S = self.config.max_slots
-        tokens = np.zeros(S, dtype=np.int32)
+        S, B = self.config.max_slots, self._n_blocks
+        k = self.spec_k
+        W = k + 1
+        tokens = np.zeros((S, W), dtype=np.int32)
         lengths = np.zeros(S, dtype=np.int32)
-        for i, s in enumerate(self._slots):
-            if s.req is not None:
-                tokens[i] = s.last_token
-                # write position: current length (clamped defensively; a
-                # slot at max_len is finished before decode in
-                # _maybe_finish, so the clamp never fires for active slots)
-                lengths[i] = min(s.length, self.max_len - 1)
+        tables = np.zeros((S, B), dtype=np.int32)
+        for i in active:
+            s = self._slots[i]
+            tokens[i, 0] = s.last_token
+            lengths[i] = min(s.length, self.max_len - 1)
+            tables[i] = s.table
         # chaos site: scripted decode fault at an exact decode-step index
         # (PT_FAULTS="decode_fault@step=2") — the in-flight requests fail,
         # their slots release, queued prompts keep being admitted
@@ -418,29 +806,70 @@ class GenerationEngine(EngineBase):
         _injector().check("decode_fault", engine=self.name,
                           step=self._decode_no)
         t_dec = time.monotonic()
+        import jax.numpy as jnp
+
         with profiler.RecordEvent(
                 f"serving::decode[{self.name} n{len(active)}]", "Serving"):
+            if k:  # draft proposal: k dense decode steps, all slots batched
+                cur = jnp.asarray(tokens[:, 0])
+                for j in range(k):
+                    with _oom_guard("generation",
+                                    label=f"serving:{self.name}:draft",
+                                    engine=self.name, step=self._decode_no):
+                        nd, self._dk, self._dv = self._draft_step(
+                            self._dparams, self._dk, self._dv, cur,
+                            jnp.asarray(lengths + j))
+                    tokens[:, j + 1] = np.asarray(nd)
+                    cur = nd
             with _oom_guard("generation", label=f"serving:{self.name}:decode",
                             engine=self.name, step=self._decode_no):
-                nxt, self._k, self._v = self._decode(
-                    self._params, self._k, self._v, tokens, lengths)
-        nxt = np.asarray(nxt)
+                nxt, self._pool.k, self._pool.v = self._window(W)(
+                    self._params, self._pool.k, self._pool.v,
+                    jnp.asarray(tables), jnp.asarray(tokens),
+                    jnp.asarray(lengths))
+        n = np.asarray(nxt)  # [S, W] target argmax at each window position
         fr = self._flight()
         if fr is not None:  # decode steps land in the flight ring
             fr.record_serving_step(self.name, "decode",
                                    (time.monotonic() - t_dec) * 1e3,
                                    len(active))
         self.metrics.inc("decode_steps")
-        self.metrics.inc("tokens_total", len(active))
+        self.metrics.inc("slot_rounds", len(active))
         self.metrics.observe_occupancy(len(active) / S)
+        emitted_total = 0
         for i in active:
             s = self._slots[i]
-            s.length += 1
-            s.last_token = int(nxt[i])
-            s.req.generated.append(s.last_token)
-            self._maybe_finish(i)
+            if k:
+                a = greedy_accept(tokens[i, 1:k + 1], n[i, :k])
+                # cap the advance at k so the draft cache stays in sync
+                # (the all-accepted bonus would outrun what the draft saw)
+                adv = min(a + 1, k)
+                emit = [int(tokens[i, j + 1]) for j in range(adv - 1)]
+                emit.append(int(n[i, adv - 1]))
+                self.metrics.inc("spec_proposed", k)
+                self.metrics.inc("spec_accepted", adv - 1)
+                if self._fam_spec is not None:
+                    self._fam_spec.inc((self.name, "proposed"), k)
+                    self._fam_spec.inc((self.name, "accepted"), adv - 1)
+            else:
+                emit = [int(n[i, 0])]
+            for t in emit:
+                s.length += 1
+                s.last_token = t
+                s.req.generated.append(t)
+                emitted_total += 1
+                if self._emit_finish_check(i):
+                    break
+        self.metrics.inc("tokens_total", emitted_total)
+        if k:
+            self.metrics.inc("spec_rounds")
+            if self._fam_spec is not None:
+                self._fam_spec.inc((self.name, "rounds"))
+                self._fam_spec.inc((self.name, "emitted"), emitted_total)
 
-    def _maybe_finish(self, slot_no: int):
+    def _emit_finish_check(self, slot_no: int) -> bool:
+        """Finish-and-release when the slot's request is done (budget
+        reached, EOS, or context exhausted). Returns True when released."""
         s = self._slots[slot_no]
         req = s.req
         eos = self.config.eos_token_id
@@ -448,7 +877,7 @@ class GenerationEngine(EngineBase):
                 or (eos is not None and req.generated[-1] == eos)
                 or s.length >= self.max_len - 1)
         if not done:
-            return
+            return False
         full = np.concatenate([req.prompt,
                                np.asarray(req.generated, dtype=np.int64)])
         if not req.future.done():
@@ -458,12 +887,21 @@ class GenerationEngine(EngineBase):
         self.metrics.inc("responses_total")
         self.metrics.mark_done()
         self._release_slot(slot_no, now, failed=False)
+        return True
+
+    def _release_pages(self, s: _Slot) -> None:
+        """Drop this slot's page refs (shared AND private; pages the trie
+        adopted survive on its ref and stay reusable)."""
+        for bi in range(s.blocks):
+            self._pool.allocator.release(int(s.table[bi]))
+        s.table[:] = 0
+        s.blocks = s.shared = 0
 
     def _release_slot(self, slot_no: int, now: float, failed: bool,
                       error: Optional[str] = None):
         """Close the residency: decode span + completion on the request's
         trace, one span on the slot-occupancy track, history row for the
-        pd_top occupancy view."""
+        pd_top occupancy view — and the KV pages go back to the pool."""
         s = self._slots[slot_no]
         req = s.req
         if req is not None:
@@ -479,6 +917,7 @@ class GenerationEngine(EngineBase):
                          tokens=tokens)
             self._slot_hist.append((slot_no, t0, now, tokens))
             self._residencies += 1
+        self._release_pages(s)
         s.req = None
         s.length = 0
         s.last_token = 0
@@ -512,4 +951,16 @@ class GenerationEngine(EngineBase):
         snap = self._stats_base()
         snap["max_slots"] = self.config.max_slots
         snap["active_slots"] = len(self._active())
+        snap["kv_pages"] = self._pool.stats()
+        c = snap["counters"]
+        pt = c.get("prompt_tokens_total", 0)
+        snap["prefix_hit_rate"] = round(
+            c.get("prefix_hit_tokens", 0) / pt, 4) if pt else 0.0
+        rounds = c.get("slot_rounds", 0)  # per-SEQUENCE decode rounds
+        snap["effective_tokens_per_step"] = round(
+            c.get("tokens_total", 0) / rounds, 3) if rounds else 0.0
+        if self.spec_k:
+            prop = c.get("spec_proposed", 0)
+            snap["spec_acceptance"] = round(
+                c.get("spec_accepted", 0) / prop, 4) if prop else 0.0
         return snap

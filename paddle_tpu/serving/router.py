@@ -1,0 +1,273 @@
+"""Load-aware multi-replica router: the serving fleet's front door.
+
+Reference lineage: the reference serves a *fleet* — ``dist_model.cc``
+drives N predictor ranks behind a dispatcher. Here N ``GenerationEngine``
+replicas (threads or processes warm-started from the shared persistent
+executable cache) sit behind ONE admission-controlled ``ReplicaRouter``:
+
+- **admission control**: a fleet-wide queue bound plus per-tenant
+  in-flight quotas (``TenantQuotaExceeded`` — a ``QueueFull`` subclass, so
+  existing backpressure handling applies);
+- **load-aware dispatch**: each submit scores every healthy replica from
+  its REAL state — queue depth (backpressure), KV-page headroom (the
+  PR-8 memory gauges' serving twin), and the p95 of its recent request
+  latencies (PR-7's trace-fed latency window) — and picks the cheapest;
+- **prefix affinity**: a prompt whose leading page-blocks are already in
+  some replica's prefix cache is steered there (its pages are reusable
+  *only* on the replica that holds them), unless that replica is
+  overloaded — affinity is a bounded bonus, not a hard pin;
+- **fault routing**: a replica whose submit raises ``EngineClosed`` (or
+  dies outright) is marked down and traffic re-dispatches to survivors;
+  the queue keeps draining.
+
+The router is thread-safe and engine-shaped: ``submit() -> Future``,
+``stats()``, context-manager lifecycle.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .base import EngineClosed, QueueFull
+from .generation import GenerationEngine
+from .paged_kv import token_blocks
+
+__all__ = ["RouterConfig", "ReplicaRouter", "TenantQuotaExceeded"]
+
+
+class TenantQuotaExceeded(QueueFull):
+    """The tenant's in-flight quota is exhausted (admission control)."""
+
+
+@dataclass
+class RouterConfig:
+    """Dispatch-policy knobs. Score = lower-is-better; the affinity bonus
+    subtracts, everything else adds."""
+
+    max_inflight: int = 1024            # fleet-wide admission bound
+    tenant_quotas: Dict[str, int] = field(default_factory=dict)
+    default_quota: Optional[int] = None  # None: unlimited per tenant
+    w_queue: float = 1.0                # per queued request (normalized)
+    w_memory: float = 0.5               # (1 - kv headroom)
+    w_latency: float = 0.5              # p95 normalized across replicas
+    w_affinity: float = 2.0             # * matched-prefix fraction
+
+    def quota_for(self, tenant: str) -> Optional[int]:
+        return self.tenant_quotas.get(tenant, self.default_quota)
+
+
+class ReplicaRouter:
+    """Admission-controlled front door over N ``GenerationEngine``
+    replicas.
+
+    ::
+
+        router = ReplicaRouter([eng_a, eng_b], RouterConfig(
+            tenant_quotas={"free": 4}, default_quota=64))
+        fut = router.submit(prompt, max_new_tokens=8, tenant="free")
+        fut.result()
+        router.stats()     # fleet + per-replica snapshot
+        router.close()
+    """
+
+    def __init__(self, replicas: Sequence[GenerationEngine],
+                 config: Optional[RouterConfig] = None,
+                 name: str = "router"):
+        if not replicas:
+            raise ValueError("need at least one replica")
+        self.name = name
+        self.config = config or RouterConfig()
+        self._replicas = list(replicas)
+        self._lock = threading.Lock()
+        self._down: set = set()          # replica names marked unhealthy
+        self._inflight: Dict[str, int] = {}   # per-tenant in-flight
+        self._inflight_total = 0
+        self._routed: Dict[str, int] = {r.name: 0 for r in self._replicas}
+        self._affinity_hits = 0
+        self._rejected = {"quota": 0, "capacity": 0}
+        self._closed = False
+        self._t0 = time.monotonic()
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self):
+        for r in self._replicas:
+            r.start()
+        return self
+
+    def close(self, drain: bool = True):
+        with self._lock:
+            self._closed = True
+        for r in self._replicas:
+            try:
+                r.close(drain=drain)
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- health ---------------------------------------------------------------
+    def mark_down(self, replica_name: str) -> None:
+        with self._lock:
+            self._down.add(replica_name)
+
+    def mark_up(self, replica_name: str) -> None:
+        with self._lock:
+            self._down.discard(replica_name)
+
+    def healthy(self) -> List[GenerationEngine]:
+        with self._lock:
+            down = set(self._down)
+        return [r for r in self._replicas if r.name not in down]
+
+    # -- dispatch -------------------------------------------------------------
+    def _scores(self, prompt, candidates: List[GenerationEngine]
+                ) -> Tuple[List[float], List[int]]:
+        """(score, matched-prefix-tokens) per candidate, lower score
+        wins. The match is probed ONCE here and reused for the affinity
+        accounting — a post-submit probe would count the request's own
+        just-inserted blocks as a hit."""
+        cfg = self.config
+        p = max(len(prompt), 1)
+        depths = [r.queue_depth() for r in candidates]
+        p95s = [r.metrics.latency_percentile(95) for r in candidates]
+        # token-block chains are built ONCE per page size, not once per
+        # replica — the probe itself is then just a trie walk
+        blk_cache: Dict[int, Any] = {}
+        matches = []
+        for r in candidates:
+            pl = getattr(getattr(r, "config", None), "page_len", None)
+            if pl is None:
+                matches.append(r.prefix_match_tokens(prompt))
+                continue
+            if pl not in blk_cache:
+                blk_cache[pl] = token_blocks(prompt, pl,
+                                             limit=(len(prompt) - 1) // pl)
+            matches.append(r.prefix_match_tokens(prompt,
+                                                 blocks=blk_cache[pl]))
+        p95_hi = max(max(p95s), 1e-9)
+        q_hi = max(max(depths), 1)
+        scores = []
+        for r, d, p95, match in zip(candidates, depths, p95s, matches):
+            s = cfg.w_queue * (d / q_hi) \
+                + cfg.w_memory * (1.0 - r.kv_headroom()) \
+                + cfg.w_latency * (p95 / p95_hi) \
+                - cfg.w_affinity * (match / p)
+            scores.append(s)
+        return scores, matches
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               tenant: str = "default",
+               deadline_ms: Optional[float] = None):
+        """Route one prompt to the best replica; returns its Future. The
+        returned future resolves/fails exactly as the owning engine's
+        would — the router adds admission control and placement only."""
+        with self._lock:
+            if self._closed:
+                raise EngineClosed("router closed")
+            if self._inflight_total >= self.config.max_inflight:
+                self._rejected["capacity"] += 1
+                raise QueueFull(
+                    f"fleet at capacity ({self.config.max_inflight})")
+            quota = self.config.quota_for(tenant)
+            if quota is not None and \
+                    self._inflight.get(tenant, 0) >= quota:
+                self._rejected["quota"] += 1
+                raise TenantQuotaExceeded(
+                    f"tenant {tenant!r} at quota ({quota})")
+            self._inflight[tenant] = self._inflight.get(tenant, 0) + 1
+            self._inflight_total += 1
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        try:
+            fut = self._dispatch(prompt, max_new_tokens, deadline_ms)
+        except Exception:
+            self._done(tenant)
+            raise
+        fut.add_done_callback(lambda _f: self._done(tenant))
+        return fut
+
+    def _dispatch(self, prompt, max_new_tokens, deadline_ms):
+        last_exc: Optional[Exception] = None
+        tried = 0
+        while True:
+            candidates = self.healthy()
+            if not candidates:
+                raise EngineClosed("no healthy replicas")
+            scores, matches = self._scores(prompt, candidates)
+            order = sorted(range(len(candidates)), key=scores.__getitem__)
+            progressed = False
+            for idx in order:
+                r = candidates[idx]
+                try:
+                    fut = r.submit(prompt, max_new_tokens,
+                                   deadline_ms=deadline_ms)
+                except EngineClosed as e:
+                    # replica fault: fence it and keep draining through
+                    # the survivors
+                    self.mark_down(r.name)
+                    last_exc = e
+                    progressed = True
+                    break  # re-score against the surviving set
+                except QueueFull as e:
+                    last_exc = e
+                    continue
+                with self._lock:
+                    self._routed[r.name] = self._routed.get(r.name, 0) + 1
+                    if matches[idx] > 0:
+                        self._affinity_hits += 1
+                return fut
+            if not progressed:
+                raise last_exc or QueueFull("all replicas at capacity")
+            tried += 1
+            if tried > len(self._replicas):
+                raise last_exc or EngineClosed("no healthy replicas")
+
+    def _done(self, tenant: str) -> None:
+        with self._lock:
+            n = self._inflight.get(tenant, 0)
+            if n > 0:
+                self._inflight[tenant] = n - 1
+                self._inflight_total -= 1
+
+    # -- observability --------------------------------------------------------
+    def queue_depth(self) -> int:
+        return sum(r.queue_depth() for r in self._replicas)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            routed = dict(self._routed)
+            down = sorted(self._down)
+            inflight = dict(self._inflight)
+            rejected = dict(self._rejected)
+            affinity = self._affinity_hits
+        per_replica = {}
+        qps = 0.0
+        for r in self._replicas:
+            snap = r.stats()
+            qps += snap.get("qps", 0.0)
+            per_replica[r.name] = {
+                "qps": snap.get("qps"),
+                "queue_depth": r.queue_depth(),
+                "active_slots": snap.get("active_slots"),
+                "kv_headroom": r.kv_headroom(),
+                "prefix_hit_rate": snap.get("prefix_hit_rate"),
+                "p95_ms": snap.get("latency_ms", {}).get("p95"),
+                "responses": snap.get("counters", {}).get(
+                    "responses_total", 0),
+                "retrace_events": snap.get("retrace_events"),
+                "routed": routed.get(r.name, 0),
+                "down": r.name in down,
+            }
+        return {"name": self.name, "replicas": per_replica,
+                "fleet_qps": round(qps, 3), "down": down,
+                "inflight": inflight, "rejected": rejected,
+                "affinity_hits": affinity,
+                "uptime_s": round(time.monotonic() - self._t0, 1)}

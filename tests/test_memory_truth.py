@@ -354,7 +354,7 @@ def test_serving_injected_oom_isolated_and_reported(tmp_path, monkeypatch):
 
 
 @pytest.mark.slow
-def test_generation_engine_kv_arena_component():
+def test_generation_engine_kv_pages_component():
     from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving import GenerationConfig, GenerationEngine
 
@@ -364,11 +364,11 @@ def test_generation_engine_kv_arena_component():
     model = GPTForCausalLM(cfg)
     eng = GenerationEngine(model, GenerationConfig(
         max_slots=2, max_seq_len=32, prefill_buckets=(8,)), name="memgen")
-    expected = eng._kv_arena_bytes()
-    assert expected == sum(int(c.nbytes) for c in eng._k) + \
-        sum(int(c.nbytes) for c in eng._v) > 0
+    expected = eng._kv_pool_bytes()
+    assert expected == sum(int(c.nbytes) for c in eng._pool.k) + \
+        sum(int(c.nbytes) for c in eng._pool.v) > 0
     rows = omem.memory_monitor().sample()["components"]
-    assert rows.get("serving:memgen:kv_arena") == expected, rows
+    assert rows.get("serving:memgen:kv_pages") == expected, rows
     with eng:
         out = eng.submit(np.arange(4), max_new_tokens=3).result(timeout=60)
         assert len(out) == 7

@@ -1,0 +1,564 @@
+"""ISSUE 12: the production serving tier — paged KV cache with prefix
+reuse, speculative decoding, and the multi-replica router.
+
+Covers the acceptance surface: allocator/trie invariants (alloc, free,
+ref-count, COW, fragmentation under churn, leaf-only LRU eviction),
+prefix-hit parity (a shared-prefix request produces the same greedy
+tokens as a cold prefill — its K/V pages ARE the cold request's pages),
+speculative greedy parity vs ``model.generate``, rejection-sampling
+distribution preservation, deadline-aware (EDF) slot joining with
+queued-expiry shedding, paged admission bounds (pool capacity, not slot
+length), router quota/backpressure/fault behavior, and the zero-retrace
+steady-state contract for the paged decode path.
+"""
+import os
+import time
+from concurrent.futures import Future
+from concurrent.futures import wait as fwait
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.serving.paged_kv import (
+    PageAllocator, PagedKVPool, PoolExhausted, PrefixCache, token_blocks,
+)
+
+
+# -- allocator ----------------------------------------------------------------
+
+def test_allocator_alloc_free_refcount_invariants():
+    a = PageAllocator(8)                    # 1 scratch + 7 usable
+    p = a.alloc(3)
+    assert len(set(p)) == 3 and 0 not in p
+    assert a.free_pages == 4 and a.live_pages == 3
+    a.retain(p[0])
+    assert a.ref(p[0]) == 2
+    a.release(p[0])                         # still held once
+    assert a.ref(p[0]) == 1 and a.free_pages == 4
+    a.release(p[0])                         # now freed
+    assert a.ref(p[0]) == 0 and a.free_pages == 5
+    with pytest.raises(RuntimeError, match="double free"):
+        a.release(p[0])
+    with pytest.raises(RuntimeError, match="retain of free"):
+        a.retain(p[0])
+    with pytest.raises(PoolExhausted):
+        a.alloc(8)
+    assert a.free_pages == 5                # all-or-nothing: no leak
+    a.check()
+
+
+def test_allocator_cow_semantics():
+    a = PageAllocator(4)
+    (p,) = a.alloc(1)
+    same, copied = a.cow(p)
+    assert same == p and not copied         # exclusive: write in place
+    a.retain(p)                             # now shared
+    new, copied = a.cow(p)
+    assert copied and new != p
+    assert a.ref(new) == 1 and a.ref(p) == 1   # writer moved off the share
+    assert a.cow_total == 1
+    a.check()
+
+
+def test_allocator_fragmentation_churn():
+    """Random alloc/free churn: the free list and refcounts stay coherent
+    (no double allocation, no lost pages) at every step."""
+    rng = np.random.RandomState(0)
+    a = PageAllocator(32)
+    live = []
+    for _ in range(400):
+        if live and (rng.rand() < 0.5 or a.free_pages == 0):
+            pages = live.pop(rng.randint(len(live)))
+            for p in pages:
+                a.release(p)
+        else:
+            n = rng.randint(1, 5)
+            if n <= a.free_pages:
+                live.append(a.alloc(n))
+        a.check()
+        held = [p for pages in live for p in pages]
+        assert len(held) == len(set(held)), "page handed out twice"
+        assert a.live_pages == len(held)
+    for pages in live:
+        for p in pages:
+            a.release(p)
+    a.check()
+    assert a.free_pages == 31
+
+
+# -- prefix trie --------------------------------------------------------------
+
+def _chain(*blocks):
+    return [tuple(b) for b in blocks]
+
+
+def test_prefix_trie_match_insert_and_context_separation():
+    a = PageAllocator(16)
+    t = PrefixCache()
+    pages = a.alloc(3)
+    blocks = _chain([1, 2], [3, 4], [5, 6])
+    assert t.insert(blocks, pages, a) == 3
+    assert all(a.ref(p) == 2 for p in pages)       # ours + the trie's
+    got = t.match(blocks, 2, a)
+    assert got == pages
+    assert all(a.ref(p) == 3 for p in pages)       # match retained for us
+    # partial chains match their prefix only
+    assert t.match(_chain([1, 2], [9, 9]), 2) == pages[:1]
+    # the SAME block under a different prefix is a different node
+    assert t.match(_chain([3, 4]), 2) == []
+    assert t.match_len(blocks) == 3
+    assert t.stats()["hit_tokens"] > 0
+
+
+def test_prefix_trie_eviction_is_lru_leaf_only():
+    a = PageAllocator(16)
+    t = PrefixCache()
+    p_ab = a.alloc(2)
+    t.insert(_chain([1], [2]), p_ab, a)
+    p_c = a.alloc(1)
+    t.insert(_chain([3]), p_c, a)
+    for p in p_ab + p_c:
+        a.release(p)                       # trie is now the only holder
+    t.match(_chain([3]), 1)                # bump [3]: chain a-b is LRU
+    # evicting ONE page must take the a-b chain's LEAF, never its root
+    assert t.evict(1, a) == 1
+    assert t.match_len(_chain([1], [2])) == 1      # root [1] survives
+    assert t.match_len(_chain([3])) == 1
+    # a held page is never evicted: retain [3]'s page, ask for everything
+    a.retain(p_c[0])
+    freed = t.evict(10, a)
+    assert freed == 1                      # [1] goes; held [3] survives
+    assert t.match_len(_chain([3])) == 1 and len(t) == 1
+    a.release(p_c[0])
+    assert t.evict(10, a) == 1 and len(t) == 0
+    a.check()
+    assert a.free_pages == 15
+
+
+def test_token_blocks_full_blocks_only():
+    assert token_blocks(np.arange(10), 4) == [(0, 1, 2, 3), (4, 5, 6, 7)]
+    assert token_blocks(np.arange(10), 4, limit=1) == [(0, 1, 2, 3)]
+    assert token_blocks(np.arange(3), 4) == []
+
+
+def test_pool_cow_copies_device_contents():
+    pool = PagedKVPool(num_layers=1, num_pages=4, page_len=2, num_heads=1,
+                       head_dim=2, dtype="float32")
+    (p,) = pool.allocate(1)
+    pool.k[0] = pool.k[0].at[p].set(1.5)
+    pool.allocator.retain(p)               # shared: a writer must COW
+    new, copied = pool.ensure_writable(p)
+    assert copied and new != p
+    np.testing.assert_array_equal(np.asarray(pool.k[0][new]),
+                                  np.asarray(pool.k[0][p]))
+
+
+# -- rejection sampling (sampled speculative correctness) ---------------------
+
+def test_rejection_sample_preserves_target_distribution():
+    """Empirical check of the published property: whatever the draft
+    proposes, the FIRST emitted token is distributed as the target."""
+    rng = np.random.RandomState(0)
+    V, k, n = 4, 1, 20000
+    draft = np.array([[0.7, 0.1, 0.1, 0.1]])
+    target = np.array([[0.1, 0.4, 0.3, 0.2], [0.25, 0.25, 0.25, 0.25]])
+    counts = np.zeros(V)
+    for _ in range(n):
+        d_tok = np.array([rng.choice(V, p=draft[0])])
+        out, acc = serving.rejection_sample(draft, target, d_tok, rng)
+        assert len(out) == acc + 1
+        counts[out[0]] += 1
+    emp = counts / n
+    np.testing.assert_allclose(emp, target[0], atol=0.015)
+
+
+def test_rejection_sample_identical_distributions_accept_all():
+    rng = np.random.RandomState(1)
+    probs = np.array([[0.5, 0.5], [0.5, 0.5], [0.5, 0.5]])
+    for _ in range(50):
+        d = np.array([rng.choice(2, p=probs[0]), rng.choice(2, p=probs[1])])
+        out, acc = serving.rejection_sample(probs[:2], probs, d, rng)
+        assert acc == 2 and list(out[:2]) == list(d)
+    assert serving.greedy_accept([3, 5, 7], [3, 5, 9]) == 2
+    assert serving.greedy_accept([4], [4]) == 1
+    assert serving.greedy_accept([1], [2]) == 0
+
+
+# -- engine: paged decode, prefix reuse, deadlines ----------------------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    """1-layer GPT trained to continue the repeating 0..7 pattern:
+    confident logits make greedy decode stable (the serving recipe)."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dtype="float32")
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-3, parameters=model.parameters())
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y), optimizer)
+    pattern = np.tile(np.arange(8), 8)[None, :]
+    ids = paddle.to_tensor(pattern.astype("int64"))
+    for _ in range(80):
+        loss = step(ids, ids)
+    assert float(loss) < 0.1
+    return model, pattern[0]
+
+
+@pytest.fixture(scope="module")
+def paged_engine(tiny_lm):
+    """ONE shared paged engine (compiles are the expensive part); tests
+    assert on counter DELTAS so they stay order-independent."""
+    model, pattern = tiny_lm
+    eng = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                        page_len=8,
+                                        prefill_buckets=(8, 16, 24)))
+    eng.start()
+    yield eng, model, pattern
+    eng.close()
+
+
+def _counters(eng):
+    snap = eng.metrics.snapshot()["counters"]
+    return lambda name: snap.get(name, 0)
+
+
+def test_prefix_hit_parity_with_cold_prefill(paged_engine):
+    """A request sharing a cached prefix must produce the SAME tokens as
+    the cold path — its prefix K/V pages ARE the cold request's pages, so
+    the logits feeding every argmax are bit-identical by construction."""
+    eng, model, pattern = paged_engine
+    before = _counters(eng)
+    prompt = pattern[:19].astype("int64")          # two full 8-blocks
+    ref = np.asarray(model.generate(paddle.to_tensor(prompt[None]),
+                                    max_new_tokens=6,
+                                    use_cache=True).numpy())[0]
+    cold = eng.submit(prompt, max_new_tokens=6).result(timeout=300)
+    warm = eng.submit(prompt, max_new_tokens=6).result(timeout=300)
+    assert cold.tolist() == ref.tolist()
+    assert warm.tolist() == ref.tolist()
+    after = _counters(eng)
+    assert after("prefix_hits") - before("prefix_hits") >= 1
+    assert after("prefix_hit_tokens") - before("prefix_hit_tokens") >= 16
+    assert eng.prefix_match_tokens(prompt) == 16
+    pool = eng.stats()["kv_pages"]
+    assert pool["prefix"]["nodes"] >= 2
+    assert pool["pages_free"] > 0
+
+
+def test_pages_release_on_completion(paged_engine):
+    """Finished requests return their private pages; only trie-adopted
+    prefix pages stay live."""
+    eng, _model, pattern = paged_engine
+    eng.submit(pattern[:9].astype("int64"), max_new_tokens=3).result(
+        timeout=300)
+    t0 = time.monotonic()
+    while eng.stats()["active_slots"] and time.monotonic() - t0 < 30:
+        time.sleep(0.01)
+    a = eng._pool.allocator
+    trie_pages = len(eng._pool.trie)
+    assert a.live_pages == trie_pages, (a.live_pages, trie_pages)
+
+
+def test_deadline_edf_join_order_and_shedding(paged_engine):
+    """Queued requests join freed slots earliest-deadline-first, and a
+    request whose deadline expires while queued is shed before prefill."""
+    from paddle_tpu.observability.trace import tracer
+
+    eng, _model, pattern = paged_engine
+    # occupy BOTH slots with long decodes so submissions below queue up
+    # (must be in-slot, not queued: EDF would sort the doomed request
+    # ahead of queued work and admit it before its deadline passes)
+    busy = [eng.submit(pattern[:12].astype("int64"), max_new_tokens=20)
+            for _ in range(2)]
+    t0 = time.monotonic()
+    while len(eng._active()) < 2 and time.monotonic() - t0 < 60:
+        time.sleep(0.0005)
+    assert len(eng._active()) == 2
+    # distinct prompt lengths tag each request's trace
+    no_dl = eng.submit(pattern[:10].astype("int64"), max_new_tokens=2)
+    late = eng.submit(pattern[:11].astype("int64"), max_new_tokens=2,
+                      deadline_ms=60_000)
+    soon = eng.submit(pattern[:13].astype("int64"), max_new_tokens=2,
+                      deadline_ms=30_000)
+    doomed = eng.submit(pattern[:14].astype("int64"), max_new_tokens=2,
+                        deadline_ms=0.5)
+    with pytest.raises(serving.DeadlineExceeded):
+        doomed.result(timeout=60)
+    for f in busy + [no_dl, late, soon]:
+        f.result(timeout=300)
+    assert eng.metrics.counter("shed_total") >= 1
+    # EDF: prefill order soon < late < no-deadline (from the trace spans)
+    t_pf = {}
+    for t in tracer().traces(engine=eng.name):
+        pl = t["meta"].get("prompt_len")
+        pf = next((s for s in t["spans"] if s["name"] == "prefill"), None)
+        if pf is not None and t["ok"] and pl in (10, 11, 13):
+            t_pf[pl] = pf["t0"]
+    assert t_pf[13] < t_pf[11] < t_pf[10]
+
+
+def test_paged_admission_pool_capacity_bounds(tiny_lm):
+    """Under paged KV the admission bound is POOL capacity: a request that
+    can never hold enough pages is a clean BadRequest; one that merely
+    oversubscribes the pool queues and completes. The position table stays
+    its own (max_seq_len) bound."""
+    model, pattern = tiny_lm
+    eng = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                        page_len=8, num_pages=4,
+                                        prefill_buckets=(8, 16)),
+        name="tinypool")
+    with eng:
+        p = pattern[:9].astype("int64")
+        with pytest.raises(serving.BadRequest, match="max_seq_len"):
+            eng.submit(p, max_new_tokens=32).result(timeout=60)
+        # needs ceil(25/8)=4 pages > the pool's 3 usable: impossible at
+        # ANY load -> clean BadRequest
+        with pytest.raises(serving.BadRequest, match="KV pages"):
+            eng.submit(p, max_new_tokens=16).result(timeout=60)
+        # two 2-page requests oversubscribe the 3-page pool: the second
+        # WAITS for pages instead of failing
+        a = eng.submit(p, max_new_tokens=7)
+        b = eng.submit(p, max_new_tokens=7)
+        for f in (a, b):
+            out = f.result(timeout=300)
+            assert out[9:].tolist() == [(9 + i) % 8
+                                        for i in range(len(out) - 9)]
+        alloc = eng._pool.allocator
+        assert alloc.live_pages == len(eng._pool.trie)  # only trie-held
+        alloc.check()
+
+
+# -- speculative decoding -----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def spec_engine(tiny_lm):
+    """Target + 1-layer draft, both pattern-trained; spec_tokens=3."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    model, pattern = tiny_lm
+    dcfg = GPTConfig(vocab_size=32, hidden_size=16, num_hidden_layers=1,
+                     num_attention_heads=2, max_position_embeddings=64,
+                     dtype="float32")
+    paddle.seed(1)
+    draft = GPTForCausalLM(dcfg)
+    optimizer = opt.AdamW(learning_rate=3e-3, parameters=draft.parameters())
+    step = jit.TrainStep(draft, lambda m, x, y: m(x, labels=y), optimizer)
+    ids = paddle.to_tensor(np.tile(np.arange(8), 8)[None, :].astype("int64"))
+    for _ in range(80):
+        step(ids, ids)
+    eng = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                        page_len=8,
+                                        prefill_buckets=(8, 16, 24),
+                                        draft_model=draft, spec_tokens=3),
+        name="specgen")
+    eng.start()
+    yield eng, model, pattern
+    eng.close()
+
+
+@pytest.mark.slow  # extra verify-window compile; ci.sh serving gate runs it
+def test_speculative_greedy_parity_vs_generate(spec_engine):
+    """Speculative greedy decode must be token-for-token equal to the
+    model's own KV-cached greedy path — for EVERY request, whatever the
+    draft proposed (acceptance only changes speed)."""
+    eng, model, pattern = spec_engine
+    before = _counters(eng)
+    jobs = [(9, 8), (13, 6), (11, 10), (17, 8)]
+    futs = [(p, m, eng.submit(pattern[:p].astype("int64"), max_new_tokens=m))
+            for p, m in jobs]
+    for p, m, f in futs:
+        ref = np.asarray(model.generate(
+            paddle.to_tensor(pattern[:p].astype("int64")[None]),
+            max_new_tokens=m, use_cache=True).numpy())[0]
+        got = f.result(timeout=300)
+        assert got.tolist() == ref.tolist(), (p, m)
+    after = _counters(eng)
+    assert after("spec_rounds") > before("spec_rounds")
+    assert after("spec_accepted") > before("spec_accepted")
+    snap = eng.stats()
+    assert snap["spec_acceptance"] > 0.3          # pattern-trained draft
+    assert snap["effective_tokens_per_step"] > 1.2
+    # speculation emitted MORE tokens than verify rounds: the whole point
+    rounds = after("decode_steps") - before("decode_steps")
+    tokens = after("tokens_total") - before("tokens_total")
+    assert tokens > rounds
+
+
+# -- zero retrace steady state ------------------------------------------------
+
+@pytest.mark.slow  # shares the spec engine; ci.sh serving gate runs it
+def test_paged_decode_zero_retrace_steady_state(tiny_lm):
+    """PT_RETRACE_AUDIT machinery: after first-use compiles (the per-label
+    baselines), mixed paged traffic — cold prefills, prefix hits, decode —
+    must record ZERO serving-labeled retrace events."""
+    model, pattern = tiny_lm
+    os.environ["PT_RETRACE_AUDIT"] = "1"
+    import paddle_tpu.analysis as A
+
+    A.retrace.enable()
+    try:
+        eng = serving.GenerationEngine(
+            model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                            page_len=8,
+                                            prefill_buckets=(8, 16, 24)),
+            name="auditgen")
+        with eng:
+            futs = [eng.submit(pattern[o:o + 9 + (i % 3)].astype("int64"),
+                               max_new_tokens=3 + (i % 4))
+                    for i, o in enumerate([0, 0, 8, 0, 8, 1, 0, 2])]
+            fwait(futs, timeout=300)
+            stats = eng.stats()
+        assert stats["retrace_events"] == 0, stats
+    finally:
+        A.retrace.disable()
+        A.retrace.reset()
+        os.environ.pop("PT_RETRACE_AUDIT", None)
+
+
+# -- router -------------------------------------------------------------------
+
+class _FakeReplica:
+    """GenerationEngine-shaped stub: deterministic router-policy tests
+    without device compiles."""
+
+    def __init__(self, name, depth=0, headroom=1.0, match=0, closed=False,
+                 full=False):
+        from paddle_tpu.serving.metrics import MetricsRegistry
+
+        self.name = name
+        self.metrics = MetricsRegistry()
+        self.depth, self.headroom, self.match = depth, headroom, match
+        self.closed, self.full = closed, full
+        self.submitted = []
+
+    def start(self):
+        return self
+
+    def close(self, drain=True):
+        self.closed = True
+
+    def queue_depth(self):
+        return self.depth
+
+    def stats(self):
+        return self.metrics.snapshot()
+
+    def kv_headroom(self):
+        return self.headroom
+
+    def prefix_match_tokens(self, prompt):
+        return self.match
+
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None):
+        if self.closed:
+            raise serving.EngineClosed("down")
+        if self.full:
+            raise serving.QueueFull("full")
+        fut = Future()
+        self.submitted.append(np.asarray(prompt))
+        return fut
+
+
+def test_router_tenant_quota_and_fleet_backpressure():
+    r1 = _FakeReplica("a")
+    router = serving.ReplicaRouter(
+        [r1], serving.RouterConfig(max_inflight=3, default_quota=2,
+                                   tenant_quotas={"vip": 3}))
+    p = np.arange(4)
+    f1 = router.submit(p, tenant="free")
+    router.submit(p, tenant="free")
+    with pytest.raises(serving.TenantQuotaExceeded):
+        router.submit(p, tenant="free")
+    router.submit(p, tenant="vip")                 # own quota
+    with pytest.raises(serving.QueueFull):         # fleet-wide bound
+        router.submit(p, tenant="vip")
+    f1.set_result(np.arange(5))                    # completion frees quota
+    router.submit(p, tenant="free")
+    st = router.stats()
+    assert st["rejected"] == {"quota": 1, "capacity": 1}
+    assert st["inflight"]["free"] == 2
+
+
+def test_router_load_aware_and_prefix_affinity_dispatch():
+    idle = _FakeReplica("idle", depth=0, headroom=1.0)
+    busy = _FakeReplica("busy", depth=50, headroom=0.1)
+    router = serving.ReplicaRouter([busy, idle])
+    router.submit(np.arange(8))
+    assert len(idle.submitted) == 1 and not busy.submitted
+    # affinity overrides moderate load: the replica holding the prefix wins
+    holder = _FakeReplica("holder", depth=2, match=8)
+    cold = _FakeReplica("cold", depth=0)
+    router2 = serving.ReplicaRouter([cold, holder])
+    router2.submit(np.arange(8))
+    assert len(holder.submitted) == 1 and not cold.submitted
+    assert router2.stats()["affinity_hits"] == 1
+
+
+def test_router_fault_marks_down_and_reroutes():
+    dead = _FakeReplica("dead", closed=True)
+    live = _FakeReplica("live")
+    router = serving.ReplicaRouter([dead, live])
+    router.submit(np.arange(4))
+    assert len(live.submitted) == 1
+    assert router.stats()["down"] == ["dead"]
+    full = _FakeReplica("full2", full=True)
+    router2 = serving.ReplicaRouter([full])
+    with pytest.raises(serving.QueueFull):
+        router2.submit(np.arange(4))
+    router2._replicas[0].full = False
+    router2.submit(np.arange(4))                   # recovers
+
+
+@pytest.mark.slow  # two real replicas; ci.sh serving gate runs it
+def test_router_end_to_end_fleet_with_replica_fault(tiny_lm):
+    """Two real replicas behind the router: shared-prefix traffic routes
+    with affinity, a replica fault mid-run fences it, and the surviving
+    replica drains the rest — every surviving future resolves correctly."""
+    model, pattern = tiny_lm
+
+    def mk(name):
+        return serving.GenerationEngine(
+            model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                            page_len=8,
+                                            prefill_buckets=(8, 16, 24)),
+            name=name)
+
+    ra, rb = mk("fleet_a"), mk("fleet_b")
+    router = serving.ReplicaRouter([ra, rb], name="fleet")
+    prompt = pattern[:17].astype("int64")
+    with router:
+        # cold landing first: its replica becomes the prefix holder
+        router.submit(prompt, max_new_tokens=4).result(timeout=300)
+        futs = [router.submit(prompt, max_new_tokens=4) for _ in range(5)]
+        outs = [f.result(timeout=300) for f in futs]
+        for out in outs:
+            assert out[17:].tolist() == [(17 + i) % 8
+                                         for i in range(len(out) - 17)]
+        st = router.stats()
+        # same-prefix traffic concentrated on the replica holding the pages
+        assert sum(r["routed"] for r in st["replicas"].values()) == 6
+        assert st["affinity_hits"] >= 4
+        assert max(r["routed"] for r in st["replicas"].values()) >= 5
+        # replica fault: close A; traffic keeps draining through B
+        ra.close(drain=False)
+        futs2 = [router.submit(prompt, max_new_tokens=3) for _ in range(4)]
+        for f in futs2:
+            out = f.result(timeout=300)
+            assert out[17:].tolist() == [(17 + i) % 8
+                                         for i in range(len(out) - 17)]
+        st = router.stats()
+        assert "fleet_a" in st["down"]
+        assert router.queue_depth() == 0           # drained, not stuck
+        assert st["replicas"]["fleet_b"]["responses"] >= 4

@@ -20,6 +20,15 @@ echo "== serving gate (engine tests + demo) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 JAX_PLATFORMS=cpu python examples/serve_gpt.py --clients 4 || exit 1
+# ISSUE-12 serving tier: the full paged-KV/speculative/router test file
+# (slow legs included: spec greedy parity vs model.generate, zero-retrace
+# audit, 2-replica fleet with injected fault), then the router drill —
+# 2 replicas, shared-system-prompt traffic -> prefix hits, zero fresh XLA
+# compiles on the warm replica (persistent-cache counter), queue drains
+# after the injected replica fault, zero serving retrace events
+JAX_PLATFORMS=cpu python -m pytest tests/test_paged_serving.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/router_drill.py || exit 1
 
 echo "== perf gate (warm path: bench headline + persistent-cache warm start) =="
 # the full warm-path file, slow-marked legs included (tier-1 excludes
@@ -72,6 +81,19 @@ print("perf gate OK:", {k: last["detail"][k]
                         for k in ("warm_path", "persistent_cache",
                                   "stream_capacity", "checkpoint_stall",
                                   "autoplan")})
+# ISSUE-12 acceptance: the paged serving recipe (full rows live in
+# bench_progress.json — the size-capped headline may slim them)
+prog = json.loads(open("bench_artifacts/bench_progress.json").read())
+pg = prog["serving"]["paged_gen"]
+assert pg["prefix_hit_rate"] > 0.5, pg          # shared-prefix traffic hits
+assert pg["speedup_vs_cold"] >= 1.5, pg         # >=1.5x vs no-reuse baseline
+assert pg["spec_acceptance"] > 0.3, pg          # the draft earns its keep
+assert pg["effective_tokens_per_step"] > 1.2, pg
+assert pg["fleet"]["replicas"] == 2, pg
+print("paged serving gate OK:", {k: pg[k] for k in
+                                 ("prefix_hit_rate", "speedup_vs_cold",
+                                  "spec_acceptance",
+                                  "effective_tokens_per_step")})
 PY
 
 echo "== observability gate (telemetry snapshot from the bench smoke) =="
