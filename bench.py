@@ -205,7 +205,7 @@ def _moe_dispatch_share(cfg, batch, seq):
             return out.astype(c.dtype), ()
         return jax.lax.scan(body, xx, None, length=L)[0]
 
-    if mode == "gmm":
+    if mode in ("gmm", "fused"):
         # dropless baseline: the same grouped matmuls on k*n pre-grouped
         # rows (the capacity-buffer einsum would execute cf x more rows
         # with a different kernel — not the no-routing twin of this path)
@@ -258,6 +258,220 @@ def _moe_dispatch_flag():
     from paddle_tpu.framework import flags as flags_mod
 
     return flags_mod.get_flags("FLAGS_moe_dispatch")["FLAGS_moe_dispatch"]
+
+
+def _ab_probe(fn, args, iters=3):
+    """(wall_us, device_us) for one jitted callable: wall is best-of-N
+    with fresh inputs (defeats request caching), device is the XPlane-
+    measured op time of one traced call (the PR-7 parser — CPU hlo
+    events and TPU device pids alike; None when the capture fails)."""
+    import jax
+    import jax.numpy as jnp
+
+    jax.block_until_ready(fn(*args))  # compile + warm
+    best = 1e18
+    for j in range(iters):
+        fresh = jax.tree_util.tree_map(
+            lambda a: jnp.add(a, (j + 1) * 1e-3)
+            if hasattr(a, "dtype") and jnp.issubdtype(a.dtype,
+                                                      jnp.floating) else a,
+            list(args))
+        jax.block_until_ready(fresh)
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*fresh))
+        best = min(best, time.perf_counter() - t0)
+    dev_us = None
+    try:
+        from paddle_tpu.observability import trace as otrace
+
+        with otrace.capture_steps() as cap:
+            jax.block_until_ready(fn(*args))
+        if cap.error is None and cap.result is not None:
+            dev_us = round(sum(r["total_us"]
+                               for r in cap.result.op_table), 1)
+    except Exception:
+        pass
+    return round(best * 1e6, 1), dev_us
+
+
+def _measure_fused_kernels():
+    """Per-op fused-vs-composed A/B for the kernels/pallas layer
+    (ISSUE-13): each op measured both ways — wall time AND XPlane-
+    attributed device time (the PR-7 op-table parser) — plus the fused
+    MoE dispatch_share probe and a tolerance-pinned parity row against
+    the index-dispatch path. On CPU the fused side runs the composed
+    twin of the fused algorithm (the registry's CPU contract), so the
+    CPU rows pin the SEAM's cost; the kernel-vs-twin delta is the TPU
+    half of the A/B."""
+    import math as _math
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework import flags as flags_mod
+    from paddle_tpu.kernels.pallas import rmsnorm as _krms
+    from paddle_tpu.kernels.pallas import rope as _krope
+    from paddle_tpu.kernels.registry import kernel_table
+    from paddle_tpu.nn.layer import moe as moe_mod
+
+    paddle.seed(0)
+    on_tpu = jax.default_backend() == "tpu"
+    dt = jnp.bfloat16 if on_tpu else jnp.float32
+    out = {"backend": jax.default_backend(),
+           "flag": kernel_table()["flag"]}
+    key = jax.random.key(0)
+    ks = jax.random.split(key, 8)
+
+    # -- rms_norm(+residual): legacy separate-op chain vs fused ---------------
+    b, s, h = (8, 2048, 2048) if on_tpu else (4, 256, 512)
+    x = jax.random.normal(ks[0], (b, s, h), dt)
+    r = jax.random.normal(ks[1], (b, s, h), dt)
+    w = jnp.ones((h,), dt)
+    eps = 1e-6
+
+    def _legacy_rms(xx, rr, ww):
+        ss = xx + rr
+        var = jnp.mean(jnp.square(ss.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        y = (ss.astype(jnp.float32) * jax.lax.rsqrt(var + eps) *
+             ww.astype(jnp.float32)).astype(ss.dtype)
+        return y, ss
+
+    def _loss(f):
+        def g(xx, rr, ww):
+            y, ss = f(xx, rr, ww)
+            return (jnp.sum(y.astype(jnp.float32)) +
+                    jnp.sum(ss.astype(jnp.float32)))
+        return jax.jit(jax.grad(g, argnums=(0, 2)))
+
+    legacy_us, legacy_dev = _ab_probe(_loss(_legacy_rms), (x, r, w))
+    fused_us, fused_dev = _ab_probe(
+        _loss(lambda xx, rr, ww: _krms.rms_norm_residual(xx, rr, ww, eps)),
+        (x, r, w))
+    out["rms_norm"] = {
+        "composed_us": legacy_us, "fused_us": fused_us,
+        "composed_device_us": legacy_dev, "fused_device_us": fused_dev,
+        "speedup": round(legacy_us / max(fused_us, 1e-9), 3)}
+
+    # -- rope -----------------------------------------------------------------
+    nh, hd = (16, 128) if on_tpu else (8, 64)
+    xr = jax.random.normal(ks[2], (b, s // 2, nh, hd), dt)
+    from paddle_tpu.models.llama import _rope as _rope_prim
+
+    lr_us, lr_dev = _ab_probe(
+        jax.jit(jax.grad(lambda z: jnp.sum(_rope_prim.fn(
+            z, theta=1e4, pos_offset=0, fused=False)
+            .astype(jnp.float32) ** 2))), (xr,))
+    fr_us, fr_dev = _ab_probe(
+        jax.jit(jax.grad(lambda z: jnp.sum(_krope.rope_apply(z, 1e4, 0)
+                                           .astype(jnp.float32) ** 2))),
+        (xr,))
+    out["rope"] = {
+        "composed_us": lr_us, "fused_us": fr_us,
+        "composed_device_us": lr_dev, "fused_device_us": fr_dev,
+        "speedup": round(lr_us / max(fr_us, 1e-9), 3)}
+
+    # -- MoE dispatch: share probe (fused + index) + parity -------------------
+    from paddle_tpu.models.llama import LlamaMoEConfig
+
+    if on_tpu:
+        mcfg = _configs()["moe"]
+        mb, ms = 8, 2048
+    else:
+        mcfg = LlamaMoEConfig(
+            vocab_size=256, hidden_size=256, intermediate_size=512,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=1024,
+            dtype="float32", num_experts=8, top_k=2, capacity_factor=1.25)
+        mb, ms = 2, 512
+    prior = _moe_dispatch_flag()
+    try:
+        flags_mod.set_flags({"FLAGS_moe_dispatch": "fused"})
+        out["moe_fused"] = _moe_dispatch_share(mcfg, batch=mb, seq=ms)
+        flags_mod.set_flags({"FLAGS_moe_dispatch": "index"})
+        out["moe_index"] = _moe_dispatch_share(mcfg, batch=mb, seq=ms)
+    finally:
+        flags_mod.set_flags({"FLAGS_moe_dispatch": prior})
+    out["dispatch_share_fused"] = out["moe_fused"]["dispatch_share"]
+    out["dispatch_share_index"] = out["moe_index"]["dispatch_share"]
+
+    # parity vs the index path: generous capacity (cap >= k*n/e * cf with
+    # cf = e guarantees zero drops), identical weights/inputs
+    e, k = mcfg.num_experts, mcfg.top_k
+    hm, im = mcfg.hidden_size, (mcfg.moe_intermediate_size
+                                or mcfg.intermediate_size)
+    pk = jax.random.split(ks[3], 5)
+    px = jax.random.normal(pk[0], (2, 64, hm), jnp.float32)
+    pwg = jax.random.normal(pk[1], (hm, e), jnp.float32) * 0.1
+    pgate = jax.random.normal(pk[2], (e, hm, im), jnp.float32) * 0.05
+    pup = jax.random.normal(pk[3], (e, hm, im), jnp.float32) * 0.05
+    pdown = jax.random.normal(pk[4], (e, im, hm), jnp.float32) * 0.05
+    of, auxf = moe_mod._moe_mlp.fn(px, pwg, pgate, pup, pdown, top_k=k,
+                                   capacity_factor=1.0, ep_degree=1,
+                                   dispatch="fused")
+    oi, auxi = moe_mod._moe_mlp.fn(px, pwg, pgate, pup, pdown, top_k=k,
+                                   capacity_factor=float(e), ep_degree=1,
+                                   dispatch="index")
+    out["dispatch_parity_max_err"] = float(
+        jnp.max(jnp.abs(of.astype(jnp.float32) - oi.astype(jnp.float32))))
+    out["dispatch_parity_aux_err"] = float(jnp.abs(auxf - auxi))
+
+    # -- paged decode: window step fused seam vs composed gather path ---------
+    try:
+        from paddle_tpu.models.gpt import GPTConfig
+        from paddle_tpu.models import GPTForCausalLM
+        from paddle_tpu.serving.generation import (_build_window_step,
+                                                   _extract_gpt_params)
+
+        gcfg = GPTConfig(vocab_size=256, hidden_size=128,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         max_position_embeddings=256)
+        gm = GPTForCausalLM(gcfg)
+        params = _extract_gpt_params(gm)
+        S, PL, B = 4, 16, 16
+        P = S * B + 1
+        ghd = gcfg.hidden_size // gcfg.num_attention_heads
+        karena = [jax.random.normal(ks[4], (P, PL, 4, ghd), jnp.float32)
+                  for _ in range(2)]
+        varena = [jax.random.normal(ks[5], (P, PL, 4, ghd), jnp.float32)
+                  for _ in range(2)]
+        tables = jnp.arange(S * B, dtype=jnp.int32).reshape(S, B) + 1
+        tokens = jnp.ones((S, 1), jnp.int32)
+        lengths = jnp.full((S,), 200, jnp.int32)
+        rows = {}
+        for name, fused in (("composed", False), ("fused", True)):
+            stp = _build_window_step(gcfg, S, B, PL, 1, donate=False,
+                                     label=f"bench:paged:{name}",
+                                     fused=fused)
+            wall, dev = _ab_probe(
+                lambda *a: stp(*a)[0],
+                (params, karena, varena, tables, tokens, lengths))
+            rows[name] = {"wall_us": wall, "device_us": dev}
+        out["paged_decode"] = dict(
+            rows, ratio=round(rows["fused"]["wall_us"] /
+                              max(rows["composed"]["wall_us"], 1e-9), 3))
+    except Exception as e:  # the probe must never sink the bench
+        out["paged_decode_error"] = str(e)[:200]
+
+    # feed the measured shares back into the persisted planner
+    # calibration (topology x jax version) so plan() prices the fused
+    # entries from THIS machine's numbers on the next round
+    try:
+        from paddle_tpu.cost_model import comm as _comm
+
+        _comm.save_calibration(
+            _comm.link_model_for(),
+            fused={"moe_dispatch": {
+                "dispatch_share_composed": max(
+                    out["dispatch_share_index"], 0.01),
+                "dispatch_share_fused": max(
+                    out["dispatch_share_fused"], 0.01)}})
+        out["calibration_persisted"] = True
+    except Exception:
+        out["calibration_persisted"] = False
+    return out
 
 
 def _measure_moe(cfg, batch, seq, iters):
@@ -1387,6 +1601,11 @@ def _run_one(name: str):
         _note_recipe(name, out)
         print("BENCH_RESULT " + json.dumps(out))
         return
+    if name == "fused_kernels":
+        out = _measure_fused_kernels()
+        _note_recipe(name, out)
+        print("BENCH_RESULT " + json.dumps(out))
+        return
     if name == "autoplan":
         # the ranking-fidelity leg runs on the 8-device CPU host mesh (the
         # MULTICHIP dryrun topology) regardless of the parent's platform —
@@ -1431,6 +1650,19 @@ def _run_one(name: str):
                                                         seq=2048)
         except Exception as e:  # the probe must never sink the bench
             out["dispatch_probe_error"] = str(e)[:200]
+        try:
+            # the ISSUE-13 A/B: the same probe through the fused Pallas
+            # routing/dispatch kernel (dropless, grouped-matmul FFN)
+            from paddle_tpu.framework import flags as flags_mod
+
+            flags_mod.set_flags({"FLAGS_moe_dispatch": "fused"})
+            out["dispatch_probe_fused"] = _moe_dispatch_share(
+                cfg, batch=8, seq=2048)
+            out["dispatch_share_fused"] = \
+                out["dispatch_probe_fused"]["dispatch_share"]
+            flags_mod.set_flags({"FLAGS_moe_dispatch": "index"})
+        except Exception as e:
+            out["dispatch_probe_fused_error"] = str(e)[:200]
     elif name == "moe_cf1":
         # tight-capacity variant (dropless-style recipes set cf=1.0): no
         # 25% expert overcompute, so activated == executed MFU. Own process
@@ -1529,7 +1761,8 @@ def _spawn(name: str, timeout=1200, env=None):
 # keys too large for the driver-parsed line (r4's parse failure was an
 # oversized single line); they live in the artifact file instead
 _HEAVY_KEYS = ("device_op_table", "op_table", "losses_tpu", "losses_cpu",
-               "dispatch_probe", "cold", "warm", "measured", "top8")
+               "dispatch_probe", "dispatch_probe_fused", "cold", "warm",
+               "measured", "top8", "moe_fused", "moe_index", "paged_decode")
 
 # -- wall-clock contract ------------------------------------------------------
 # the r05 blackout was rc=124 with NOTHING on stdout: one leg overran the
@@ -1776,6 +2009,7 @@ def main():
                     LlamaConfig.tiny(), batch=2, seq=64)),
                 ("serving", lambda: _measure_serving(clients_sweep=(2, 8),
                                                      per_client=30)),
+                ("fused_kernels", _measure_fused_kernels),
                 ("persistent_cache", _warm_start_probe)):
             rem = _remaining_s()
             if rem is not None and rem < 90:  # same skip-and-note contract
@@ -1841,6 +2075,9 @@ def main():
     leg("autoplan",
         lambda: detail.__setitem__("autoplan", _spawn("autoplan",
                                                       timeout=600)))
+    leg("fused_kernels",
+        lambda: detail.__setitem__("fused_kernels",
+                                   _spawn("fused_kernels", timeout=900)))
     leg("stream_capacity",
         lambda: detail.__setitem__("stream_capacity",
                                    _spawn("stream_capacity")))

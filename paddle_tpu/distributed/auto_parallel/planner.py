@@ -512,14 +512,35 @@ def _opt_words(optimizer) -> float:
     return _OPT_STATE_WORDS.get(name.lower(), 2.0)
 
 
+def _resolve_fused_ops(fused_kernels) -> Tuple[str, ...]:
+    """Normalize the ``fused_kernels`` knob: None = whatever the live
+    kernel registry would engage (``FLAGS_fused_kernels`` + backend),
+    True = every registered op, False/() = none, or an explicit op
+    iterable."""
+    from ...cost_model.fused import FUSED_OP_ENTRIES, enabled_fused_ops
+
+    if fused_kernels is None:
+        return enabled_fused_ops()
+    if fused_kernels is True:
+        return tuple(sorted(FUSED_OP_ENTRIES))
+    if not fused_kernels:
+        return ()
+    return tuple(sorted(fused_kernels))
+
+
 def score_config(profile: ModelProfile, config: Dict[str, Any], *,
                  link: Optional[LinkModel] = None,
                  hbm_bytes: Optional[float] = None,
                  optimizer: Any = "adamw",
                  drift_ratio: Optional[float] = None,
-                 headroom: float = 0.9) -> PlanCandidate:
+                 headroom: float = 0.9,
+                 fused_kernels=None) -> PlanCandidate:
     """Score ONE config (loose dicts accepted — every MULTICHIP_r05
-    matrix entry round-trips through here)."""
+    matrix entry round-trips through here). ``fused_kernels`` prices the
+    kernels/pallas layer into the step-time model: None follows the live
+    registry gate, True/False force it, an iterable names the op set —
+    the per-op deltas land in the breakdown (``fused_gain_s`` /
+    ``fused_ops``) so a fusion that changes a ranking is visible."""
     cfg = normalize_config(dict(config), batch=profile.batch) \
         if "mesh" not in config else config
     link = link or link_model_for()
@@ -531,6 +552,20 @@ def score_config(profile: ModelProfile, config: Dict[str, Any], *,
     peak, mem_break = _predict_peak_bytes(profile, cfg, _opt_words(optimizer),
                                           ratio)
     step_s, time_break = _predict_step_s(profile, cfg, link)
+    ops = _resolve_fused_ops(fused_kernels)
+    if ops:
+        from ...cost_model.fused import fused_gain_s
+
+        gain, per_op = fused_gain_s(profile, cfg, link, ops=ops,
+                                    compute_s=time_break["compute_s"])
+        # the fusions cannot reclaim more than the terms they act on —
+        # cap at half the modeled compute so a mis-calibrated entry can
+        # never drive a candidate's cost to zero
+        gain = min(gain, 0.5 * time_break["compute_s"])
+        if gain > 0:
+            step_s = max(step_s - gain, 1e-9)
+            time_break = dict(time_break, fused_gain_s=gain,
+                              fused_ops=per_op)
     feasible = peak <= headroom * float(hbm_bytes)
     return PlanCandidate(
         config=cfg, predicted_step_s=step_s, predicted_peak_bytes=peak,
@@ -545,7 +580,7 @@ def plan(model, n_devices: Optional[int] = None,
          loss_fn: Optional[Callable] = None, optimizer: Any = "adamw",
          topology: Optional[str] = None, link: Optional[LinkModel] = None,
          include_infeasible: bool = False, top_k: Optional[int] = None,
-         **enum_kw) -> List[PlanCandidate]:
+         fused_kernels=None, **enum_kw) -> List[PlanCandidate]:
     """Rank every feasible parallel config for ``model`` on ``n_devices``
     chips with ``hbm_bytes`` per-device memory.
 
@@ -573,8 +608,10 @@ def plan(model, n_devices: Optional[int] = None,
         raise ValueError(
             f"plan: no candidate config covers {n_devices} devices at "
             f"batch={profile.batch} (check head/seq/batch divisibility)")
+    fused_ops = _resolve_fused_ops(fused_kernels)
     cands = [score_config(profile, c, link=link, hbm_bytes=hbm_bytes,
-                          optimizer=opt_words, drift_ratio=ratio)
+                          optimizer=opt_words, drift_ratio=ratio,
+                          fused_kernels=fused_ops)
              for c in configs]
     feasible = sorted([c for c in cands if c.feasible],
                       key=lambda c: (c.predicted_step_s,

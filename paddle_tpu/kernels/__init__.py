@@ -1,1 +1,10 @@
-"""Pallas TPU kernels: the fused-op library (operators/fused/ role)."""
+"""Pallas TPU kernels: the fused-op library (operators/fused/ role).
+
+- ``flash_attention``: Pallas flash attention fwd/bwd (online softmax).
+- ``grouped_matmul``: megablox-style ragged per-expert matmul.
+- ``pallas``: the fused-op layer (RMSNorm/RoPE fusions, fused MoE
+  dispatch, paged attention) — each op a Pallas kernel + composed-XLA
+  twin pair behind the ``registry`` dispatch seam
+  (``FLAGS_fused_kernels``; see docs/performance.md "Fused kernels").
+"""
+from . import registry  # noqa: F401

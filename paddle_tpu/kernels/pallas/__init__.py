@@ -1,0 +1,25 @@
+"""Pallas fused-op library (the operators/fused/ role, TPU-native).
+
+Each module ships one fused op as a matched pair — the Pallas TPU kernel
+and its composed-XLA twin (identical math + custom-VJP structure) — and
+registers both through ``kernels.registry``:
+
+- ``rmsnorm``: RMSNorm and RMSNorm+residual, fwd + VJP in single kernels
+  (the FlashAttention lesson applied to norms: the f32 normalize never
+  round-trips the activation through HBM twice);
+- ``rope``: rotate-half rotary embedding, fwd + VJP (the VJP is the
+  inverse rotation — no residuals beyond the input positions);
+- ``moe_dispatch``: dropless MoE routing/dispatch — top-k select +
+  position-in-expert (the "sort by expert") in ONE sequential-grid
+  kernel, row movement through scalar-prefetch gather/combine kernels
+  with gather-only VJPs, feeding ``kernels.grouped_matmul``;
+- ``paged_attention``: decode/window attention straight against the
+  ``serving.paged_kv`` page table (per-page online softmax) instead of
+  gather-then-attend.
+
+Import order matters only in that importing this package populates the
+registry; call sites go through ``kernels.registry.resolve``.
+"""
+from . import moe_dispatch, paged_attention, rmsnorm, rope  # noqa: F401
+
+__all__ = ["rmsnorm", "rope", "moe_dispatch", "paged_attention"]

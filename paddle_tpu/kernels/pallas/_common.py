@@ -1,0 +1,24 @@
+"""Shared helpers for the fused-op kernel modules."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["interpret_default", "pick_rows"]
+
+
+def interpret_default() -> bool:
+    """Run the Pallas kernel through the interpreter? (CPU backend —
+    tests and virtual meshes; real TPUs compile.)"""
+    try:
+        return jax.default_backend() == "cpu"
+    except Exception:  # pragma: no cover
+        return True
+
+
+def pick_rows(n: int, pref: int = 256) -> int:
+    """Largest row-block <= pref dividing n (kernels that reduce over
+    the full row width block whole rows only)."""
+    b = min(pref, n)
+    while n % b:
+        b -= 1
+    return max(b, 1)

@@ -1,0 +1,197 @@
+"""Paged attention — decode/window attention against a page table.
+
+PR 11's window step gathers every slot's K/V pages into a dense
+[S, L, h, d] context (``kc[tables]``) and then attends — the gather
+round-trips the whole addressable context through HBM even though the
+attention itself touches each page once. This kernel closes that follow-
+up: the grid walks (slot, page), the page table rides SMEM via scalar
+prefetch, and each step DMAs ONE page of K/V and folds it into a
+per-slot online softmax (flash-style f32 accumulators in VMEM scratch) —
+the dense gathered context never exists.
+
+Layouts (matching ``serving.paged_kv`` + ``_build_window_step``):
+
+- ``q``:        [S, W, nh, hd] — W window tokens per slot
+- ``k/v``:      [P, PL, kvh, hd] — the page-pool arenas (kvh <= nh, GQA)
+- ``tables``:   [S, B] int32 page ids (0 = scratch page)
+- ``pos``:      [S, W] int32 global positions; key position j is visible
+                to window token (s, w) iff j <= pos[s, w]
+
+Serving never differentiates through the decode step, but the op still
+carries a VJP (backward = ``jax.vjp`` of the composed twin) so the
+parity suite can pin gradients and nothing breaks if a scoring path
+ever backprops through it. The composed twin IS the PR-11 gather-then-
+attend math — on CPU the registry resolves to it, so the paged-decode
+step is by construction no slower than the gather path there; the TPU
+A/B rides the bench ``fused_kernels`` recipe.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import register_kernel, resolve
+from ._common import interpret_default as _interpret
+
+__all__ = ["paged_attention"]
+
+_NEG = -1e30
+
+
+def _paged_kernel(tbl_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+                  acc_ref, m_ref, l_ref, *, W, nh, kvh, hd, PL, scale):
+    b = pl.program_id(1)
+    rep = nh // kvh
+
+    @pl.when(b == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    qpos = pos_ref[...][0]                                   # [W] int32
+    kpos = b * PL + jax.lax.broadcasted_iota(jnp.int32, (1, PL), 1)[0]
+    # rows are (w, r) pairs flattened per kv-head group
+    qpos_r = jnp.broadcast_to(qpos[:, None], (W, rep)).reshape(W * rep)
+    visible = kpos[None, :] <= qpos_r[:, None]               # [W*rep, PL]
+
+    for g in range(kvh):
+        lo, hi = g * W * rep, (g + 1) * W * rep
+        q = q_ref[0][:, g * rep:(g + 1) * rep, :].reshape(W * rep, hd)
+        k = k_ref[0][:, g, :]                                # [PL, hd]
+        v = v_ref[0][:, g, :]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        s = jnp.where(visible, s, _NEG)
+        m_prev = m_ref[lo:hi, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(s > _NEG * 0.5, jnp.exp(s - m_new), 0.0)
+        l_new = alpha * l_ref[lo:hi, :1] + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[lo:hi, :] = acc_ref[lo:hi, :] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[lo:hi, :] = jnp.broadcast_to(m_new, (hi - lo, m_ref.shape[1]))
+        l_ref[lo:hi, :] = jnp.broadcast_to(l_new, (hi - lo, l_ref.shape[1]))
+
+    @pl.when(b == pl.num_programs(1) - 1)
+    def _():
+        for g in range(kvh):
+            lo, hi = g * W * rep, (g + 1) * W * rep
+            l = jnp.maximum(l_ref[lo:hi, :1], 1e-30)
+            ctx = (acc_ref[lo:hi, :] / l).reshape(W, rep, hd)
+            o_ref[0, :, g * rep:(g + 1) * rep, :] = ctx.astype(o_ref.dtype)
+
+
+def _paged_pallas(q, k_arena, v_arena, tables, pos, scale, interpret):
+    S, W, nh, hd = q.shape
+    P, PL, kvh, _ = k_arena.shape
+    B = tables.shape[1]
+    out = pl.pallas_call(
+        functools.partial(_paged_kernel, W=W, nh=nh, kvh=kvh, hd=hd, PL=PL,
+                          scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(S, B),
+            in_specs=[
+                pl.BlockSpec((1, W), lambda s, b, t: (s, 0)),
+                pl.BlockSpec((1, W, nh, hd), lambda s, b, t: (s, 0, 0, 0)),
+                pl.BlockSpec((1, PL, kvh, hd),
+                             lambda s, b, t: (t[s, b], 0, 0, 0)),
+                pl.BlockSpec((1, PL, kvh, hd),
+                             lambda s, b, t: (t[s, b], 0, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, W, nh, hd),
+                                   lambda s, b, t: (s, 0, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((W * nh, hd), jnp.float32),
+                pltpu.VMEM((W * nh, 128), jnp.float32),
+                pltpu.VMEM((W * nh, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, W, nh, hd), q.dtype),
+        interpret=interpret,
+    )(tables, pos, q, k_arena, v_arena)
+    return out
+
+
+def _paged_composed(q, k_arena, v_arena, tables, pos, scale):
+    """The PR-11 gather-then-attend math, verbatim (the CPU production
+    path and the TPU A/B reference)."""
+    S, W, nh, hd = q.shape
+    _P, PL, kvh, _ = k_arena.shape
+    B = tables.shape[1]
+    L = B * PL
+    kk = k_arena[tables].reshape(S, L, kvh, hd)
+    vv = v_arena[tables].reshape(S, L, kvh, hd)
+    if kvh != nh:
+        rep = nh // kvh
+        kk = jnp.repeat(kk, rep, axis=2)
+        vv = jnp.repeat(vv, rep, axis=2)
+    j = jnp.arange(L)
+    mask = j[None, None, :] <= pos[:, :, None]               # [S, W, L]
+    logits = jnp.einsum("swhd,sLhd->swhL", q, kk)
+    logits = logits.astype(jnp.float32) * scale
+    logits = jnp.where(mask[:, :, None, :], logits, _NEG)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("swhL,sLhd->swhd", probs, vv)
+
+
+def _run(q, k_arena, v_arena, tables, pos, scale, impl):
+    if impl in ("pallas", "interpret"):
+        return _paged_pallas(q, k_arena, v_arena, tables, pos, scale,
+                             interpret=(impl == "interpret") or _interpret())
+    return _paged_composed(q, k_arena, v_arena, tables, pos, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6))
+def _paged(q, k_arena, v_arena, tables, pos, scale, impl):
+    return _run(q, k_arena, v_arena, tables, pos, scale, impl)
+
+
+def _paged_fwd(q, k_arena, v_arena, tables, pos, scale, impl):
+    out = _run(q, k_arena, v_arena, tables, pos, scale, impl)
+    return out, (q, k_arena, v_arena, tables, pos)
+
+
+def _paged_bwd(scale, impl, res, do):
+    # serving never backprops through decode; the VJP exists for the
+    # parity suite and recomputes through the composed twin
+    q, k_arena, v_arena, tables, pos = res
+    _, vjp = jax.vjp(
+        lambda qq, kk, vv: _paged_composed(qq, kk, vv, tables, pos, scale),
+        q, k_arena, v_arena)
+    dq, dk, dv = vjp(do)
+    return dq, dk, dv, None, None
+
+
+_paged.defvjp(_paged_fwd, _paged_bwd)
+
+
+def paged_attention(q, k_arena, v_arena, tables, pos, scale=None,
+                    impl: str = None):
+    """Window attention straight against the page table. ``q`` [S, W,
+    nh, hd]; arenas [P, PL, kvh, hd]; ``tables`` [S, B]; ``pos`` [S, W]
+    (key j visible iff j <= pos). Returns [S, W, nh, hd] in q.dtype."""
+    nh, kvh = q.shape[2], k_arena.shape[2]
+    if nh % kvh:
+        raise ValueError(f"num_heads {nh} not a multiple of kv heads {kvh}")
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if impl is None:
+        impl = resolve("paged_attention")[0]
+    return _paged(q, k_arena, v_arena, tables.astype(jnp.int32),
+                  pos.astype(jnp.int32), float(scale), impl)
+
+
+register_kernel(
+    "paged_attention",
+    pallas=functools.partial(paged_attention, impl="pallas"),
+    composed=functools.partial(paged_attention, impl="composed"),
+    doc="decode window attention against the PagedKVPool page table: "
+        "per-page online softmax, no dense gathered context")

@@ -1,0 +1,288 @@
+"""Fused RMSNorm and RMSNorm+residual — Pallas kernels (fwd + VJP).
+
+The FlashAttention lesson applied to the norm: the composed-XLA form
+reads the activation once for the mean-square reduction and again for the
+normalize (plus a third pass when a residual add precedes it), so a
+[b, s, h] hidden state round-trips HBM up to 3x per norm. The fused
+kernel streams each row block once: residual add, f32 mean-square,
+rsqrt, scale — one read, one write, with the per-row ``rstd`` saved for
+a single-pass backward (no recompute of the reduction).
+
+Two entry points:
+
+- ``rms_norm(x, w, eps)``: plain norm, y = x * rsqrt(mean(x^2)+eps) * w.
+- ``rms_norm_residual(x, res, w, eps) -> (y, s)``: the decoder-layer
+  pattern ``s = x + res; y = norm(s)`` fused; ``s`` is returned as the
+  new residual stream (both outputs carry cotangents in the VJP).
+
+Both carry custom VJPs whose backward is also one kernel (dx [+dres] and
+a cross-row dw accumulated in VMEM scratch over the sequential grid).
+The composed-XLA twin implements the identical math + VJP structure in
+jnp — the CPU production path and the TPU A/B reference. Parity is
+pinned by tests/test_pallas_kernels.py (fwd and grads, odd widths).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..registry import register_kernel, resolve
+from ._common import interpret_default as _interpret
+from ._common import pick_rows as _pick_rows
+
+__all__ = ["rms_norm", "rms_norm_residual"]
+
+
+# -- forward ------------------------------------------------------------------
+# The plain and +residual variants have DIFFERENT operand lists (not just
+# different math): the plain kernel must not stream a dead residual input
+# or write a redundant s output — on a memory-bound op those extra
+# [n, h] DMAs would cost what the fusion saves. The saved "s" for the
+# plain backward IS the primal input.
+
+def _fwd_kernel(x_ref, r_ref, w_ref, y_ref, s_ref, rstd_ref, *, eps):
+    s = x_ref[...].astype(jnp.float32) + r_ref[...].astype(jnp.float32)
+    ms = jnp.mean(s * s, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y_ref[...] = (s * rstd * w_ref[...].astype(jnp.float32)).astype(
+        y_ref.dtype)
+    s_ref[...] = s.astype(s_ref.dtype)
+    rstd_ref[...] = rstd
+
+
+def _fwd_kernel_plain(x_ref, w_ref, y_ref, rstd_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)
+    ms = jnp.mean(x * x, axis=-1, keepdims=True)
+    rstd = jax.lax.rsqrt(ms + eps)
+    y_ref[...] = (x * rstd * w_ref[...].astype(jnp.float32)).astype(
+        y_ref.dtype)
+    rstd_ref[...] = rstd
+
+
+def _fwd_pallas(x2, r2, w, eps, residual, interpret):
+    n, h = x2.shape
+    bn = _pick_rows(n)
+    grid = (n // bn,)
+    w2 = w.reshape(1, h)
+    row = pl.BlockSpec((bn, h), lambda i: (i, 0))
+    wspec = pl.BlockSpec((1, h), lambda i: (0, 0))
+    rstd_spec = pl.BlockSpec((bn, 1), lambda i: (i, 0))
+    if residual:
+        y, s, rstd = pl.pallas_call(
+            functools.partial(_fwd_kernel, eps=eps),
+            grid=grid,
+            in_specs=[row, row, wspec],
+            out_specs=[row, row, rstd_spec],
+            out_shape=[
+                jax.ShapeDtypeStruct((n, h), x2.dtype),
+                jax.ShapeDtypeStruct((n, h), x2.dtype),
+                jax.ShapeDtypeStruct((n, 1), jnp.float32),
+            ],
+            interpret=interpret,
+        )(x2, r2, w2)
+        return y, s, rstd
+    y, rstd = pl.pallas_call(
+        functools.partial(_fwd_kernel_plain, eps=eps),
+        grid=grid,
+        in_specs=[row, wspec],
+        out_specs=[row, rstd_spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, h), x2.dtype),
+            jax.ShapeDtypeStruct((n, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(x2, w2)
+    return y, x2, rstd
+
+
+def _fwd_composed(x2, r2, w, eps, residual):
+    if residual:
+        s = x2.astype(jnp.float32) + r2.astype(jnp.float32)
+    else:
+        s = x2.astype(jnp.float32)
+    rstd = jax.lax.rsqrt(jnp.mean(s * s, axis=-1, keepdims=True) + eps)
+    y = (s * rstd * w.astype(jnp.float32)).astype(x2.dtype)
+    return y, (s.astype(x2.dtype) if residual else x2), rstd
+
+
+# -- backward -----------------------------------------------------------------
+
+def _bwd_body(s, w, rstd, dy, dr):
+    g = dy * w
+    # y = s * rstd * w with rstd = (mean(s^2)+eps)^-1/2:
+    # ds = rstd * (g - s * rstd^2 * mean(g*s))
+    ds = rstd * (g - s * (rstd * rstd) *
+                 jnp.mean(g * s, axis=-1, keepdims=True))
+    if dr is not None:
+        # s is ALSO the new-residual output — its cotangent adds straight
+        # through (dx == dres: the add fans the same gradient both ways)
+        ds = ds + dr
+    return ds, jnp.sum(dy * s * rstd, axis=0, keepdims=True)
+
+
+def _bwd_kernel(s_ref, w_ref, rstd_ref, dy_ref, dr_ref, dx_ref, dw_ref,
+                dw_acc):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+
+    ds, dw_part = _bwd_body(
+        s_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        rstd_ref[...], dy_ref[...].astype(jnp.float32),
+        dr_ref[...].astype(jnp.float32))
+    dx_ref[...] = ds.astype(dx_ref.dtype)
+    dw_acc[...] += dw_part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        dw_ref[...] = dw_acc[...]
+
+
+def _bwd_kernel_plain(s_ref, w_ref, rstd_ref, dy_ref, dx_ref, dw_ref,
+                      dw_acc):
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        dw_acc[...] = jnp.zeros_like(dw_acc)
+
+    ds, dw_part = _bwd_body(
+        s_ref[...].astype(jnp.float32), w_ref[...].astype(jnp.float32),
+        rstd_ref[...], dy_ref[...].astype(jnp.float32), None)
+    dx_ref[...] = ds.astype(dx_ref.dtype)
+    dw_acc[...] += dw_part
+
+    @pl.when(i == pl.num_programs(0) - 1)
+    def _():
+        dw_ref[...] = dw_acc[...]
+
+
+def _bwd_pallas(s, w, rstd, dy, dr, residual, interpret):
+    n, h = s.shape
+    bn = _pick_rows(n)
+    grid = (n // bn,)
+    w2 = w.reshape(1, h)
+    row = pl.BlockSpec((bn, h), lambda i: (i, 0))
+    wspec = pl.BlockSpec((1, h), lambda i: (0, 0))
+    rstd_spec = pl.BlockSpec((bn, 1), lambda i: (i, 0))
+    out_specs = [row, wspec]
+    out_shape = [jax.ShapeDtypeStruct((n, h), s.dtype),
+                 jax.ShapeDtypeStruct((1, h), jnp.float32)]
+    scratch = [pltpu.VMEM((1, h), jnp.float32)]
+    if residual:
+        dx, dw = pl.pallas_call(
+            _bwd_kernel, grid=grid,
+            in_specs=[row, wspec, rstd_spec, row, row],
+            out_specs=out_specs, out_shape=out_shape,
+            scratch_shapes=scratch, interpret=interpret,
+        )(s, w2, rstd, dy, dr)
+    else:
+        dx, dw = pl.pallas_call(
+            _bwd_kernel_plain, grid=grid,
+            in_specs=[row, wspec, rstd_spec, row],
+            out_specs=out_specs, out_shape=out_shape,
+            scratch_shapes=scratch, interpret=interpret,
+        )(s, w2, rstd, dy)
+    return dx, dw.reshape(h)
+
+
+def _bwd_composed(s, w, rstd, dy, dr, residual):
+    ds, dw = _bwd_body(s.astype(jnp.float32),
+                       w.astype(jnp.float32), rstd,
+                       dy.astype(jnp.float32),
+                       dr.astype(jnp.float32) if residual else None)
+    return ds.astype(s.dtype), dw.reshape(-1)
+
+
+# -- differentiable wrappers ([n, h] layout) ----------------------------------
+
+def _run_fwd(x2, r2, w, eps, impl, residual):
+    if impl in ("pallas", "interpret"):
+        return _fwd_pallas(x2, r2, w, eps, residual,
+                           interpret=(impl == "interpret") or _interpret())
+    return _fwd_composed(x2, r2, w, eps, residual)
+
+
+def _run_bwd(s, w, rstd, dy, dr, impl, residual):
+    if impl in ("pallas", "interpret"):
+        return _bwd_pallas(s, w, rstd, dy, dr, residual,
+                           interpret=(impl == "interpret") or _interpret())
+    return _bwd_composed(s, w, rstd, dy, dr, residual)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _rms2(x2, w, eps, impl):
+    return _run_fwd(x2, x2, w, eps, impl, residual=False)[0]
+
+
+def _rms2_fwd(x2, w, eps, impl):
+    y, s, rstd = _run_fwd(x2, x2, w, eps, impl, residual=False)
+    return y, (s, w, rstd)
+
+
+def _rms2_bwd(eps, impl, res, dy):
+    s, w, rstd = res
+    dx, dw = _run_bwd(s, w, rstd, dy, dy, impl, residual=False)
+    return dx, dw.astype(w.dtype)
+
+
+_rms2.defvjp(_rms2_fwd, _rms2_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _rms2_res(x2, r2, w, eps, impl):
+    y, s, _ = _run_fwd(x2, r2, w, eps, impl, residual=True)
+    return y, s
+
+
+def _rms2_res_fwd(x2, r2, w, eps, impl):
+    y, s, rstd = _run_fwd(x2, r2, w, eps, impl, residual=True)
+    return (y, s), (s, w, rstd)
+
+
+def _rms2_res_bwd(eps, impl, res, cts):
+    s, w, rstd = res
+    dy, dr = cts
+    ds, dw = _run_bwd(s, w, rstd, dy, dr, impl, residual=True)
+    return ds, ds, dw.astype(w.dtype)
+
+
+_rms2_res.defvjp(_rms2_res_fwd, _rms2_res_bwd)
+
+
+# -- public API ([..., h] layout) ---------------------------------------------
+
+def rms_norm(x, w, eps: float = 1e-6, impl: str = None):
+    """Fused RMSNorm over the last axis. ``impl``: None (registry pick),
+    'pallas', 'interpret' (Pallas through the interpreter — parity
+    tests), or 'composed' (the jnp twin)."""
+    if impl is None:
+        impl = resolve("rms_norm")[0]
+    h = x.shape[-1]
+    y = _rms2(x.reshape(-1, h), w, float(eps), impl)
+    return y.reshape(x.shape)
+
+
+def rms_norm_residual(x, res, w, eps: float = 1e-6, impl: str = None):
+    """Fused ``s = x + res; y = rmsnorm(s) * w`` -> ``(y, s)`` — the
+    pre-norm decoder pattern with the residual add folded into the same
+    HBM pass. Returns the normed branch input and the new residual."""
+    if impl is None:
+        impl = resolve("rms_norm")[0]
+    h = x.shape[-1]
+    y, s = _rms2_res(x.reshape(-1, h), res.reshape(-1, h), w, float(eps),
+                     impl)
+    return y.reshape(x.shape), s.reshape(x.shape)
+
+
+register_kernel(
+    "rms_norm",
+    pallas=functools.partial(rms_norm, impl="pallas"),
+    composed=functools.partial(rms_norm, impl="composed"),
+    doc="RMSNorm (+residual) fused: one HBM pass fwd, one-kernel VJP")

@@ -1,0 +1,130 @@
+"""Fused rotate-half RoPE — Pallas kernel (fwd + VJP).
+
+The composed form materializes cos/sin tables, splits the activation,
+and concatenates — several elementwise HLOs over the full [b, s, h, d]
+q/k tensors. The fused kernel streams each sequence block once and
+computes the angles in-register from the block's global positions (no
+cos/sin tables in HBM at all).
+
+The VJP needs no residuals: a rotation is orthogonal, so the backward is
+the same kernel with the angle negated (``inverse=True``) applied to the
+cotangent — RoPE becomes memory-traffic-free to differentiate.
+
+``pos_offset`` shifts the global positions (decode-cache append and the
+context-parallel rank offset ride this, matching ``models/llama.py``'s
+``rope_apply`` contract). Parity vs the composed twin (and the legacy
+``_rope`` primitive) is pinned by tests/test_pallas_kernels.py.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from ..registry import register_kernel, resolve
+from ._common import interpret_default as _interpret
+from ._common import pick_rows
+
+__all__ = ["rope_apply"]
+
+
+def _pick_seq_block(s: int, pref: int = 512) -> int:
+    return pick_rows(s, pref)
+
+
+def _angles(bs: int, d: int, theta: float, base_pos):
+    """cos/sin [bs, 1, d//2] for positions base_pos + [0..bs) — computed
+    in-register (f32) from iotas; no table input."""
+    half = d // 2
+    pos = base_pos + jax.lax.broadcasted_iota(jnp.float32, (bs, 1, half), 0)
+    # inv_freq_i = theta^(-2i/d) == exp(-(2i/d) * ln(theta))
+    idx = jax.lax.broadcasted_iota(jnp.float32, (bs, 1, half), 2)
+    inv = jnp.exp(idx * (-2.0 / d) * math.log(theta))
+    freqs = pos * inv
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _rope_kernel(x_ref, o_ref, *, theta, pos_offset, block_s, d, inverse):
+    s_start = pl.program_id(1) * block_s
+    cos, sin = _angles(block_s, d, theta, jnp.float32(pos_offset) + s_start)
+    if inverse:
+        sin = -sin
+    xf = x_ref[0].astype(jnp.float32)          # [block_s, h, d]
+    half = d // 2
+    x1, x2 = xf[..., :half], xf[..., half:]
+    o_ref[0] = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+        axis=-1).astype(o_ref.dtype)
+
+
+def _rope_pallas(x, theta, pos_offset, inverse, interpret):
+    b, s, h, d = x.shape
+    bs = _pick_seq_block(s)
+    return pl.pallas_call(
+        functools.partial(_rope_kernel, theta=theta, pos_offset=pos_offset,
+                          block_s=bs, d=d, inverse=inverse),
+        grid=(b, s // bs),
+        in_specs=[pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0))],
+        out_specs=pl.BlockSpec((1, bs, h, d), lambda i, j: (i, j, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=interpret,
+    )(x)
+
+
+def _rope_composed(x, theta, pos_offset, inverse):
+    b, s, h, d = x.shape
+    pos = jnp.arange(pos_offset, pos_offset + s, dtype=jnp.float32)
+    inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    freqs = jnp.outer(pos, inv)
+    cos = jnp.cos(freqs)[None, :, None, :]
+    sin = jnp.sin(freqs)[None, :, None, :]
+    if inverse:
+        sin = -sin
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., : d // 2], xf[..., d // 2:]
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _run(x, theta, pos_offset, impl, inverse):
+    if impl in ("pallas", "interpret"):
+        return _rope_pallas(x, theta, pos_offset, inverse,
+                            interpret=(impl == "interpret") or _interpret())
+    return _rope_composed(x, theta, pos_offset, inverse)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _rope4(x, theta, pos_offset, impl):
+    return _run(x, theta, pos_offset, impl, inverse=False)
+
+
+def _rope4_fwd(x, theta, pos_offset, impl):
+    return _run(x, theta, pos_offset, impl, inverse=False), None
+
+
+def _rope4_bwd(theta, pos_offset, impl, _res, dy):
+    return (_run(dy, theta, pos_offset, impl, inverse=True),)
+
+
+_rope4.defvjp(_rope4_fwd, _rope4_bwd)
+
+
+def rope_apply(x, theta: float = 10000.0, pos_offset: int = 0,
+               impl: str = None):
+    """Fused rotate-half RoPE on [b, s, h, d]; d must be even. ``impl``:
+    None (registry pick), 'pallas', 'interpret', or 'composed'."""
+    if x.shape[-1] % 2:
+        raise ValueError(f"RoPE head_dim must be even, got {x.shape[-1]}")
+    if impl is None:
+        impl = resolve("rope")[0]
+    return _rope4(x, float(theta), int(pos_offset), impl)
+
+
+register_kernel(
+    "rope",
+    pallas=functools.partial(rope_apply, impl="pallas"),
+    composed=functools.partial(rope_apply, impl="composed"),
+    doc="rotate-half RoPE: in-register angles, residual-free inverse VJP")

@@ -91,8 +91,12 @@ class LlamaMoEConfig(LlamaConfig):
 
 
 @primitive("rope_apply")
-def _rope(x, *, theta, pos_offset):
+def _rope(x, *, theta, pos_offset, fused=False):
     # x: [b, s, h, d]; rotate-half RoPE in fp32
+    if fused:
+        from ..kernels.pallas.rope import rope_apply as _fused_rope
+
+        return _fused_rope(x, theta, pos_offset)
     b, s, h, d = x.shape
     pos = jnp.arange(pos_offset, pos_offset + s, dtype=jnp.float32)
     inv = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
@@ -106,7 +110,12 @@ def _rope(x, *, theta, pos_offset):
 
 
 def apply_rotary_pos_emb(x: Tensor, theta: float = 10000.0, pos_offset: int = 0) -> Tensor:
-    return _rope(x, theta=float(theta), pos_offset=int(pos_offset))
+    # the fused-kernel gate is a primitive ATTR (cache-key participant):
+    # an FLAGS_fused_kernels flip retraces and the retrace auditor names it
+    from ..kernels.registry import fused_enabled
+
+    return _rope(x, theta=float(theta), pos_offset=int(pos_offset),
+                 fused=fused_enabled("rope"))
 
 
 def _cp_axes():
@@ -213,11 +222,25 @@ class LlamaDecoderLayer(nn.Layer):
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
     def forward(self, hidden):
+        from ..kernels.registry import fused_enabled
+
         hidden = _mark_seq(hidden)
-        residual = hidden
-        hidden = residual + self.self_attn(self.input_layernorm(hidden))
-        residual = hidden
-        hidden = residual + self.mlp(self.post_attention_layernorm(hidden))
+        if fused_enabled("rms_norm"):
+            # fused residual-add + norm: the attn output, the residual
+            # stream and the post-norm read/write collapse into one HBM
+            # pass (kernels/pallas/rmsnorm.py); the first norm of the
+            # layer has no preceding add, so it fuses as the plain kernel
+            attn_out = self.self_attn(self.input_layernorm(hidden))
+            mlp_in, hidden = F.rms_norm_residual(
+                attn_out, hidden, self.post_attention_layernorm.weight,
+                self.post_attention_layernorm._epsilon)
+            hidden = hidden + self.mlp(mlp_in)
+        else:
+            residual = hidden
+            hidden = residual + self.self_attn(self.input_layernorm(hidden))
+            residual = hidden
+            hidden = residual + self.mlp(
+                self.post_attention_layernorm(hidden))
         return _mark_seq(hidden)
 
 

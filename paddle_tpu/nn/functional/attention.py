@@ -39,8 +39,16 @@ def _sdpa_xla(q, k, v, mask, *, causal, scale, dropout_p, key=None):
 
 
 @primitive("sdpa")
-def _sdpa(q, k, v, *, causal, scale):
-    return _flash_or_xla(q, k, v, None, causal=causal, scale=scale)
+def _sdpa(q, k, v, *, causal, scale, impl="xla"):
+    if impl == "flash":
+        try:
+            from ...kernels.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal, scale=scale)
+        except Exception:  # pragma: no cover - kernel unavailable
+            pass
+    return _sdpa_xla(q, k, v, None, causal=causal, scale=scale,
+                     dropout_p=0.0)
 
 
 @primitive("sdpa_mask")
@@ -60,29 +68,40 @@ def _sdpa_mask_dropout(q, k, v, mask, rngkey, *, causal, scale, dropout_p):
                      dropout_p=dropout_p, key=rngkey)
 
 
-def _flash_or_xla(q, k, v, mask, *, causal, scale):
-    """Route to the Pallas flash kernel when on TPU + shapes allow."""
-    if mask is None and _use_flash(q, k):
-        try:
-            from ...kernels.flash_attention import flash_attention
+def attention_backend(sq: int, sk: int, head_dim: int,
+                      platform: str = None) -> str:
+    """Which kernel ``scaled_dot_product_attention`` lands on for a
+    (platform, shape): ``'flash'`` (Pallas) or ``'xla'`` (fused-XLA
+    softmax). The old hard-coded "TPU + long sequence" heuristic is now
+    a documented threshold — ``FLAGS_flash_min_seq`` (live-read): both
+    q and kv sequences must reach it, on top of the kernel's structural
+    constraints (block-divisible sequences, MXU-friendly head_dim).
 
-            return flash_attention(q, k, v, causal=causal, scale=scale)
-        except Exception:  # pragma: no cover - fall back if kernel unavailable
-            pass
-    return _sdpa_xla(q, k, v, mask, causal=causal, scale=scale, dropout_p=0.0)
-
-
-def _use_flash(q, k):
+    The decision is passed to the ``sdpa`` primitive as an ATTR, so it
+    participates in the jit cache key: a threshold-driven path flip
+    shows up as a new cache key the ``analysis.retrace`` auditor names
+    (``op:sdpa`` label) instead of silently recompiling.
+    """
     if os.environ.get("PADDLE_TPU_DISABLE_FLASH", "0") == "1":
-        return False
-    try:
-        dev = jax.devices()[0].platform
-    except Exception:
-        return False
-    if dev == "cpu":
-        return False
-    # flash kernel wants seq multiples of its block size and head_dim >= 128-friendly
-    return q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0 and q.shape[3] in (64, 128, 256)
+        return "xla"
+    if platform is None:
+        try:
+            platform = jax.devices()[0].platform
+        except Exception:
+            return "xla"
+    if platform == "cpu":
+        return "xla"
+    from ...framework import flags as flags_mod
+
+    if not flags_mod.flag("use_pallas_flash_attention"):
+        return "xla"
+    min_seq = int(flags_mod.flag("flash_min_seq"))
+    if sq < min_seq or sk < min_seq:
+        return "xla"
+    # structural: block-divisible sequences, MXU-friendly head_dim
+    if sq % 128 or sk % 128 or head_dim not in (64, 128, 256):
+        return "xla"
+    return "flash"
 
 
 def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.0,
@@ -99,7 +118,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         return _sdpa_mask_dropout(query, key, value, attn_mask, rk,
                                   causal=bool(is_causal), scale=s, dropout_p=float(dropout_p))
     if attn_mask is None:
-        return _sdpa(query, key, value, causal=bool(is_causal), scale=s)
+        impl = attention_backend(query.shape[1], key.shape[1],
+                                 query.shape[3])
+        return _sdpa(query, key, value, causal=bool(is_causal), scale=s,
+                     impl=impl)
     return _sdpa_mask(query, key, value, attn_mask, causal=bool(is_causal), scale=s)
 
 

@@ -114,15 +114,49 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=N
 
 
 @primitive("rms_norm_op")
-def _rms_norm(x, w, *, eps):
+def _rms_norm(x, w, *, eps, fused=False):
+    if fused:
+        from ...kernels.pallas.rmsnorm import rms_norm as _fused
+
+        return _fused(x, w, eps)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     xn = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
     return (xn * w.astype(jnp.float32)).astype(x.dtype)
 
 
+@primitive("rms_norm_residual_op")
+def _rms_norm_residual(x, res, w, *, eps, fused=False):
+    """Pre-norm decoder pattern ``s = x + res; y = rmsnorm(s)`` ->
+    (y, s): fused through kernels/pallas when the registry gate is open,
+    else the composed two-op form (identical math)."""
+    if fused:
+        from ...kernels.pallas.rmsnorm import rms_norm_residual as _fused
+
+        return _fused(x, res, w, eps)
+    s = x + res
+    var = jnp.mean(jnp.square(s.astype(jnp.float32)), axis=-1, keepdims=True)
+    sn = s.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (sn * w.astype(jnp.float32)).astype(x.dtype), s
+
+
+def _rms_fused_gate() -> bool:
+    from ...kernels.registry import fused_enabled
+
+    return fused_enabled("rms_norm")
+
+
 def rms_norm(x, weight, epsilon=1e-6, name=None):
-    """RMSNorm (not in the reference snapshot; required by the Llama family)."""
-    return _rms_norm(x, weight, eps=float(epsilon))
+    """RMSNorm (not in the reference snapshot; required by the Llama
+    family). The fused-kernel gate rides the jit cache key as an attr,
+    so an ``FLAGS_fused_kernels`` flip retraces (retrace-auditable)."""
+    return _rms_norm(x, weight, eps=float(epsilon), fused=_rms_fused_gate())
+
+
+def rms_norm_residual(x, residual, weight, epsilon=1e-6, name=None):
+    """Fused residual-add + RMSNorm -> ``(normed, new_residual)`` — the
+    decoder-layer hot pattern (see docs/performance.md "Fused kernels")."""
+    return _rms_norm_residual(x, residual, weight, eps=float(epsilon),
+                              fused=_rms_fused_gate())
 
 
 @primitive("batch_norm_infer_op")

@@ -117,12 +117,24 @@ def _moe_mlp(x, wg, w_gate, w_up, w_down, *, top_k, capacity_factor,
       capacity_factor ignored. Single-device experts only (falls back to
       'index' when ep_degree > 1 — ragged row counts can't cross a GSPMD
       all_to_all with static shapes).
+    - 'fused': DROPLESS fused routing/dispatch (kernels/pallas/
+      moe_dispatch.py) — the whole router (top-k + sort-by-expert
+      position counters) is one Pallas kernel and row movement runs as
+      scalar-prefetch gathers with gather-only VJPs, feeding the same
+      grouped matmul; row order (and therefore output) matches 'gmm'
+      without executing the argsort. Single-device experts and
+      num_experts <= 128 only (falls back to 'index' outside that).
     - 'einsum': GShard one-hot dispatch/combine einsums. O(n*e*cap)
       intermediates — kept as the oracle for parity tests.
 
     `dispatch` is a primitive ATTR (cache-key participant): the caller reads
     the flag so a set_flags after the first call still takes effect.
     """
+    if dispatch == "fused" and ep_degree <= 1:
+        from ...kernels.pallas.moe_dispatch import MAX_EXPERTS, fused_moe_mlp
+
+        if wg.shape[1] <= MAX_EXPERTS:
+            return fused_moe_mlp(x, wg, w_gate, w_up, w_down, top_k=top_k)
     if dispatch == "gmm" and ep_degree <= 1:
         return _moe_mlp_gmm(x, wg, w_gate, w_up, w_down, top_k=top_k)
     impl = {"einsum": _moe_mlp_einsum, "sort": _moe_mlp_sort}.get(

@@ -33,13 +33,26 @@ def snapshot() -> Dict[str, Any]:
 
 
 def dump(path: str) -> str:
-    """Write ``snapshot()`` as JSON (atomic rename); returns the path."""
+    """Write ``snapshot()`` as JSON (atomic rename); returns the path.
+
+    Deterministic payload (sorted keys) and a byte-identical rewrite is
+    SKIPPED: repeated dumps of an unchanged snapshot leave the file's
+    mtime/content alone, so artifact-only churn (the PR-12 class: a
+    telemetry re-dump masquerading as a diff) can't originate here.
+    """
+    payload = json.dumps(snapshot(), indent=1, default=str, sort_keys=True)
+    try:
+        with open(path) as f:
+            if f.read() == payload:
+                return path
+    except Exception:  # unreadable/corrupt prior file: just overwrite it
+        pass
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
     tmp = path + ".tmp"
     with open(tmp, "w") as f:
-        json.dump(snapshot(), f, indent=1, default=str)
+        f.write(payload)
     os.replace(tmp, path)
     return path
 

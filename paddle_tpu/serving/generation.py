@@ -210,21 +210,30 @@ def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool,
 
 
 def _build_window_step(cfg, max_slots: int, n_blocks: int, page_len: int,
-                       window: int, donate: bool, label: str):
+                       window: int, donate: bool, label: str,
+                       fused: bool = False):
     """The PAGED executable family: embed ``W = window`` tokens per slot
     at positions ``lengths + [0..W)``, write their K/V through the page
     tables into the pool arenas, attend each window token causally against
-    the gathered pages, and return the greedy argmax at every window
-    position.
+    the page pool, and return the greedy argmax at every window position.
 
     One shape serves three roles — W=1 is the decode step, W=k+1 scores a
     draft model's k proposals (speculative verify), W=bucket prefills a
     prompt suffix (cold prefill is the zero-prefix special case). Rows
     whose page table is all-zero write only the scratch page, so a prefill
     call touches exactly one request's pages.
+
+    ``fused=True`` (registry-gated: ``FLAGS_fused_kernels``) attends
+    straight against the page table through the Pallas paged-attention
+    kernel — the dense ``kc[tables]`` gathered context never
+    materializes; ``fused=False`` keeps the composed gather-then-attend
+    path (the CPU production path and the TPU A/B reference).
     """
     import jax
     import jax.numpy as jnp
+
+    if fused:
+        from ..kernels.pallas.paged_attention import paged_attention
 
     nh = cfg.num_attention_heads
     hd = cfg.hidden_size // nh
@@ -262,13 +271,22 @@ def _build_window_step(cfg, max_slots: int, n_blocks: int, page_len: int,
                 k1.reshape(S * W, nh, hd)).reshape(P, PL, nh, hd)
             vc = vc.reshape(P * PL, nh, hd).at[flat].set(
                 v1.reshape(S * W, nh, hd)).reshape(P, PL, nh, hd)
-            kk = kc[tables].reshape(S, L, nh, hd)
-            vv = vc[tables].reshape(S, L, nh, hd)
-            logits = jnp.einsum("swhd,sLhd->swhL", q, kk)
-            logits = logits.astype(jnp.float32) * scale
-            logits = jnp.where(mask[:, :, None, :], logits, -1e30)
-            probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-            ctx = jnp.einsum("swhL,sLhd->swhd", probs, vv)
+            if fused:
+                # attend against the page table directly (per-page online
+                # softmax); key j visible iff j <= pos[s, w] — the same
+                # containment the composed mask enforces
+                # impl resolves through the registry: Pallas on TPU, the
+                # composed twin on CPU, interpreter under
+                # PT_PALLAS_INTERPRET=1 (parity tests)
+                ctx = paged_attention(q, kc, vc, tables, pos, scale=scale)
+            else:
+                kk = kc[tables].reshape(S, L, nh, hd)
+                vv = vc[tables].reshape(S, L, nh, hd)
+                logits = jnp.einsum("swhd,sLhd->swhL", q, kk)
+                logits = logits.astype(jnp.float32) * scale
+                logits = jnp.where(mask[:, :, None, :], logits, -1e30)
+                probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+                ctx = jnp.einsum("swhL,sLhd->swhd", probs, vv)
             ctx = ctx.reshape(S, W, nh * hd)
             x = x + (ctx @ p["out_w"] + p["out_b"])
             h2 = ln(x, p["ln2_w"], p["ln2_b"])
@@ -432,12 +450,19 @@ class GenerationEngine(EngineBase):
         fn = self._windows.get(W)
         if fn is None:
             from .. import jit as jit_mod
+            from ..kernels.registry import fused_enabled
 
-            label = f"serving:{self.name}:window{W}"
+            # build-time decision (executables are cached per engine);
+            # the ":fused" label suffix keeps the retrace audit and the
+            # persistent-cache keyspace honest about which path compiled
+            fused = fused_enabled("paged_attention")
+            label = f"serving:{self.name}:window{W}" + \
+                (":fused" if fused else "")
             fn = jit_mod._maybe_audit(
                 label, _build_window_step(self._mcfg, self.config.max_slots,
                                           self._n_blocks, self._pl, W,
-                                          self._donate, label=label))
+                                          self._donate, label=label,
+                                          fused=fused))
             self._windows[W] = fn
         return fn
 

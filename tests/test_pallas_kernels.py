@@ -1,0 +1,600 @@
+"""ISSUE-13: the kernels/pallas fused-op layer.
+
+Interpret-mode (the Pallas kernels through the Pallas interpreter) vs
+composed-XLA parity — forward AND gradients — for fused MoE routing/
+dispatch, RMSNorm(+residual), RoPE and paged attention, including odd /
+non-divisible shapes, GQA head ratios and the flash ``q_offset``
+context-parallel path; the registry/flag seam; the retrace-auditable
+attention-path threshold (``FLAGS_flash_min_seq``); zero-retrace on the
+warm fused path; and the planner's fused-kernel cost entries.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework import flags as flags_mod
+from paddle_tpu.kernels import registry as kreg
+from paddle_tpu.kernels.pallas import moe_dispatch as kmoe
+from paddle_tpu.kernels.pallas import paged_attention as kpaged
+from paddle_tpu.kernels.pallas import rmsnorm as krms
+from paddle_tpu.kernels.pallas import rope as krope
+
+TOL = dict(rtol=2e-5, atol=2e-5)
+
+
+def _close(a, b, **kw):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               **(kw or TOL))
+
+
+@pytest.fixture(autouse=True)
+def _restore_flags():
+    prior = flags_mod.get_flags(["FLAGS_fused_kernels",
+                                 "FLAGS_moe_dispatch",
+                                 "FLAGS_flash_min_seq"])
+    yield
+    flags_mod.set_flags(prior)
+
+
+# -- registry seam ------------------------------------------------------------
+
+def test_registry_gate_modes():
+    kreg.registry()  # ensure builtin ops registered
+    flags_mod.set_flags({"FLAGS_fused_kernels": "off"})
+    assert not kreg.fused_enabled("rms_norm")
+    flags_mod.set_flags({"FLAGS_fused_kernels": "on"})
+    assert kreg.fused_enabled("rms_norm")
+    assert kreg.fused_enabled("paged_attention")
+    flags_mod.set_flags({"FLAGS_fused_kernels": "rms_norm,rope"})
+    assert kreg.fused_enabled("rms_norm") and kreg.fused_enabled("rope")
+    assert not kreg.fused_enabled("moe_dispatch")
+    flags_mod.set_flags({"FLAGS_fused_kernels": "auto"})
+    # auto on the CPU test backend = legacy composed path (tier-1 runs
+    # the code it always ran)
+    assert kreg.fused_enabled("rms_norm") == (
+        jax.default_backend() == "tpu")
+    # unknown ops never gate on
+    assert not kreg.fused_enabled("nope")
+
+
+def test_registry_resolve_and_table():
+    impl, fn = kreg.resolve("rms_norm")
+    assert impl == ("pallas" if jax.default_backend() == "tpu"
+                    else "composed")
+    assert callable(fn)
+    table = kreg.kernel_table()
+    assert set(table["ops"]) >= {"rms_norm", "rope", "moe_dispatch",
+                                 "paged_attention"}
+    row = table["ops"]["rms_norm"]
+    assert row["impl"] in ("pallas", "composed", "interpret")
+    assert row["calls"]["composed"] >= 1
+    # the table is a hub provider
+    from paddle_tpu import observability as obs
+
+    assert "fused_kernels" in obs.snapshot()
+
+
+# -- RMSNorm ------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape", [(4, 96), (2, 7, 96), (3, 5, 130)])
+def test_rms_norm_parity_fwd(shape):
+    """Interpret vs composed vs the legacy primitive, odd widths."""
+    ks = jax.random.split(jax.random.key(0), 2)
+    x = jax.random.normal(ks[0], shape, jnp.float32)
+    w = jax.random.normal(ks[1], shape[-1:], jnp.float32)
+    yi = krms.rms_norm(x, w, 1e-6, impl="interpret")
+    yc = krms.rms_norm(x, w, 1e-6, impl="composed")
+    from paddle_tpu.nn.functional.common import _rms_norm
+
+    yl = _rms_norm.fn(x, w, eps=1e-6, fused=False)
+    _close(yi, yc)
+    _close(yi, yl)
+
+
+def test_rms_norm_residual_parity_fwd_and_grad():
+    ks = jax.random.split(jax.random.key(1), 3)
+    x = jax.random.normal(ks[0], (3, 9, 96), jnp.float32)
+    r = jax.random.normal(ks[1], (3, 9, 96), jnp.float32)
+    w = jax.random.normal(ks[2], (96,), jnp.float32)
+
+    def loss(impl):
+        def f(x, r, w):
+            y, s = krms.rms_norm_residual(x, r, w, 1e-6, impl=impl)
+            return jnp.sum(y * 1.3) + jnp.sum(jnp.sin(s))
+        return f
+
+    yi, si = krms.rms_norm_residual(x, r, w, 1e-6, impl="interpret")
+    yc, sc = krms.rms_norm_residual(x, r, w, 1e-6, impl="composed")
+    _close(yi, yc)
+    _close(si, sc)
+    _close(si, x + r)  # the new residual IS the sum
+    gi = jax.grad(loss("interpret"), argnums=(0, 1, 2))(x, r, w)
+    gc = jax.grad(loss("composed"), argnums=(0, 1, 2))(x, r, w)
+    for a, b in zip(gi, gc):
+        _close(a, b)
+    # composed twin's grads vs pure-jnp autodiff of the same math
+    def ref(x, r, w):
+        s = (x + r).astype(jnp.float32)
+        y = s * jax.lax.rsqrt(jnp.mean(s * s, -1, keepdims=True) + 1e-6) * w
+        return jnp.sum(y * 1.3) + jnp.sum(jnp.sin(s))
+    gr = jax.grad(ref, argnums=(0, 1, 2))(x, r, w)
+    for a, b in zip(gc, gr):
+        _close(a, b)
+
+
+def test_rms_norm_functional_gate_routes_fused():
+    """The functional passes the live gate as a primitive attr; 'on' on
+    CPU runs the composed twin — same numbers as legacy."""
+    import paddle_tpu.nn.functional as F
+
+    x = paddle.randn([2, 5, 64])
+    w = paddle.ones([64])
+    flags_mod.set_flags({"FLAGS_fused_kernels": "off"})
+    y_off = np.asarray(F.rms_norm(x, w).numpy())
+    flags_mod.set_flags({"FLAGS_fused_kernels": "on"})
+    y_on = np.asarray(F.rms_norm(x, w).numpy())
+    _close(y_off, y_on)
+    y2, s2 = F.rms_norm_residual(x, x, w)
+    _close(np.asarray(s2.numpy()), 2 * np.asarray(x.numpy()))
+
+
+# -- RoPE ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("shape,offset", [((2, 12, 3, 8), 0),
+                                          ((1, 10, 5, 6), 7),
+                                          ((2, 16, 4, 64), 3)])
+def test_rope_parity_fwd_and_grad(shape, offset):
+    x = jax.random.normal(jax.random.key(2), shape, jnp.float32)
+    oi = krope.rope_apply(x, 1e4, offset, impl="interpret")
+    oc = krope.rope_apply(x, 1e4, offset, impl="composed")
+    from paddle_tpu.models.llama import _rope
+
+    ol = _rope.fn(x, theta=1e4, pos_offset=offset, fused=False)
+    _close(oi, oc)
+    _close(oi, ol)
+
+    def loss(impl):
+        return lambda z: jnp.sum(
+            jnp.sin(krope.rope_apply(z, 1e4, offset, impl=impl)))
+
+    gi = jax.grad(loss("interpret"))(x)
+    gc = jax.grad(loss("composed"))(x)
+    gl = jax.grad(lambda z: jnp.sum(jnp.sin(
+        _rope.fn(z, theta=1e4, pos_offset=offset, fused=False))))(x)
+    _close(gi, gc)
+    _close(gi, gl)
+
+
+def test_rope_rejects_odd_head_dim():
+    x = jnp.zeros((1, 4, 2, 7))
+    with pytest.raises(ValueError):
+        krope.rope_apply(x, 1e4, 0, impl="composed")
+
+
+# -- fused MoE routing/dispatch ----------------------------------------------
+
+def _moe_weights(h=32, e=4, i=48, key=7):
+    ks = jax.random.split(jax.random.key(key), 5)
+    return (jax.random.normal(ks[1], (h, e), jnp.float32) * 0.1,
+            jax.random.normal(ks[2], (e, h, i), jnp.float32) * 0.1,
+            jax.random.normal(ks[3], (e, h, i), jnp.float32) * 0.1,
+            jax.random.normal(ks[4], (e, i, h), jnp.float32) * 0.1)
+
+
+def test_fused_route_parity_and_order():
+    """The routing kernel's gates/positions/counts/aux match the jnp
+    twin, and positions reproduce the gmm path's stable-argsort order."""
+    h, e, k = 24, 4, 2
+    wg, *_ = _moe_weights(h=h, e=e)
+    xt = jax.random.normal(jax.random.key(3), (30, h), jnp.float32)
+    gi_out = kmoe.fused_route(xt, wg, k, "interpret")
+    gc_out = kmoe.fused_route(xt, wg, k, "composed")
+    for a, b in zip(gi_out, gc_out):
+        _close(a, b)
+    gv, gi, pos, cnt, aux = gc_out
+    # index outputs ride as f32 across the custom-vjp boundary (float0
+    # tangent avoidance) — integer-exact
+    gi, pos, cnt = (np.asarray(a).astype(np.int32) for a in (gi, pos, cnt))
+    assert np.all(np.asarray(gc_out[1]) == gi)  # exact integers as floats
+    # stable-argsort order: dest is a permutation, grouped by expert in
+    # token-major traversal order
+    flat_e = np.asarray(gi).reshape(-1)
+    offsets = np.concatenate([[0], np.cumsum(np.asarray(cnt))[:-1]])
+    dest = offsets[flat_e] + np.asarray(pos).reshape(-1)
+    assert sorted(dest) == list(range(len(dest)))
+    order = np.argsort(flat_e, kind="stable")
+    ref_dest = np.empty_like(order)
+    ref_dest[order] = np.arange(len(order))
+    assert np.array_equal(dest, ref_dest)
+
+
+def test_fused_moe_parity_vs_gmm_and_index():
+    """Fwd + grads vs the gmm (dropless twin) and index (no-drop
+    capacity) paths, odd token counts included."""
+    from paddle_tpu.nn.layer import moe as moe_mod
+
+    wg, w_gate, w_up, w_down = _moe_weights()
+    x = jax.random.normal(jax.random.key(4), (2, 15, 32), jnp.float32)
+
+    def floss(impl):
+        def f(x, wg, w_gate, w_up, w_down):
+            o, aux = kmoe.fused_moe_mlp(x, wg, w_gate, w_up, w_down,
+                                        top_k=2, impl=impl)
+            return jnp.sum(o * o) + 0.1 * aux
+        return f
+
+    def gmm_loss(x, wg, w_gate, w_up, w_down):
+        o, aux = moe_mod._moe_mlp_gmm(x, wg, w_gate, w_up, w_down, top_k=2)
+        return jnp.sum(o * o) + 0.1 * aux
+
+    def idx_loss(x, wg, w_gate, w_up, w_down):
+        # capacity_factor == num_experts guarantees zero drops
+        o, aux = moe_mod._moe_mlp_index(x, wg, w_gate, w_up, w_down,
+                                        top_k=2, capacity_factor=4.0,
+                                        ep_degree=1)
+        return jnp.sum(o * o) + 0.1 * aux
+
+    args = (x, wg, w_gate, w_up, w_down)
+    of, auxf = kmoe.fused_moe_mlp(*args, top_k=2, impl="interpret")
+    oc, auxc = kmoe.fused_moe_mlp(*args, top_k=2, impl="composed")
+    og, auxg = moe_mod._moe_mlp_gmm(*args, top_k=2)
+    _close(of, oc)
+    _close(of, og)
+    _close(auxf, auxg)
+    gi = jax.grad(floss("interpret"), argnums=tuple(range(5)))(*args)
+    gc = jax.grad(floss("composed"), argnums=tuple(range(5)))(*args)
+    gg = jax.grad(gmm_loss, argnums=tuple(range(5)))(*args)
+    gx = jax.grad(idx_loss, argnums=tuple(range(5)))(*args)
+    for a, b in zip(gi, gc):
+        _close(a, b)
+    for a, b in zip(gi, gg):
+        _close(a, b)
+    for a, b in zip(gi, gx):  # router + expert grads match the index path
+        _close(a, b, rtol=1e-4, atol=1e-4)
+
+
+def test_moe_layer_fused_flag_end_to_end():
+    """FLAGS_moe_dispatch='fused' through the real MoELayer primitive,
+    vs gmm — identical dropless math."""
+    from paddle_tpu.nn.layer import moe as moe_mod
+
+    wg, w_gate, w_up, w_down = _moe_weights()
+    x = jax.random.normal(jax.random.key(5), (2, 12, 32), jnp.float32)
+    of, auxf = moe_mod._moe_mlp.fn(x, wg, w_gate, w_up, w_down, top_k=2,
+                                   capacity_factor=1.25, ep_degree=1,
+                                   dispatch="fused")
+    og, auxg = moe_mod._moe_mlp.fn(x, wg, w_gate, w_up, w_down, top_k=2,
+                                   capacity_factor=1.25, ep_degree=1,
+                                   dispatch="gmm")
+    _close(of, og)
+    _close(auxf, auxg)
+    # ep_degree > 1 falls back to the index path (no ragged a2a)
+    oi, _ = moe_mod._moe_mlp.fn(x, wg, w_gate, w_up, w_down, top_k=2,
+                                capacity_factor=1.25, ep_degree=2,
+                                dispatch="fused")
+    assert oi.shape == x.shape
+
+
+def test_fused_moe_grad_under_scan():
+    """Regression: differentiating fused_moe_mlp inside a lax.scan body
+    (the scanned decoder stack) must not materialize float0 tangents —
+    the routing indices cross the custom-vjp boundary as floats."""
+    wg, w_gate, w_up, w_down = _moe_weights()
+    x = jax.random.normal(jax.random.key(12), (2, 8, 32), jnp.float32)
+
+    def loss(x, wg):
+        def body(c, _):
+            o, aux = kmoe.fused_moe_mlp(c, wg, w_gate, w_up, w_down,
+                                        top_k=2, impl="composed")
+            return o, aux
+        out, auxes = jax.lax.scan(body, x, None, length=2)
+        return jnp.sum(out * out) + 0.1 * jnp.sum(auxes)
+
+    g = jax.jit(jax.grad(loss, argnums=(0, 1)))(x, wg)
+    assert all(np.isfinite(np.asarray(a)).all() for a in g)
+    assert float(jnp.abs(g[1]).sum()) > 0  # router grads flow
+
+
+def test_fused_moe_rejects_too_many_experts():
+    h, e = 8, 130
+    wg = jnp.zeros((h, e))
+    with pytest.raises(ValueError):
+        kmoe.fused_moe_mlp(jnp.zeros((1, 4, h)), wg,
+                           jnp.zeros((e, h, 8)), jnp.zeros((e, h, 8)),
+                           jnp.zeros((e, 8, h)), top_k=2, impl="composed")
+
+
+# -- paged attention ----------------------------------------------------------
+
+@pytest.mark.parametrize("nh,kvh,hd,PL", [(4, 4, 16, 4), (4, 2, 16, 4),
+                                          (6, 2, 12, 5)])
+def test_paged_attention_parity(nh, kvh, hd, PL):
+    """Interpret vs composed (the PR-11 gather math), GQA ratios and
+    non-divisible page/head shapes; grads through the VJP."""
+    S, W, P, B = 3, 2, 11, 3
+    ks = jax.random.split(jax.random.key(6), 5)
+    q = jax.random.normal(ks[0], (S, W, nh, hd), jnp.float32)
+    ka = jax.random.normal(ks[1], (P, PL, kvh, hd), jnp.float32)
+    va = jax.random.normal(ks[2], (P, PL, kvh, hd), jnp.float32)
+    tables = jax.random.randint(ks[3], (S, B), 0, P).astype(jnp.int32)
+    pos = jnp.array([[3, 4], [0, 1], [2 * PL, 2 * PL + 1]], jnp.int32)
+    pi = kpaged.paged_attention(q, ka, va, tables, pos, impl="interpret")
+    pc = kpaged.paged_attention(q, ka, va, tables, pos, impl="composed")
+    _close(pi, pc)
+    gi = jax.grad(lambda a, b_, c: jnp.sum(kpaged.paged_attention(
+        a, b_, c, tables, pos, impl="interpret") ** 2),
+        argnums=(0, 1, 2))(q, ka, va)
+    gc = jax.grad(lambda a, b_, c: jnp.sum(kpaged.paged_attention(
+        a, b_, c, tables, pos, impl="composed") ** 2),
+        argnums=(0, 1, 2))(q, ka, va)
+    for a, b in zip(gi, gc):
+        _close(a, b)
+
+
+def test_paged_attention_masks_by_position():
+    """A key past pos is invisible: growing pos by one token changes the
+    row; keys beyond the allocation never leak in."""
+    S, W, nh, hd, P, PL, B = 1, 1, 2, 8, 6, 4, 2
+    ks = jax.random.split(jax.random.key(8), 3)
+    q = jax.random.normal(ks[0], (S, W, nh, hd), jnp.float32)
+    ka = jax.random.normal(ks[1], (P, PL, nh, hd), jnp.float32)
+    va = jax.random.normal(ks[2], (P, PL, nh, hd), jnp.float32)
+    tables = jnp.array([[2, 3]], jnp.int32)
+    o3 = kpaged.paged_attention(q, ka, va, tables,
+                                jnp.array([[3]], jnp.int32),
+                                impl="interpret")
+    o4 = kpaged.paged_attention(q, ka, va, tables,
+                                jnp.array([[4]], jnp.int32),
+                                impl="interpret")
+    assert not np.allclose(np.asarray(o3), np.asarray(o4))
+    # pos = 3: only page 2's 4 keys visible -> equals dense attention
+    # over those keys
+    keys = np.asarray(ka)[2]                       # [PL, nh, hd]
+    vals = np.asarray(va)[2]
+    logits = np.einsum("whd,Lhd->whL", np.asarray(q)[0], keys) / np.sqrt(hd)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    ref = np.einsum("whL,Lhd->whd", probs, vals)
+    _close(o3[0], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_window_step_fused_seam_token_parity():
+    """The serving window step builds fused vs composed to identical
+    argmaxes and K/V writes (the CPU 'no worse than gather' contract is
+    ratio-checked by the bench fused_kernels recipe)."""
+    from paddle_tpu.models import GPTForCausalLM
+    from paddle_tpu.models.gpt import GPTConfig
+    from paddle_tpu.serving.generation import (_build_window_step,
+                                               _extract_gpt_params)
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=64, hidden_size=32, num_hidden_layers=2,
+                    num_attention_heads=4, max_position_embeddings=64)
+    params = _extract_gpt_params(GPTForCausalLM(cfg))
+    S, B, PL, W = 2, 4, 8, 2
+    P = S * B + 1
+    hd = cfg.hidden_size // cfg.num_attention_heads
+    ks = jax.random.split(jax.random.key(9), 2)
+    mk = lambda kk: [jax.random.normal(kk, (P, PL, 4, hd), jnp.float32) * 0.1
+                     for _ in range(2)]
+    tables = jnp.arange(S * B, dtype=jnp.int32).reshape(S, B) + 1
+    tokens = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    lengths = jnp.array([5, 11], jnp.int32)
+    outs = {}
+    for fused in (False, True):
+        stp = _build_window_step(cfg, S, B, PL, W, donate=False,
+                                 label=f"t:{fused}", fused=fused)
+        outs[fused] = stp(params, mk(ks[0]), mk(ks[1]), tables, tokens,
+                          lengths)
+    assert np.array_equal(np.asarray(outs[False][0]),
+                          np.asarray(outs[True][0]))
+    for a, b in zip(outs[False][1], outs[True][1]):
+        _close(a, b, rtol=0, atol=0)
+
+
+# -- flash q_offset (context-parallel path) -----------------------------------
+
+def test_flash_q_offset_matches_full_causal():
+    """A q chunk attending the full K/V prefix with its global offset
+    (the ring-attention rank view) matches the same rows of full causal
+    flash — the cp path's correctness contract."""
+    from paddle_tpu.kernels.flash_attention import flash_attention_with_lse
+
+    bh, s, d = 2, 32, 16
+    ks = jax.random.split(jax.random.key(10), 3)
+    q = jax.random.normal(ks[0], (bh, s, d), jnp.float32)
+    k = jax.random.normal(ks[1], (bh, s, d), jnp.float32)
+    v = jax.random.normal(ks[2], (bh, s, d), jnp.float32)
+    full, _ = flash_attention_with_lse(q, k, v, 0, True, 0.25, 8, 8)
+    half, _ = flash_attention_with_lse(q[:, s // 2:], k, v, s // 2, True,
+                                       0.25, 8, 8)
+    _close(half, full[:, s // 2:], rtol=1e-5, atol=1e-5)
+
+
+# -- attention path threshold (FLAGS_flash_min_seq) ---------------------------
+
+def test_attention_backend_threshold_and_flags():
+    from paddle_tpu.nn.functional.attention import attention_backend
+
+    # CPU always lands on the fused-XLA path
+    assert attention_backend(4096, 4096, 128, platform="cpu") == "xla"
+    # TPU: threshold + structural constraints
+    assert attention_backend(4096, 4096, 128, platform="tpu") == "flash"
+    assert attention_backend(64, 64, 128, platform="tpu") == "xla"
+    assert attention_backend(4096, 4096, 80, platform="tpu") == "xla"
+    assert attention_backend(4100, 4096, 128, platform="tpu") == "xla"
+    flags_mod.set_flags({"FLAGS_flash_min_seq": 8192})
+    assert attention_backend(4096, 4096, 128, platform="tpu") == "xla"
+    assert attention_backend(8192, 8192, 128, platform="tpu") == "flash"
+    flags_mod.set_flags({"FLAGS_flash_min_seq": 128})
+    prior = flags_mod.get_flags("FLAGS_use_pallas_flash_attention")
+    try:
+        flags_mod.set_flags({"FLAGS_use_pallas_flash_attention": False})
+        assert attention_backend(4096, 4096, 128, platform="tpu") == "xla"
+    finally:
+        flags_mod.set_flags(prior)
+    os.environ["PADDLE_TPU_DISABLE_FLASH"] = "1"
+    try:
+        assert attention_backend(4096, 4096, 128, platform="tpu") == "xla"
+    finally:
+        os.environ.pop("PADDLE_TPU_DISABLE_FLASH", None)
+
+
+def test_attention_impl_attr_is_cache_key_participant():
+    """The chosen path rides the sdpa primitive's attrs — two impls, two
+    jit cache keys (what makes a threshold flip retrace-auditable)."""
+    from paddle_tpu.core.dispatch import _FWD_CACHE, get_primitive
+
+    prim = get_primitive("sdpa")
+    f_x = prim.fwd({"causal": True, "scale": 0.1, "impl": "xla"})
+    f_f = prim.fwd({"causal": True, "scale": 0.1, "impl": "flash"})
+    assert f_x is not f_f
+    assert ("sdpa", (("causal", True), ("impl", "xla"),
+                     ("scale", 0.1))) in _FWD_CACHE
+
+
+# -- zero-retrace on the warm fused path --------------------------------------
+
+def test_warm_fused_path_zero_retrace():
+    """With the audit armed, repeated fused calls at fixed shapes add
+    ZERO retrace events; flipping the gate is a NEW key, not a silent
+    recompile of the old one."""
+    import paddle_tpu.analysis as A
+    import paddle_tpu.nn.functional as F
+
+    os.environ["PT_RETRACE_AUDIT"] = "1"
+    A.retrace.enable()
+    try:
+        flags_mod.set_flags({"FLAGS_fused_kernels": "on"})
+        x = paddle.randn([2, 6, 64])
+        w = paddle.ones([64])
+        F.rms_norm(x, w)
+        F.rms_norm_residual(x, x, w)
+        base = A.retrace.get_auditor().summary()["retrace_events"]
+        for _ in range(3):  # warm path: same shapes, same flags
+            F.rms_norm(x, w)
+            F.rms_norm_residual(x, x, w)
+        assert A.retrace.get_auditor().summary()["retrace_events"] == base
+        flags_mod.set_flags({"FLAGS_fused_kernels": "off"})
+        F.rms_norm(x, w)  # the flip is an AUDITED new key: one event
+        aud = A.retrace.get_auditor()
+        assert aud.summary()["retrace_events"] == base + 1
+        ev = aud.events[-1]
+        assert "fused" in str(ev.deltas), ev.deltas  # names the attr flip
+    finally:
+        A.retrace.disable()
+        A.retrace.reset()
+        os.environ.pop("PT_RETRACE_AUDIT", None)
+
+
+# -- llama end-to-end gate parity ---------------------------------------------
+
+def test_llama_fused_gate_loss_parity():
+    """tiny-Llama fwd+bwd: gate on (CPU -> composed twins) equals gate
+    off to float tolerance — the tier-1 'runs both, pins parity' seam."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny()
+    losses = {}
+    for mode in ("off", "on"):
+        flags_mod.set_flags({"FLAGS_fused_kernels": mode})
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        o = opt.AdamW(learning_rate=1e-3, parameters=m.parameters())
+        step = jit.TrainStep(m, lambda mm, x, y: mm(x, labels=y), o)
+        ids = paddle.randint(0, cfg.vocab_size, [2, 32])
+        losses[mode] = [float(step(ids, ids)) for _ in range(2)]
+    np.testing.assert_allclose(losses["off"], losses["on"],
+                               rtol=1e-4, atol=1e-5)
+
+
+# -- planner cost entries -----------------------------------------------------
+
+def test_planner_fused_entries_reprice_and_rerank():
+    """plan(fused_kernels=True) records per-op cost deltas on every
+    candidate; the MoE model prices the dispatch entry too."""
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.llama import LlamaMoEConfig
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(LlamaConfig.tiny())
+    off = dist.plan(m, n_devices=8, hbm_bytes=9.5e9, batch=16, seq=64,
+                    fused_kernels=False)
+    on = dist.plan(m, n_devices=8, hbm_bytes=9.5e9, batch=16, seq=64,
+                   fused_kernels=True)
+    by_key = {str(c.config): c.predicted_step_s for c in off}
+    deltas = [by_key[str(c.config)] - c.predicted_step_s
+              for c in on if str(c.config) in by_key]
+    assert any(d > 0 for d in deltas), "fused entries changed no cost"
+    assert on[0].breakdown.get("fused_gain_s", 0) > 0
+    assert "rms_norm" in on[0].breakdown["fused_ops"]
+
+    paddle.seed(0)
+    moe = dist.plan(LlamaForCausalLM(LlamaMoEConfig.tiny()), n_devices=8,
+                    hbm_bytes=9.5e9, batch=16, seq=64, fused_kernels=True)
+    assert "moe_dispatch" in moe[0].breakdown["fused_ops"]
+    # fused_kernels=None follows the live registry (CPU auto -> none)
+    flags_mod.set_flags({"FLAGS_fused_kernels": "auto"})
+    paddle.seed(0)
+    auto = dist.plan(m, n_devices=8, hbm_bytes=9.5e9, batch=16, seq=64)
+    if jax.default_backend() == "cpu":
+        assert "fused_gain_s" not in auto[0].breakdown
+
+
+def test_calibration_persist_roundtrip(tmp_path, monkeypatch):
+    """calibrate_from_counters persists per-(topology, jax version) next
+    to the persistent cache; link_model_for merges it under
+    PT_LINK_CALIBRATION=1; fused entries calibrate the same way."""
+    from paddle_tpu.cost_model import comm
+    from paddle_tpu.cost_model.fused import fused_entries
+
+    monkeypatch.setenv("PT_CALIBRATION_DIR", str(tmp_path))
+    lm = comm.link_model_for("cpu-host")
+    path = comm.save_calibration(
+        lm.override(ici_bytes_per_s=3.21e10),
+        fused={"moe_dispatch": {"dispatch_share_composed": 0.2,
+                                "dispatch_share_fused": 0.05}})
+    assert os.path.exists(path) and "cpu-host" in path
+    monkeypatch.setenv("PT_LINK_CALIBRATION", "1")
+    assert comm.link_model_for("cpu-host").ici_bytes_per_s == 3.21e10
+    ent = fused_entries("cpu-host")["moe_dispatch"]
+    assert ent.dispatch_share_composed == 0.2
+    monkeypatch.setenv("PT_LINK_CALIBRATION", "0")
+    assert comm.link_model_for("cpu-host").ici_bytes_per_s != 3.21e10
+
+
+def test_calibrate_from_counters_reads_device_trace(monkeypatch):
+    """The XPlane op-table feed: collective device time + collective
+    byte counters refit the ICI link; a flops hint refits peak_flops."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.cost_model import comm
+
+    fake = {
+        "device_trace": {
+            "op_table": [
+                {"op": "all-reduce.1", "total_us": 2000.0},
+                {"op": "fusion.7", "total_us": 5000.0},
+            ],
+            "device_compute_us": {"per_step_avg": 7000.0},
+            "steps_correlated": 2,
+        },
+        "step_timeline": {"steps": 10},
+        "collectives": {"values": {"all_reduce|bytes": 8e7,
+                                   "all_reduce|calls": 4}},
+    }
+    monkeypatch.setattr(obs, "snapshot", lambda: fake)
+    lm = comm.calibrate_from_counters(comm.link_model_for("cpu-host"),
+                                      flops_per_step=7e9)
+    # cumulative bytes normalize over ALL 10 timeline steps; device time
+    # over the 2 captured steps: (8e7/10) / (2000us/2 per step)
+    assert lm.ici_bytes_per_s == pytest.approx((8e7 / 10) / 1e-3)
+    assert lm.peak_flops == pytest.approx(7e9 / 7e-3)

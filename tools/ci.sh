@@ -96,6 +96,60 @@ print("paged serving gate OK:", {k: pg[k] for k in
                                   "effective_tokens_per_step")})
 PY
 
+echo "== kernels gate (ISSUE-13: Pallas fused-op layer) =="
+# interpret-vs-composed parity (fwd + grad) for fused MoE dispatch,
+# RMSNorm+residual, RoPE and paged attention; registry/flag seam;
+# retrace-audited attention threshold; planner fused cost entries
+JAX_PLATFORMS=cpu python -m pytest tests/test_pallas_kernels.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+# the bench smoke's fused-vs-composed A/B rows (full rows in
+# bench_progress.json; the size-capped headline keeps the scalars)
+python - <<'PY' || exit 1
+import json
+last = json.loads([l for l in open("/tmp/_bench_smoke.log")
+                   if l.strip()][-1])
+assert "fused_kernels" in last["detail"], "fused_kernels headline row missing"
+prog = json.loads(open("bench_artifacts/bench_progress.json").read())
+fk = prog["fused_kernels"]
+for op in ("rms_norm", "rope"):                 # per-op A/B rows
+    row = fk[op]
+    assert row["composed_us"] > 0 and row["fused_us"] > 0, (op, row)
+# ISSUE-13 acceptance: fused MoE dispatch_share <= 0.08, parity pinned
+assert fk["dispatch_share_fused"] <= 0.08, fk["dispatch_share_fused"]
+assert fk["dispatch_parity_max_err"] < 1e-4, fk["dispatch_parity_max_err"]
+# paged decode: the fused seam is no worse than the gather path on CPU
+pd = fk.get("paged_decode")
+assert pd and pd["ratio"] <= 1.25, pd
+print("kernels gate OK:", {"dispatch_share_fused": fk["dispatch_share_fused"],
+                           "dispatch_share_index": fk["dispatch_share_index"],
+                           "parity_err": fk["dispatch_parity_max_err"],
+                           "rms_speedup": fk["rms_norm"]["speedup"],
+                           "rope_speedup": fk["rope"]["speedup"],
+                           "paged_ratio": pd["ratio"]})
+PY
+# the planner must re-rank or record cost deltas when fused entries are on
+JAX_PLATFORMS=cpu python - <<'PY' || exit 1
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+paddle.seed(0)
+m = LlamaForCausalLM(LlamaConfig.tiny())
+kw = dict(n_devices=8, hbm_bytes=9.5e9, batch=16, seq=64)
+off = dist.plan(m, fused_kernels=False, **kw)
+on = dist.plan(m, fused_kernels=True, **kw)
+by = {str(c.config): c.predicted_step_s for c in off}
+deltas = [by[str(c.config)] - c.predicted_step_s
+          for c in on if str(c.config) in by]
+assert sum(1 for d in deltas if d > 0) >= 1, "no fused cost delta recorded"
+assert on[0].breakdown.get("fused_gain_s", 0) > 0, on[0].breakdown
+reranked = [c.describe() for c in off[:10]] != [c.describe() for c in on[:10]]
+print("planner fused entries OK:", {
+    "configs_repriced": sum(1 for d in deltas if d > 0),
+    "top_reranked": reranked,
+    "top_gain_ms": round(on[0].breakdown["fused_gain_s"] * 1e3, 4)})
+PY
+
 echo "== observability gate (telemetry snapshot from the bench smoke) =="
 # the smoke above ran with PT_METRICS_PORT off; its per-recipe telemetry
 # dump must carry the unified-hub families, with real step-timeline and
