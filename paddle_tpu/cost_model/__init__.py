@@ -15,9 +15,10 @@ import time
 from typing import Dict, List, Optional
 
 from . import comm  # noqa: F401
+from . import embedding  # noqa: F401  (streamed-table traffic term)
 from .comm import LinkModel, link_model_for, calibrate_from_counters  # noqa: F401
 
-__all__ = ["CostModel", "comm", "LinkModel", "link_model_for",
+__all__ = ["CostModel", "comm", "embedding", "LinkModel", "link_model_for",
            "calibrate_from_counters"]
 
 

@@ -1,0 +1,66 @@
+"""Streamed-embedding traffic as a planner cost term.
+
+A ``sparse.ShardedEmbeddingTable`` moves its per-batch MISS rows over the
+host link every step (PR-5 ``StreamLane``), partially hidden behind
+compute by the cross-step prefetch. A candidate config that shrinks
+compute below the exposed miss-transfer time gains nothing from more
+chips — the planner must price the table traffic or it will keep ranking
+recsys configs by compute alone (the same argument that put the offload
+stream and the fused kernels into ``plan()``).
+
+The model: expected streamed bytes per step =
+``unique_ids_per_step * (1 - hit_rate) * dim * 4``, with ``hit_rate``
+taken from the table's LIVE counters once traffic has flowed (every
+bench round is a calibration round) and a conservative default before
+that. Exposed seconds = bytes / host link bandwidth x (1 - the link's
+measured hidden fraction) — the same shape as the offload term.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+__all__ = ["DEFAULT_MISS_RATE", "expected_stream_bytes", "embed_stream_s",
+           "table_rows"]
+
+#: before a table has served traffic, assume the zipf-ish default: the
+#: hot cache absorbs ~80% of unique rows (the bench acceptance floor)
+DEFAULT_MISS_RATE = 0.2
+
+
+def table_rows(model) -> List[Any]:
+    """The ShardedEmbeddingTables reachable from ``model`` (empty for
+    dense models — the term then prices to zero)."""
+    try:
+        from ..sparse.embedding import sparse_tables
+
+        return sparse_tables(model)
+    except Exception:
+        return []
+
+
+def expected_stream_bytes(model, batch: int, seq: int,
+                          miss_rate: Optional[float] = None) -> int:
+    """Expected per-step miss-row bytes across every sparse table in
+    ``model`` at (batch, seq) ids per step."""
+    total = 0
+    ids_per_step = max(int(batch), 1) * max(int(seq), 1)
+    for t in table_rows(model):
+        if miss_rate is None:
+            st = t.stats()
+            seen = st["hit_rows"] + st["miss_rows"]
+            mr = (1.0 - st["hit_rate"]) if seen else DEFAULT_MISS_RATE
+        else:
+            mr = float(miss_rate)
+        uniq = min(ids_per_step, int(t.num_rows))
+        total += int(uniq * mr * t.dim * 4)
+    return total
+
+
+def embed_stream_s(nbytes: int, link) -> float:
+    """Exposed seconds of miss-row streaming per step on ``link`` (the
+    prefetch hides ``host_hidden_frac`` of it, same as the offload
+    stream's model)."""
+    if nbytes <= 0:
+        return 0.0
+    return float(nbytes) / link.host_bytes_per_s * \
+        (1.0 - link.host_hidden_frac)

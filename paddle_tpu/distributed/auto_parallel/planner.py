@@ -75,13 +75,15 @@ class ModelProfile:
     flops_per_step: float         # fwd+bwd at (batch, seq), unsharded
     act_bytes: int                # live-range transient peak beyond
     # params+grads at (batch, seq), unsharded, no remat
+    embed_stream_bytes: int = 0   # expected per-step sparse-table miss
+    # traffic over the host link (cost_model.embedding; 0 = dense model)
     label: str = "model"
 
     def to_dict(self) -> Dict[str, Any]:
         return {k: getattr(self, k) for k in (
             "param_elems", "param_bytes", "num_heads", "num_kv_heads",
             "num_layers", "num_experts", "hidden", "batch", "seq",
-            "flops_per_step", "act_bytes", "label")}
+            "flops_per_step", "act_bytes", "embed_stream_bytes", "label")}
 
 
 def _default_loss_fn(model, *batch):
@@ -128,11 +130,23 @@ def _capture_fwd_bwd(model, loss_fn, batch_arrays):
 
         return jax.value_and_grad(loss_of)(tuple(param_arrays))
 
+    import contextlib
+
+    try:
+        # sparse tables: sanction tracer-ids lookups to trace as zeros
+        # for THIS capture only (the planner prices table traffic
+        # analytically via embed_stream_bytes; outside this context a
+        # traced lookup raises so exports can't bake zero embeddings)
+        from ...sparse.embedding import abstract_zero_lookups
+        zero_ok = abstract_zero_lookups
+    except Exception:  # pragma: no cover - mid-build partial package
+        zero_ok = contextlib.nullcontext
     gen = random_mod.default_generator()
     saved = gen.get_state()
     try:
-        closed = jax.make_jaxpr(fwd_bwd)(train_arrays, frozen_arrays,
-                                         *batch_arrays)
+        with zero_ok():
+            closed = jax.make_jaxpr(fwd_bwd)(train_arrays, frozen_arrays,
+                                             *batch_arrays)
     finally:
         gen.set_state(saved)
     return closed, train_arrays
@@ -166,9 +180,19 @@ def profile_model(model, batch: int = 8, seq: int = 128,
     # per-candidate independently of the weight terms
     act = max(int(est.peak_bytes) - 2 * param_bytes - batch_bytes,
               param_bytes // 8, 1)
+    # streamed sparse-table traffic (zero for dense models): the planner
+    # must price the miss-row stream or recsys candidates rank on
+    # compute alone (cost_model.embedding)
+    try:
+        from ...cost_model.embedding import expected_stream_bytes
+
+        embed_bytes = expected_stream_bytes(model, batch, seq)
+    except Exception:
+        embed_bytes = 0
     cfg = getattr(model, "config", None)
     return ModelProfile(
         param_elems=param_elems, param_bytes=param_bytes,
+        embed_stream_bytes=embed_bytes,
         dtype_size=max(param_bytes // max(param_elems, 1), 1),
         num_heads=int(getattr(cfg, "num_attention_heads", 0) or 0),
         num_kv_heads=int(getattr(cfg, "num_key_value_heads", 0) or 0),
@@ -498,9 +522,20 @@ def _predict_step_s(profile: ModelProfile, cfg: Dict[str, Any],
         off = moved / link.host_bytes_per_s * (1.0 - link.host_hidden_frac)
         dispatch += 4 * link.dispatch_s  # per-group host update walk
     total = compute + coll + dispatch + off + update_s
-    return total, {"compute_s": compute, "collective_s": coll,
-                   "dispatch_s": dispatch, "offload_s": off,
-                   "update_s": update_s, "bubble": bubble}
+    out = {"compute_s": compute, "collective_s": coll,
+           "dispatch_s": dispatch, "offload_s": off,
+           "update_s": update_s, "bubble": bubble}
+    if profile.embed_stream_bytes:
+        # sparse-table miss rows over the host link: the data axes shard
+        # the batch (each replica streams its own shard's unique ids);
+        # the cross-step prefetch hides the link's measured hidden frac
+        from ...cost_model.embedding import embed_stream_s
+
+        emb = embed_stream_s(profile.embed_stream_bytes / max(data, 1),
+                             link)
+        total += emb
+        out["embed_stream_s"] = emb
+    return total, out
 
 
 def _opt_words(optimizer) -> float:

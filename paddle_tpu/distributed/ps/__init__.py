@@ -31,7 +31,7 @@ from ..store import TCPStore
 from .graph_table import GraphTable  # noqa: F401
 
 __all__ = ["ParameterServer", "PsTrainer", "SparseEmbedding",
-           "AsyncCommunicator", "GraphTable"]
+           "AsyncCommunicator", "GraphTable", "PsShardSource"]
 
 
 def _dumps(arr: np.ndarray) -> bytes:
@@ -383,6 +383,45 @@ class AsyncCommunicator:
         self.flush()
         self._stop.set()
         self._thread.join(timeout=5)
+
+
+class PsShardSource:
+    """The PS wiring of ``sparse.ShardedEmbeddingTable``: canonical rows
+    live in a ParameterServer gang instead of in-process numpy — the
+    table's hot-row cache, streaming and dedup front the SAME pull/push
+    wire protocol ``SparseEmbedding`` uses, so a multi-process PS cluster
+    (launch/process.py gangs) serves tables beyond one host's RAM.
+
+    The SERVER owns the update policy (its ``lr`` / accessor — the
+    reference contract: trainers push raw row gradients); the table's
+    local row rule is ignored on this source. ``apply`` pushes the
+    accumulated (unique_ids, grads) pairs and pulls the post-update rows
+    back so the device cache stays coherent with the authoritative
+    shards."""
+
+    def __init__(self, trainer: "PsTrainer", table: str, rows: int,
+                 dim: int):
+        self.trainer = trainer
+        self.table = table
+        self.rows, self.dim = int(rows), int(dim)
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, "int64")
+        if not len(ids):
+            return np.zeros((0, self.dim), "float32")
+        return self.trainer.pull(self.table, ids)
+
+    def apply(self, ids: np.ndarray, grads: np.ndarray, rule) -> np.ndarray:
+        ids = np.asarray(ids, "int64")
+        if not len(ids):
+            return np.zeros((0, self.dim), "float32")
+        # wait=True: the pull below must observe the applied update
+        self.trainer.push(self.table, ids, np.asarray(grads, "float32"),
+                          wait=True)
+        return self.trainer.pull(self.table, ids)
+
+    def nbytes(self) -> int:
+        return 0  # rows live server-side, not in this process
 
 
 class SparseEmbedding:

@@ -148,6 +148,21 @@ define_flag("flash_min_seq", 128,
             "kernel's block pipeline has nothing to hide). The chosen "
             "path is a primitive attr, so the analysis.retrace auditor "
             "names any threshold-driven flip.")
+define_flag("embedding_oov_policy", "error",
+            "F.embedding out-of-vocabulary id policy: 'error' (default) "
+            "raises on concrete eager ids outside [0, num_rows) — inside "
+            "a traced program ids are abstract and keep XLA's clamped "
+            "gather, documented; 'clip' opts into the silent clamp "
+            "everywhere (the pre-PR-14 jnp.take behavior). Per-call "
+            "override via F.embedding(..., oov_policy=).")
+define_flag("sparse_embedding_min_rows", 16384,
+            "nn.Embedding(sparse=True) routes to the host-sharded "
+            "ShardedEmbeddingTable (dedup lookup, hot-row device cache, "
+            "sparse row grads) only at/above this row count; smaller "
+            "tables keep the dense device parameter — the documented "
+            "dense fallback (a table that fits HBM gains nothing from "
+            "host residency, and dense grads keep it inside compiled "
+            "train steps).")
 define_flag("matmul_precision", "default",
             "XLA matmul/conv precision: 'default' (bf16 mantissas on the "
             "MXU), 'high', or 'highest' (full f32 — use for parity "

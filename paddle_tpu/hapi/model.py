@@ -130,6 +130,18 @@ class Model:
         losses = to_list(self._loss(*(to_list(outputs) + labels)))
         return losses
 
+    def _sparse_tables(self):
+        """ShardedEmbeddingTables behind the network's sparse Embedding
+        layers (cached per network — the layer-tree walk is not a
+        per-step cost)."""
+        cached = getattr(self, "_sparse_tables_cache", None)
+        if cached is None or cached[0] is not self.network:
+            from ..sparse.embedding import sparse_tables
+
+            cached = (self.network, sparse_tables(self.network))
+            self._sparse_tables_cache = cached
+        return cached[1]
+
     def train_batch(self, inputs, labels=None, update=True, _loss_scale=1.0):
         tl = _timeline()
         self.network.train()
@@ -148,6 +160,12 @@ class Model:
                 (total * _loss_scale).backward()
             else:
                 total.backward()
+            # sparse embedding tables: harvest the (unique_ids, rows)
+            # gradients every micro-step (the leaves are per-forward);
+            # the host row update applies at the SAME boundary as the
+            # dense optimizer step, so accumulate(k) composes
+            for t in self._sparse_tables():
+                t.flush(update=update)
             if update and self._optimizer is not None:
                 self._optimizer.step()
                 self._optimizer.clear_grad()
@@ -219,7 +237,26 @@ class Model:
     # -- checkpoint ----------------------------------------------------------
     def save(self, path, training=True):
         """Save `<path>.pdparams` (+ `.pdopt` when training). For deployment
-        (training=False) export the traced program via paddle_tpu.jit.save."""
+        (training=False) export the traced program via paddle_tpu.jit.save.
+
+        Sparse embedding tables are NOT in ``state_dict()`` (their
+        canonical rows are host-resident, not Parameters): they save
+        alongside as ``<path>.sparse.<table>.npz`` so a plain ``save``
+        never silently drops learned embeddings; ``load`` restores
+        them."""
+        if training:
+            for t in self._sparse_tables():
+                try:
+                    t.save(f"{path}.sparse.{t.name}")
+                except NotImplementedError:
+                    import warnings
+
+                    warnings.warn(
+                        f"Model.save: sparse table {t.name!r} is not "
+                        f"LocalShards-backed — its rows are NOT in this "
+                        f"checkpoint (a PsShardSource table's authority "
+                        f"is the server gang)", RuntimeWarning,
+                        stacklevel=2)
         if not training:
             from .. import jit
 
@@ -242,6 +279,20 @@ class Model:
         if not reset_optimizer and self._optimizer is not None \
                 and os.path.exists(path + ".pdopt"):
             self._optimizer.set_state_dict(fio.load(path + ".pdopt"))
+        for t in self._sparse_tables():
+            sp = f"{path}.sparse.{t.name}.npz"
+            if os.path.exists(sp):
+                t.load(sp)
+            else:
+                # never silent: a renamed/auto-numbered table would
+                # otherwise keep its fresh random rows after a "load"
+                import warnings
+
+                warnings.warn(
+                    f"Model.load: no sparse-table checkpoint at {sp!r} — "
+                    f"table {t.name!r} keeps its current rows (tables "
+                    f"are matched by NAME; give tables stable name= "
+                    f"values)", RuntimeWarning, stacklevel=2)
         return self
 
     # -- loops ---------------------------------------------------------------
@@ -519,6 +570,8 @@ class Model:
 
                         if self._optimizer is not None:
                             self._optimizer.clear_grad()
+                        for t in self._sparse_tables():
+                            t.clear_pending()
                         nan_window = accumulate_grad_batches > 1 and not update
                         pending = False
                         from ..distributed.resilience import metrics as _rm
@@ -539,6 +592,8 @@ class Model:
                         # partial remainder instead of stepping on it
                         if self._optimizer is not None:
                             self._optimizer.clear_grad()
+                        for t in self._sparse_tables():
+                            t.clear_pending()
                         nan_window = False
                         pending = False
                         stepped = False
@@ -600,13 +655,19 @@ class Model:
                                        "epoch_rng": ckpt_ctx.get("epoch_rng")})
                             ckpt_ctx["last_save"] = gs
             step += 1
-        if nan_window and self._optimizer is not None:
+        if nan_window:
             # epoch ended inside a poisoned window: drop its remainder
-            self._optimizer.clear_grad()
-        if pending and self._optimizer is not None:
+            if self._optimizer is not None:
+                self._optimizer.clear_grad()
+            for t in self._sparse_tables():
+                t.clear_pending()
+        if pending:
             # flush the trailing partial accumulation group
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            if self._optimizer is not None:
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+            for t in self._sparse_tables():
+                t.flush(update=True)
         for m in self._metrics:
             res = m.accumulate()
             for n, v in zip(to_list(m.name()), to_list(res)):

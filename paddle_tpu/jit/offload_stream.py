@@ -489,6 +489,19 @@ class StreamLane:
             # even if the telemetry above throws on this worker thread
             handle._event.set()
 
+    def submit_rows(self, rows, placement=None, kind: str = "h2d",
+                    tag=None, names=None) -> "RowStreamHandle":
+        """Generic row-stream API: move ONE ``[n, dim]`` row block through
+        the lane (default h2d — host-gathered embedding/feature rows up to
+        the device). Same overlap/backpressure/retry/telemetry contract
+        as the group transfers; the sparse embedding path
+        (``sparse.embedding.ShardedEmbeddingTable``) is the flagship
+        consumer, streaming per-batch miss rows and prefetching the next
+        batch's while the current step computes."""
+        handle = self.submit(kind, [rows], [placement], tag=tag,
+                             names=names)
+        return RowStreamHandle(handle)
+
     def _note_stall(self, ms: float):
         with self._lock:
             self._stats["stall_ms"] += ms
@@ -534,6 +547,29 @@ class StreamLane:
             self.close()
         except Exception:
             pass
+
+
+class RowStreamHandle:
+    """One in-flight row-block transfer (``StreamLane.submit_rows``)."""
+
+    __slots__ = ("_handle",)
+
+    def __init__(self, handle: _TransferHandle):
+        self._handle = handle
+
+    def done(self) -> bool:
+        return self._handle.done()
+
+    def rows(self):
+        """The landed device rows (blocks; consumer wait charged to the
+        lane's ``stall_ms``)."""
+        return self._handle.wait()[0]
+
+    def rows_dispatched(self):
+        """The rows as soon as the transfer is ISSUED (jax futures) — the
+        cross-step fill variant; a post-issue failure surfaces at the
+        next lane interaction (PR-6 sticky contract)."""
+        return self._handle.wait_dispatched()[0]
 
 
 @contextlib.contextmanager

@@ -33,7 +33,9 @@ def linear(x, weight, bias=None, name=None):
 
 
 @primitive("embedding_op")
-def _embedding(w, ids, *, padding_idx):
+def _embedding(w, ids, *, padding_idx, oov=None):
+    if oov == "clip":
+        ids = jnp.clip(ids, 0, w.shape[0] - 1)
     out = jnp.take(w, ids, axis=0)
     if padding_idx is not None:
         mask = (ids == padding_idx)[..., None]
@@ -42,16 +44,60 @@ def _embedding(w, ids, *, padding_idx):
 
 
 @_embedding.defvjp
-def _embedding_vjp(ct, out, primals, *, padding_idx):
+def _embedding_vjp(ct, out, primals, *, padding_idx, oov=None):
     w, ids = primals
+    if oov == "clip":
+        ids = jnp.clip(ids, 0, w.shape[0] - 1)
     if padding_idx is not None:
         ct = jnp.where((ids == padding_idx)[..., None], 0.0, ct)
     gw = jnp.zeros_like(w).at[ids].add(ct.astype(w.dtype))
     return (gw, None)
 
 
-def embedding(x, weight, padding_idx=None, sparse=False, name=None):
-    return _embedding(weight, x, padding_idx=padding_idx)
+def embedding(x, weight, padding_idx=None, sparse=False, name=None,
+              oov_policy=None):
+    """Row lookup with an EXPLICIT out-of-vocabulary policy.
+
+    ``jnp.take`` clamps out-of-range ids silently — a recsys id stream
+    with a hashing bug would train on row 0/row n-1 garbage without a
+    peep. Policy (``FLAGS_embedding_oov_policy`` default, per-call
+    override): ``'error'`` raises on concrete eager ids outside
+    ``[0, num_rows)`` (inside a traced program ids are abstract — the
+    check cannot run and the clamped gather remains, documented);
+    ``'clip'`` opts into the clamp everywhere and makes it part of the
+    op's cache key (the attr rides the jit key, so flipping policies
+    retraces auditable)."""
+    from ...framework import flags as _flags
+
+    policy = oov_policy or _flags.flag("embedding_oov_policy")
+    if policy not in ("error", "clip"):
+        raise ValueError(
+            f"embedding oov_policy must be 'error' or 'clip', got "
+            f"{policy!r}")
+    if policy == "error":
+        ids = x.data if isinstance(x, Tensor) else x
+        if not isinstance(ids, jax.core.Tracer):
+            if not isinstance(ids, jax.Array):
+                ids = np.asarray(ids)  # lists/scalars are checkable too
+        if not isinstance(ids, jax.core.Tracer) and \
+                getattr(ids, "size", 0):
+            n = int((weight.data if isinstance(weight, Tensor)
+                     else weight).shape[0])
+            if isinstance(ids, np.ndarray):
+                # host ids validate host-side (no H2D round-trip)
+                lo, hi = int(ids.min()), int(ids.max())
+            else:
+                # ONE blocking readback for both bounds, not two
+                lo, hi = (int(v) for v in np.asarray(
+                    jnp.stack([jnp.min(ids), jnp.max(ids)])))
+            if lo < 0 or hi >= n:
+                raise ValueError(
+                    f"embedding: id out of range [0, {n}) "
+                    f"(min={lo}, max={hi}); pass oov_policy='clip' or set "
+                    f"FLAGS_embedding_oov_policy='clip' for the clamped "
+                    f"legacy behavior")
+    return _embedding(weight, x, padding_idx=padding_idx,
+                      oov=("clip" if policy == "clip" else None))
 
 
 @primitive("dropout_op")

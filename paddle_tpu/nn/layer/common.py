@@ -30,21 +30,59 @@ class Linear(Layer):
 
 
 class Embedding(Layer):
+    """``sparse=True`` routes large tables to the host-sharded
+    ``sparse.ShardedEmbeddingTable`` (dedup lookup, device hot-row cache,
+    streamed misses, sparse (unique_ids, rows) gradients applied by the
+    table's own row rule — no dense gradient, no dense Parameter in the
+    optimizer). Tables below ``FLAGS_sparse_embedding_min_rows`` keep the
+    dense device parameter — the documented fallback: a table that fits
+    HBM gains nothing from host residency and dense grads keep it usable
+    inside compiled train steps. ``sparse_table=`` attaches a pre-built
+    table (cache size, shard count, row rule all caller-controlled)."""
+
     def __init__(self, num_embeddings, embedding_dim, padding_idx=None, sparse=False,
-                 weight_attr=None, name=None):
+                 weight_attr=None, name=None, sparse_table=None):
         super().__init__()
         self._num_embeddings = num_embeddings
         self._embedding_dim = embedding_dim
         self._padding_idx = padding_idx
-        self.weight = self.create_parameter(
-            [num_embeddings, embedding_dim], attr=weight_attr,
-            default_initializer=I.Normal(0.0, 1.0))
+        self._sparse = bool(sparse)
+        self._table = None
+        if sparse_table is not None:
+            self._table = sparse_table
+        elif sparse:
+            from ...framework import flags as _flags
+
+            if num_embeddings >= _flags.flag("sparse_embedding_min_rows"):
+                from ...sparse.embedding import ShardedEmbeddingTable
+
+                self._table = ShardedEmbeddingTable(
+                    num_embeddings, embedding_dim,
+                    cache_rows=max(1024, num_embeddings // 16),
+                    name=name)
+        if self._table is not None:
+            if (self._table.num_rows != num_embeddings
+                    or self._table.dim != embedding_dim):
+                raise ValueError(
+                    f"sparse_table shape ({self._table.num_rows}, "
+                    f"{self._table.dim}) != Embedding ({num_embeddings}, "
+                    f"{embedding_dim})")
+            self.weight = None  # canonical rows are the table's host shards
+            self._parameters["weight"] = None
+        else:
+            self.weight = self.create_parameter(
+                [num_embeddings, embedding_dim], attr=weight_attr,
+                default_initializer=I.Normal(0.0, 1.0))
 
     def forward(self, x):
-        return F.embedding(x, self.weight, padding_idx=self._padding_idx)
+        if self._table is not None:
+            return self._table.lookup(x, padding_idx=self._padding_idx)
+        return F.embedding(x, self.weight, padding_idx=self._padding_idx,
+                           sparse=self._sparse)
 
     def extra_repr(self):
-        return f"{self._num_embeddings}, {self._embedding_dim}"
+        tail = ", sparse_table" if self._table is not None else ""
+        return f"{self._num_embeddings}, {self._embedding_dim}{tail}"
 
 
 class Dropout(Layer):

@@ -87,6 +87,10 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             lines.append(_offload_stream_table(body))
             lines.append("")
             continue
+        if fam == "embedding_stream" and isinstance(body, dict):
+            lines.append(_embedding_stream_table(body))
+            lines.append("")
+            continue
         if fam == "device_trace" and isinstance(body, dict) \
                 and body.get("op_table"):
             lines.append(_device_trace_table(body))
@@ -163,6 +167,31 @@ def _offload_stream_table(body: Dict[str, Any]) -> str:
         lines.append(f"  {'hidden_ms':<24} {round(hidden, 3)}")
         lines.append(f"  {'overlap_efficiency':<24} {round(hidden / t, 4)}")
     return "\n".join(lines) if lines else "  (no transfers yet)"
+
+
+def _embedding_stream_table(body: Dict[str, Any]) -> str:
+    """Sparse-table lookup family with the derived rates pd_top shows:
+    hit_rate = hit_rows / (hit + miss), streamed MB, and the serving-side
+    hit rate when the table also serves lookups."""
+    vals = body.get("values", body) or {}
+    lines = []
+    for key in sorted(vals):
+        v = vals[key]
+        lines.append(f"  {key:<24} "
+                     f"{round(v, 3) if isinstance(v, float) else v}")
+    hits = float(vals.get("hit_rows", 0) or 0)
+    miss = float(vals.get("miss_rows", 0) or 0)
+    if hits + miss > 0:
+        lines.append(f"  {'hit_rate':<24} {round(hits / (hits + miss), 4)}")
+    sh = float(vals.get("serve_hit_rows", 0) or 0)
+    sm = float(vals.get("serve_miss_rows", 0) or 0)
+    if sh + sm > 0:
+        lines.append(f"  {'serve_hit_rate':<24} "
+                     f"{round(sh / (sh + sm), 4)}")
+    sb = float(vals.get("streamed_bytes", 0) or 0)
+    if sb:
+        lines.append(f"  {'streamed_mb':<24} {round(sb / 1e6, 3)}")
+    return "\n".join(lines) if lines else "  (no lookups yet)"
 
 
 def _histogram_table(body: Dict[str, Any]) -> str:

@@ -4,3 +4,6 @@ from .optimizer import (  # noqa: F401
     Optimizer, SGD, Momentum, Adagrad, Adam, AdamW, Adamax, RMSProp, Lamb,
     LarsMomentum, Adafactor, Adadelta,
 )
+from .sparse import (  # noqa: F401  (host-side sparse row rules)
+    SparseRowAdagrad, SparseRowAdam, SparseRowRule, SparseRowSGD,
+)

@@ -1,0 +1,132 @@
+"""Sparse per-row update rules for host-resident embedding shards.
+
+Reference: paddle/fluid/operators/optimizers/{sparse_sgd, adagrad, adam}
+lazy-mode kernels + distributed/ps/table sgd rules — the PS applies an
+optimizer step to exactly the rows a batch touched, never materializing a
+dense gradient or walking untouched state.
+
+TPU-native stance: the canonical storage of a ``ShardedEmbeddingTable``
+(sparse/embedding.py) is HOST memory (numpy), so the row update is a pure
+numpy function over the gathered rows — ``(rows, grads, state_rows) ->
+(new_rows, new_state_rows)``. The table gathers the touched rows + their
+state slices from the owning shard, applies the rule ONCE per unique row
+(duplicate ids are pre-accumulated by the caller), and scatters the
+results back. The same rule instance updates the device hot-row cache in
+place (the freshly-computed rows are uploaded), so host and cache never
+diverge.
+
+Rules mirror the dense ``optimizer.Optimizer._rule`` math restricted to
+touched rows — for SGD/Momentum/Adagrad/Adam the dense update of an
+untouched row is exactly zero (g=0 ⇒ no param change), so a sparse-rows
+run is bit-equal to the dense run on the touched set and trivially equal
+elsewhere. Adam is the deliberate exception: bias correction uses a
+PER-ROW step count (the row's own update count), the standard lazy-Adam
+semantics — a dense Adam would also decay untouched moments, which a
+row-sparse table cannot (and should not: rows seen once a day would have
+their moments flushed to zero by the decay).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+__all__ = ["SparseRowRule", "SparseRowSGD", "SparseRowAdagrad",
+           "SparseRowAdam", "make_row_rule"]
+
+
+class SparseRowRule:
+    """One row-wise update policy: owns the per-row state layout
+    (``state_slots``: name -> per-row width, dim-wide slots use the
+    embedding dim) and the pure update ``apply``."""
+
+    #: name -> columns per row ("dim" means the embedding width)
+    state_slots: Dict[str, str] = {}
+
+    def __init__(self, lr: float = 0.01):
+        self.lr = float(lr)
+
+    def init_state(self, n_rows: int, dim: int) -> Dict[str, np.ndarray]:
+        out = {}
+        for name, width in self.state_slots.items():
+            w = dim if width == "dim" else int(width)
+            out[name] = np.zeros((n_rows, w), np.float32)
+        return out
+
+    def apply(self, rows: np.ndarray, grads: np.ndarray,
+              state: Dict[str, np.ndarray]
+              ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+        """Pure float32 numpy update over the touched rows only."""
+        raise NotImplementedError
+
+
+class SparseRowSGD(SparseRowRule):
+    """Plain row SGD (reference sparse_sgd lazy kernel)."""
+
+    state_slots: Dict[str, str] = {}
+
+    def apply(self, rows, grads, state):
+        return rows - self.lr * grads, state
+
+
+class SparseRowAdagrad(SparseRowRule):
+    """Row Adagrad (reference adagrad lazy kernel + the PS sparse-table
+    default): per-row second-moment accumulator, touched rows only."""
+
+    state_slots = {"moment": "dim"}
+
+    def __init__(self, lr: float = 0.01, epsilon: float = 1e-6,
+                 initial_accumulator_value: float = 0.0):
+        super().__init__(lr)
+        self.eps = float(epsilon)
+        self.init_val = float(initial_accumulator_value)
+
+    def init_state(self, n_rows, dim):
+        st = super().init_state(n_rows, dim)
+        if self.init_val:
+            st["moment"] += self.init_val
+        return st
+
+    def apply(self, rows, grads, state):
+        m = state["moment"] + grads * grads
+        new = rows - self.lr * grads / (np.sqrt(m) + self.eps)
+        return new, {"moment": m}
+
+
+class SparseRowAdam(SparseRowRule):
+    """Lazy Adam over rows: moments and the bias-correction step count
+    advance only when a row is touched (its own update count rides a
+    1-wide state slot)."""
+
+    state_slots = {"moment1": "dim", "moment2": "dim", "count": "1"}
+
+    def __init__(self, lr: float = 0.001, beta1: float = 0.9,
+                 beta2: float = 0.999, epsilon: float = 1e-8):
+        super().__init__(lr)
+        self.b1, self.b2, self.eps = float(beta1), float(beta2), float(epsilon)
+
+    def apply(self, rows, grads, state):
+        t = state["count"] + 1.0
+        m = self.b1 * state["moment1"] + (1 - self.b1) * grads
+        v = self.b2 * state["moment2"] + (1 - self.b2) * grads * grads
+        mhat = m / (1 - np.power(self.b1, t))
+        vhat = v / (1 - np.power(self.b2, t))
+        new = rows - self.lr * mhat / (np.sqrt(vhat) + self.eps)
+        return new, {"moment1": m, "moment2": v, "count": t}
+
+
+_RULES = {"sgd": SparseRowSGD, "adagrad": SparseRowAdagrad,
+          "adam": SparseRowAdam}
+
+
+def make_row_rule(spec, **kw) -> SparseRowRule:
+    """'sgd' | 'adagrad' | 'adam' | a SparseRowRule instance."""
+    if isinstance(spec, SparseRowRule):
+        return spec
+    try:
+        cls = _RULES[str(spec).lower()]
+    except KeyError:
+        raise ValueError(
+            f"unknown sparse row rule {spec!r}; known: {sorted(_RULES)} "
+            "(or pass a SparseRowRule instance)")
+    return cls(**kw)

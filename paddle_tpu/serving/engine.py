@@ -196,6 +196,21 @@ class ServingEngine(EngineBase):
 
         donate = self.config.donate_inputs and jax.default_backend() != "cpu"
 
+        build_native = getattr(target, "build_serving_runner", None)
+        if build_native is not None:
+            # engine-native target (e.g. sparse.EmbeddingLookupTarget):
+            # the TARGET builds the per-bucket runner — host-side work
+            # (dedup/routing) around its own warmed fixed-shape
+            # executables, which a plain jitted-callable target cannot
+            # express. The engine still owns buckets/padding/coalescing,
+            # and the runner is audit-wrapped under the engine label so
+            # the zero-retrace contract stays checkable.
+            def build(bucket_b, key):
+                label = self._label(bucket_b, key)
+                return jit_mod._maybe_audit(
+                    label, build_native(bucket_b, key, label=label))
+            return build
+
         pred_layer = getattr(target, "_layer", None)
         if pred_layer is not None and hasattr(target, "run"):  # Predictor
             def build(bucket_b, key):

@@ -13,9 +13,17 @@ import numpy as np
 from jax.experimental import sparse as jsparse
 
 from ..core.tensor import Tensor
+from .embedding import (  # noqa: F401
+    EmbeddingLookupTarget, HotRowCache, LocalShards, LookupReplica,
+    ShardedEmbeddingTable, clear_sparse_pending, flush_sparse_layers,
+    sparse_tables, zipf_ids,
+)
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "matmul", "add", "relu", "is_sparse_coo"]
+           "matmul", "add", "relu", "is_sparse_coo",
+           "ShardedEmbeddingTable", "LocalShards", "HotRowCache",
+           "EmbeddingLookupTarget", "LookupReplica", "flush_sparse_layers",
+           "clear_sparse_pending", "sparse_tables", "zipf_ids"]
 
 
 class SparseCooTensor:

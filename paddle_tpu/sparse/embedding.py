@@ -1,0 +1,967 @@
+"""Giant streamed embedding tables: host-sharded canonical storage with a
+device hot-row cache (ROADMAP direction 3 — the sparse recsys workload).
+
+Reference: paddle/fluid/distributed/ps/table/memory_sparse_table.cc +
+ssd_sparse_table.h (two-tier sparse tables with an LRU hot tier) and
+the_one_ps.py's DistributedLookupTable front end. TPU-native mapping:
+
+- **canonical rows live on the HOST** (numpy shards, row ``r`` owned by
+  shard ``r % n_shards`` — the PS key-hash convention), so table capacity
+  is bound by host RAM, not HBM;
+- a fixed-capacity **device hot-row cache** fronts the shards: admission
+  is frequency-based (ghost counters — a row must prove itself before it
+  earns a slot, the TinyLFU idea), eviction is LRU among cold rows;
+- a training lookup dedups the batch (``np.unique`` + inverse), serves
+  hits from the cache as ONE gather, and streams only the miss rows up
+  through the PR-5 ``StreamLane`` — ``prefetch(next_ids)`` starts the
+  next batch's miss fetch while the current step computes, so steady
+  state approaches max(compute, miss-transfer);
+- gradients come back as (unique_ids, rows) pairs: the host applies a
+  **sparse row update** (optimizer.sparse rules — Adagrad by default) to
+  the owning shard via scatter-add, never materializing a dense
+  gradient, and cached rows are refreshed in place on device so the
+  cache never diverges from the shards;
+- a serving view (``serving_target()``) exposes the same table through
+  ``ServingEngine`` as warmed fixed-shape lookup executables
+  (miss-capacity buckets), zero-retrace in steady state.
+
+Telemetry rides the ``embedding_stream`` hub family (hit/miss rows,
+streamed bytes, stall ms, admissions/evictions) and the hot cache's bytes
+register as a PR-8 memory component, so OOM forensics name it.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core import autograd
+from ..core.tensor import Tensor
+from ..optimizer.sparse import SparseRowRule, make_row_rule
+
+__all__ = ["ShardedEmbeddingTable", "LocalShards", "HotRowCache",
+           "EmbeddingLookupTarget", "LookupReplica", "zipf_ids",
+           "flush_sparse_layers", "clear_sparse_pending", "sparse_tables"]
+
+_TABLE_NO = itertools.count(1)
+
+_FAM = None  # lazily-bound "embedding_stream" counter family
+
+
+def _fam():
+    global _FAM
+    if _FAM is None:
+        from ..observability import family
+
+        _FAM = family("embedding_stream", ("metric",))
+    return _FAM
+
+
+_ABSTRACT_ZERO_OK = [False]
+
+
+@contextlib.contextmanager
+def abstract_zero_lookups():
+    """Sanction tracer-ids lookups to return shape-correct ZEROS for the
+    duration — the planner's abstract fwd+bwd capture uses this (it
+    prices table traffic analytically); everywhere else a traced lookup
+    raises so an exported program can never silently carry zero
+    embeddings."""
+    _ABSTRACT_ZERO_OK.append(True)
+    try:
+        yield
+    finally:
+        _ABSTRACT_ZERO_OK.pop()
+
+
+def _bucket(n: int, lo: int = 8) -> int:
+    """Next power-of-two >= max(n, lo): the shape-bucket contract that
+    keeps the eager combine executables to a closed family instead of one
+    XLA compile per distinct unique-id count."""
+    b = lo
+    while b < n:
+        b <<= 1
+    return b
+
+
+def zipf_ids(n: int, rows: int, a: float = 1.2, seed: int = 0,
+             shuffle_rows: bool = True) -> np.ndarray:
+    """A deterministic zipf-distributed id stream over ``[0, rows)`` — the
+    canonical recsys access pattern (a small hot set carries most of the
+    traffic). ``shuffle_rows`` permutes which rows are hot so the hot set
+    is not just the low ids (exercises the hash-sharded layout)."""
+    rng = np.random.RandomState(seed)
+    raw = rng.zipf(float(a), size=int(n))
+    ids = (raw - 1) % int(rows)
+    if shuffle_rows:
+        perm = np.random.RandomState(seed + 1).permutation(int(rows))
+        ids = perm[ids]
+    return ids.astype(np.int64)
+
+
+# ---------------------------------------------------------------------------
+# canonical host storage
+# ---------------------------------------------------------------------------
+
+class LocalShards:
+    """In-process host shards: row ``r`` lives in shard ``r % n_shards``
+    at local index ``r // n_shards`` (the PS routing convention). All
+    shards draw from ONE full-table RNG stream in bounded blocks, so the
+    sharded init equals the single-shard init row-for-row and peak init
+    memory is O(block)."""
+
+    def __init__(self, rows: int, dim: int, n_shards: int = 1,
+                 seed: int = 0, init_std: float = 0.01):
+        self.rows, self.dim = int(rows), int(dim)
+        self.n_shards = max(int(n_shards), 1)
+        self.shards: List[np.ndarray] = []
+        rng = np.random.RandomState(seed)
+        block = max(1, min(self.rows, (1 << 22) // max(self.dim, 1)))
+        for s in range(self.n_shards):
+            n_own = len(range(s, self.rows, self.n_shards))
+            self.shards.append(np.empty((n_own, self.dim), np.float32))
+        outs = [0] * self.n_shards
+        for start in range(0, self.rows, block):
+            stop = min(start + block, self.rows)
+            chunk = (rng.randn(stop - start, self.dim) *
+                     float(init_std)).astype(np.float32)
+            for s in range(self.n_shards):
+                first = (s - start) % self.n_shards
+                mine = chunk[first::self.n_shards]
+                self.shards[s][outs[s]:outs[s] + len(mine)] = mine
+                outs[s] += len(mine)
+        self._state: List[Optional[Dict[str, np.ndarray]]] = \
+            [None] * self.n_shards
+
+    def _route(self, ids: np.ndarray):
+        owner = ids % self.n_shards
+        local = ids // self.n_shards
+        return owner, local
+
+    def gather(self, ids: np.ndarray) -> np.ndarray:
+        ids = np.asarray(ids, np.int64)
+        if self.n_shards == 1:
+            return self.shards[0][ids].copy()
+        owner, local = self._route(ids)
+        out = np.empty((len(ids), self.dim), np.float32)
+        for s in range(self.n_shards):
+            mask = owner == s
+            if mask.any():
+                out[mask] = self.shards[s][local[mask]]
+        return out
+
+    def apply(self, ids: np.ndarray, grads: np.ndarray,
+              rule: SparseRowRule) -> np.ndarray:
+        """Sparse row update on the owning shards (``ids`` pre-deduped,
+        ``grads`` pre-accumulated per unique id). Returns the POST-update
+        rows in ``ids`` order so the caller can refresh its cache."""
+        ids = np.asarray(ids, np.int64)
+        grads = np.asarray(grads, np.float32)
+        out = np.empty((len(ids), self.dim), np.float32)
+        owner, local = self._route(ids)
+        for s in range(self.n_shards):
+            mask = owner == s
+            if not mask.any():
+                continue
+            li = local[mask]
+            if self._state[s] is None and rule.state_slots:
+                self._state[s] = rule.init_state(len(self.shards[s]),
+                                                 self.dim)
+            st_full = self._state[s] or {}
+            st = {k: v[li] for k, v in st_full.items()}
+            new_rows, new_st = rule.apply(self.shards[s][li], grads[mask],
+                                          st)
+            self.shards[s][li] = new_rows
+            for k, v in new_st.items():
+                st_full[k][li] = v
+            out[mask] = new_rows
+        return out
+
+    def nbytes(self) -> int:
+        return sum(int(sh.nbytes) for sh in self.shards) + sum(
+            int(v.nbytes) for st in self._state if st for v in st.values())
+
+
+# ---------------------------------------------------------------------------
+# device hot-row cache
+# ---------------------------------------------------------------------------
+
+class HotRowCache:
+    """Fixed-capacity device row cache with frequency-based admission.
+
+    - ``ghost`` counters track access frequency for rows NOT in the cache
+      (the ghost list of ARC/TinyLFU): a missed row is only admitted once
+      it has been seen ``admit_threshold`` times, so one-off ids never
+      evict a proven-hot row. The counter table is bounded; overflow ages
+      every count by half and drops zeros — deterministic for a seeded
+      stream.
+    - eviction is LRU among rows NOT referenced by the current batch.
+
+    All bookkeeping is host-side python/numpy; the device side is one
+    ``[capacity, dim]`` array written with one batched scatter per
+    admission set and one per in-place update set.
+    """
+
+    def __init__(self, capacity: int, dim: int, admit_threshold: int = 2,
+                 ghost_cap: Optional[int] = None):
+        self.capacity = int(capacity)
+        self.dim = int(dim)
+        self.admit_threshold = max(int(admit_threshold), 1)
+        self.ghost_cap = int(ghost_cap or max(8 * self.capacity, 1024))
+        self.dev = jnp.zeros((self.capacity, self.dim), jnp.float32)
+        self._scatter_fns: Dict[int, Any] = {}
+        self._slot: Dict[int, int] = {}           # id -> slot
+        self._lru: "OrderedDict[int, int]" = OrderedDict()  # id -> slot
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._ghost: Dict[int, int] = {}
+        self.admissions = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._slot)
+
+    def nbytes(self) -> int:
+        return int(self.dev.nbytes)
+
+    def slots_of(self, ids: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray]:
+        """(hit_mask, slots) for ``ids``; slots valid where hit_mask."""
+        hit = np.zeros(len(ids), bool)
+        slots = np.zeros(len(ids), np.int32)
+        for i, r in enumerate(ids):
+            s = self._slot.get(int(r))
+            if s is not None:
+                hit[i] = True
+                slots[i] = s
+        return hit, slots
+
+    def touch(self, ids) -> None:
+        for r in ids:
+            r = int(r)
+            if r in self._lru:
+                self._lru.move_to_end(r)
+
+    def note_access(self, ids) -> None:
+        """Bump ghost counters (admission evidence) for every accessed id
+        not currently cached; bounded with halving decay."""
+        for r in ids:
+            r = int(r)
+            if r in self._slot:
+                continue
+            self._ghost[r] = self._ghost.get(r, 0) + 1
+        if len(self._ghost) > self.ghost_cap:
+            self._ghost = {k: v // 2 for k, v in self._ghost.items()
+                           if v // 2 > 0}
+
+    def admittable(self, ids) -> List[int]:
+        """The subset of missed ``ids`` whose ghost count has reached the
+        admission threshold (call after ``note_access``)."""
+        return [int(r) for r in ids
+                if self._ghost.get(int(r), 0) >= self.admit_threshold
+                and int(r) not in self._slot]
+
+    def admit(self, ids: Sequence[int], rows: np.ndarray,
+              pinned: Optional[set] = None) -> int:
+        """Install ``rows[i]`` for ``ids[i]`` (cold rows evicted
+        LRU-first, never a ``pinned`` id — the current batch's working
+        set). Returns how many were admitted; one batched device
+        scatter."""
+        pinned = pinned or set()
+        take_rows: List[int] = []
+        take_slots: List[int] = []
+        for i, r in enumerate(ids):
+            r = int(r)
+            if r in self._slot:
+                continue
+            if self._free:
+                slot = self._free.pop()
+            else:
+                victim = None
+                for cand in self._lru:          # oldest first
+                    if cand not in pinned:
+                        victim = cand
+                        break
+                if victim is None:
+                    break                        # everything pinned: skip
+                slot = self._lru.pop(victim)
+                del self._slot[victim]
+                self.evictions += 1
+            self._slot[r] = slot
+            self._lru[r] = slot
+            self._lru.move_to_end(r)
+            self._ghost.pop(r, None)
+            take_rows.append(i)
+            take_slots.append(slot)
+        if take_rows:
+            self._scatter(take_slots, np.asarray(rows, np.float32)[take_rows])
+            self.admissions += len(take_rows)
+        return len(take_rows)
+
+    def _scatter(self, slots, rows_np) -> None:
+        """One bucket-padded device scatter (pad slots with ``capacity``
+        -> dropped), so the executable family stays closed instead of one
+        XLA compile per distinct row count."""
+        n = len(slots)
+        b = _bucket(n)
+        sl = np.full(b, self.capacity, np.int32)
+        sl[:n] = np.asarray(slots, np.int32)
+        rows = np.zeros((b, self.dim), np.float32)
+        rows[:n] = rows_np
+        f = self._scatter_fns.get(b)
+        if f is None:
+            from ..jit.persistent_cache import cached_jit
+
+            def scatter(dev, sl_, rows_):
+                return dev.at[sl_].set(rows_, mode="drop")
+
+            f = cached_jit(scatter, label=f"sparse:cache_scatter:{b}")
+            self._scatter_fns[b] = f
+        self.dev = f(self.dev, jnp.asarray(sl), jnp.asarray(rows))
+
+    def update_rows(self, ids: np.ndarray, rows: np.ndarray) -> int:
+        """In-place refresh for the subset of ``ids`` currently cached
+        (post-update coherence). One batched scatter; returns count."""
+        slots, keep = [], []
+        for i, r in enumerate(ids):
+            s = self._slot.get(int(r))
+            if s is not None:
+                slots.append(s)
+                keep.append(i)
+        if slots:
+            self._scatter(slots, np.asarray(rows, np.float32)[keep])
+        return len(slots)
+
+
+# ---------------------------------------------------------------------------
+# the table
+# ---------------------------------------------------------------------------
+
+class ShardedEmbeddingTable:
+    """Row-sharded host-resident embedding table with a device hot-row
+    cache and streamed miss fetches.
+
+    ::
+
+        table = ShardedEmbeddingTable(10_000_000, 64, cache_rows=100_000,
+                                      rule="adagrad", lr=0.05)
+        out = table.lookup(ids)            # Tensor on the autograd tape
+        loss.backward()
+        table.flush(update=True)           # sparse row update (host)
+        table.prefetch(next_ids)           # overlap next batch's misses
+
+    ``source`` defaults to in-process ``LocalShards``; pass
+    ``distributed.ps.PsShardSource`` to back the table by a
+    ParameterServer gang (the multi-process PS wiring — the server then
+    owns the update rule). ``overlap=False`` builds the serialized
+    StreamLane twin (the A/B baseline: identical bytes, nothing hidden).
+    """
+
+    def __init__(self, num_rows: int, dim: int, *, cache_rows: int = 4096,
+                 n_shards: int = 1, rule: Any = "adagrad", lr: float = 0.05,
+                 seed: int = 0, init_std: float = 0.01,
+                 admit_threshold: int = 2, overlap: bool = True,
+                 source: Any = None, name: Optional[str] = None,
+                 rule_kwargs: Optional[Dict[str, Any]] = None):
+        from ..jit.offload_stream import StreamLane
+
+        self.num_rows, self.dim = int(num_rows), int(dim)
+        self.name = name or f"table#{next(_TABLE_NO)}"
+        self.rule = make_row_rule(rule, lr=lr, **(rule_kwargs or {}))
+        self.source = source if source is not None else LocalShards(
+            num_rows, dim, n_shards=n_shards, seed=seed, init_std=init_std)
+        self.cache = HotRowCache(min(int(cache_rows), self.num_rows),
+                                 self.dim, admit_threshold=admit_threshold)
+        self.lane = StreamLane(overlap=overlap)
+        self._mu = threading.RLock()
+        self._pending: List[Tuple[np.ndarray, int, Tensor]] = []
+        self._accum: List[Tuple[np.ndarray, np.ndarray]] = []
+        self._prefetch: Optional[Dict[str, Any]] = None
+        self._dirty_since_prefetch: set = set()
+        self._combine_fns: Dict[int, Callable] = {}
+        self._serve_fns: Dict[Tuple[int, int], Callable] = {}
+        self._stats = {"lookups": 0, "hit_rows": 0, "miss_rows": 0,
+                       "streamed_bytes": 0, "stall_ms": 0.0,
+                       "prefetch_hits": 0, "prefetch_stale_rows": 0,
+                       "updates": 0, "updated_rows": 0,
+                       "serve_lookups": 0, "serve_hit_rows": 0,
+                       "serve_miss_rows": 0}
+        # memory truth: the hot cache is a named component so pd_top and
+        # OOM forensics attribute its bytes (PR-8 contract)
+        try:
+            from ..observability.memory import register_component
+
+            register_component(f"sparse:{self.name}:hot_cache",
+                               type(self).cache_bytes, owner=self)
+        except Exception:
+            pass
+
+    # -- sizing ---------------------------------------------------------------
+    def table_bytes(self) -> int:
+        return self.num_rows * self.dim * 4
+
+    def cache_bytes(self) -> int:
+        return self.cache.nbytes()
+
+    # -- the combine executables ----------------------------------------------
+    def _combine_fn(self, u_pad: int) -> Callable:
+        f = self._combine_fns.get(u_pad)
+        if f is None:
+            from ..jit.persistent_cache import cached_jit
+
+            def combine(cache, hit_slots, hit_pos, miss_rows, miss_pos):
+                out = jnp.zeros((u_pad, cache.shape[1]), cache.dtype)
+                hits = jnp.take(cache, hit_slots, axis=0)
+                out = out.at[hit_pos].set(hits, mode="drop")
+                out = out.at[miss_pos].set(miss_rows, mode="drop")
+                return out
+
+            f = cached_jit(combine, label=f"sparse:{self.name}:combine")
+            self._combine_fns[u_pad] = f
+        return f
+
+    # -- miss streaming --------------------------------------------------------
+    def _staged_miss_block(self, miss_ids: np.ndarray) -> np.ndarray:
+        """Host-gather the miss rows into a bucket-padded ``[m_pad, dim]``
+        staging block — padded HOST-side so the device only ever sees the
+        closed bucket family of shapes (no per-count XLA churn)."""
+        block = np.zeros((_bucket(len(miss_ids)), self.dim), np.float32)
+        if len(miss_ids):
+            block[:len(miss_ids)] = self.source.gather(miss_ids)
+        return block
+
+    def _fetch_miss_rows(self, miss_ids: np.ndarray):
+        """Host-gather + one lane h2d of the (padded) miss block; returns
+        ``(rows_dev, rows_np, nbytes, stall_ms)`` — the HOST block rides
+        along so admission can slice it without a device read-back."""
+        rows_np = self._staged_miss_block(miss_ids)
+        handle = self.lane.submit_rows(rows_np,
+                                       tag=("sparse", self.name),
+                                       names=(f"{self.name}:miss",))
+        t0 = time.perf_counter()
+        rows_dev = handle.rows()
+        stall = (time.perf_counter() - t0) * 1e3
+        return rows_dev, rows_np, int(rows_np.nbytes), stall
+
+    def prefetch(self, ids) -> None:
+        """Start streaming the NEXT batch's miss rows now, while the
+        current step computes — the cross-step fill of the streamed
+        lookup. Consumed by the next ``lookup`` whose unique-id set
+        matches; rows updated in between are re-fetched (never stale)."""
+        flat = self._flat_ids(ids)
+        uniq = np.unique(flat)
+        with self._mu:
+            hit, _slots = self.cache.slots_of(uniq)
+            miss_ids = uniq[~hit]
+            if not len(miss_ids):
+                # fully cache-covered batch: nothing to stream (the hot
+                # steady state) — skip the lane round-trip entirely
+                self._prefetch = {"uniq": uniq, "miss_ids": miss_ids,
+                                  "handle": None, "nbytes": 0}
+                self._dirty_since_prefetch = set()
+                return
+            rows_np = self._staged_miss_block(miss_ids)
+            handle = self.lane.submit_rows(
+                rows_np, tag=("sparse", self.name, "prefetch"),
+                names=(f"{self.name}:prefetch",))
+            self._prefetch = {"uniq": uniq, "miss_ids": miss_ids,
+                              "handle": handle, "rows_np": rows_np,
+                              "nbytes": int(rows_np.nbytes)}
+            self._dirty_since_prefetch = set()
+
+    @staticmethod
+    def _flat_ids(ids) -> np.ndarray:
+        arr = ids.numpy() if hasattr(ids, "numpy") else np.asarray(ids)
+        return np.asarray(arr, np.int64).ravel()
+
+    def _consume_prefetch(self, uniq, miss_ids):
+        """If the outstanding prefetch covers this lookup, take its rows;
+        re-fetch any row updated since it was issued (staleness guard).
+        Returns (miss_rows_dev, miss_rows_np, streamed_bytes, stall_ms)
+        or None."""
+        pf = self._prefetch
+        if pf is None or not np.array_equal(pf["uniq"], uniq):
+            return None
+        self._prefetch = None
+        dirty = self._dirty_since_prefetch
+        self._dirty_since_prefetch = set()
+        if pf["handle"] is None:
+            if len(miss_ids):          # membership drifted: fall back
+                return None
+            self._bump("prefetch_hits", 1)
+            return (jnp.zeros((_bucket(0), self.dim), jnp.float32),
+                    np.zeros((_bucket(0), self.dim), np.float32), 0, 0.0)
+        t0 = time.perf_counter()
+        # dispatched-futures consume (the PR-9 cross-step fill): take the
+        # rows as soon as the transfer is ISSUED and let the runtime
+        # sequence the landing behind the step's own compute; a
+        # post-issue failure surfaces at the next lane interaction (the
+        # PR-6 sticky contract)
+        rows_dev = pf["handle"].rows_dispatched()
+        stall = (time.perf_counter() - t0) * 1e3
+        pids = pf["miss_ids"]
+        rows_np = pf["rows_np"]
+        if not np.array_equal(pids, miss_ids):
+            # membership drifted (a lookup ran in between): fall back
+            return None
+        if dirty:
+            stale = [i for i, r in enumerate(pids) if int(r) in dirty]
+            if stale:
+                # bucket-padded patch (same closed-shape-family contract
+                # as every other cache write); the host twin is patched
+                # too so admission slices stay fresh
+                b = _bucket(len(stale))
+                idx = np.full(b, rows_dev.shape[0], np.int32)
+                idx[:len(stale)] = stale
+                fresh = np.zeros((b, self.dim), np.float32)
+                fresh[:len(stale)] = self.source.gather(
+                    pids[np.asarray(stale)])
+                rows_dev = rows_dev.at[jnp.asarray(idx)].set(
+                    jnp.asarray(fresh), mode="drop")
+                rows_np = rows_np.copy()
+                rows_np[np.asarray(stale)] = fresh[:len(stale)]
+                self._bump("prefetch_stale_rows", len(stale))
+        self._bump("prefetch_hits", 1)
+        return rows_dev, rows_np, pf["nbytes"], stall
+
+    def _bump(self, key, n=1):
+        self._stats[key] += n
+        _fam().inc((key,), n)
+
+    # -- training lookup -------------------------------------------------------
+    def lookup(self, ids, padding_idx: Optional[int] = None) -> Tensor:
+        """Dedup -> cache gather + streamed misses -> one tape-bridged
+        embedding op. The returned Tensor participates in eager autograd;
+        the row gradient is harvested by ``flush()`` after backward as a
+        (unique_ids, rows) pair — no dense gradient ever exists."""
+        from ..nn.functional.common import _embedding
+
+        raw = ids.data if isinstance(ids, Tensor) else ids
+        if isinstance(raw, jax.core.Tracer):
+            if _ABSTRACT_ZERO_OK[-1]:
+                # sanctioned abstract capture (planner profiling under
+                # abstract_zero_lookups()): the host-side dedup cannot
+                # run on a tracer — a shape-correct zero lookup keeps
+                # the surrounding program traceable; the planner prices
+                # the real table traffic via profile.embed_stream_bytes.
+                return Tensor(jnp.zeros(tuple(raw.shape) + (self.dim,),
+                                        jnp.float32))
+            # anywhere else (jit.to_static, jit.save export, a compiled
+            # TrainStep) a traced lookup would silently BAKE ZEROS into
+            # the program — fail loudly instead
+            raise NotImplementedError(
+                f"ShardedEmbeddingTable[{self.name}]: lookups cannot be "
+                "traced into a compiled/exported program — the canonical "
+                "rows are host-resident and the dedup/cache routing is "
+                "host work. Serve through table.serving_target() / keep "
+                "the lookup in the eager step (hapi.Model.train_batch).")
+        arr = ids.numpy() if hasattr(ids, "numpy") else np.asarray(ids)
+        shape = tuple(np.shape(arr))
+        flat = np.asarray(arr, np.int64).ravel()
+        if len(flat) and (flat.min() < 0 or flat.max() >= self.num_rows):
+            raise ValueError(
+                f"ShardedEmbeddingTable[{self.name}]: id out of range "
+                f"[0, {self.num_rows}) in lookup")
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        with self._mu:
+            self._bump("lookups", 1)
+            self.cache.note_access(uniq)
+            hit, slots = self.cache.slots_of(uniq)
+            miss_ids = uniq[~hit]
+            self.cache.touch(uniq[hit])
+            self._bump("hit_rows", int(hit.sum()))
+            self._bump("miss_rows", int(len(miss_ids)))
+            got = self._consume_prefetch(uniq, miss_ids)
+            if got is None:
+                if len(miss_ids):
+                    got = self._fetch_miss_rows(miss_ids)
+                else:
+                    got = (jnp.zeros((_bucket(0), self.dim), jnp.float32),
+                           np.zeros((_bucket(0), self.dim), np.float32),
+                           0, 0.0)
+            miss_dev, miss_np, nbytes, stall = got
+            self._bump("streamed_bytes", nbytes)
+            self._stats["stall_ms"] += stall
+            _fam().inc(("stall_ms",), stall)
+            # frequency-gated admission: rows that have proven themselves
+            # (ghost count >= threshold) earn a slot; the current batch's
+            # ids are pinned so a victim is always a cold row
+            admit = self.cache.admittable(miss_ids)
+            if admit:
+                # slice the HOST block (no device read-back — a
+                # np.asarray(miss_dev) here would block on the in-flight
+                # transfer and undo the dispatched-futures overlap)
+                pos = {int(r): i for i, r in enumerate(miss_ids)}
+                rows_np = miss_np[[pos[r] for r in admit]]
+                self.cache.admit(admit, rows_np,
+                                 pinned=set(int(r) for r in uniq))
+            # combine into the [U_pad, dim] unique-rows block; the miss
+            # block arrives already bucket-padded from the lane
+            u, h, m = len(uniq), int(hit.sum()), len(miss_ids)
+            u_pad, h_pad = _bucket(u), _bucket(h)
+            m_pad = int(miss_dev.shape[0])
+            hit_slots = np.zeros(h_pad, np.int32)
+            hit_slots[:h] = slots[hit]
+            hit_pos = np.full(h_pad, u_pad, np.int32)    # pad -> dropped
+            hit_pos[:h] = np.nonzero(hit)[0]
+            miss_pos = np.full(m_pad, u_pad, np.int32)
+            miss_pos[:m] = np.nonzero(~hit)[0]
+            rows = self._combine_fn(u_pad)(
+                self.cache.dev, jnp.asarray(hit_slots),
+                jnp.asarray(hit_pos), miss_dev, jnp.asarray(miss_pos))
+        leaf = Tensor(rows, stop_gradient=not autograd.is_grad_enabled(),
+                      name=f"{self.name}:rows")
+        idx = Tensor(jnp.asarray(inverse.reshape(shape or (1,))
+                                 .astype(np.int32)))
+        pad_u = None
+        if padding_idx is not None:
+            # remap: padding zeroing happens on the UNIQUE axis position
+            where = np.nonzero(uniq == int(padding_idx))[0]
+            pad_u = int(where[0]) if len(where) else None
+        out = _embedding(leaf, idx, padding_idx=pad_u, oov="clip")
+        if not leaf.stop_gradient:
+            with self._mu:
+                self._pending.append((uniq, len(uniq), leaf))
+        if not shape:  # scalar ids looked up through the (1,) reshape
+            out = out[0]
+        return out
+
+    # -- gradient application ---------------------------------------------------
+    def flush(self, update: bool = True) -> int:
+        """Harvest pending row gradients (post-``backward``) into the
+        accumulation buffer; ``update=True`` applies the sparse row rule
+        to the owning shards (and refreshes cached rows in place).
+        ``update=False`` is the accumulate(k) micro-step: grads merge
+        host-side and apply once at the window boundary. Returns the
+        number of unique rows updated (0 when accumulating)."""
+        with self._mu:
+            for uniq, n, leaf in self._pending:
+                g = leaf.grad
+                if g is None:
+                    continue
+                ga = np.asarray(g.data, np.float32)[:n]
+                self._accum.append((uniq, ga))
+                leaf.grad = None
+            self._pending.clear()
+            if not update or not self._accum:
+                return 0
+            ids = np.concatenate([a for a, _ in self._accum])
+            gs = np.concatenate([g for _, g in self._accum])
+            self._accum.clear()
+            uniq, inv = np.unique(ids, return_inverse=True)
+            merged = np.zeros((len(uniq), self.dim), np.float32)
+            np.add.at(merged, inv, gs)
+            new_rows = self.source.apply(uniq, merged, self.rule)
+            self.cache.update_rows(uniq, new_rows)
+            if self._prefetch is not None:
+                self._dirty_since_prefetch.update(int(r) for r in uniq)
+            self._bump("updates", 1)
+            self._bump("updated_rows", len(uniq))
+            return len(uniq)
+
+    def clear_pending(self) -> None:
+        """Drop harvested + pending gradients (the NaN-skip/poisoned-window
+        path: the step never happened)."""
+        with self._mu:
+            self._pending.clear()
+            self._accum.clear()
+
+    # -- checkpointing ----------------------------------------------------------
+    def save(self, path: str) -> str:
+        """Checkpoint the canonical rows + row-rule state to one ``.npz``
+        (atomic rename). The table is NOT part of ``state_dict()`` — a
+        table-backed Embedding has no dense Parameter — so this is the
+        checkpoint surface; ``hapi.Model.save`` warns when it would
+        otherwise silently drop a table. LocalShards only: a
+        ``PsShardSource`` table's authority is the server gang."""
+        import os
+
+        src = self.source
+        if not isinstance(src, LocalShards):
+            raise NotImplementedError(
+                "ShardedEmbeddingTable.save: only LocalShards-backed "
+                "tables checkpoint here; a PsShardSource table's "
+                "authoritative rows live server-side")
+        with self._mu:
+            payload: Dict[str, Any] = {
+                "meta": np.asarray([self.num_rows, self.dim,
+                                    src.n_shards], np.int64)}
+            for s, shard in enumerate(src.shards):
+                payload[f"shard_{s}"] = shard
+                for k, v in (src._state[s] or {}).items():
+                    payload[f"state_{s}_{k}"] = v
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        tmp = path + ".tmp.npz"
+        np.savez(tmp.removesuffix(".npz"), **payload)
+        os.replace(tmp, path)
+        return path
+
+    def load(self, path: str) -> "ShardedEmbeddingTable":
+        """Restore rows + row-rule state saved by ``save``; the hot
+        cache is rebuilt empty (re-warmed by traffic) so it can never
+        serve pre-restore rows."""
+        src = self.source
+        if not isinstance(src, LocalShards):
+            raise NotImplementedError(
+                "ShardedEmbeddingTable.load: LocalShards-backed tables "
+                "only")
+        if not path.endswith(".npz"):
+            path = path + ".npz"
+        data = np.load(path)
+        rows, dim, n_shards = (int(v) for v in data["meta"])
+        if (rows, dim, n_shards) != (self.num_rows, self.dim,
+                                     src.n_shards):
+            raise ValueError(
+                f"table checkpoint shape ({rows}, {dim}, x{n_shards}) != "
+                f"this table ({self.num_rows}, {self.dim}, "
+                f"x{src.n_shards})")
+        with self._mu:
+            for s in range(n_shards):
+                src.shards[s][...] = data[f"shard_{s}"]
+                st = {}
+                for key in data.files:
+                    if key.startswith(f"state_{s}_"):
+                        st[key[len(f"state_{s}_"):]] = data[key].copy()
+                src._state[s] = st or None
+            self.cache = HotRowCache(self.cache.capacity, self.dim,
+                                     admit_threshold=self.cache
+                                     .admit_threshold)
+            self._pending.clear()
+            self._accum.clear()
+            self._prefetch = None
+            self._dirty_since_prefetch = set()
+        return self
+
+    # -- serving ---------------------------------------------------------------
+    def serving_target(self, miss_caps: Optional[Sequence[int]] = None
+                       ) -> "EmbeddingLookupTarget":
+        """An engine-native ``ServingEngine`` target: warmed fixed-shape
+        lookup executables over (cache, staged-miss-bucket) inputs."""
+        return EmbeddingLookupTarget(self, miss_caps=miss_caps)
+
+    def _serve_fn(self, n_ids: int, miss_cap: int) -> Callable:
+        key = (n_ids, miss_cap)
+        f = self._serve_fns.get(key)
+        if f is None:
+            from ..jit.persistent_cache import cached_jit
+
+            def look(cache, staged, idx):
+                return jnp.take(jnp.concatenate([cache, staged], axis=0),
+                                idx, axis=0)
+
+            f = cached_jit(
+                look, label=f"serving:sparse:{self.name}:{n_ids}x{miss_cap}")
+            self._serve_fns[key] = f
+        return f
+
+    def serve_lookup(self, ids_np: np.ndarray, miss_caps) -> np.ndarray:
+        """One fixed-shape serving lookup: dedup, read-through (no
+        admission, no gradient), misses staged into the smallest fitting
+        padded bucket of ``miss_caps`` (int or sorted sequence), ONE warm
+        gather executable. The cap is chosen UNDER the table lock from
+        the same hit/miss split the lookup serves — a concurrent
+        training eviction between a pre-pick and the lookup can never
+        strand a request past its bucket. ``ids_np`` keeps its shape."""
+        if isinstance(miss_caps, int):
+            miss_caps = (miss_caps,)
+        shape = np.shape(ids_np)
+        # copy before the clamp: ravel of a contiguous input is a VIEW
+        # and an in-place clip would write through to the caller's array
+        flat = np.array(ids_np, np.int64).ravel()
+        np.clip(flat, 0, self.num_rows - 1, out=flat)
+        uniq, inverse = np.unique(flat, return_inverse=True)
+        with self._mu:
+            self._bump("serve_lookups", 1)
+            hit, slots = self.cache.slots_of(uniq)
+            miss_ids = uniq[~hit]
+            self.cache.touch(uniq[hit])
+            self._bump("serve_hit_rows", int(hit.sum()))
+            self._bump("serve_miss_rows", int(len(miss_ids)))
+            miss_cap = next((c for c in miss_caps if c >= len(miss_ids)),
+                            None)
+            if miss_cap is None:
+                raise ValueError(
+                    f"serve_lookup: {len(miss_ids)} misses exceed the "
+                    f"largest declared miss bucket {miss_caps[-1]}")
+            staged_np = np.zeros((miss_cap, self.dim), np.float32)
+            if len(miss_ids):
+                staged_np[:len(miss_ids)] = self.source.gather(miss_ids)
+            # per-unique source index into concat(cache, staged)
+            src = np.empty(len(uniq), np.int32)
+            src[hit] = slots[hit]
+            src[~hit] = self.cache.capacity + np.arange(
+                len(miss_ids), dtype=np.int32)
+            idx = src[inverse].astype(np.int32)
+            cache_dev = self.cache.dev
+        rows = self._serve_fn(len(idx), miss_cap)(
+            cache_dev, jnp.asarray(staged_np), jnp.asarray(idx))
+        return np.asarray(rows).reshape(shape + (self.dim,))
+
+    # -- observability ----------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._mu:
+            s = dict(self._stats)
+            s["cache_rows"] = len(self.cache)
+            s["cache_capacity"] = self.cache.capacity
+            s["cache_bytes"] = self.cache.nbytes()
+            s["admissions"] = self.cache.admissions
+            s["evictions"] = self.cache.evictions
+        total = s["hit_rows"] + s["miss_rows"]
+        s["hit_rate"] = round(s["hit_rows"] / total, 4) if total else 0.0
+        s["table_bytes"] = self.table_bytes()
+        s["lane"] = self.lane.stats()
+        return s
+
+
+# ---------------------------------------------------------------------------
+# ServingEngine-native target
+# ---------------------------------------------------------------------------
+
+class EmbeddingLookupTarget:
+    """Duck-typed ``ServingEngine`` target (``build_serving_runner``):
+    the engine coalesces/pads/buckets requests as usual, and THIS object
+    builds the per-bucket runner — host dedup/routing around warmed
+    fixed-shape gather executables, which a plain jitted-callable target
+    could not express (the dedup is host work).
+
+    Every (batch-bucket, seq) runner pre-warms its full miss-capacity
+    executable family at build time, so a warmed engine serves lookups
+    with zero fresh XLA compiles and zero retraces (CI-gated)."""
+
+    def __init__(self, table: ShardedEmbeddingTable,
+                 miss_caps: Optional[Sequence[int]] = None):
+        self.table = table
+        self._miss_caps = tuple(sorted(set(int(c) for c in miss_caps))) \
+            if miss_caps else None
+
+    def caps_for(self, n_ids: int) -> Tuple[int, ...]:
+        """Miss-capacity buckets for an ``n_ids`` request block. The
+        terminal cap is ALWAYS ``n_ids`` (the worst case — every unique
+        id a cold miss), so a declared cap list can narrow the warm set
+        but never leave a miss count unservable."""
+        if self._miss_caps:
+            return tuple(c for c in self._miss_caps if c < n_ids) \
+                + (n_ids,)
+        return tuple(sorted({min(64, n_ids), min(256, n_ids), n_ids}))
+
+    def build_serving_runner(self, bucket_b: int, key: Tuple,
+                             label: Optional[str] = None) -> Callable:
+        (dt, shape), = key
+        n_per = 1
+        for d in shape:
+            n_per *= int(d)
+        n_ids = bucket_b * n_per
+        caps = self.caps_for(n_ids)
+        table = self.table
+        # AOT-warm every miss-cap executable for this bucket so steady
+        # state never compiles, whatever the miss count turns out to be
+        dummy_idx = jnp.zeros((n_ids,), jnp.int32)
+        for cap in caps:
+            table._serve_fn(n_ids, cap)(
+                table.cache.dev, jnp.zeros((cap, table.dim), jnp.float32),
+                dummy_idx)
+
+        def runner(np_inputs: List[np.ndarray]) -> List[np.ndarray]:
+            # serve_lookup picks the smallest warmed miss bucket UNDER
+            # the table lock (a pre-pick here could race a concurrent
+            # training eviction past its cap); caps always terminate at
+            # the every-id-cold worst case, so every request fits
+            return [table.serve_lookup(np.asarray(np_inputs[0], np.int64),
+                                       caps)]
+
+        return runner
+
+
+class LookupReplica:
+    """Router-facing adapter: a table-lookup ``ServingEngine`` wearing
+    the replica duck surface ``serving.ReplicaRouter`` scores on —
+    ``queue_depth``/``metrics.latency_percentile`` come from the engine,
+    ``kv_headroom`` is the hot cache's free-slot fraction, and
+    ``prefix_match_tokens`` probes how many of a request's unique ids
+    are already hot HERE, so the router's affinity term routes an id set
+    to the replica whose cache covers it (the embedding analog of
+    prefix-cache affinity). ``max_new_tokens`` is accepted and ignored
+    (lookups generate nothing)."""
+
+    def __init__(self, engine, table: ShardedEmbeddingTable):
+        self.engine = engine
+        self.table = table
+        self.name = engine.name
+        self.metrics = engine.metrics
+
+    def start(self):
+        self.engine.start()
+        return self
+
+    def close(self, drain: bool = True):
+        self.engine.close(drain=drain)
+
+    def queue_depth(self) -> int:
+        return self.engine.queue_depth()
+
+    def kv_headroom(self) -> float:
+        c = self.table.cache
+        return 1.0 - len(c) / max(c.capacity, 1)
+
+    def prefix_match_tokens(self, prompt, blocks=None) -> int:
+        uniq = np.unique(np.asarray(prompt, np.int64).ravel())
+        with self.table._mu:
+            hit, _ = self.table.cache.slots_of(uniq)
+        return int(hit.sum())
+
+    def submit(self, prompt, max_new_tokens: int = 0, deadline_ms=None):
+        return self.engine.submit([np.asarray(prompt, np.int64)],
+                                  deadline_ms=deadline_ms)
+
+    def stats(self) -> Dict[str, Any]:
+        return self.engine.stats()
+
+
+# ---------------------------------------------------------------------------
+# layer-walk helpers (hapi integration)
+# ---------------------------------------------------------------------------
+
+def sparse_tables(network) -> List[ShardedEmbeddingTable]:
+    """Every ShardedEmbeddingTable reachable from ``network``'s layer
+    tree (via the ``nn.Embedding(sparse=True)`` front end's ``_table``)."""
+    out: List[ShardedEmbeddingTable] = []
+    seen = set()
+
+    def walk(layer):
+        t = getattr(layer, "_table", None)
+        if isinstance(t, ShardedEmbeddingTable) and id(t) not in seen:
+            seen.add(id(t))
+            out.append(t)
+        for sub in getattr(layer, "_sub_layers", {}).values():
+            if sub is not None:
+                walk(sub)
+
+    if network is not None:
+        walk(network)
+    return out
+
+
+def flush_sparse_layers(network, update: bool = True) -> int:
+    """Post-``backward`` helper for HAND-WRITTEN training loops: harvest
+    every sparse table's row gradients; apply the sparse updates when
+    ``update`` (the accumulate(k) boundary). ``hapi.Model`` does this
+    automatically (with a cached table list) — use this only when you
+    own the loop. Returns rows updated."""
+    n = 0
+    for t in sparse_tables(network):
+        n += t.flush(update=update)
+    return n
+
+
+def clear_sparse_pending(network) -> None:
+    """Hand-written-loop twin of the NaN-skip / dropped-window path:
+    discard harvested grads (``hapi.Model`` does this automatically)."""
+    for t in sparse_tables(network):
+        t.clear_pending()
