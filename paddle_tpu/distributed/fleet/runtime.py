@@ -156,6 +156,7 @@ class FleetStateMachine:
         self._left: Dict[int, int] = {}   # rank -> exit code
         self._fence_reason = ""
         self._start_t = float(now)
+        self._rank_restarts: Dict[int, int] = {}  # replica mode: per rank
 
     # -- inputs ---------------------------------------------------------------
     def _event(self, event: str, now: float, **data) -> None:
@@ -291,6 +292,70 @@ class FleetStateMachine:
         return FleetAction(kind="restart", dead=dead, world=survivors,
                            backoff_s=backoff)
 
+    # -- replica mode (the serving fleet's per-replica supervision) -----------
+    # A training gang fences and restarts as ONE unit: a lost rank tears
+    # the collective, so everyone drains and the gang respawns at the
+    # surviving world size. A SERVING fleet is the opposite shape — the
+    # replicas are independent, the survivors must keep serving, and the
+    # dead one restarts ALONE. These methods drive that per-rank
+    # lifecycle against the same beats/eviction/timeline state (one
+    # membership record, one grace window, one budget/backoff policy),
+    # without touching the gang decision paths above.
+
+    def replica_fence(self, rank: int, now: float, cause: str,
+                      rc: Optional[int] = None) -> bool:
+        """Fence ONE replica (crash rc / stale heartbeat / operator).
+        Records evict+fence in the timeline; the fleet phase is untouched
+        because the survivors keep serving. Idempotent per incarnation —
+        returns False when the rank is already fenced."""
+        if rank in self._evicted:
+            return False
+        self._evicted.add(rank)
+        self._event("evict", now, rank=rank, cause=cause, rc=rc,
+                    last_beat=self._beats.get(rank))
+        self._event("fence", now, dead=[rank], reason=cause)
+        # the beat record dies with the incarnation: a hung-not-dead
+        # process that wakes later must not flap a fenced replica back
+        self._beats.pop(rank, None)
+        return True
+
+    def replica_restart_decision(self, rank: int, now: float) -> FleetAction:
+        """Restart-or-fail for ONE fenced replica: per-rank budget, the
+        shared exponential-capped backoff formula."""
+        n = self._rank_restarts.get(rank, 0)
+        if n >= self.policy.max_restarts:
+            self._event("fail", now, rank=rank, reason="restart_budget",
+                        restarts=n)
+            return FleetAction(
+                kind="fail", dead=[rank],
+                reason=f"replica {rank} restart budget exhausted "
+                       f"({n}/{self.policy.max_restarts})")
+        backoff = self.policy.backoff_s(n + 1)
+        self._event("restart", now, rank=rank, restart_id=n + 1,
+                    backoff_s=backoff)
+        return FleetAction(kind="restart", dead=[rank], backoff_s=backoff)
+
+    def replica_restarted(self, rank: int, now: float,
+                          count: bool = True) -> None:
+        """The supervisor respawned one replica: clear its fenced state so
+        its first beat re-joins membership. ``count=False`` is the planned
+        rolling-restart path — it spends no restart budget."""
+        if count:
+            self._rank_restarts[rank] = self._rank_restarts.get(rank, 0) + 1
+            self.restarts += 1
+        self._evicted.discard(rank)
+        self._beats.pop(rank, None)
+        self._left.pop(rank, None)
+
+    def replica_restart_counts(self) -> Dict[int, int]:
+        return dict(self._rank_restarts)
+
+    def note(self, event: str, now: float, **data) -> None:
+        """Record a supervisor-annotated event (planned rolling restart,
+        brownout transition) in the membership timeline — one ordered
+        record of everything that happened to the fleet."""
+        self._event(event, now, **data)
+
     def restarted(self, now: float, world: int) -> None:
         """The supervisor re-spawned the gang: reset per-generation state."""
         self.restarts += 1
@@ -303,9 +368,13 @@ class FleetStateMachine:
         self._start_t = float(now)
 
     def snapshot(self) -> Dict[str, Any]:
-        return {"phase": self.phase.value, "gen": self.gen,
+        snap = {"phase": self.phase.value, "gen": self.gen,
                 "world": self.world, "restarts": self.restarts,
                 "timeline": list(self.timeline)}
+        if self._rank_restarts:
+            snap["rank_restarts"] = {str(r): n for r, n
+                                     in self._rank_restarts.items()}
+        return snap
 
 
 # ---------------------------------------------------------------------------

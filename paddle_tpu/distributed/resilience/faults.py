@@ -38,6 +38,22 @@ process-level fleet kinds (``fleet/runtime.py``):
 - ``heartbeat_stall@rank=r&ms=MS`` — stalls one worker's heartbeat
   daemon (``ElasticManager._beat``) so the eviction grace window is
   drillable: a stall under ``heartbeat_timeout`` must never evict.
+
+And the serving-replica kinds (``serving/fleet.py`` replica worker —
+every serving-fleet drill scenario is injectable without real kills):
+
+- ``replica_crash@name=NAME&seq=N[&inc=I]`` — hard ``os._exit`` of the
+  named replica process at its N-th submitted request (mid-stream
+  crash); pin ``inc=0`` so the rule fires in the first incarnation
+  only — a RESTARTED worker re-parses ``PT_FAULTS`` and walks ``seq``
+  from 1 again;
+- ``replica_hang@name=NAME&seq=N[&inc=I]`` — wedges the replica's
+  serve loop at its N-th submit, so heartbeats stop and the supervisor
+  must fence it within the grace window (the hung-not-dead failure
+  mode);
+- ``replica_slow@name=NAME&ms=MS&times=-1`` — per-request slowdown on
+  one replica (the hedging trigger: a request past its hedge deadline
+  gets a speculative second submission on a survivor).
 """
 from __future__ import annotations
 

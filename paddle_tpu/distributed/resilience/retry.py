@@ -2,19 +2,63 @@
 
 One policy for both lanes that move checkpoint/offload bytes: a transfer
 that throws is retried up to ``PT_TRANSFER_RETRIES`` times (default 2)
-with exponential backoff starting at ``PT_TRANSFER_BACKOFF_MS`` (default
-25 ms). ``InjectedFault(transient=False)`` and interpreter-exit signals
-are never retried; every retry lands in the ``resilience`` family.
+with DECORRELATED-JITTER backoff starting at ``PT_TRANSFER_BACKOFF_MS``
+(default 25 ms), capped at ``PT_TRANSFER_BACKOFF_MAX_MS`` (default
+2000 ms). ``InjectedFault(transient=False)`` and interpreter-exit
+signals are never retried; every retry lands in the ``resilience``
+family.
+
+Why jitter: N fleet replicas hitting the same coordinator-store blip
+retry in LOCKSTEP under pure exponential backoff — every wave lands on
+the store at the same instant (thundering herd). Each attempt instead
+sleeps ``U[base, prev*3]`` (the AWS "decorrelated jitter" schedule),
+which spreads the waves while keeping the expected growth exponential.
+Drills that replay failures bit-identically pin the schedule by seeding
+``PT_RETRY_SEED`` (one process-wide ``random.Random``), so chaos runs
+stay deterministic-under-seed; jitter can be disabled outright with
+``PT_RETRY_JITTER=0`` (pure exponential, the pre-PR-15 behavior).
 """
 from __future__ import annotations
 
 import os
+import random
+import threading
 import time
 from typing import Callable, Optional
 
 from . import metrics
 
-__all__ = ["retry_policy", "with_retries"]
+__all__ = ["retry_policy", "with_retries", "decorrelated_backoff_ms"]
+
+_RNG: Optional[random.Random] = None
+_RNG_LOCK = threading.Lock()
+
+
+def _rng() -> random.Random:
+    """The process-wide jitter stream. Seeded from ``PT_RETRY_SEED`` when
+    set (the drills' deterministic-under-seed contract) else from system
+    entropy. One stream, not per-call: reseeding per retry would make
+    concurrent retriers draw IDENTICAL jitter — the herd again."""
+    global _RNG
+    rng = _RNG
+    if rng is not None:
+        return rng
+    with _RNG_LOCK:
+        if _RNG is None:
+            seed = os.environ.get("PT_RETRY_SEED")
+            _RNG = random.Random(int(seed)) if seed not in (None, "") \
+                else random.Random()
+    return _RNG
+
+
+def decorrelated_backoff_ms(prev_ms: float, base_ms: float, cap_ms: float,
+                            rng: random.Random) -> float:
+    """Next sleep: ``min(cap, U[base, prev*3])`` — grows exponentially in
+    expectation, never below ``base`` or above ``cap``, and two retriers
+    sharing a failure window desynchronize after the first draw."""
+    lo = max(base_ms, 0.0)
+    hi = max(prev_ms * 3.0, lo)
+    return min(max(cap_ms, lo), rng.uniform(lo, hi))
 
 
 def retry_policy():
@@ -27,6 +71,18 @@ def retry_policy():
     except ValueError:
         backoff_ms = 25.0
     return max(retries, 0), max(backoff_ms, 0.0)
+
+
+def _backoff_cap_ms() -> float:
+    try:
+        return max(float(os.environ.get("PT_TRANSFER_BACKOFF_MAX_MS",
+                                        "2000")), 0.0)
+    except ValueError:
+        return 2000.0
+
+
+def _jitter_enabled() -> bool:
+    return os.environ.get("PT_RETRY_JITTER", "1") not in ("0", "false")
 
 
 def _transient(e: BaseException) -> bool:
@@ -47,13 +103,18 @@ def transient(e: BaseException) -> bool:
 
 def with_retries(fn: Callable, what: str = "transfer",
                  retries: Optional[int] = None,
-                 backoff_ms: Optional[float] = None):
+                 backoff_ms: Optional[float] = None,
+                 jitter: Optional[bool] = None):
     """Run ``fn()``; on a transient failure sleep-and-retry up to the
     bound, then re-raise the last error. ``what`` labels nothing but the
-    debugger's stack — counting is uniform (``retries`` metric)."""
+    debugger's stack — counting is uniform (``retries`` metric).
+    ``jitter=None`` follows ``PT_RETRY_JITTER`` (default on)."""
     env_retries, env_backoff = retry_policy()
     retries = env_retries if retries is None else int(retries)
     backoff_ms = env_backoff if backoff_ms is None else float(backoff_ms)
+    use_jitter = _jitter_enabled() if jitter is None else bool(jitter)
+    cap_ms = _backoff_cap_ms()
+    prev_ms = backoff_ms
     attempt = 0
     while True:
         try:
@@ -63,4 +124,9 @@ def with_retries(fn: Callable, what: str = "transfer",
                 raise
             attempt += 1
             metrics.inc("retries")
-            time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1e3)
+            if use_jitter:
+                prev_ms = decorrelated_backoff_ms(prev_ms, backoff_ms,
+                                                  cap_ms, _rng())
+                time.sleep(prev_ms / 1e3)
+            else:
+                time.sleep(backoff_ms * (2 ** (attempt - 1)) / 1e3)

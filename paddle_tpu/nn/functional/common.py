@@ -86,6 +86,15 @@ def embedding(x, weight, padding_idx=None, sparse=False, name=None,
             if isinstance(ids, np.ndarray):
                 # host ids validate host-side (no H2D round-trip)
                 lo, hi = int(ids.min()), int(ids.max())
+            elif not jax.core.trace_state_clean():
+                # CONCRETE device ids under an AMBIENT trace: possible
+                # when an upstream op ran through an AOT-compiled
+                # executable (persistent-cache per-op jits) — the
+                # min/max readback below would be STAGED by the ambient
+                # trace and np.asarray would crash on the new tracer.
+                # Same contract as tracer ids: traced programs are
+                # documented unchecked.
+                lo, hi = 0, -1
             else:
                 # ONE blocking readback for both bounds, not two
                 lo, hi = (int(v) for v in np.asarray(

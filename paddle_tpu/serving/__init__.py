@@ -20,7 +20,15 @@ The serving tier, bottom up:
 - ``ReplicaRouter`` (+ ``RouterConfig``): N engine replicas behind an
   admission-controlled front door — per-tenant quotas, load-aware
   dispatch from real queue/KV-headroom/p95 state, prefix-affinity
-  placement, fault fencing;
+  placement, fault fencing with classified errors and health-probe
+  re-admission;
+- ``ServingFleet`` (+ ``ServingFleetPolicy``, ``fleet``): the
+  fault-tolerant MULTI-PROCESS tier — each replica engine in its own
+  supervised process behind a socket RPC, heartbeat-fenced within a
+  grace window, restarted with bounded backoff; in-flight work replays
+  onto survivors with the token stream deduped, slow requests hedge,
+  overload degrades in brownout stages, and ``rolling_restart()``
+  rolls the fleet with zero downtime;
 - ``MetricsRegistry``: QPS, latency percentiles, batch occupancy, queue
   depth, compile-cache hits/misses, exposed via ``engine.stats()`` and
   ``profiler.RecordEvent`` spans.
@@ -31,6 +39,10 @@ from .buckets import BucketSpec  # noqa: F401
 from .engine import (  # noqa: F401
     BadRequest, DeadlineExceeded, EngineClosed, QueueFull, ServingConfig,
     ServingEngine,
+)
+from .base import ReplicaFault, RequestCancelled  # noqa: F401
+from .fleet import (  # noqa: F401
+    BrownoutShed, ReplicaClient, ServingFleet, ServingFleetPolicy,
 )
 from .generation import GenerationConfig, GenerationEngine  # noqa: F401
 from .metrics import LatencyWindow, MetricsRegistry  # noqa: F401
@@ -44,6 +56,8 @@ __all__ = [
     "BucketSpec", "ServingConfig", "ServingEngine",
     "GenerationConfig", "GenerationEngine",
     "ReplicaRouter", "RouterConfig", "TenantQuotaExceeded",
+    "ServingFleet", "ServingFleetPolicy", "ReplicaClient", "BrownoutShed",
+    "ReplicaFault", "RequestCancelled",
     "PageAllocator", "PrefixCache", "PagedKVPool", "PoolExhausted",
     "token_blocks", "greedy_accept", "rejection_sample",
     "MetricsRegistry", "LatencyWindow",

@@ -13,7 +13,7 @@ from typing import Any, Dict, Optional
 from .metrics import MetricsRegistry
 
 __all__ = ["EngineBase", "QueueFull", "DeadlineExceeded", "EngineClosed",
-           "BadRequest"]
+           "BadRequest", "ReplicaFault", "RequestCancelled"]
 
 
 def _tracer():
@@ -49,6 +49,18 @@ class DeadlineExceeded(TimeoutError):
     """The request expired before execution and was shed."""
 
 
+class ReplicaFault(EngineClosed):
+    """The replica itself failed (process crash, lost RPC connection,
+    hung heartbeat) — the REPLICA-fault shape the router fences on, as
+    opposed to request-scoped errors (``BadRequest``/``DeadlineExceeded``)
+    that must leave a healthy replica in the candidate set."""
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled before completion (hedge first-wins,
+    client cancel RPC)."""
+
+
 class EngineBase:
     """Queue + condition + worker-thread lifecycle. Subclasses implement
     ``_worker`` (the loop) and may override ``_on_start`` (e.g. AOT
@@ -70,6 +82,7 @@ class EngineBase:
         self._cond = threading.Condition()
         self._start_lock = threading.Lock()
         self._closed = False
+        self._fenced = False
         self._thread: Optional[threading.Thread] = None
         self._flight_rec = None  # lazily-resolved process flight recorder
 
@@ -132,6 +145,46 @@ class EngineBase:
         self.close()
         return False
 
+    def fence(self) -> None:
+        """Stop admitting NEW work while queued + in-flight requests run
+        to completion — the rolling-restart drain half: fence-new-work,
+        finish in-flight, then restart."""
+        with self._cond:
+            self._fenced = True
+
+    def unfence(self) -> None:
+        with self._cond:
+            self._fenced = False
+
+    def health(self) -> bool:
+        """Liveness probe (router re-admission): the engine accepts work
+        and its worker loop (if started) is still running."""
+        if self._closed or self._fenced:
+            return False
+        t = self._thread
+        return t is None or t.is_alive()
+
+    def cancel(self, future) -> bool:
+        """Dequeue the request owning ``future`` before it executes (its
+        future fails with ``RequestCancelled``). Returns False when the
+        request already left the queue — an executing request runs to
+        completion and the caller discards the result."""
+        req = None
+        with self._cond:
+            for r in self._queue:
+                if r.future is future:
+                    self._queue.remove(r)
+                    req = r
+                    break
+        if req is None:
+            return False
+        if not req.future.done():
+            req.future.set_exception(RequestCancelled("request cancelled"))
+        _tracer().finish(getattr(req, "trace", None), ok=False,
+                         error="RequestCancelled")
+        self.metrics.inc("cancelled_total")
+        return True
+
     # -- admission ------------------------------------------------------------
     def queue_depth(self) -> int:
         with self._cond:
@@ -143,6 +196,8 @@ class EngineBase:
         with self._cond:
             if self._closed:
                 raise EngineClosed("engine closed")
+            if self._fenced:
+                raise EngineClosed("engine fenced (draining)")
             if len(self._queue) >= max_queue:
                 self.metrics.inc("rejected_total")
                 raise QueueFull(f"queue at capacity ({max_queue})")

@@ -87,15 +87,16 @@ class GenerationConfig:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "generated", "trace", "t_decode0", "deadline",
-                 "blocks", "total_blocks")
+                 "blocks", "total_blocks", "on_token")
 
     def __init__(self, prompt, max_new_tokens, future, t_submit,
-                 deadline=None):
+                 deadline=None, on_token=None):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline  # absolute monotonic seconds, or None
+        self.on_token = on_token  # per-token stream callback, or None
         self.generated: List[int] = []
         self.trace = None      # request-scoped trace id
         self.t_decode0 = None  # decode-phase start (prefill done)
@@ -375,6 +376,7 @@ class GenerationEngine(EngineBase):
 
         # -- speculative decoding (draft model) --------------------------------
         self.spec_k = 0
+        self._spec_on = True  # brownout toggle: set_speculative(False)
         if self.config.draft_model is not None:
             import jax.numpy as jnp
 
@@ -506,12 +508,16 @@ class GenerationEngine(EngineBase):
 
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
-               deadline_ms: Optional[float] = None) -> "Future":
+               deadline_ms: Optional[float] = None,
+               on_token=None) -> "Future":
         """Queue one prompt (1-D int array). The future resolves to the
         full sequence (prompt + generated) as a 1-D np.int64 array. A
         ``deadline_ms`` bounds QUEUE time: expired requests are shed with
         ``DeadlineExceeded`` before prefill, and queued requests join
-        slots earliest-deadline-first."""
+        slots earliest-deadline-first. ``on_token(t)`` (optional) fires
+        once per emitted token IN ORDER, before the future resolves — the
+        streaming seam the fleet RPC uses for replay/dedup bookkeeping;
+        callbacks run on the engine worker thread and must be cheap."""
         self.metrics.inc("requests_total")
         fut: Future = Future()
         prompt = np.asarray(prompt_ids)
@@ -555,7 +561,7 @@ class GenerationEngine(EngineBase):
         deadline = None if deadline_ms is None \
             else t_submit + deadline_ms / 1000.0
         req = _GenRequest(prompt.astype(np.int64), int(max_new_tokens), fut,
-                          t_submit, deadline)
+                          t_submit, deadline, on_token=on_token)
         req.blocks = token_blocks(req.prompt, self._pl)
         req.total_blocks = needed
         tr = _tracer()
@@ -576,6 +582,18 @@ class GenerationEngine(EngineBase):
             if b >= n:
                 return b if b <= self.max_len else None
         return None
+
+    def set_speculative(self, enabled: bool) -> None:
+        """Brownout lever: toggle draft-model speculation per decode
+        round. Off = classic W=1 decode (already warmed), shedding the
+        draft's k dense steps per round under overload. The draft's
+        prompt prefill keeps running so a later re-enable stays correct —
+        only its proposal quality degrades until its cache catches up
+        (the target verifies every token, so output never changes)."""
+        self._spec_on = bool(enabled)
+
+    def speculative_enabled(self) -> bool:
+        return bool(self.spec_k) and self._spec_on
 
     # -- router probes --------------------------------------------------------
     def kv_headroom(self) -> float:
@@ -780,8 +798,18 @@ class GenerationEngine(EngineBase):
         s.length = p
         s.last_token = first
         s.t0 = t1  # slot residency opens (occupancy track)
-        req.generated.append(first)
+        self._note_token(req, first)
         self._emit_finish_check(slot_no)
+
+    def _note_token(self, req: _GenRequest, t: int) -> None:
+        """One emitted token: record it and fire the stream callback (a
+        client callback must never sink the decode batch)."""
+        req.generated.append(int(t))
+        if req.on_token is not None:
+            try:
+                req.on_token(int(t))
+            except Exception:
+                pass
 
     def _draft_prefill(self, slot_no: int, prompt: np.ndarray):
         """Land the draft model's K/V for the whole prompt in its slot
@@ -814,7 +842,7 @@ class GenerationEngine(EngineBase):
         from .. import profiler
 
         S, B = self.config.max_slots, self._n_blocks
-        k = self.spec_k
+        k = self.spec_k if self._spec_on else 0
         W = k + 1
         tokens = np.zeros((S, W), dtype=np.int32)
         lengths = np.zeros(S, dtype=np.int32)
@@ -881,7 +909,7 @@ class GenerationEngine(EngineBase):
             for t in emit:
                 s.length += 1
                 s.last_token = t
-                s.req.generated.append(t)
+                self._note_token(s.req, t)
                 emitted_total += 1
                 if self._emit_finish_check(i):
                     break

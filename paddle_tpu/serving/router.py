@@ -32,15 +32,84 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from .base import EngineClosed, QueueFull
+from .base import (BadRequest, DeadlineExceeded, EngineClosed, QueueFull,
+                   ReplicaFault)
 from .generation import GenerationEngine
 from .paged_kv import token_blocks
 
-__all__ = ["RouterConfig", "ReplicaRouter", "TenantQuotaExceeded"]
+__all__ = ["RouterConfig", "ReplicaRouter", "TenantQuotaExceeded",
+           "classify_submit_error", "score_candidates"]
 
 
 class TenantQuotaExceeded(QueueFull):
     """The tenant's in-flight quota is exhausted (admission control)."""
+
+
+def classify_submit_error(e: BaseException) -> str:
+    """What a replica's ``submit`` raising ``e`` means for FENCING:
+
+    - ``"busy"``: backpressure (``QueueFull``) — try the next candidate,
+      the replica is healthy;
+    - ``"request"``: the REQUEST is at fault (malformed payload, expired
+      deadline, unexpected programming error) — surface it to the caller
+      and leave the replica in the candidate set;
+    - ``"fault"``: the REPLICA is at fault (closed, lost RPC connection,
+      dead process) — fence it and re-dispatch through the survivors.
+
+    Order matters: ``DeadlineExceeded`` IS a ``TimeoutError`` which IS an
+    ``OSError`` in py3, so request shapes are matched before the
+    connection-error shapes. Unknown exceptions default to ``"request"``
+    — fencing a healthy replica on every stray bug starves the fleet one
+    exception at a time (the PR-15 satellite's regression)."""
+    if isinstance(e, QueueFull):
+        return "busy"
+    if isinstance(e, (BadRequest, DeadlineExceeded)):
+        return "request"
+    if isinstance(e, (EngineClosed, ReplicaFault, ConnectionError,
+                      BrokenPipeError, OSError)):
+        return "fault"
+    return "request"
+
+
+def score_candidates(cfg: "RouterConfig", prompt,
+                     candidates: Sequence[Any]
+                     ) -> Tuple[List[float], List[int]]:
+    """(score, matched-prefix-tokens) per candidate, lower score wins —
+    the load/affinity dispatch policy shared by ``ReplicaRouter`` (thread
+    replicas) and ``ServingFleet`` (process replicas). The prefix match
+    is probed ONCE here and reused for the affinity accounting — a
+    post-submit probe would count the request's own just-inserted blocks
+    as a hit."""
+    p = max(len(prompt), 1)
+    # the prefix-match probe runs FIRST: for an RPC-backed replica it
+    # is the combined probe whose reply also carries queue depth /
+    # headroom / p95, so the reads below are cache hits — one round
+    # trip per candidate, not four. Token-block chains are built ONCE
+    # per page size, not once per replica — for an in-process engine
+    # the probe is then just a trie walk.
+    blk_cache: Dict[int, Any] = {}
+    matches = []
+    for r in candidates:
+        pl = getattr(getattr(r, "config", None), "page_len", None)
+        if pl is None:
+            matches.append(r.prefix_match_tokens(prompt))
+            continue
+        if pl not in blk_cache:
+            blk_cache[pl] = token_blocks(prompt, pl,
+                                         limit=(len(prompt) - 1) // pl)
+        matches.append(r.prefix_match_tokens(prompt, blocks=blk_cache[pl]))
+    depths = [r.queue_depth() for r in candidates]
+    p95s = [r.metrics.latency_percentile(95) for r in candidates]
+    p95_hi = max(max(p95s), 1e-9)
+    q_hi = max(max(depths), 1)
+    scores = []
+    for r, d, p95, match in zip(candidates, depths, p95s, matches):
+        s = cfg.w_queue * (d / q_hi) \
+            + cfg.w_memory * (1.0 - r.kv_headroom()) \
+            + cfg.w_latency * (p95 / p95_hi) \
+            - cfg.w_affinity * (match / p)
+        scores.append(s)
+    return scores, matches
 
 
 @dataclass
@@ -88,6 +157,7 @@ class ReplicaRouter:
         self._inflight_total = 0
         self._routed: Dict[str, int] = {r.name: 0 for r in self._replicas}
         self._affinity_hits = 0
+        self._readmitted = 0
         self._rejected = {"quota": 0, "capacity": 0}
         self._closed = False
         self._t0 = time.monotonic()
@@ -128,41 +198,36 @@ class ReplicaRouter:
             down = set(self._down)
         return [r for r in self._replicas if r.name not in down]
 
+    def probe_down(self) -> List[str]:
+        """Health-probe every fenced replica and RE-ADMIT the ones that
+        pass (fence -> probe -> re-admission): a replica fenced on a
+        transient fault — or restarted by the fleet supervisor — rejoins
+        the candidate set, and prefix-affinity routing resumes steering
+        it the prefixes it still caches. A replica without a ``health``
+        probe stays fenced (only positive evidence re-admits)."""
+        with self._lock:
+            down = set(self._down)
+        readmitted = []
+        for r in self._replicas:
+            if r.name not in down:
+                continue
+            probe = getattr(r, "health", None)
+            try:
+                ok = bool(probe()) if probe is not None else False
+            except Exception:
+                ok = False
+            if ok:
+                self.mark_up(r.name)
+                readmitted.append(r.name)
+        if readmitted:
+            with self._lock:
+                self._readmitted += len(readmitted)
+        return readmitted
+
     # -- dispatch -------------------------------------------------------------
     def _scores(self, prompt, candidates: List[GenerationEngine]
                 ) -> Tuple[List[float], List[int]]:
-        """(score, matched-prefix-tokens) per candidate, lower score
-        wins. The match is probed ONCE here and reused for the affinity
-        accounting — a post-submit probe would count the request's own
-        just-inserted blocks as a hit."""
-        cfg = self.config
-        p = max(len(prompt), 1)
-        depths = [r.queue_depth() for r in candidates]
-        p95s = [r.metrics.latency_percentile(95) for r in candidates]
-        # token-block chains are built ONCE per page size, not once per
-        # replica — the probe itself is then just a trie walk
-        blk_cache: Dict[int, Any] = {}
-        matches = []
-        for r in candidates:
-            pl = getattr(getattr(r, "config", None), "page_len", None)
-            if pl is None:
-                matches.append(r.prefix_match_tokens(prompt))
-                continue
-            if pl not in blk_cache:
-                blk_cache[pl] = token_blocks(prompt, pl,
-                                             limit=(len(prompt) - 1) // pl)
-            matches.append(r.prefix_match_tokens(prompt,
-                                                 blocks=blk_cache[pl]))
-        p95_hi = max(max(p95s), 1e-9)
-        q_hi = max(max(depths), 1)
-        scores = []
-        for r, d, p95, match in zip(candidates, depths, p95s, matches):
-            s = cfg.w_queue * (d / q_hi) \
-                + cfg.w_memory * (1.0 - r.kv_headroom()) \
-                + cfg.w_latency * (p95 / p95_hi) \
-                - cfg.w_affinity * (match / p)
-            scores.append(s)
-        return scores, matches
+        return score_candidates(self.config, prompt, candidates)
 
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                tenant: str = "default",
@@ -197,8 +262,15 @@ class ReplicaRouter:
     def _dispatch(self, prompt, max_new_tokens, deadline_ms):
         last_exc: Optional[Exception] = None
         tried = 0
+        probed = False
         while True:
             candidates = self.healthy()
+            if not candidates and not probed:
+                # last resort before failing the request: maybe a fenced
+                # replica recovered (restarted by the fleet supervisor)
+                probed = True
+                if self.probe_down():
+                    continue
             if not candidates:
                 raise EngineClosed("no healthy replicas")
             scores, matches = self._scores(prompt, candidates)
@@ -209,16 +281,23 @@ class ReplicaRouter:
                 try:
                     fut = r.submit(prompt, max_new_tokens,
                                    deadline_ms=deadline_ms)
-                except EngineClosed as e:
+                except Exception as e:
+                    kind = classify_submit_error(e)
+                    if kind == "request":
+                        # the REQUEST is at fault (malformed payload,
+                        # expired deadline): the replica stays healthy —
+                        # fencing here would let one bad client starve
+                        # the fleet a replica at a time
+                        raise
+                    if kind == "busy":
+                        last_exc = e
+                        continue
                     # replica fault: fence it and keep draining through
                     # the survivors
                     self.mark_down(r.name)
                     last_exc = e
                     progressed = True
                     break  # re-score against the surviving set
-                except QueueFull as e:
-                    last_exc = e
-                    continue
                 with self._lock:
                     self._routed[r.name] = self._routed.get(r.name, 0) + 1
                     if matches[idx] > 0:
@@ -270,4 +349,5 @@ class ReplicaRouter:
                 "fleet_qps": round(qps, 3), "down": down,
                 "inflight": inflight, "rejected": rejected,
                 "affinity_hits": affinity,
+                "readmitted": self._readmitted,
                 "uptime_s": round(time.monotonic() - self._t0, 1)}

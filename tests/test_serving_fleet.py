@@ -1,0 +1,762 @@
+"""ISSUE 15: the fault-tolerant multi-process serving fleet.
+
+Covers the acceptance surface without paying for processes where the
+logic is pure or in-process: the wire protocol, the FleetStateMachine's
+replica-mode fence/restart decisions (grace window, per-rank budget,
+backoff), the router's classified submit errors (a malformed request
+must leave a healthy replica in the candidate set) and health-probe
+re-admission (fence -> probe -> rejoin, prefix affinity resumes), the
+replay-dedup ledger (no duplicated or lost streamed token across a
+fence), hedging first-wins with loser cancellation, brownout stages
+with hysteresis/clamp/shed, rolling restarts, decorrelated retry
+jitter, and the deterministic replica fault kinds. The real N-process
+protocol is drilled end to end by ``tools/serving_fleet_drill.py``
+(ci.sh serving-fleet gate) plus a 2-process crash test here (slow).
+"""
+import os
+import socket
+import threading
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.distributed.fleet.runtime import (
+    FleetPolicy, FleetStateMachine,
+)
+from paddle_tpu.distributed.resilience import retry as rz
+from paddle_tpu.distributed.resilience.faults import (
+    FaultInjector, _parse_env,
+)
+from paddle_tpu.serving import (
+    BrownoutShed, ServingFleet, ServingFleetPolicy,
+)
+from paddle_tpu.serving.base import (
+    BadRequest, DeadlineExceeded, EngineClosed, QueueFull, ReplicaFault,
+    RequestCancelled,
+)
+from paddle_tpu.serving.fleet import (
+    BROWNOUT_STAGES, brownout_max_new, brownout_sheds, brownout_stage,
+    recv_frame, send_frame, stitch_replay,
+)
+from paddle_tpu.serving.metrics import MetricsRegistry
+from paddle_tpu.serving.router import (
+    ReplicaRouter, RouterConfig, classify_submit_error,
+)
+
+
+# -- wire protocol ------------------------------------------------------------
+
+def test_frame_roundtrip_and_numpy_coercion():
+    a, b = socket.socketpair()
+    try:
+        msgs = [
+            {"op": "submit", "rid": 1, "prompt": [1, 2, 3]},
+            {"rid": 2, "event": "token", "t": np.int64(7)},
+            {"rid": 3, "event": "done",
+             "seq": np.arange(4, dtype=np.int64)},
+            {"big": "x" * 70000},  # larger than one recv() chunk
+        ]
+        got = []
+        def reader():
+            for _ in msgs:
+                got.append(recv_frame(b))
+        th = threading.Thread(target=reader)
+        th.start()
+        for m in msgs:
+            send_frame(a, m)
+        th.join(timeout=10)
+        assert got[0] == msgs[0]
+        assert got[1]["t"] == 7
+        assert got[2]["seq"] == [0, 1, 2, 3]   # ndarray -> list
+        assert got[3]["big"] == "x" * 70000
+        a.close()
+        assert recv_frame(b) is None           # clean EOF -> None
+    finally:
+        for s in (a, b):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+
+# -- FleetStateMachine replica mode -------------------------------------------
+
+def test_replica_mode_fence_restart_budget_and_backoff():
+    pol = FleetPolicy(heartbeat_timeout=2.0, max_restarts=2,
+                      backoff_base_s=0.5, backoff_max_s=2.0)
+    sm = FleetStateMachine(3, pol, now=0.0)
+    for r in range(3):
+        sm.heartbeat(r, 0.0)
+    # fence one replica; the others are untouched (no gang semantics)
+    assert sm.replica_fence(1, 1.0, "crash", rc=43)
+    assert not sm.replica_fence(1, 1.1, "crash")   # idempotent
+    assert sm.phase.value == "running"             # survivors serve on
+    acts = [sm.replica_restart_decision(1, 2.0)]
+    sm.replica_restarted(1, 2.5)
+    sm.heartbeat(1, 3.0)                           # re-join
+    sm.replica_fence(1, 4.0, "stale_heartbeat")
+    acts.append(sm.replica_restart_decision(1, 5.0))
+    sm.replica_restarted(1, 5.5)
+    # budget exhausted on the third decision
+    sm.replica_fence(1, 6.0, "crash")
+    act = sm.replica_restart_decision(1, 7.0)
+    assert act.kind == "fail" and "budget" in act.reason
+    # backoff grows with the per-rank restart count (capped formula)
+    assert acts[0].kind == "restart" and acts[0].backoff_s == 0.5
+    assert acts[1].backoff_s == 1.0
+    assert sm.replica_restart_counts() == {1: 2}
+    events = [e["event"] for e in sm.timeline]
+    assert events.count("fence") == 3
+    assert events.count("evict") == 3
+    assert events.count("restart") == 2
+    assert "fail" in events
+    # join recorded again after the restart
+    assert events.count("join") >= 4
+    sm.note("roll_done", 8.0, rank=1)
+    assert sm.timeline[-1]["event"] == "roll_done"
+
+
+def test_replica_mode_grace_window_no_false_evict():
+    pol = FleetPolicy(heartbeat_timeout=5.0)
+    sm = FleetStateMachine(2, pol, now=0.0)
+    sm.heartbeat(0, 0.0)
+    sm.heartbeat(1, 0.0)
+    # a stall SHORTER than the grace window never lands in stale_ranks
+    assert sm.stale_ranks(4.9) == []
+    assert sm.stale_ranks(5.1) == [0, 1]
+    sm.heartbeat(0, 5.0)
+    assert sm.stale_ranks(6.0) == [1]
+    # fencing pops the beat record: a hung process waking later must
+    # not flap the fenced replica back into membership bookkeeping
+    sm.replica_fence(1, 6.0, "stale_heartbeat")
+    assert sm.stale_ranks(7.0) == []
+
+
+# -- satellite 1: classified submit errors ------------------------------------
+
+def test_classify_submit_error_shapes():
+    assert classify_submit_error(QueueFull("full")) == "busy"
+    assert classify_submit_error(
+        serving.TenantQuotaExceeded("q")) == "busy"
+    assert classify_submit_error(BadRequest("bad")) == "request"
+    # DeadlineExceeded IS a TimeoutError IS an OSError: must still be
+    # request-scoped (the ordering trap the satellite names)
+    assert classify_submit_error(DeadlineExceeded("late")) == "request"
+    assert classify_submit_error(EngineClosed("down")) == "fault"
+    assert classify_submit_error(ReplicaFault("gone")) == "fault"
+    assert classify_submit_error(ConnectionResetError("rst")) == "fault"
+    assert classify_submit_error(BrokenPipeError("pipe")) == "fault"
+    assert classify_submit_error(OSError("io")) == "fault"
+    # unknown exceptions never fence a healthy replica
+    assert classify_submit_error(RuntimeError("?")) == "request"
+    assert classify_submit_error(TypeError("?")) == "request"
+
+
+class _FakeReplica:
+    """GenerationEngine-shaped stub for router/fleet policy tests."""
+
+    def __init__(self, name, depth=0, headroom=1.0, match=0,
+                 submit_exc=None, healthy=True):
+        self.name = name
+        self.metrics = MetricsRegistry()
+        self.depth, self.headroom, self.match = depth, headroom, match
+        self.submit_exc = submit_exc
+        self.healthy = healthy
+        self.submitted = []
+        self.jobs = []            # (prompt, max_new, on_token, future)
+        self.restarts = 0
+        self.drained = 0
+        self.spec = True
+        self.cancelled = []
+
+    def start(self):
+        return self
+
+    def close(self, drain=True):
+        pass
+
+    def restart(self):
+        self.restarts += 1
+
+    def fence(self):
+        pass
+
+    def drain(self):
+        self.drained += 1
+
+    def health(self):
+        return self.healthy
+
+    def queue_depth(self):
+        return self.depth
+
+    def stats(self):
+        return self.metrics.snapshot()
+
+    def kv_headroom(self):
+        return self.headroom
+
+    def prefix_match_tokens(self, prompt, blocks=None):
+        return self.match
+
+    def set_speculative(self, on):
+        self.spec = on
+
+    def cancel(self, fut):
+        self.cancelled.append(fut)
+        return False
+
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               on_token=None):
+        if self.submit_exc is not None:
+            raise self.submit_exc
+        fut = Future()
+        self.submitted.append(np.asarray(prompt))
+        self.jobs.append((np.asarray(prompt), int(max_new_tokens),
+                          on_token, fut))
+        return fut
+
+    def finish_job(self, i=0):
+        """Complete one job: tokens continue prompt[-1]+1, +2, ..."""
+        prompt, mx, cb, fut = self.jobs.pop(i)
+        toks = [int(prompt[-1]) + 1 + j for j in range(mx)]
+        for t in toks:
+            if cb:
+                cb(t)
+        fut.set_result(np.asarray(list(prompt) + toks, np.int64))
+
+
+def test_router_request_error_leaves_replica_healthy():
+    """The satellite regression: a request-scoped error (malformed
+    payload, expired deadline) must surface to the caller and leave the
+    replica in ``healthy()`` — NOT fence it like a crash."""
+    bad = _FakeReplica("only", submit_exc=BadRequest("malformed"))
+    router = ReplicaRouter([bad])
+    with pytest.raises(BadRequest):
+        router.submit(np.arange(4))
+    assert [r.name for r in router.healthy()] == ["only"]
+    assert router.stats()["down"] == []
+    bad.submit_exc = DeadlineExceeded("expired")
+    with pytest.raises(DeadlineExceeded):
+        router.submit(np.arange(4))
+    assert [r.name for r in router.healthy()] == ["only"]
+    # quota release: the request-scoped failure freed its admission slot
+    assert router.stats()["inflight"] == {"default": 0}
+
+
+def test_router_fault_shapes_fence_and_reroute():
+    dead = _FakeReplica("dead", submit_exc=ConnectionResetError("rst"))
+    live = _FakeReplica("live")
+    router = ReplicaRouter([dead, live])
+    router.submit(np.arange(4))
+    assert len(live.submitted) == 1
+    assert router.stats()["down"] == ["dead"]
+
+
+def test_router_probe_down_readmission_health_gated():
+    a = _FakeReplica("a")
+    b = _FakeReplica("b", healthy=False)
+    router = ReplicaRouter([a, b])
+    router.mark_down("a")
+    router.mark_down("b")
+    # only the replica whose health probe passes rejoins
+    assert router.probe_down() == ["a"]
+    assert sorted(r.name for r in router.healthy()) == ["a"]
+    st = router.stats()
+    assert st["down"] == ["b"] and st["readmitted"] == 1
+    # ...and an all-down router probes as a last resort inside submit
+    router.mark_down("a")
+    router.submit(np.arange(3))
+    assert len(a.submitted) == 1
+
+
+def test_router_fence_probe_rejoin_affinity_cycle_three_replicas():
+    """Satellite 4 (the PR-14 2-replica affinity test grown to a
+    3-replica fence/rejoin cycle): the prefix holder is fenced, traffic
+    fails over, the health probe re-admits it, and prefix-affinity
+    routing RESUMES steering it matching prefixes."""
+    holder = _FakeReplica("holder", match=16)
+    cold1 = _FakeReplica("cold1")
+    cold2 = _FakeReplica("cold2")
+    router = ReplicaRouter([cold1, holder, cold2],
+                           RouterConfig(w_affinity=4.0))
+    prompt = np.arange(16)
+    router.submit(prompt)
+    assert len(holder.submitted) == 1          # affinity wins
+    # fence the holder (supervisor view of a crash)
+    router.mark_down("holder")
+    router.submit(prompt)
+    assert len(holder.submitted) == 1          # no traffic while down
+    assert len(cold1.submitted) + len(cold2.submitted) == 1
+    # probe -> re-admission -> affinity resumes on the SAME prefix
+    assert router.probe_down() == ["holder"]
+    router.submit(prompt)
+    assert len(holder.submitted) == 2
+    st = router.stats()
+    assert st["down"] == [] and st["readmitted"] == 1
+    assert st["affinity_hits"] >= 2
+
+
+# -- satellite 2: decorrelated retry jitter -----------------------------------
+
+def test_decorrelated_backoff_bounds_and_decorrelation():
+    import random
+
+    rng = random.Random(7)
+    prev, seen = 10.0, []
+    for _ in range(50):
+        prev = rz.decorrelated_backoff_ms(prev, 10.0, 500.0, rng)
+        assert 10.0 <= prev <= 500.0
+        seen.append(prev)
+    assert len(set(round(s, 6) for s in seen)) > 10  # jittered, not fixed
+    # deterministic under the same seed (the drills' replay contract)
+    r1, r2 = random.Random(3), random.Random(3)
+    s1 = [rz.decorrelated_backoff_ms(25.0, 25.0, 1000.0, r1)
+          for _ in range(10)]
+    s2 = [rz.decorrelated_backoff_ms(25.0, 25.0, 1000.0, r2)
+          for _ in range(10)]
+    assert s1 == s2
+
+
+def test_with_retries_jitter_sleeps_within_bounds(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(rz.time, "sleep", lambda s: sleeps.append(s))
+    monkeypatch.setenv("PT_TRANSFER_BACKOFF_MAX_MS", "200")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 4:
+            raise RuntimeError("transient-ish")
+        return "ok"
+
+    assert rz.with_retries(flaky, retries=3, backoff_ms=20) == "ok"
+    assert len(sleeps) == 3
+    for s in sleeps:
+        assert 0.02 <= s <= 0.2 + 1e-9      # base..cap, in seconds
+    # the kill-switch restores the pre-jitter exponential schedule
+    sleeps.clear()
+    calls["n"] = 0
+    assert rz.with_retries(flaky, retries=3, backoff_ms=20,
+                           jitter=False) == "ok"
+    assert sleeps == [0.02, 0.04, 0.08]
+
+
+def test_retry_seed_env_gives_deterministic_jitter(monkeypatch):
+    import random
+
+    monkeypatch.setenv("PT_RETRY_SEED", "11")
+    monkeypatch.setattr(rz, "_RNG", None)
+    assert rz._rng().random() == random.Random(11).random()
+    monkeypatch.setattr(rz, "_RNG", None)  # fresh process twin
+    assert rz._rng().random() == random.Random(11).random()
+
+
+# -- satellite 3: deterministic replica fault kinds ---------------------------
+
+def test_replica_fault_kinds_parse_and_match():
+    inj = FaultInjector()
+    _parse_env("replica_crash@name=r1&seq=4,"
+               "replica_hang@name=r2&seq=6,"
+               "replica_slow@name=r3&ms=5&times=-1", inj)
+    # name+seq matching: only the named replica at the exact submit
+    assert not inj.peek("replica_crash", name="r2", seq=4)
+    assert not inj.peek("replica_crash", name="r1", seq=3)
+    assert inj.peek("replica_crash", name="r1", seq=4)
+    assert not inj.peek("replica_crash", name="r1", seq=4)  # consumed
+    assert inj.peek("replica_hang", name="r2", seq=6)
+    # replica_slow: unlimited sleep rule, never raises
+    t0 = time.perf_counter()
+    inj.check("replica_slow", name="r3")
+    assert time.perf_counter() - t0 >= 0.004
+    inj.check("replica_slow", name="r3")                    # times=-1
+    assert inj.fired("replica_slow") == 2
+    inj.check("replica_slow", name="r1")                    # no match
+    # inc pinning: a restarted worker re-parses PT_FAULTS and walks seq
+    # from 1 again — inc=0 rules must not re-fire in incarnation 1
+    inj2 = FaultInjector()
+    _parse_env("replica_crash@name=r1&seq=2&inc=0", inj2)
+    assert not inj2.peek("replica_crash", name="r1", seq=2, inc=1)
+    assert inj2.peek("replica_crash", name="r1", seq=2, inc=0)
+
+
+# -- replay stitching + brownout (pure) ---------------------------------------
+
+def test_stitch_replay_dedups_exactly():
+    # replica_seq = (prompt + emitted) re-prefilled + fresh tail
+    assert stitch_replay([1, 2], [3, 4], [1, 2, 3, 4, 5, 6]) == \
+        [1, 2, 3, 4, 5, 6]
+    # nothing fresh (crash after the last token, before the done frame)
+    assert stitch_replay([1], [2], [1, 2]) == [1, 2]
+    assert stitch_replay([1], [], [1, 9]) == [1, 9]
+
+
+def test_brownout_stage_thresholds_and_hysteresis():
+    p = ServingFleetPolicy()           # 0.7 / 0.85 / 0.95, hyst 0.2
+    assert brownout_stage(0, 0.0, p) == 0
+    assert brownout_stage(0, 0.7, p) == 1
+    assert brownout_stage(0, 0.85, p) == 2
+    assert brownout_stage(0, 0.96, p) == 3
+    # hysteresis: entry at 0.7 exits only below 0.5, one stage per eval
+    assert brownout_stage(1, 0.6, p) == 1
+    assert brownout_stage(1, 0.45, p) == 0
+    assert brownout_stage(3, 0.1, p) == 2
+    assert brownout_stage(2, 0.1, p) == 1
+    assert len(BROWNOUT_STAGES) == 4
+
+
+def test_brownout_clamp_and_shed_decisions():
+    p = ServingFleetPolicy(brownout_clamp_tokens=4,
+                           interactive_deadline_ms=1000.0,
+                           brownout_keep_priority=1)
+    # stage < 2 never clamps
+    assert brownout_max_new(1, None, 64, p) == 64
+    # stage 2 clamps the batch class (no deadline / lax deadline)
+    assert brownout_max_new(2, None, 64, p) == 4
+    assert brownout_max_new(2, 60_000, 64, p) == 4
+    # ...but interactive traffic keeps its budget
+    assert brownout_max_new(2, 500, 64, p) == 64
+    assert brownout_sheds(3, 0, p) and not brownout_sheds(3, 1, p)
+    assert not brownout_sheds(2, 0, p)
+
+
+# -- the fleet's reliability logic (in-process replicas, no spawning) ---------
+
+def _mini_fleet(n=2, **policy_kw):
+    pol = ServingFleetPolicy(poll_interval=0.02, **policy_kw)
+    reps = [_FakeReplica(f"f{i}") for i in range(n)]
+    fleet = ServingFleet(replicas=reps, policy=pol).start()
+    return fleet, reps
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def test_fleet_stream_replay_dedup_after_fence():
+    """The core failover contract: fence a replica with a half-streamed
+    request -> the replay carries prompt+emitted, the final stream has
+    no duplicated or missing token, and the fenced replica restarts."""
+    fleet, (a, b) = _mini_fleet()
+    try:
+        streamed = []
+        fut = fleet.submit([7, 8], max_new_tokens=3,
+                           on_token=streamed.append)
+        assert _wait(lambda: a.jobs or b.jobs)
+        holder = a if a.jobs else b
+        survivor = b if holder is a else a
+        _p, _m, cb, _f = holder.jobs[0]
+        cb(9)                                   # one token streamed...
+        fleet.fence_replica(holder.name, cause="test_crash")
+        assert _wait(lambda: survivor.jobs)     # ...then the fence
+        rp, rmx, _cb, _f2 = survivor.jobs[0]
+        assert rp.tolist() == [7, 8, 9]         # prompt + emitted
+        assert rmx == 2                         # remaining budget only
+        survivor.finish_job()
+        out = fut.result(timeout=10)
+        assert out.tolist() == [7, 8, 9, 10, 11]
+        assert streamed == [9, 10, 11]          # exactly-once stream
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["replays"] == 1
+        assert snap["counters"]["fences"] == 1
+        assert snap["counters"].get("stream_mismatch", 0) == 0
+        # bounded backoff passed -> the external replica restarted
+        assert _wait(lambda: fleet.provider_snapshot()["replicas"]
+                     [holder.name]["state"] == "ready", timeout=15)
+        assert holder.restarts == 1
+        events = [e["event"] for e in snap["timeline"]]
+        assert "fence" in events and "restart" in events
+    finally:
+        fleet.close()
+
+
+def test_fleet_replay_completes_from_ledger_when_done_frame_lost():
+    """Crash after the LAST token but before the done frame: the replay
+    path completes straight from the emitted ledger — no re-execution,
+    no duplicate tokens."""
+    fleet, (a, b) = _mini_fleet()
+    try:
+        streamed = []
+        fut = fleet.submit([1], max_new_tokens=2,
+                           on_token=streamed.append)
+        assert _wait(lambda: a.jobs or b.jobs)
+        holder = a if a.jobs else b
+        survivor = b if holder is a else a
+        _p, _m, cb, _f = holder.jobs[0]
+        cb(5)
+        cb(6)                                   # full budget streamed
+        fleet.fence_replica(holder.name, cause="test_crash")
+        out = fut.result(timeout=10)
+        assert out.tolist() == [1, 5, 6]
+        assert streamed == [5, 6]
+        assert not survivor.jobs                # never re-dispatched
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["replayed_complete"] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_hedge_first_wins_and_cancels_loser():
+    fleet, (a, b) = _mini_fleet(hedge_ms=100)
+    try:
+        fut = fleet.submit([1, 2], max_new_tokens=2)
+        assert _wait(lambda: a.jobs or b.jobs)
+        prim = a if a.jobs else b
+        other = b if prim is a else a
+        # no token progress past hedge_ms -> hedge lands on the other
+        assert _wait(lambda: other.jobs, timeout=10)
+        other.finish_job()                      # the hedge wins
+        out = fut.result(timeout=10)
+        assert out.tolist() == [1, 2, 3, 4]
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["hedges"] == 1
+        assert snap["counters"]["hedge_wins"] == 1
+        assert snap["counters"]["hedge_cancelled"] == 1
+        assert len(prim.cancelled) == 1         # loser cancel RPC
+        prim.finish_job()                       # late loser: ignored
+        time.sleep(0.1)
+        assert fleet.provider_snapshot()["counters"]["completed"] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_brownout_stages_spec_toggle_clamp_shed():
+    fleet, (a, b) = _mini_fleet(replica_capacity=2, hedge_ms=None)
+    try:
+        futs = [fleet.submit([9], max_new_tokens=1) for _ in range(8)]
+        assert _wait(lambda: fleet.provider_snapshot()["brownout"]
+                     ["stage"] == 3, timeout=10)
+        assert a.spec is False and b.spec is False   # stage-1 lever
+        with pytest.raises(BrownoutShed):            # stage-3 shed
+            fleet.submit([9], max_new_tokens=1, priority=0)
+        # default priority opts OUT of shedding; batch class clamps
+        cf = fleet.submit([5], max_new_tokens=20)
+        for r in (a, b):
+            while r.jobs:
+                r.finish_job()
+        time.sleep(0.2)
+        for r in (a, b):
+            while r.jobs:
+                r.finish_job()
+        out = cf.result(timeout=10)
+        assert len(out) == 1 + fleet.policy.brownout_clamp_tokens
+        for f in futs:
+            f.result(timeout=10)
+        assert _wait(lambda: fleet.provider_snapshot()["brownout"]
+                     ["stage"] == 0, timeout=10)     # decays
+        assert a.spec is True and b.spec is True     # spec restored
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["shed_brownout"] >= 1
+        assert snap["counters"]["clamped"] >= 1
+        assert snap["counters"]["brownout_transitions"] >= 2
+        assert any(e["event"] == "brownout" for e in snap["timeline"])
+    finally:
+        fleet.close()
+
+
+def test_fleet_rolling_restart_serialized_and_zero_failures():
+    fleet, reps = _mini_fleet(n=3)
+    try:
+        res = fleet.rolling_restart()
+        assert res["ok"] and len(res["rolled"]) == 3
+        assert all(r.restarts == 1 for r in reps)
+        assert all(r.drained == 1 for r in reps)
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["rolled_replicas"] == 3
+        assert snap["counters"].get("restarts", 0) == 0  # no budget spent
+        assert all(r["state"] == "ready"
+                   for r in snap["replicas"].values())
+        # serialized: every drain closes before the next one opens
+        rolls = [e for e in snap["timeline"]
+                 if e["event"] in ("roll_drain", "roll_done")]
+        kinds = [e["event"] for e in rolls]
+        assert kinds == ["roll_drain", "roll_done"] * 3
+    finally:
+        fleet.close()
+
+
+def test_fleet_admission_quota_shed_and_provider_registration():
+    from paddle_tpu import observability as obs
+
+    pol = ServingFleetPolicy(poll_interval=0.02)
+    reps = [_FakeReplica("q0")]
+    fleet = ServingFleet(
+        replicas=reps, policy=pol,
+        router_config=RouterConfig(max_inflight=3, default_quota=2)
+    ).start()
+    try:
+        f1 = fleet.submit(np.arange(3), tenant="free")
+        fleet.submit(np.arange(3), tenant="free")
+        with pytest.raises(serving.TenantQuotaExceeded):
+            fleet.submit(np.arange(3), tenant="free")
+        fleet.submit(np.arange(3), tenant="vip")
+        with pytest.raises(QueueFull):
+            fleet.submit(np.arange(3), tenant="vip")
+        with pytest.raises(BadRequest):
+            fleet.submit([], max_new_tokens=2)
+        with pytest.raises(BadRequest):
+            fleet.submit([1.5, 2.5])
+        reps[0].finish_job()                   # completion frees quota
+        f1.result(timeout=10)
+        fleet.submit(np.arange(3), tenant="free")
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["rejected_quota"] == 1
+        assert snap["counters"]["rejected_capacity"] == 1
+        # the hub provider serves the same snapshot
+        hub = obs.snapshot()["serving_fleet"]
+        assert hub["name"] == "serving_fleet"
+        assert hub["counters"]["rejected_quota"] == 1
+    finally:
+        fleet.close()
+
+
+def test_fleet_close_fails_outstanding_and_rejects_new():
+    fleet, reps = _mini_fleet(n=1)
+    fut = fleet.submit(np.arange(3))
+    fleet.close()
+    with pytest.raises(EngineClosed):
+        fut.result(timeout=10)
+    with pytest.raises(EngineClosed):
+        fleet.submit(np.arange(3))
+
+
+# -- real-engine integration (slow legs; the ci.sh gate runs them) ------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    """1-layer GPT trained to continue the repeating 0..7 pattern."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dtype="float32")
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-3,
+                          parameters=model.parameters())
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y),
+                         optimizer)
+    pattern = np.tile(np.arange(8), 8)
+    ids = paddle.to_tensor(pattern[None, :].astype("int64"))
+    for _ in range(80):
+        loss = step(ids, ids)
+    assert float(loss) < 0.1
+    return model, pattern
+
+
+@pytest.mark.slow  # real engine compile; ci.sh serving-fleet gate runs it
+def test_engine_on_token_stream_order_cancel_and_fence(tiny_lm):
+    model, pattern = tiny_lm
+    eng = serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=32,
+                                        page_len=8,
+                                        prefill_buckets=(8, 16, 24)),
+        name="fleetstream")
+    with eng:
+        streamed = []
+        out = eng.submit(pattern[:9].astype("int64"), max_new_tokens=5,
+                         on_token=streamed.append).result(timeout=300)
+        # the stream IS the generated tail: in order, exactly once
+        assert streamed == out[9:].tolist()
+        assert streamed == [(9 + i) % 8 for i in range(5)]
+        # cancel() dequeues a queued request and fails its future
+        eng.fence()
+        assert not eng.health()                 # fenced: fails probes
+        with pytest.raises(EngineClosed, match="fenced"):
+            eng.submit(pattern[:9].astype("int64"), max_new_tokens=2)
+        eng.unfence()
+        assert eng.health()
+        # a queued (not yet admitted) request cancels cleanly: fill both
+        # slots with long decodes, then queue one more
+        busy = [eng.submit(pattern[:12].astype("int64"),
+                           max_new_tokens=18) for _ in range(2)]
+        queued = eng.submit(pattern[:10].astype("int64"),
+                            max_new_tokens=2)
+        assert eng.cancel(queued) in (True, False)
+        for f in busy:
+            f.result(timeout=300)
+        if queued.done() and queued.exception() is not None:
+            assert isinstance(queued.exception(), RequestCancelled)
+    # speculative toggle surface (no draft: stays a safe no-op)
+    assert eng.speculative_enabled() is False
+    eng.set_speculative(False)
+    eng.set_speculative(True)
+
+
+@pytest.mark.slow  # two real replica PROCESSES; ci.sh gate runs it
+def test_two_process_fleet_crash_failover_e2e(tmp_path):
+    """Process-mode acceptance in miniature (the full 3-process chaos
+    run lives in tools/serving_fleet_drill.py): a 2-process fleet, one
+    replica hard-crashes at its 2nd submit mid-load, every request
+    still completes with the exact greedy continuation, the crashed
+    replica restarts and is re-admitted."""
+    import subprocess
+    import sys as _sys
+
+    drill = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "serving_fleet_drill.py")
+    env = dict(os.environ)
+    env["PT_FAULTS"] = "replica_crash@name=p1&seq=2&inc=0"
+    env.setdefault("PT_PERSISTENT_CACHE_DIR",
+                   str(tmp_path / "cache"))
+    code = f"""
+import os, time
+import numpy as np
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu as paddle
+from paddle_tpu.serving import ServingFleet, ServingFleetPolicy
+from paddle_tpu.serving.fleet import resolve_builder
+
+# the uninterrupted reference: the same seeded recipe the workers run
+ref = resolve_builder({drill!r} + ":build_replica")().model
+pattern = np.tile(np.arange(8), 8)
+
+def expect(prompt, mx):
+    return np.asarray(ref.generate(
+        paddle.to_tensor(np.asarray(prompt, np.int64)[None]),
+        max_new_tokens=mx, use_cache=True).numpy())[0].tolist()
+
+fleet = ServingFleet(
+    builder={drill!r} + ":build_replica", n_replicas=2,
+    names=["p1", "p2"],
+    policy=ServingFleetPolicy(heartbeat_interval=0.25,
+                              heartbeat_timeout=3.0,
+                              backoff_base_s=0.2, poll_interval=0.05),
+    log_dir={str(tmp_path / "logs")!r})
+fleet.start(wait_ready=True, timeout=600)
+futs = [fleet.submit(pattern[o:o + 9].astype(np.int64),
+                     max_new_tokens=14) for o in (0, 3, 1, 2, 0, 5)]
+for o, f in zip((0, 3, 1, 2, 0, 5), futs):
+    out = f.result(timeout=300)
+    want = expect(pattern[o:o + 9], 14)
+    assert out.tolist() == want, (o, out.tolist(), want)
+deadline = time.time() + 90
+while time.time() < deadline:
+    snap = fleet.provider_snapshot()
+    if snap["replicas"]["p1"]["state"] == "ready" and \\
+            snap["replicas"]["p1"]["incarnation"] >= 1:
+        break
+    time.sleep(0.2)
+snap = fleet.provider_snapshot()
+assert snap["replicas"]["p1"]["state"] == "ready", snap["replicas"]
+assert snap["counters"]["fences"] >= 1, snap["counters"]
+assert snap["counters"]["restarts"] >= 1, snap["counters"]
+assert snap["counters"].get("stream_mismatch", 0) == 0
+fleet.close()
+print("E2E_OK")
+"""
+    out = subprocess.run([_sys.executable, "-c", code], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert out.returncode == 0, (out.stdout[-3000:], out.stderr[-3000:])
+    assert "E2E_OK" in out.stdout

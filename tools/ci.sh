@@ -323,6 +323,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_runtime.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 python tools/resilience_drill.py --fleet || exit 1
 
+echo "== serving-fleet gate (ISSUE-15: fault-tolerant multi-process serving) =="
+# the reliability protocol in-process (classified fence errors, health
+# re-admission, replay dedup ledger, hedging, brownout stages, rolling
+# restart, retry jitter, replica fault kinds — slow legs included:
+# real-engine stream/cancel + the 2-process crash-failover e2e), then
+# the REAL 3-process chaos drill: replica_crash mid-stream fenced and
+# replayed bit-identically (zero lost-or-duplicated tokens), a hung
+# replica fenced within the heartbeat grace window, hedged re-prefill
+# first-wins, brownout walk + decay, and a rolling restart under load
+# with zero failed requests; counters + timeline land in the
+# serving_fleet hub provider and the telemetry dump
+JAX_PLATFORMS=cpu python -m pytest tests/test_serving_fleet.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/serving_fleet_drill.py || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
