@@ -2023,7 +2023,7 @@ def _start_watchdog(budget: float):
         os._exit(0 if ok else 1)
 
     threading.Thread(target=watch, daemon=True,
-                     name="bench-watchdog").start()
+                     name="pt-bench-watchdog").start()
 
 
 def _prior_headline():

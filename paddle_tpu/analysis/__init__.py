@@ -15,8 +15,11 @@ every pass inspects traced programs WITHOUT running them.
     print(A.render(A.retrace.report()))    # why did it recompile?
 
     A.selfcheck.run_selfcheck()            # repo footgun lint (CI)
+    A.concurrency.run_concurrency()        # threads-and-locks lint (CC codes)
 
-CLI: ``python tools/pd_check.py [--self]``.
+CLI: ``python tools/pd_check.py [--self | --concurrency]``. The runtime
+half of the concurrency checker (``PT_LOCKDEP=1`` lock-order witness)
+lives in ``A.lockdep``.
 """
 from __future__ import annotations
 
@@ -26,6 +29,8 @@ from . import memory  # noqa: F401  (registers the "memory" pass)
 from . import spmd  # noqa: F401    (registers the "spmd" pass)
 from . import retrace  # noqa: F401
 from . import selfcheck  # noqa: F401
+from . import concurrency  # noqa: F401  (CC lint: threads & locks)
+from . import lockdep  # noqa: F401     (runtime lock-order witness)
 from .memory import (HBM_BYTES, PeakEstimate, estimate_peak,  # noqa: F401
                      estimate_offload_stream_hbm, estimate_train_step_hbm,
                      offload_stream_plan, stream_plan_check)
@@ -34,7 +39,7 @@ from .resilience_lint import checkpoint_story_check  # noqa: F401
 __all__ = [
     "Diagnostic", "max_severity", "render", "to_json",
     "OpNode", "Program", "capture", "run_passes", "PASSES",
-    "memory", "spmd", "retrace", "selfcheck",
+    "memory", "spmd", "retrace", "selfcheck", "concurrency", "lockdep",
     "HBM_BYTES", "PeakEstimate", "estimate_peak", "estimate_train_step_hbm",
     "estimate_offload_stream_hbm", "offload_stream_plan",
     "stream_plan_check", "checkpoint_story_check",

@@ -1,0 +1,611 @@
+"""Concurrency lint: AST pass over the threaded runtime (CC codes).
+
+The static half of pd-lockdep (the dynamic half is ``analysis.lockdep``).
+PRs 5-15 grew a dozen long-lived threads and ~300 lock sites; the
+failure modes are always the same and none of them show up in a unit
+test that never hits the interleaving. This pass finds them in the
+source:
+
+- CC001 error   blocking call under a held lock: socket / frame I/O
+  (``send_frame``/``recv_frame``/``sendall``/``recv``/``accept``/
+  ``connect``), TCPStore RPCs (``store.get/set/add/wait``), untimed
+  ``queue.get``/``put``, ``subprocess``/thread/event ``.wait()`` and
+  ``.join()`` without a timeout, ``future.result()`` without a timeout,
+  ``time.sleep``, ``jax.device_get``/``block_until_ready``, and the
+  bounded StreamLane ``submit_rows`` — inside a ``with <lock>:`` body or
+  between explicit ``acquire``/``release``. One level smarter than a
+  grep: a call to a same-module function/method that itself blocks is
+  flagged too, with the chain in the message. The condition-variable
+  idiom (``cond.wait()`` while holding ``cond`` itself) is exempt.
+- CC002 error   lock acquired in a signal handler or ``__del__``:
+  handlers run between bytecodes on the main thread — if the
+  interrupted frame holds the same (non-reentrant) lock, the process
+  self-deadlocks at the exact moment it must answer. Detected through
+  the same one-level call chain (``signal.signal(sig, fn)`` +
+  ``__del__`` methods).
+- CC003 warning non-daemon long-lived thread with no ``join``/
+  ``close()`` path in the module (also ``threading.Timer``, whose
+  thread is non-daemon by default) — leaks hang interpreter exit.
+- CC004 warning read-modify-write (``+=`` etc.) of a shared attribute
+  inside a thread-target function with no lock in scope (heuristic:
+  the lost-update race class).
+- CC005 error   nested acquisition of two repo-named locks in an order
+  that conflicts with another site (static order graph over qualified
+  lock names; ``lint_tree`` builds the graph repo-wide, so an AB site
+  in one file conflicts with a BA site in another).
+
+Lock recognition is by name: an attribute/variable whose last component
+contains ``lock``/``mutex``/``cond`` or is ``mu``/``_mu``/``cv`` (the
+repo convention: ``_lock``, ``_mu``, ``_cond``, ``_send_lock``, ...).
+
+Suppression: trailing ``# pd-lint: disable=CC001`` on the offending
+line (or on the ``def`` line for a whole function), exactly as the
+selfcheck pass. Suppressions should carry a justification comment —
+e.g. a send-serialization lock whose entire purpose is to hold the lock
+across the socket write.
+
+CLI: ``python tools/pd_check.py --concurrency`` (repo-wide, exit 1 on
+any error); library: ``run_concurrency()`` / ``lint_tree`` /
+``lint_file``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from .diagnostics import Diagnostic
+
+__all__ = ["lint_file", "lint_tree", "run_concurrency"]
+
+_LOCKISH_EXACT = {"mu", "_mu", "cv", "_cv"}
+_LOCKISH_SUBSTR = ("lock", "mutex", "cond")
+
+
+def _dotted(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression ('self._lock', ...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _is_lockish(name: str) -> bool:
+    if not name:
+        return False
+    last = name.split(".")[-1].lower()
+    return last in _LOCKISH_EXACT or \
+        any(s in last for s in _LOCKISH_SUBSTR)
+
+
+def _suppressed(src_lines: List[str], lineno: int, code: str) -> bool:
+    if 0 < lineno <= len(src_lines):
+        line = src_lines[lineno - 1]
+        if "pd-lint:" in line and ("disable=" + code in line
+                                   or "disable=all" in line):
+            return True
+    return False
+
+
+def _queueish(recv: str) -> bool:
+    comp = recv.split(".")[-1].lower() if recv else ""
+    return comp in ("q", "queue") or comp.endswith("_q") or \
+        comp.endswith("queue")
+
+
+def _storeish(recv: str) -> bool:
+    comp = recv.split(".")[-1].lower() if recv else ""
+    return comp == "store" or comp.endswith("_store")
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(k.arg == "timeout" for k in call.keywords):
+        return True
+    # positional timeout: .wait(0.05) / .join(5) / .result(30)
+    return any(isinstance(a, ast.Constant) and
+               isinstance(a.value, (int, float)) for a in call.args)
+
+
+def _blocking_reason(call: ast.Call, held: Iterable[str]) -> Optional[str]:
+    """Why this call can block, or None. ``held`` are the dotted names of
+    currently-held locks (for the condition-variable exemption)."""
+    name = _dotted(call.func)
+    if not name:
+        return None
+    parts = name.split(".")
+    last = parts[-1]
+    recv = ".".join(parts[:-1])
+    if name == "time.sleep" or name.endswith(".time.sleep"):
+        return "time.sleep"
+    if name in ("jax.device_get", "device_get") or \
+            last == "block_until_ready":
+        return f"device sync `{name}`"
+    if last in ("send_frame", "recv_frame"):
+        return f"socket frame I/O `{name}`"
+    if last in ("sendall", "accept", "connect") or \
+            (last == "recv" and recv):
+        return f"socket `{name}`"
+    if _storeish(recv) and last in ("get", "set", "add", "wait",
+                                    "delete_key"):
+        return f"TCPStore RPC `{name}`"
+    if last == "submit_rows":
+        return f"bounded-lane submit `{name}` (blocks when the ring " \
+               f"is full)"
+    if _queueish(recv):
+        if last == "get" and not call.args and not _has_timeout(call):
+            return f"untimed queue get `{name}`"
+        if last == "put" and not _has_timeout(call) and \
+                not any(k.arg == "block" and
+                        isinstance(k.value, ast.Constant) and
+                        k.value.value is False for k in call.keywords):
+            return f"untimed queue put `{name}` (bounded queues block)"
+    if last == "wait" and not _has_timeout(call):
+        if recv in held:
+            return None  # cond.wait() while holding cond: THE cv idiom
+        return f"untimed `{name}` wait"
+    if last == "result" and not call.args and not _has_timeout(call):
+        return f"`{name}` future result without a timeout"
+    if last == "join" and not call.args and not _has_timeout(call) and recv:
+        return f"untimed `{name}` join"
+    return None
+
+
+# ---------------------------------------------------------------------------
+# per-function scan
+# ---------------------------------------------------------------------------
+class _FnScan:
+    """One function's facts, collected by a statement-ordered walk that
+    tracks the held-lock context (``with`` nesting + explicit
+    acquire/release) without descending into nested function bodies."""
+
+    def __init__(self, fn: ast.AST, cls: Optional[str]):
+        self.fn = fn
+        self.cls = cls
+        self.direct_block: Optional[Tuple[ast.Call, str]] = None
+        self.acquire_sites: List[ast.AST] = []  # lock-taking sites
+        self.calls: Set[Tuple[str, str]] = set()  # callee keys
+        # CC001 candidates: (node, reason, held_names) for direct hits,
+        # (node, calleekey, held_names) for local-call hits
+        self.direct_hits: List[Tuple[ast.AST, str, Tuple[str, ...]]] = []
+        self.call_hits: List[Tuple[ast.AST, Tuple[str, str],
+                                   Tuple[str, ...]]] = []
+        self.pairs: List[Tuple[str, str, int]] = []  # (qualA, qualB, line)
+        self.has_lock_scope = False  # any with-lock / acquire in body
+
+
+def _qual_lock(name: str, cls: Optional[str], modname: str) -> str:
+    if name.startswith("self.") and cls:
+        return f"{cls}.{name[5:]}"
+    return f"{modname}:{name}"
+
+
+def _callee_key(call: ast.Call, cls: Optional[str]
+                ) -> Optional[Tuple[str, str]]:
+    f = call.func
+    if isinstance(f, ast.Name):
+        return ("mod", f.id)
+    if isinstance(f, ast.Attribute) and \
+            isinstance(f.value, ast.Name) and f.value.id == "self" and cls:
+        return (f"cls:{cls}", f.attr)
+    return None
+
+
+def _iter_calls(node: ast.AST) -> Iterable[ast.Call]:
+    """Every Call in ``node``, not descending into nested functions."""
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.Lambda)) and n is not node:
+            continue
+        if isinstance(n, ast.Call):
+            yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_fn(fn: ast.AST, cls: Optional[str], modname: str) -> _FnScan:
+    scan = _FnScan(fn, cls)
+    held: List[str] = []  # dotted receiver names, acquisition order
+
+    def note_call(call: ast.Call):
+        key = _callee_key(call, cls)
+        if key is not None:
+            scan.calls.add(key)
+        name = _dotted(call.func)
+        last = name.split(".")[-1] if name else ""
+        recv = name[: -(len(last) + 1)] if last and "." in name else ""
+        if last == "acquire" and _is_lockish(recv):
+            scan.acquire_sites.append(call)
+            scan.has_lock_scope = True
+            for prev in held:
+                if prev != recv:
+                    scan.pairs.append(
+                        (_qual_lock(prev, cls, modname),
+                         _qual_lock(recv, cls, modname), call.lineno))
+            held.append(recv)
+            return
+        if last == "release" and _is_lockish(recv):
+            if recv in held:
+                held.remove(recv)
+            return
+        reason = _blocking_reason(call, held)
+        if reason is not None:
+            if scan.direct_block is None:
+                scan.direct_block = (call, reason)
+            if held:
+                scan.direct_hits.append((call, reason, tuple(held)))
+        elif held and key is not None:
+            scan.call_hits.append((call, key, tuple(held)))
+
+    def scan_expr(node: ast.AST):
+        for call in _iter_calls(node):
+            note_call(call)
+
+    def scan_stmts(stmts: List[ast.stmt]):
+        for st in stmts:
+            scan_stmt(st)
+
+    def scan_stmt(st: ast.stmt):
+        if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                           ast.ClassDef)):
+            return  # nested defs run later, with their own held context
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            acquired = []
+            for item in st.items:
+                expr = item.context_expr
+                nm = _dotted(expr)
+                if nm and _is_lockish(nm):
+                    scan.has_lock_scope = True
+                    scan.acquire_sites.append(expr)
+                    for prev in held:
+                        if prev != nm:
+                            scan.pairs.append(
+                                (_qual_lock(prev, cls, modname),
+                                 _qual_lock(nm, cls, modname),
+                                 st.lineno))
+                    held.append(nm)
+                    acquired.append(nm)
+                else:
+                    scan_expr(expr)
+            scan_stmts(st.body)
+            for nm in reversed(acquired):
+                if nm in held:
+                    held.remove(nm)
+            return
+        if isinstance(st, ast.Try):
+            scan_stmts(st.body)
+            for h in st.handlers:
+                scan_stmts(h.body)
+            scan_stmts(st.orelse)
+            scan_stmts(st.finalbody)
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            scan_expr(st.test)
+            scan_stmts(st.body)
+            scan_stmts(st.orelse)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            scan_expr(st.iter)
+            scan_stmts(st.body)
+            scan_stmts(st.orelse)
+            return
+        scan_expr(st)
+
+    scan_stmts(fn.body)
+    return scan
+
+
+# ---------------------------------------------------------------------------
+# module-level facts: threads, signal handlers
+# ---------------------------------------------------------------------------
+def _thread_calls(tree: ast.Module) -> List[Dict[str, Any]]:
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _dotted(node.func)
+        if not (name.endswith("Thread") or name.endswith("Timer")):
+            continue
+        if name.split(".")[-1] not in ("Thread", "Timer"):
+            continue
+        kw = {k.arg: k.value for k in node.keywords}
+        daemon = kw.get("daemon")
+        target = kw.get("target")
+        tgt = None
+        if isinstance(target, ast.Name):
+            tgt = ("mod", target.id)
+        elif isinstance(target, ast.Attribute) and \
+                isinstance(target.value, ast.Name) and \
+                target.value.id == "self":
+            tgt = ("cls", target.attr)
+        out.append({
+            "node": node, "kind": name.split(".")[-1],
+            "daemon": (isinstance(daemon, ast.Constant) and
+                       daemon.value is True),
+            "named": "name" in kw, "target": tgt,
+        })
+    return out
+
+
+def _signal_handlers(tree: ast.Module) -> List[Any]:
+    """Names / lambdas registered via ``signal.signal(sig, fn)``."""
+    out: List[Any] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not _dotted(node.func).endswith("signal.signal"):
+            continue
+        if len(node.args) < 2:
+            continue
+        h = node.args[1]
+        if isinstance(h, ast.Name):
+            out.append(("mod", h.id))
+        elif isinstance(h, ast.Attribute) and \
+                isinstance(h.value, ast.Name) and h.value.id == "self":
+            out.append(("cls", h.attr))
+        elif isinstance(h, ast.Lambda):
+            out.append(("lambda", h))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# lint driver
+# ---------------------------------------------------------------------------
+def _lint_file_ex(path: str, src: Optional[str] = None
+                  ) -> Tuple[List[Diagnostic],
+                             List[Tuple[str, str, int, str]],
+                             List[str]]:
+    """Returns (diags-without-CC005, order pairs as
+    (lockA, lockB, line, fn-name), src lines). ``lint_file``/``lint_tree``
+    layer the CC005 order-graph check on top."""
+    if src is None:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return ([Diagnostic(severity="error", code="CC000",
+                            pass_name="concurrency",
+                            location=f"{path}:{e.lineno or 0}",
+                            message=f"syntax error: {e.msg}")], [], [])
+    src_lines = src.splitlines()
+    modname = os.path.splitext(os.path.basename(path))[0]
+    diags: List[Diagnostic] = []
+
+    # -- collect every function with its enclosing class ---------------------
+    fns: Dict[Tuple[str, str], _FnScan] = {}
+
+    def collect(body, cls):
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = (f"cls:{cls}" if cls else "mod", node.name)
+                fns.setdefault(key, _scan_fn(node, cls, modname))
+                collect(node.body, cls)  # nested defs, same class scope
+            elif isinstance(node, ast.ClassDef):
+                collect(node.body, node.name)
+            elif hasattr(node, "body") and isinstance(
+                    getattr(node, "body"), list):
+                collect(node.body, cls)
+
+    collect(tree.body, None)
+
+    # -- taint fixpoints: blocks / acquires, through same-module calls -------
+    block_reason: Dict[Tuple[str, str], str] = {}
+    acquires: Set[Tuple[str, str]] = set()
+    for key, scan in fns.items():
+        if scan.direct_block is not None:
+            block_reason[key] = scan.direct_block[1]
+        if scan.acquire_sites:
+            acquires.add(key)
+    changed = True
+    while changed:
+        changed = False
+        for key, scan in fns.items():
+            for callee in scan.calls:
+                if callee == key:
+                    continue
+                if callee in block_reason and key not in block_reason:
+                    via = f"{callee[1]}() → {block_reason[callee]}"
+                    block_reason[key] = via
+                    changed = True
+                if callee in acquires and key not in acquires:
+                    acquires.add(key)
+                    changed = True
+
+    def emit(node, severity, code, fn, message, suggestion=None):
+        line = getattr(node, "lineno", fn.lineno if fn else 0)
+        if _suppressed(src_lines, line, code) or \
+                (fn is not None and
+                 _suppressed(src_lines, fn.lineno, code)):
+            return
+        diags.append(Diagnostic(
+            severity=severity, code=code, pass_name="concurrency",
+            op=fn.name if fn is not None else "<module>",
+            location=f"{path}:{line}", message=message,
+            suggestion=suggestion))
+
+    # -- CC001 ----------------------------------------------------------------
+    for key, scan in fns.items():
+        for node, reason, held in scan.direct_hits:
+            emit(node, "error", "CC001", scan.fn,
+                 f"blocking call under held lock "
+                 f"{', '.join(f'`{h}`' for h in held)}: {reason}",
+                 "move the blocking call outside the lock, or bound it "
+                 "with a timeout")
+        for node, callee, held in scan.call_hits:
+            if callee in block_reason:
+                emit(node, "error", "CC001", scan.fn,
+                     f"call under held lock "
+                     f"{', '.join(f'`{h}`' for h in held)} blocks: "
+                     f"{callee[1]}() → {block_reason[callee]}",
+                     "hoist the blocking work out of the locked region")
+
+    # -- CC002 ----------------------------------------------------------------
+    handlers = _signal_handlers(tree)
+    for kind, h in handlers:
+        if kind == "lambda":
+            hit = None
+            for call in _iter_calls(h):
+                nm = _dotted(call.func)
+                if nm.endswith(".acquire") and \
+                        _is_lockish(nm.rsplit(".", 1)[0]):
+                    hit = (call, "acquires a lock")
+                key = _callee_key(call, None)
+                if key in acquires:
+                    hit = (call, f"calls {key[1]}() which takes a lock")
+            if hit is not None:
+                emit(hit[0], "error", "CC002", None,
+                     f"signal handler {hit[1]} — if the interrupted "
+                     f"frame holds it, the process self-deadlocks",
+                     "only set flags/events in signal context; do lock-"
+                     "taking work on a helper thread")
+        else:
+            for (scope, name), scan in fns.items():
+                if name != h:
+                    continue
+                if kind == "mod" and scope != "mod":
+                    continue
+                if (scope, name) in acquires:
+                    site = scan.acquire_sites[0] if scan.acquire_sites \
+                        else scan.fn
+                    emit(site, "error", "CC002", scan.fn,
+                         f"`{name}` is a signal handler but acquires a "
+                         f"lock (directly or via a callee) — handlers "
+                         f"interrupt the main thread between bytecodes; "
+                         f"if the interrupted frame holds the same non-"
+                         f"reentrant lock the process self-deadlocks",
+                         "set a flag/Event in the handler; take locks "
+                         "from a worker thread")
+    for (scope, name), scan in fns.items():
+        if name == "__del__" and (scope, name) in acquires:
+            site = scan.acquire_sites[0] if scan.acquire_sites else scan.fn
+            emit(site, "error", "CC002", scan.fn,
+                 "__del__ acquires a lock — finalizers run at arbitrary "
+                 "points (GC) on whatever thread triggered collection, "
+                 "including one already holding the lock",
+                 "use weakref finalizers or an explicit close()")
+
+    # -- CC003 / CC004 --------------------------------------------------------
+    threads = _thread_calls(tree)
+    for th in threads:
+        node = th["node"]
+        if not th["daemon"]:
+            # bound to a var/attr that is joined or daemonized later?
+            bound = None
+            for a in ast.walk(tree):
+                if isinstance(a, ast.Assign) and a.value is node and \
+                        a.targets:
+                    t = a.targets[0]
+                    if isinstance(t, ast.Name):
+                        bound = t.id
+                    elif isinstance(t, ast.Attribute):
+                        bound = t.attr
+            joined = bound is not None and (
+                f"{bound}.join" in src or f"{bound}.cancel" in src)
+            daemonized = bound is not None and \
+                f"{bound}.daemon = True" in src
+            if not joined and not daemonized:
+                emit(node, "warning", "CC003", None,
+                     f"non-daemon {th['kind']} with no join/cancel/"
+                     f"close() path in this module — leaks hold the "
+                     f"interpreter open at exit",
+                     "pass daemon=True, or register a close()/join() "
+                     "teardown")
+        if th["target"] is not None:
+            kind, tname = th["target"]
+            for (scope, name), scan in fns.items():
+                if name != tname:
+                    continue
+                if kind == "mod" and scope != "mod":
+                    continue
+                if scan.has_lock_scope:
+                    continue
+                for n in ast.walk(scan.fn):
+                    if isinstance(n, ast.AugAssign) and \
+                            isinstance(n.target, ast.Attribute):
+                        recv = _dotted(n.target.value)
+                        emit(n, "warning", "CC004", scan.fn,
+                             f"read-modify-write of shared attribute "
+                             f"`{recv}.{n.target.attr}` in thread-target "
+                             f"`{name}` with no lock in scope — "
+                             f"concurrent writers lose updates",
+                             "guard the update with the owning lock, or "
+                             "suppress with a single-writer note")
+    return diags, [(a, b, ln, fn)
+                   for key, scan in fns.items()
+                   for (a, b, ln) in scan.pairs
+                   for fn in [scan.fn.name]], src_lines
+
+
+def _order_conflicts(pairs_by_file: Dict[str, List[Tuple[str, str, int,
+                                                         str]]],
+                     lines_by_file: Dict[str, List[str]]
+                     ) -> List[Diagnostic]:
+    """CC005: build the order graph over every collected (A held -> B
+    acquired) pair and flag each site whose reverse pair exists."""
+    seen: Dict[Tuple[str, str], List[Tuple[str, int, str]]] = {}
+    for path, pairs in pairs_by_file.items():
+        for a, b, line, fn in pairs:
+            seen.setdefault((a, b), []).append((path, line, fn))
+    diags: List[Diagnostic] = []
+    emitted = set()
+    for (a, b), sites in sorted(seen.items()):
+        if (b, a) not in seen or (a, b) in emitted or a >= b:
+            continue
+        emitted.add((a, b))
+        emitted.add((b, a))
+        for (a1, b1) in ((a, b), (b, a)):
+            for path, line, fn in seen[(a1, b1)]:
+                if _suppressed(lines_by_file.get(path, []), line,
+                               "CC005"):
+                    continue
+                other = seen[(b1, a1)][0]
+                diags.append(Diagnostic(
+                    severity="error", code="CC005",
+                    pass_name="concurrency", op=fn,
+                    location=f"{path}:{line}",
+                    message=f"lock order conflict: `{a1}` held while "
+                            f"acquiring `{b1}` here, but "
+                            f"{os.path.basename(other[0])}:{other[1]} "
+                            f"({other[2]}) acquires them in the "
+                            f"opposite order — a potential AB/BA "
+                            f"deadlock",
+                    suggestion="pick one global order for these locks "
+                               "and restructure one site"))
+    return diags
+
+
+def lint_file(path: str, src: Optional[str] = None) -> List[Diagnostic]:
+    diags, pairs, lines = _lint_file_ex(path, src)
+    diags += _order_conflicts({path: pairs}, {path: lines})
+    return diags
+
+
+def lint_tree(root: str, exclude: Tuple[str, ...] = ("tests",)
+              ) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    pairs_by_file: Dict[str, List[Tuple[str, str, int, str]]] = {}
+    lines_by_file: Dict[str, List[str]] = {}
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in exclude and not d.startswith(".")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            p = os.path.join(dirpath, fname)
+            d, pairs, lines = _lint_file_ex(p)
+            diags += d
+            pairs_by_file[p] = pairs
+            lines_by_file[p] = lines
+    diags += _order_conflicts(pairs_by_file, lines_by_file)
+    return diags
+
+
+def run_concurrency(root: Optional[str] = None) -> List[Diagnostic]:
+    """Lint the installed paddle_tpu package (CI entry point)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return lint_tree(root)

@@ -1,0 +1,381 @@
+"""pd-lockdep: a runtime lock-order witness for the threaded runtime.
+
+The dynamic half of the concurrency checker (the static half is
+``analysis.concurrency``): named wrappers around ``threading.Lock`` /
+``RLock`` that record, per thread, the stack of held locks and feed every
+nested acquisition into a bounded process-wide **order graph**. A cycle
+in that graph is a potential deadlock (thread 1 takes A then B, thread 2
+takes B then A — each run is fine, the interleaving is not), the failure
+class no test catches until the fleet wedges in production.
+
+Arming
+------
+Default **off**: ``lock(name)`` / ``rlock(name)`` return plain
+``threading`` primitives — zero overhead, bit-identical behavior. Armed
+by ``PT_LOCKDEP=1`` in the environment (worker processes inherit it) or
+``lockdep.enable()`` *before* the locks are constructed; arming wraps
+every lock created afterwards. What the witness records:
+
+- **order edges**: first-seen acquisition site (short stack digest) for
+  every ``held -> acquired`` pair of distinct lock names;
+- **cycles**: a new edge closing a directed cycle is recorded once per
+  unique cycle, counted, and force-dumps a flight-recorder bundle whose
+  reason names the cycle (``lockdep_cycle:A->B->A``) — the bundle's
+  ``snapshot.json`` carries the full graph via the hub provider;
+- **contention**: acquisitions that had to wait, per lock;
+- **held-time**: max wall-ms each lock was held; holds longer than
+  ``PT_LOCKDEP_HELD_MS`` (default 250) land in a bounded outlier list
+  with the release site.
+
+Everything is bounded (edges, cycles, outliers are capped) so an armed
+long-running fleet never grows without limit. The witness's own state is
+guarded by one plain (unwitnessed) mutex, held only for dict updates —
+never across user code — so the witness cannot deadlock the runtime it
+watches.
+
+Snapshot-time surfaces: the ``lockdep`` hub provider
+(``observability.snapshot()["lockdep"]``) and ``lockdep.snapshot()``
+directly. Seeded AB/BA fixtures drill the cycle path in
+``tests/test_lockdep.py``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+import traceback
+from typing import Any, Dict, List, Optional
+
+__all__ = ["lock", "rlock", "enable", "disable", "armed", "snapshot",
+           "reset", "cycles", "Lock", "RLock"]
+
+_MAX_EDGES = 512
+_MAX_CYCLES = 16
+_MAX_OUTLIERS = 32
+_STACK_FRAMES = 6
+
+
+def _env_armed() -> bool:
+    return os.environ.get("PT_LOCKDEP", "") not in ("", "0", "false")
+
+
+_ARMED = _env_armed()
+
+
+def armed() -> bool:
+    return _ARMED
+
+
+def enable() -> None:
+    """Arm the witness for locks created from now on (tests; production
+    arms via ``PT_LOCKDEP=1`` so locks are wrapped from first import)."""
+    global _ARMED
+    _ARMED = True
+    _ensure_provider()
+
+
+def disable() -> None:
+    global _ARMED
+    _ARMED = False
+
+
+class _State:
+    """Process-wide witness state. One plain mutex guards the graph and
+    stats; it is never held while user code (or a dump) runs."""
+
+    def __init__(self):
+        self.mu = threading.Lock()
+        self.tls = threading.local()
+        # (a, b) -> {"count", "site"}: a was held when b was acquired
+        self.edges: Dict[tuple, Dict[str, Any]] = {}
+        self.adj: Dict[str, set] = {}
+        # name -> {"acquisitions", "contentions", "max_held_ms"}
+        self.locks: Dict[str, Dict[str, Any]] = {}
+        self.cycles: List[Dict[str, Any]] = []
+        self._cycle_keys: set = set()
+        self.outliers: List[Dict[str, Any]] = []
+        self.held_warn_ms = float(
+            os.environ.get("PT_LOCKDEP_HELD_MS", "250"))
+
+    def held(self) -> List[List[Any]]:
+        st = getattr(self.tls, "stack", None)
+        if st is None:
+            st = self.tls.stack = []
+        return st
+
+
+_S = _State()
+_PROVIDER_REGISTERED = False
+
+
+def _ensure_provider() -> None:
+    """Register the ``lockdep`` hub provider (idempotent; tolerates the
+    observability package mid-import — retried at the next lock
+    creation, so it lands as soon as the hub exists)."""
+    global _PROVIDER_REGISTERED
+    if _PROVIDER_REGISTERED:
+        return
+    try:
+        from ..observability import register_provider
+
+        register_provider("lockdep", snapshot)
+        _PROVIDER_REGISTERED = True
+    except Exception:
+        pass
+
+
+def _site(skip: int = 3) -> List[str]:
+    """Short acquisition-site digest: the last few in-repo frames."""
+    out = []
+    for f in traceback.extract_stack()[:-skip][-_STACK_FRAMES:]:
+        out.append(f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}")
+    return out
+
+
+def _find_path(src: str, dst: str) -> Optional[List[str]]:
+    """DFS over the order graph: a path src ->* dst (bounded by the
+    edge cap, so always small)."""
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for nxt in _S.adj.get(node, ()):
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _record_acquired(name: str, waited: bool) -> None:
+    held = _S.held()
+    new_cycle = None
+    with _S.mu:
+        st = _S.locks.setdefault(
+            name, {"acquisitions": 0, "contentions": 0, "max_held_ms": 0.0})
+        st["acquisitions"] += 1
+        if waited:
+            st["contentions"] += 1
+        for prev, _t in held:
+            if prev == name:
+                continue  # reentrant / same-name aggregation: no edge
+            key = (prev, name)
+            edge = _S.edges.get(key)
+            if edge is not None:
+                edge["count"] += 1
+                continue
+            if len(_S.edges) >= _MAX_EDGES:
+                continue
+            # new edge prev -> name: does name already reach prev?
+            back = _find_path(name, prev)
+            _S.edges[key] = {"count": 1, "site": _site(skip=4)}
+            _S.adj.setdefault(prev, set()).add(name)
+            if back is not None:
+                cyc = [prev] + back  # prev -> name ->* prev
+                ck = "->".join(sorted(set(cyc)))
+                if ck not in _S._cycle_keys and \
+                        len(_S.cycles) < _MAX_CYCLES:
+                    _S._cycle_keys.add(ck)
+                    rec = {"cycle": cyc, "thread":
+                           threading.current_thread().name,
+                           "site": _site(skip=4), "t": time.time()}
+                    _S.cycles.append(rec)
+                    new_cycle = cyc
+    held.append([name, time.perf_counter()])
+    if new_cycle is not None:
+        _on_cycle(new_cycle)
+
+
+def _record_released(name: str) -> None:
+    held = _S.held()
+    t0 = None
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][0] == name:
+            t0 = held[i][1]
+            del held[i]
+            break
+    if t0 is None:
+        return
+    held_ms = (time.perf_counter() - t0) * 1e3
+    with _S.mu:
+        st = _S.locks.get(name)
+        if st is not None and held_ms > st["max_held_ms"]:
+            st["max_held_ms"] = held_ms
+        if held_ms > _S.held_warn_ms and \
+                len(_S.outliers) < _MAX_OUTLIERS:
+            _S.outliers.append({"lock": name,
+                                "held_ms": round(held_ms, 2),
+                                "site": _site(skip=4),
+                                "thread":
+                                threading.current_thread().name})
+
+
+def _on_cycle(cyc: List[str]) -> None:
+    """A potential deadlock: count it and force-dump a flight bundle
+    naming the cycle. The dump runs on its own short-lived thread from a
+    clean lock stack — the acquiring thread is by definition holding
+    user locks right now, and the dump's snapshot walk takes hub locks."""
+    try:
+        from ..observability.registry import family
+
+        family("lockdep", ("event",)).inc(("cycle",))
+    except Exception:
+        pass
+
+    def _dump():
+        try:
+            from ..observability.trace.flight import flight_recorder
+
+            flight_recorder().trigger(
+                "lockdep_cycle:" + "->".join(cyc), force=True)
+        except Exception:
+            pass
+
+    threading.Thread(target=_dump, daemon=True,
+                     name="pt-lockdep-dump").start()
+
+
+class Lock:
+    """Witnessed non-reentrant lock. Drop-in for ``threading.Lock``
+    (also usable as the lock of a ``threading.Condition`` — ``wait``'s
+    release/reacquire passes through ``release``/``acquire`` and keeps
+    the per-thread held stack truthful)."""
+
+    _reentrant = False
+
+    def __init__(self, name: str):
+        self.name = name
+        self._inner = self._make_inner()
+
+    def _make_inner(self):
+        return threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        waited = False
+        if self._inner.acquire(False):
+            ok = True
+        elif not blocking:
+            ok = False
+        else:
+            waited = True
+            ok = self._inner.acquire(True, timeout)
+        if ok:
+            _record_acquired(self.name, waited)
+        return ok
+
+    def release(self) -> None:
+        _record_released(self.name)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+
+    def __repr__(self):  # pragma: no cover - debug aid
+        return f"<lockdep.{type(self).__name__} {self.name!r}>"
+
+
+class RLock(Lock):
+    """Witnessed reentrant lock: only the outermost acquire/release is
+    recorded (a re-entry is not an ordering event)."""
+
+    _reentrant = True
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self._owner: Optional[int] = None
+        self._depth = 0
+
+    def _make_inner(self):
+        return threading.RLock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        me = threading.get_ident()
+        if self._owner == me:
+            if not self._inner.acquire(blocking, timeout):
+                return False  # pragma: no cover - owned: cannot fail
+            self._depth += 1
+            return True
+        waited = False
+        if self._inner.acquire(False):
+            ok = True
+        elif not blocking:
+            ok = False
+        else:
+            waited = True
+            ok = self._inner.acquire(True, timeout)
+        if ok:
+            self._owner = me
+            self._depth = 1
+            _record_acquired(self.name, waited)
+        return ok
+
+    def release(self) -> None:
+        if self._owner != threading.get_ident():
+            raise RuntimeError(
+                f"lockdep.RLock {self.name!r}: release from a thread "
+                f"that does not own it")
+        self._depth -= 1
+        if self._depth == 0:
+            self._owner = None
+            _record_released(self.name)
+        self._inner.release()
+
+
+def lock(name: str):
+    """A named mutex: witnessed when armed, a plain ``threading.Lock``
+    otherwise (the adoption seam the runtime classes use)."""
+    if _ARMED:
+        _ensure_provider()
+        return Lock(name)
+    return threading.Lock()
+
+
+def rlock(name: str):
+    if _ARMED:
+        _ensure_provider()
+        return RLock(name)
+    return threading.RLock()
+
+
+# -- reads ------------------------------------------------------------------
+def snapshot() -> Dict[str, Any]:
+    """The ``lockdep`` hub provider payload: order edges, cycles,
+    per-lock acquisition/contention/held stats, held-time outliers."""
+    with _S.mu:
+        return {
+            "armed": _ARMED,
+            "edges": [{"from": a, "to": b, "count": e["count"],
+                       "site": e["site"]}
+                      for (a, b), e in sorted(_S.edges.items())],
+            "cycles": [dict(c) for c in _S.cycles],
+            "locks": {n: dict(st)
+                      for n, st in sorted(_S.locks.items())},
+            "outliers": [dict(o) for o in _S.outliers],
+            "held_warn_ms": _S.held_warn_ms,
+        }
+
+
+def cycles() -> List[Dict[str, Any]]:
+    with _S.mu:
+        return [dict(c) for c in _S.cycles]
+
+
+def reset() -> None:
+    """Clear the graph and stats (tests)."""
+    with _S.mu:
+        _S.edges.clear()
+        _S.adj.clear()
+        _S.locks.clear()
+        _S.cycles.clear()
+        _S._cycle_keys.clear()
+        _S.outliers.clear()
+
+

@@ -370,37 +370,48 @@ def init_p2p_channel(store=None):
             return _P2P_STORE
         if _P2P_STORE is not None:
             return _P2P_STORE
-        import os
-        import time
+    # build the connection with the channel lock RELEASED: the dial-retry
+    # loop below can spin for up to 60s, and threads parked on the lock
+    # for per-message sequencing must not wedge behind it (CC001)
+    import os
+    import time
 
-        endpoint = os.environ.get("PADDLE_P2P_ENDPOINT")
-        if not endpoint or ":" not in endpoint:
+    endpoint = os.environ.get("PADDLE_P2P_ENDPOINT")
+    if not endpoint or ":" not in endpoint:
+        raise RuntimeError(
+            "send/recv across processes needs a store endpoint: set "
+            "PADDLE_P2P_ENDPOINT=host:port (process rank 0 hosts the "
+            "daemon; paddle_tpu.distributed.launch sets this for gangs) "
+            "or call init_p2p_channel(store) with a connected TCPStore")
+    from .store import TCPStore
+
+    host, port = endpoint.rsplit(":", 1)
+    rank, world = _proc_rank_world()
+    if rank == 0:
+        built = TCPStore(host="0.0.0.0", port=int(port),
+                         is_master=True, world_size=world)
+    else:
+        deadline = time.time() + 60
+        built = last = None
+        while time.time() < deadline:
+            try:
+                built = TCPStore(host=host, port=int(port),
+                                 is_master=False, world_size=world)
+                break
+            except RuntimeError as e:  # master not up yet
+                last = e
+                time.sleep(0.2)
+        if built is None:
             raise RuntimeError(
-                "send/recv across processes needs a store endpoint: set "
-                "PADDLE_P2P_ENDPOINT=host:port (process rank 0 hosts the "
-                "daemon; paddle_tpu.distributed.launch sets this for gangs) "
-                "or call init_p2p_channel(store) with a connected TCPStore")
-        from .store import TCPStore
-
-        host, port = endpoint.rsplit(":", 1)
-        rank, world = _proc_rank_world()
-        if rank == 0:
-            _P2P_STORE = TCPStore(host="0.0.0.0", port=int(port),
-                                  is_master=True, world_size=world)
-        else:
-            deadline = time.time() + 60
-            last = None
-            while time.time() < deadline:
-                try:
-                    _P2P_STORE = TCPStore(host=host, port=int(port),
-                                          is_master=False, world_size=world)
-                    break
-                except RuntimeError as e:  # master not up yet
-                    last = e
-                    time.sleep(0.2)
-            else:
-                raise RuntimeError(
-                    f"cannot reach p2p store at {endpoint}: {last}")
+                f"cannot reach p2p store at {endpoint}: {last}")
+    with _P2P_CHAN_LOCK:
+        if _P2P_STORE is None:
+            _P2P_STORE = built
+        elif built is not _P2P_STORE:  # lost an init race: drop ours
+            try:
+                built.close()
+            except Exception:
+                pass
         return _P2P_STORE
 
 
@@ -600,7 +611,8 @@ def irecv(tensor, src=0, group=None, tag=0):
         except BaseException as e:
             op._exc = e
 
-    t = threading.Thread(target=run, daemon=True)
+    t = threading.Thread(target=run, daemon=True,
+                         name="pt-collective-irecv")
     op._thread = t
     t.start()
     return op

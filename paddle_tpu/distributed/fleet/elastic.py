@@ -53,7 +53,8 @@ class ElasticManager:
         # one-time publish marker so liveness probes never block (see
         # store_get_nowait: TCPStore.get blocks on absent keys by design)
         self.store.add(f"elastic/worker/{self.rank}/published", 1)
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pt-elastic-heartbeat")
         self._thread.start()
         return self
 
@@ -84,7 +85,9 @@ class ElasticManager:
                 # tries again next interval: a heartbeat gap is for the
                 # SUPERVISOR's grace window to judge, never a reason for
                 # the worker to silently stop reporting
-                self.beat_failures += 1
+                # single-writer counter: only this heartbeat thread ever
+                # increments it (readers tolerate a stale read)
+                self.beat_failures += 1  # pd-lint: disable=CC004
                 if not self._warned:
                     self._warned = True
                     import warnings

@@ -446,7 +446,9 @@ class ElasticFleet:
         self.forensics_path: Optional[str] = None
         self._ctx = None
         self._gen_t0 = 0.0
-        self._lock = threading.Lock()
+        from ...analysis.lockdep import lock as _named_lock  # lazy
+
+        self._lock = _named_lock("fleet.FleetSupervisor._lock")
         self._register_provider()
 
     # -- provider -------------------------------------------------------------
@@ -476,26 +478,29 @@ class ElasticFleet:
                 for r, ts in self.sm._beats.items()}
             snap["recoveries"] = list(self.recoveries)
             snap["plans"] = {str(g): p for g, p in self.plans.items()}
-            snap["flight_bundles"] = self._rank_bundles()
-            snap["worker_exits"] = self._worker_exits()
+            gen, world = self.sm.gen, self.sm.world
             if self.forensics_path:
                 snap["forensics"] = self.forensics_path
-            return snap
+        # store probes + bundle dir walk are TCP/disk I/O: done with the
+        # lock RELEASED so a telemetry scrape can never stall the
+        # supervisor loop behind a slow store round-trip (CC001)
+        snap["flight_bundles"] = self._rank_bundles()
+        snap["worker_exits"] = self._worker_exits(gen, world)
+        return snap
 
-    def _worker_exits(self) -> Dict[str, Any]:
+    def _worker_exits(self, gen: int, world: int) -> Dict[str, Any]:
         """The structured exit/done records workers publish on their way
         out (code + reason + ts) — richer than the raw process rc the
         state machine classifies on, and what the forensics bundle quotes
         for 'why did rank r leave'."""
         out: Dict[str, Any] = {}
         try:
-            for r in range(self.sm.world):
-                rec = _probe_json(self.store,
-                                  f"fleet/{self.sm.gen}/exit/{r}")
+            for r in range(world):
+                rec = _probe_json(self.store, f"fleet/{gen}/exit/{r}")
                 if rec is not None:
                     out[str(r)] = rec
                 elif _probe(self.store,
-                            f"fleet/{self.sm.gen}/done/{r}") is not None:
+                            f"fleet/{gen}/done/{r}") is not None:
                     out[str(r)] = {"code": 0, "reason": "done"}
         except Exception:
             pass  # store already closed: the rc classification stands
@@ -567,29 +572,40 @@ class ElasticFleet:
                                    log_dir=log_dir, extra_env_fn=rank_env)
         return ctx
 
-    def _pump_heartbeats(self, now: float) -> None:
-        """Feed worker beats (and any published plan) into the machine.
-        The machine is fed the SUPERVISOR's receipt time, deduped on the
-        worker-written payload ts: staleness must never compare clocks
-        across hosts — a worker host lagging the supervisor by more than
-        the grace window would otherwise be falsely evicted on every
-        beat."""
+    def _poll_beats(self):
+        """Read worker beats (and any unpublished plan) off the store —
+        TCP round-trips, so called from the supervisor thread with NO
+        lock held (CC001: a telemetry scrape must never queue behind a
+        store probe). gen/world only mutate on this same thread."""
+        beats: Dict[int, float] = {}
         for r in range(self.sm.world):
             beat = _probe_json(self.store, f"elastic/worker/{r}")
             if beat is None:
                 continue
             try:
-                ts = float(beat["ts"])
+                beats[r] = float(beat["ts"])
             except (KeyError, TypeError, ValueError):
                 continue
+        plan = None
+        if self.sm.gen not in self.plans:
+            plan = _probe_json(self.store, f"fleet/{self.sm.gen}/plan")
+        return beats, plan
+
+    def _pump_heartbeats(self, now: float, beats: Dict[int, float],
+                         plan) -> None:
+        """Feed polled beats (and any published plan) into the machine.
+        The machine is fed the SUPERVISOR's receipt time, deduped on the
+        worker-written payload ts: staleness must never compare clocks
+        across hosts — a worker host lagging the supervisor by more than
+        the grace window would otherwise be falsely evicted on every
+        beat."""
+        for r, ts in beats.items():
             if self._beat_payload.get(r) == ts:
                 continue  # same beat re-read, not a fresh one
             self._beat_payload[r] = ts
             self.sm.heartbeat(r, now)
-        if self.sm.gen not in self.plans:
-            p = _probe_json(self.store, f"fleet/{self.sm.gen}/plan")
-            if p is not None:
-                self.plans[self.sm.gen] = p
+        if plan is not None and self.sm.gen not in self.plans:
+            self.plans[self.sm.gen] = plan
 
     def fence(self, reason: str = "operator") -> None:
         """Raise the fence for the current generation: workers drain at
@@ -625,8 +641,9 @@ class ElasticFleet:
                     self.sm.phase = FleetPhase.FAILED
                     self.sm._event("fail", now, reason="coordinator_lost")
                 return self._finish("coordinator_lost", forensics=False)
+            beats, plan = self._poll_beats()  # store I/O: lock released
             with self._lock:
-                self._pump_heartbeats(now)
+                self._pump_heartbeats(now, beats, plan)
                 exits = {e.rank: e.proc.poll() for e in self._ctx.entries}
                 act = self.sm.observe(now, exits)
             if act.kind == "hold":

@@ -154,7 +154,8 @@ class ParameterServer:
         """Serve pull/push requests until stop() (reference brpc service loop;
         here requests rendezvous through store counters)."""
         self._thread = threading.Thread(target=self._loop,
-                                        args=(poll_interval,), daemon=True)
+                                        args=(poll_interval,), daemon=True,
+                                        name="pt-ps-server")
         self._thread.start()
         return self
 
@@ -340,7 +341,8 @@ class AsyncCommunicator:
         self.q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self.errors: List[Exception] = []
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="pt-ps-push")
         self._thread.start()
 
     def _loop(self):

@@ -99,7 +99,9 @@ class FaultInjector:
     the checkpoint writer consult it concurrently."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from ...analysis.lockdep import lock as _named_lock  # lazy
+
+        self._lock = _named_lock("resilience.FaultInjector._lock")
         self._rules: List[_Rule] = []
         self._fired: Dict[str, int] = {}
 

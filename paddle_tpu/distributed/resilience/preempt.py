@@ -47,8 +47,12 @@ def off_preemption(cb) -> None:
 
 
 def _fire_callbacks() -> None:
-    with _LOCK:
-        cbs = list(_CALLBACKS)
+    # runs in SIGNAL CONTEXT (CC002): must not take _LOCK — the handler
+    # interrupts the main thread between bytecodes, and if that thread is
+    # inside on_preemption() holding _LOCK the process self-deadlocks.
+    # list() of a list is a single GIL-atomic snapshot; registration
+    # keeps the lock only for its own read-modify-write.
+    cbs = list(_CALLBACKS)
     for cb in cbs:
         try:
             cb()

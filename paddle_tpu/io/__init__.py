@@ -372,7 +372,8 @@ class _PrefetchIterator:
             finally:
                 self.q.put(self._SENTINEL)
 
-        self.t = threading.Thread(target=worker, daemon=True)
+        self.t = threading.Thread(target=worker, daemon=True,
+                                  name="pt-io-prefetch")
         self.t.start()
 
     def __iter__(self):

@@ -287,7 +287,9 @@ class StreamLane:
                 "0", "false", "off")
         self._pinned_sh = _probe_pinned_host() if pinned_staging else None
         self.pinned_staging = self._pinned_sh is not None
-        self._lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self._lock = _named_lock("jit.StreamLane._lock")
         self._stats = {"h2d_bytes": 0, "d2h_bytes": 0, "transfer_ms": 0.0,
                        "stall_ms": 0.0, "transfers": 0, "in_flight_sum": 0,
                        "retries": 0, "pinned_staged": 0}

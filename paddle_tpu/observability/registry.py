@@ -38,6 +38,24 @@ __all__ = ["LatencyWindow", "MetricsRegistry", "CounterFamily", "Histogram",
            "register_registry"]
 
 
+def _named_lock(name: str):
+    """Hub-internal mutex: witnessed under PT_LOCKDEP=1, plain otherwise.
+    Env-gated so the default path never imports paddle_tpu.analysis (and
+    jax) at registry-import time, and built on the raw ``lockdep.Lock``
+    class — the factory's provider registration would re-enter hub
+    construction from inside ``Hub.__init__``."""
+    import os
+
+    if os.environ.get("PT_LOCKDEP", "") not in ("", "0", "false"):
+        try:
+            from ..analysis.lockdep import Lock
+
+            return Lock(name)
+        except Exception:
+            pass
+    return threading.Lock()
+
+
 class LatencyWindow:
     """Ring buffer of the most recent latencies (ms); percentiles on read.
 
@@ -81,7 +99,7 @@ class MetricsRegistry:
     """
 
     def __init__(self, qps_window_s: float = 30.0, latency_capacity: int = 8192):
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.MetricsRegistry._lock")
         self._counters: Dict[str, int] = {}
         self._latency = LatencyWindow(latency_capacity)
         self._queue_wait = LatencyWindow(latency_capacity)
@@ -212,7 +230,7 @@ class CounterFamily:
     def __init__(self, name: str, label_names: Sequence[str] = ()):
         self.name = name
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        self._lock = _named_lock(f"obs.family[{name}]._lock")
         self._values: Dict[Tuple[str, ...], float] = {}
 
     def inc(self, labels: _Labels = (), n: float = 1) -> None:
@@ -270,7 +288,7 @@ class Histogram:
                                                       for b in buckets))
         if not self.bounds:
             raise ValueError(f"histogram {name!r}: need at least one bucket")
-        self._lock = threading.Lock()
+        self._lock = _named_lock(f"obs.hist[{name}]._lock")
         self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf overflow
         self._sum = 0.0
         self._n = 0
@@ -323,7 +341,7 @@ class Hub:
     here, and ``snapshot()`` is the one JSON of all of them."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _named_lock("obs.Hub._lock")
         self._families: Dict[str, CounterFamily] = {}
         self._histograms: Dict[str, Histogram] = {}
         self._providers: Dict[str, Callable[[], Any]] = {}

@@ -114,7 +114,8 @@ def buffered(reader, size):
             except BaseException as e:  # forward, don't truncate
                 q.put((fail, e))
 
-        t = threading.Thread(target=fill, daemon=True)
+        t = threading.Thread(target=fill, daemon=True,
+                             name="pt-reader-fill")
         t.start()
         while True:
             s = q.get()
@@ -169,9 +170,11 @@ def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
                     out_q.put(end)
                     return
 
-        threading.Thread(target=feed, daemon=True).start()
-        for _ in range(process_num):
-            threading.Thread(target=work, daemon=True).start()
+        threading.Thread(target=feed, daemon=True,
+                         name="pt-reader-feed").start()
+        for i in range(process_num):
+            threading.Thread(target=work, daemon=True,
+                             name=f"pt-reader-work-{i}").start()
 
         def next_item():
             item = out_q.get()

@@ -79,8 +79,14 @@ class EngineBase:
 
         register_registry(f"serving:{name}", self.metrics)
         self._queue: deque = deque()
-        self._cond = threading.Condition()
-        self._start_lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        # a witnessed Lock works as Condition's lock: wait()'s release/
+        # re-acquire pass through acquire/release, keeping the per-thread
+        # held stack truthful across parks
+        self._cond = threading.Condition(
+            _named_lock(f"serving.Engine[{name}]._cond"))
+        self._start_lock = _named_lock(f"serving.Engine[{name}]._start_lock")
         self._closed = False
         self._fenced = False
         self._thread: Optional[threading.Thread] = None

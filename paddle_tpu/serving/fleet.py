@@ -278,7 +278,10 @@ class _ReplicaServer:
         os.set_blocking(self._wake_w, False)
         self._conns: Dict[socket.socket, bytearray] = {}
         self._out: deque = deque()           # (conn, frame)
-        self._out_lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self._out_lock = _named_lock(
+            f"serving.fleet._ReplicaServer[{name}]._out_lock")
         self._futs: Dict[int, Future] = {}   # rid -> engine future
         self._dead_rids: set = set()         # cancelled: frames suppressed
         self._seq = 0                        # submit counter (fault ids)
@@ -478,8 +481,13 @@ class _ReplicaServer:
         # drop its sleep_ms.
         slow = inj._take("replica_slow", {"name": self.name})
         if slow is not None and slow.sleep_ms:
-            threading.Timer(slow.sleep_ms / 1e3, self._do_submit,
-                            args=(conn, rid, msg)).start()
+            t = threading.Timer(slow.sleep_ms / 1e3, self._do_submit,
+                                args=(conn, rid, msg))
+            # Timer threads are non-daemon by default (CC003): an armed
+            # timer outliving the replica would hold the process open
+            t.daemon = True
+            t.name = f"pt-serving-slow-submit-{self.name}"
+            t.start()
             return
         self._do_submit(conn, rid, msg)
 
@@ -612,8 +620,12 @@ class ReplicaClient:
         self._probe_timeout = float(probe_timeout_s)
         self._sock = socket.create_connection((host, port), timeout=10)
         self._sock.settimeout(None)
-        self._send_lock = threading.Lock()
-        self._lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self._send_lock = _named_lock(
+            f"serving.fleet.ReplicaClient[{name}]._send_lock")
+        self._lock = _named_lock(
+            f"serving.fleet.ReplicaClient[{name}]._lock")
         self._rid = itertools.count(1)
         self._pending: Dict[int, _Pending] = {}
         self._alive = True
@@ -629,8 +641,11 @@ class ReplicaClient:
         if not self._alive:
             raise ReplicaFault(f"replica {self.name} connection lost")
         try:
+            # _send_lock exists precisely to hold across the socket
+            # write: frames from the submit path and the hedge timer
+            # must not interleave mid-frame. Leaf lock, never nested.
             with self._send_lock:
-                send_frame(self._sock, obj)
+                send_frame(self._sock, obj)  # pd-lint: disable=CC001
         except OSError as e:
             self._fail(ReplicaFault(
                 f"replica {self.name} send failed: {e}"))
@@ -868,7 +883,10 @@ class FleetRequest:
         # client-stream delivery state: `delivered` tokens of `emitted`
         # have reached on_token; stream_lock serializes deliveries so
         # racing rx threads can never reorder them
-        self.stream_lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self.stream_lock = _named_lock(
+            "serving.fleet.FleetRequest.stream_lock")
         self.delivered = 0
 
 
@@ -959,7 +977,9 @@ class ServingFleet:
                                     self.policy.fleet_policy(),
                                     now=time.time())
         self._store = None
-        self._lock = threading.RLock()
+        from ..analysis.lockdep import rlock as _named_rlock  # lazy
+
+        self._lock = _named_rlock("serving.fleet.ServingFleet._lock")
         self._req_no = itertools.count(1)
         self._requests: Dict[int, FleetRequest] = {}
         self._unplaced: deque = deque()

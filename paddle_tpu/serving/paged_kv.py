@@ -174,7 +174,9 @@ class PrefixCache:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self._lock = _named_lock("serving.PrefixCache._lock")
         self._nodes: Dict[Any, _TrieNode] = {}
         self._tick = 0
         self.lookups = 0

@@ -151,7 +151,9 @@ class ReplicaRouter:
         self.name = name
         self.config = config or RouterConfig()
         self._replicas = list(replicas)
-        self._lock = threading.Lock()
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self._lock = _named_lock(f"serving.Router[{name}]._lock")
         self._down: set = set()          # replica names marked unhealthy
         self._inflight: Dict[str, int] = {}   # per-tenant in-flight
         self._inflight_total = 0

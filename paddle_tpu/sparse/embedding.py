@@ -379,7 +379,11 @@ class ShardedEmbeddingTable:
         self.cache = HotRowCache(min(int(cache_rows), self.num_rows),
                                  self.dim, admit_threshold=admit_threshold)
         self.lane = StreamLane(overlap=overlap)
-        self._mu = threading.RLock()
+        from ..analysis.lockdep import rlock as _named_rlock  # lazy
+
+        # one table mutex guards the HotRowCache too (its slots/ghost
+        # state is only ever touched under _mu)
+        self._mu = _named_rlock(f"sparse.Table[{self.name}]._mu")
         self._pending: List[Tuple[np.ndarray, int, Tensor]] = []
         self._accum: List[Tuple[np.ndarray, np.ndarray]] = []
         self._prefetch: Optional[Dict[str, Any]] = None
@@ -456,24 +460,34 @@ class ShardedEmbeddingTable:
         matches; rows updated in between are re-fetched (never stale)."""
         flat = self._flat_ids(ids)
         uniq = np.unique(flat)
+        token = object()
         with self._mu:
             hit, _slots = self.cache.slots_of(uniq)
             miss_ids = uniq[~hit]
+            # publish a placeholder FIRST so flush() keeps the dirty set
+            # live while the gather+submit below runs unlocked (a lookup
+            # landing in the gap sees handle=None and falls back to the
+            # synchronous miss path — slower, never wrong)
+            self._prefetch = {"uniq": uniq, "miss_ids": miss_ids,
+                              "handle": None, "nbytes": 0, "token": token}
+            self._dirty_since_prefetch = set()
             if not len(miss_ids):
                 # fully cache-covered batch: nothing to stream (the hot
                 # steady state) — skip the lane round-trip entirely
-                self._prefetch = {"uniq": uniq, "miss_ids": miss_ids,
-                                  "handle": None, "nbytes": 0}
-                self._dirty_since_prefetch = set()
                 return
-            rows_np = self._staged_miss_block(miss_ids)
-            handle = self.lane.submit_rows(
-                rows_np, tag=("sparse", self.name, "prefetch"),
-                names=(f"{self.name}:prefetch",))
-            self._prefetch = {"uniq": uniq, "miss_ids": miss_ids,
-                              "handle": handle, "rows_np": rows_np,
-                              "nbytes": int(rows_np.nbytes)}
-            self._dirty_since_prefetch = set()
+        # host gather + bounded-lane submit block (a full 2-deep ring
+        # parks the submitter): done with the table lock RELEASED (CC001)
+        # so a concurrent lookup/flush never stalls behind the ring
+        rows_np = self._staged_miss_block(miss_ids)
+        handle = self.lane.submit_rows(
+            rows_np, tag=("sparse", self.name, "prefetch"),
+            names=(f"{self.name}:prefetch",))
+        with self._mu:
+            pf = self._prefetch
+            if pf is None or pf.get("token") is not token:
+                return  # consumed/replaced mid-flight: abandon the rows
+            pf.update(handle=handle, rows_np=rows_np,
+                      nbytes=int(rows_np.nbytes))
 
     @staticmethod
     def _flat_ids(ids) -> np.ndarray:
@@ -580,7 +594,12 @@ class ShardedEmbeddingTable:
             got = self._consume_prefetch(uniq, miss_ids)
             if got is None:
                 if len(miss_ids):
-                    got = self._fetch_miss_rows(miss_ids)
+                    # the synchronous miss path is deliberately serialized
+                    # under the table mutex: its stall is the product
+                    # (measured into stall_ms) and prefetch() exists to
+                    # hide it — hoisting it would let a racing lookup
+                    # double-fetch the same rows
+                    got = self._fetch_miss_rows(miss_ids)  # pd-lint: disable=CC001
                 else:
                     got = (jnp.zeros((_bucket(0), self.dim), jnp.float32),
                            np.zeros((_bucket(0), self.dim), np.float32),

@@ -13,3 +13,39 @@ os.environ["XLA_FLAGS"] = (
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+import threading
+import time
+
+import pytest
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "thread_leak_ok: opt out of the non-daemon thread-leak guard "
+        "(tests that intentionally leave a joinable thread behind)")
+
+
+@pytest.fixture(autouse=True)
+def _no_thread_leaks(request):
+    """Every test must clean up its non-daemon threads: a leaked joinable
+    thread holds the interpreter open at exit and poisons later tests'
+    lockdep/leak accounting. Daemon threads (named pt-*) are the
+    runtime's long-lived workers and are exempt by design."""
+    before = set(threading.enumerate())
+    yield
+    if request.node.get_closest_marker("thread_leak_ok"):
+        return
+    # teardown grace: threads mid-join finish within a short window
+    deadline = time.time() + 2.0
+    while time.time() < deadline:
+        leaked = [t for t in threading.enumerate()
+                  if t not in before and not t.daemon and t.is_alive()]
+        if not leaked:
+            return
+        time.sleep(0.05)
+    pytest.fail(
+        f"test leaked non-daemon thread(s): "
+        f"{[t.name for t in leaked]} — join them in teardown or mark "
+        f"the test @pytest.mark.thread_leak_ok", pytrace=False)

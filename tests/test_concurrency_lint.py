@@ -1,0 +1,392 @@
+"""Concurrency lint (CC codes) drills.
+
+True-positive proof: seeded fixture sources for every CC code are
+detected. False-positive proof: the condition-variable idiom, timeouts,
+suppressions, and the repo itself (post-fix) all lint clean. The real
+findings this pass surfaced (fleet supervisor store probes under the
+lock, embedding prefetch submitting to the bounded lane under the table
+mutex, the SIGTERM handler taking the callback lock) are each
+regression-pinned — by lint and, for the two runtime fixes, by a
+thread-based behavioral pin.
+"""
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu.analysis import concurrency
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "paddle_tpu")
+
+
+def lint(src, path="fixture.py"):
+    return concurrency.lint_file(path, src)
+
+
+def codes(diags):
+    return [d.code for d in diags]
+
+
+# -- CC001: blocking call under a held lock ---------------------------------
+def test_cc001_sleep_under_with_lock():
+    d = lint("""
+import threading, time
+lock = threading.Lock()
+def f():
+    with lock:
+        time.sleep(1)
+""")
+    assert codes(d) == ["CC001"] and d[0].severity == "error"
+    assert "time.sleep" in d[0].message
+
+
+def test_cc001_untimed_queue_get_under_lock():
+    d = lint("""
+def f(self):
+    with self._lock:
+        item = self._q.get()
+""")
+    assert codes(d) == ["CC001"]
+
+
+def test_cc001_between_acquire_release_only():
+    d = lint("""
+def f(self, sock, obj):
+    self._lock.acquire()
+    sock.sendall(obj)
+    self._lock.release()
+    sock.sendall(obj)
+""")
+    assert codes(d) == ["CC001"]
+    assert d[0].location.endswith(":4")  # only the held-region send
+
+
+def test_cc001_device_get_and_frame_io():
+    d = lint("""
+import jax
+def f(self, x):
+    with self._mu:
+        y = jax.device_get(x)
+def g(self, sock, obj):
+    with self._send_lock:
+        send_frame(sock, obj)
+""")
+    assert codes(d) == ["CC001", "CC001"]
+
+
+def test_cc001_local_call_taint_chain():
+    d = lint("""
+class C:
+    def _probe(self):
+        return self.store.get("k")
+    def _exits(self):
+        return self._probe()
+    def snapshot(self):
+        with self._lock:
+            return self._exits()
+""")
+    assert codes(d) == ["CC001"]
+    assert "_exits" in d[0].message and "store.get" in d[0].message
+
+
+def test_cc001_cond_wait_idiom_exempt():
+    d = lint("""
+def worker(self):
+    with self._cond:
+        while not self._queue:
+            self._cond.wait()
+""")
+    assert d == []
+
+
+def test_cc001_timeouts_exempt():
+    d = lint("""
+def f(self):
+    with self._lock:
+        self._q.get(timeout=1)
+        self._q.put(1, timeout=0.5)
+        fut.result(timeout=2)
+        ev.wait(0.05)
+        t.join(5)
+""")
+    assert d == []
+
+
+def test_cc001_nested_def_does_not_inherit_held_context():
+    d = lint("""
+import time
+def f(self):
+    with self._lock:
+        def later():
+            time.sleep(1)   # runs later, lock not held then
+        self.cb = later
+""")
+    assert d == []
+
+
+def test_cc001_suppression_line_and_def():
+    d = lint("""
+import time
+def f(self):
+    with self._lock:
+        time.sleep(1)  # pd-lint: disable=CC001
+def g(self):  # pd-lint: disable=CC001
+    with self._lock:
+        time.sleep(1)
+""")
+    assert d == []
+
+
+# -- CC002: lock in signal handler / __del__ --------------------------------
+def test_cc002_signal_handler_lock_via_callee():
+    d = lint("""
+import signal, threading
+_LOCK = threading.Lock()
+def _fire():
+    _LOCK.acquire()
+    _LOCK.release()
+def _handler(signum, frame):
+    _fire()
+signal.signal(signal.SIGTERM, _handler)
+""")
+    assert "CC002" in codes(d)
+
+
+def test_cc002_flag_only_handler_clean():
+    d = lint("""
+import signal, threading
+_FLAG = threading.Event()
+def _handler(signum, frame):
+    _FLAG.set()
+signal.signal(signal.SIGTERM, _handler)
+""")
+    assert d == []
+
+
+def test_cc002_del_with_lock():
+    d = lint("""
+class C:
+    def __del__(self):
+        with self._lock:
+            self.closed = True
+""")
+    assert codes(d) == ["CC002"]
+
+
+# -- CC003: non-daemon thread without join path -----------------------------
+def test_cc003_leaky_thread_and_timer():
+    d = lint("""
+import threading
+def go(fn):
+    threading.Thread(target=fn).start()
+    threading.Timer(1.0, fn).start()
+""")
+    assert codes(d) == ["CC003", "CC003"]
+    assert all(x.severity == "warning" for x in d)
+
+
+def test_cc003_daemon_or_joined_clean():
+    d = lint("""
+import threading
+class C:
+    def start(self):
+        threading.Thread(target=self.run, daemon=True).start()
+        self._t = threading.Thread(target=self.run)
+        self._t.start()
+    def close(self):
+        self._t.join(timeout=5)
+""")
+    assert d == []
+
+
+def test_cc003_daemonized_after_construction_clean():
+    d = lint("""
+import threading
+def go(fn):
+    t = threading.Timer(1.0, fn)
+    t.daemon = True
+    t.start()
+""")
+    assert d == []
+
+
+# -- CC004: unguarded shared write in a thread target -----------------------
+def test_cc004_augassign_in_thread_target():
+    d = lint("""
+import threading
+class C:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        self.failures += 1
+""")
+    assert codes(d) == ["CC004"]
+
+
+def test_cc004_locked_target_clean():
+    d = lint("""
+import threading
+class C:
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+    def _loop(self):
+        with self._lock:
+            self.failures += 1
+""")
+    assert d == []
+
+
+# -- CC005: conflicting lock order ------------------------------------------
+def test_cc005_ab_ba_conflict_same_file():
+    d = lint("""
+class C:
+    def f(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+    def g(self):
+        with self.lock_b:
+            with self.lock_a:
+                pass
+""")
+    cc5 = [x for x in d if x.code == "CC005"]
+    assert len(cc5) == 2 and all(x.severity == "error" for x in cc5)
+    assert "opposite order" in cc5[0].message
+
+
+def test_cc005_consistent_order_clean():
+    d = lint("""
+class C:
+    def f(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+    def g(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+""")
+    assert d == []
+
+
+def test_cc005_cross_file_conflict(tmp_path):
+    (tmp_path / "m1.py").write_text("""
+class C:
+    def f(self):
+        with self.lock_a:
+            with self.lock_b:
+                pass
+""")
+    (tmp_path / "m2.py").write_text("""
+class C:
+    def g(self):
+        with self.lock_b:
+            with self.lock_a:
+                pass
+""")
+    d = concurrency.lint_tree(str(tmp_path))
+    cc5 = [x for x in d if x.code == "CC005"]
+    assert len(cc5) == 2
+    files = {os.path.basename(x.location.split(":")[0]) for x in cc5}
+    assert files == {"m1.py", "m2.py"}
+
+
+def test_cc005_suppressed():
+    d = lint("""
+class C:
+    def f(self):
+        with self.lock_a:
+            with self.lock_b:  # pd-lint: disable=CC005
+                pass
+    def g(self):
+        with self.lock_b:
+            with self.lock_a:  # pd-lint: disable=CC005
+                pass
+""")
+    assert d == []
+
+
+def test_cc000_syntax_error():
+    d = lint("def broken(:\n")
+    assert codes(d) == ["CC000"]
+
+
+# -- regression pins: the real findings stay fixed ---------------------------
+@pytest.mark.parametrize("rel", [
+    "distributed/fleet/runtime.py",      # supervisor probes under _lock
+    "distributed/resilience/preempt.py",  # SIGTERM handler took _LOCK
+    "sparse/embedding.py",               # lane submit under table _mu
+    "distributed/collective.py",         # p2p dial retry under chan lock
+    "serving/fleet.py",                  # unjoined non-daemon hedge Timer
+])
+def test_fixed_files_stay_clean(rel):
+    diags = concurrency.lint_file(os.path.join(PKG, rel))
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.render() for d in errors]
+
+
+def test_repo_wide_zero_errors():
+    diags = concurrency.run_concurrency()
+    errors = [d for d in diags if d.severity == "error"]
+    assert errors == [], [d.render() for d in errors]
+    warnings = [d for d in diags if d.severity == "warning"]
+    assert warnings == [], [d.render() for d in warnings]
+
+
+def test_prefetch_releases_mutex_during_lane_submit():
+    """Behavioral pin for the embedding CC001 fix: while prefetch() is
+    parked in the (bounded, blockable) lane submit, another thread can
+    still take the table mutex — pre-fix this times out."""
+    from paddle_tpu.sparse.embedding import ShardedEmbeddingTable
+
+    t = ShardedEmbeddingTable(256, 8, cache_rows=16, overlap=False,
+                              name="ccpin")
+    in_submit = threading.Event()
+    release = threading.Event()
+
+    def slow_submit(rows, **kw):
+        in_submit.set()
+        assert release.wait(10)
+
+        class H:
+            def rows_dispatched(self):
+                raise AssertionError("not consumed in this test")
+        return H()
+
+    t.lane.submit_rows = slow_submit
+    ids = np.arange(32, dtype=np.int64)
+    worker = threading.Thread(target=t.prefetch, args=(ids,), daemon=True)
+    worker.start()
+    assert in_submit.wait(10), "prefetch never reached the lane submit"
+    got = t._mu.acquire(timeout=2)
+    assert got, "table mutex held across the blocking lane submit"
+    t._mu.release()
+    release.set()
+    worker.join(timeout=10)
+    assert not worker.is_alive()
+
+
+def test_preempt_fire_callbacks_lock_free():
+    """Behavioral pin for the CC002 fix: firing preemption callbacks
+    while _LOCK is already held (exactly what a SIGTERM landing inside
+    on_preemption() does) must not self-deadlock."""
+    from paddle_tpu.distributed.resilience import preempt
+
+    fired = []
+    preempt.on_preemption(lambda: fired.append(1))
+    try:
+        done = threading.Event()
+
+        def fire_while_locked():
+            with preempt._LOCK:  # the interrupted frame's held lock
+                preempt._fire_callbacks()
+            done.set()
+
+        th = threading.Thread(target=fire_while_locked, daemon=True)
+        th.start()
+        assert done.wait(5), "_fire_callbacks deadlocked on _LOCK"
+        assert fired == [1]
+    finally:
+        preempt._CALLBACKS.clear()

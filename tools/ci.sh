@@ -12,6 +12,11 @@ cd "$(dirname "$0")/.."
 echo "== pd_check --self (repo footgun lint) =="
 JAX_PLATFORMS=cpu python tools/pd_check.py --self || exit 1
 
+echo "== pd_check --concurrency (CC lint: threads & locks) =="
+# repo-wide blocking-under-lock / signal-handler-lock / thread-leak /
+# lock-order pass; any error-severity finding fails the build
+JAX_PLATFORMS=cpu python tools/pd_check.py --concurrency || exit 1
+
 if [ "${1:-}" = "--lint" ]; then
     exit 0
 fi
@@ -337,6 +342,17 @@ echo "== serving-fleet gate (ISSUE-15: fault-tolerant multi-process serving) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_serving_fleet.py -q \
     -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
 JAX_PLATFORMS=cpu python tools/serving_fleet_drill.py || exit 1
+
+echo "== lockdep gate (ISSUE-16: armed drills, zero lock-order cycles) =="
+# concurrency lint + witness unit drills (seeded AB/BA deadlock, CC
+# true-positive fixtures), then the two heaviest multi-threaded drills
+# re-run with the runtime lock-order witness ARMED: each must complete
+# bit-identical with a populated lockdep provider and zero cycles
+JAX_PLATFORMS=cpu python -m pytest tests/test_concurrency_lint.py \
+    tests/test_lockdep.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+PT_LOCKDEP=1 python tools/resilience_drill.py || exit 1
+JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/serving_fleet_drill.py || exit 1
 
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log

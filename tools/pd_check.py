@@ -4,8 +4,9 @@
 No TPU required (set JAX_PLATFORMS=cpu); nothing is executed on device
 except the tiny retrace demo loop. Examples:
 
-    JAX_PLATFORMS=cpu python tools/pd_check.py            # all five passes
+    JAX_PLATFORMS=cpu python tools/pd_check.py            # all passes
     JAX_PLATFORMS=cpu python tools/pd_check.py --self     # repo self-lint
+    JAX_PLATFORMS=cpu python tools/pd_check.py --concurrency  # CC lint
     JAX_PLATFORMS=cpu python tools/pd_check.py --json --models llama
     JAX_PLATFORMS=cpu python tools/pd_check.py --passes memory,spmd
 
@@ -23,7 +24,7 @@ import sys
 def _bootstrap():
     # an 8-device host mesh lets the SPMD pass walk real shard_map programs;
     # must be set before jax initializes its backends
-    if "--self" not in sys.argv:
+    if "--self" not in sys.argv and "--concurrency" not in sys.argv:
         flags = os.environ.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             os.environ["XLA_FLAGS"] = \
@@ -146,8 +147,13 @@ def main(argv=None):
     ap = argparse.ArgumentParser(prog="pd_check", description=__doc__)
     ap.add_argument("--self", action="store_true", dest="self_lint",
                     help="run the repo self-lint (AST footgun pass) only")
+    ap.add_argument("--concurrency", action="store_true",
+                    dest="concurrency_lint",
+                    help="run the repo concurrency lint (CC codes: "
+                         "blocking-under-lock, signal-handler locks, "
+                         "thread/daemon audit, lock-order conflicts) only")
     ap.add_argument("--root", default=None,
-                    help="self-lint root (default: the paddle_tpu package)")
+                    help="lint root (default: the paddle_tpu package)")
     ap.add_argument("--json", action="store_true", help="JSON output")
     ap.add_argument("--models", default="llama,bert,gpt,pipeline",
                     help=f"comma list from {sorted(MODEL_CHECKS)}")
@@ -167,10 +173,15 @@ def main(argv=None):
     all_diags = []
     blocks = []
 
-    if args.self_lint:
-        diags = A.selfcheck.run_selfcheck(args.root)
-        all_diags += diags
-        blocks.append(("selfcheck", None, diags))
+    if args.self_lint or args.concurrency_lint:
+        if args.self_lint:
+            diags = A.selfcheck.run_selfcheck(args.root)
+            all_diags += diags
+            blocks.append(("selfcheck", None, diags))
+        if args.concurrency_lint:
+            diags = A.concurrency.run_concurrency(args.root)
+            all_diags += diags
+            blocks.append(("concurrency", None, diags))
     else:
         cfg = {"hbm_bytes": int(args.hbm_gb * 1e9), "hbm_frac": args.frac}
         if args.passes:
@@ -192,6 +203,9 @@ def main(argv=None):
             blocks.append(("retrace-demo", None, _retrace_demo(A)))
         diags = A.selfcheck.run_selfcheck(args.root)
         blocks.append(("selfcheck", None, diags))
+        all_diags += diags
+        diags = A.concurrency.run_concurrency(args.root)
+        blocks.append(("concurrency", None, diags))
         all_diags += diags
 
     if args.json:

@@ -108,6 +108,23 @@ def _run_child(mode: str, ckpt: str, out: str) -> None:
               "saves": rm.get("saves"), "restores": rm.get("restores")}
     with open(out, "w") as f:
         json.dump(record, f)
+    _assert_lockdep(f"child:{mode}")
+
+
+def _assert_lockdep(tag: str) -> None:
+    """Armed re-run gate (ci.sh): the drill must finish with the witness
+    live, locks actually witnessed, and a cycle-free order graph."""
+    if os.environ.get("PT_LOCKDEP", "") in ("", "0", "false"):
+        return
+    from paddle_tpu.analysis import lockdep
+
+    snap = lockdep.snapshot()
+    assert snap["armed"] and snap["locks"], \
+        f"[{tag}] PT_LOCKDEP=1 but the witness saw no locks"
+    assert snap["cycles"] == [], \
+        f"[{tag}] lock-order cycles: {snap['cycles']}"
+    print(f"[{tag}] lockdep ok: {len(snap['locks'])} witnessed locks, "
+          f"{len(snap['edges'])} order edges, zero cycles", flush=True)
 
 
 def _spawn(mode: str, ckpt: str, out: str, devices: int) -> subprocess.Popen:
@@ -275,6 +292,7 @@ def _run_fleet_child(out_dir: str) -> None:
     res = elastic_fit(build, global_batch=FLEET_GLOBAL_BATCH, epochs=1,
                       checkpoint_every=FLEET_CKPT_EVERY)
     _write(res)
+    _assert_lockdep("fleet-child")
 
 
 def fleet_main() -> int:
@@ -400,6 +418,7 @@ def fleet_main() -> int:
         "max_abs_loss_delta": float(np.max(np.abs(
             np.asarray(stitched) - np.asarray(ref_losses)))),
     }))
+    _assert_lockdep("fleet-supervisor")
     return 0
 
 

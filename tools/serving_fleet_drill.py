@@ -289,7 +289,8 @@ def main():
             i += 1
             time.sleep(0.05)
 
-    th = threading.Thread(target=background_load, daemon=True)
+    th = threading.Thread(target=background_load, daemon=True,
+                          name="pt-drill-roll-load")
     th.start()
     res = fleet.rolling_restart()
     stop.set()
@@ -319,6 +320,16 @@ def main():
     assert sf["counters"]["replays"] >= 1 and sf["timeline"], \
         "serving_fleet provider missing from the telemetry dump"
     print("[drill] telemetry ok: serving_fleet provider in dump")
+    if os.environ.get("PT_LOCKDEP", "") not in ("", "0", "false"):
+        # armed re-run (ci.sh): the whole chaos drill must complete with
+        # the lock-order witness live and a cycle-free graph
+        ld = tele.get("lockdep")
+        assert ld and ld.get("armed"), \
+            "PT_LOCKDEP=1 but the lockdep provider is missing/disarmed"
+        assert ld["cycles"] == [], f"lock-order cycles: {ld['cycles']}"
+        assert ld["locks"], "lockdep witnessed no locks"
+        print(f"[drill] lockdep ok: {len(ld['locks'])} witnessed locks, "
+              f"{len(ld['edges'])} order edges, zero cycles", flush=True)
 
     fleet.close()
     headline = {
