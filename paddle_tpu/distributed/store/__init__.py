@@ -160,17 +160,30 @@ class TCPStore(Store):
         return int(out)
 
     def wait(self, keys, timeout=None):
+        """Block until every key exists.
+
+        `timeout` (seconds) overrides the store-level timeout for this call
+        only — the socket deadline is re-armed around the blocking wait, so a
+        long-lived client can make short liveness-checked waits (poll a key,
+        check a subprocess, poll again) without a second connection. On
+        expiry raises TimeoutError and the connection comes back with the
+        store-level timeout.
+        """
         keys = keys if isinstance(keys, (list, tuple)) else [keys]
+        t = self.timeout if timeout is None else max(1, int(timeout))
         for key in keys:
             k = key.encode()
             with self._lock:
+                if t != self.timeout:
+                    self._lib.tcpstore_set_timeout(self._fd, t)
                 rc = self._lib.tcpstore_wait(self._fd, k, len(k))
                 if rc != 0:
-                    self._drop_connection()
+                    self._drop_connection()  # reconnect re-arms self.timeout
+                elif t != self.timeout:
+                    self._lib.tcpstore_set_timeout(self._fd, self.timeout)
             if rc != 0:
                 raise TimeoutError(
-                    f"TCPStore.wait({key}) failed or timed out after "
-                    f"{self.timeout}s")
+                    f"TCPStore.wait({key}) failed or timed out after {t}s")
 
     def delete_key(self, key: str):
         k = key.encode()

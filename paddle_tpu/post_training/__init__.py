@@ -1,0 +1,94 @@
+"""Post-training RL loop: rollout -> reward -> train -> publish.
+
+Composes the two fleets the repo already owns — ``ServingFleet``
+generates (exactly-once token streams with behavior logprobs),
+``ElasticFleet`` trains — through the streaming weight-distribution
+service in :mod:`.weights`. The loop:
+
+1. :class:`RolloutWorker` submits seeded prompts through the serving
+   fleet and emits ``(prompt, tokens, behavior_logprobs,
+   weight_version)`` trajectories.
+2. :class:`ReplayBuffer` rewards them (programmatic or model-scored)
+   and samples staleness-bounded, seed-deterministic batches.
+3. :func:`rl_fit` trains the importance-weighted policy-gradient
+   objective under ``elastic_fit``.
+4. :class:`WeightPublisher` streams each update to every replica's
+   :class:`WeightSubscriber` (chunked, digest-verified, resumable);
+   ``EngineBase.swap_weights()`` applies it in place between batches,
+   ``rolling_restart()`` is the fallback.
+
+Everything registers with the process-wide telemetry hub under the
+``post_training`` provider: loop rounds, trajectory counts, buffer
+depth/staleness, published/applied weight versions, push latency.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Dict
+
+from .buffer import (ReplayBuffer, Trajectory, model_scored_reward,
+                     pattern_reward)
+from .rollout import RolloutWorker, cyclic_prompts
+from .trainer import (StoreBatchDataset, WeightPushCallback, make_rl_batch,
+                      make_rl_loss, put_batch, rl_fit)
+from .weights import WeightPublisher, WeightSubscriber, pack_state, \
+    unpack_state
+
+__all__ = [
+    "ReplayBuffer", "Trajectory", "pattern_reward", "model_scored_reward",
+    "RolloutWorker", "cyclic_prompts",
+    "WeightPublisher", "WeightSubscriber", "pack_state", "unpack_state",
+    "make_rl_batch", "make_rl_loss", "rl_fit", "put_batch",
+    "StoreBatchDataset", "WeightPushCallback",
+    "track", "loop_note", "provider_snapshot",
+]
+
+
+# ---------------------------------------------------------------------------
+# the post_training hub provider: weak registry of live loop components
+# ---------------------------------------------------------------------------
+
+_components: "weakref.WeakSet" = weakref.WeakSet()
+_loop_state: Dict[str, Any] = {}
+
+
+def track(obj):
+    """Register a loop component (buffer / publisher / subscriber /
+    rollout worker — anything with ``stats()``) so its rows appear in
+    the ``post_training`` provider. Weak: a collected component's rows
+    disappear with it."""
+    _components.add(obj)
+    return obj
+
+
+def loop_note(**kw) -> None:
+    """Record scalar loop-level facts (round, rewards, push latency)
+    into the provider snapshot — the drill's heartbeat."""
+    _loop_state.update({k: v for k, v in kw.items()})
+
+
+def provider_snapshot() -> Dict[str, Any]:
+    out: Dict[str, Any] = {"loop": dict(_loop_state)}
+    rows = []
+    for obj in list(_components):
+        try:
+            st = dict(obj.stats())
+        except Exception:
+            continue
+        st["kind"] = type(obj).__name__
+        rows.append(st)
+    out["components"] = sorted(
+        rows, key=lambda r: (r.get("kind", ""), str(r.get("name", ""))))
+    return out
+
+
+def _register_provider() -> None:
+    try:
+        from ..observability import register_provider
+
+        register_provider("post_training", provider_snapshot)
+    except Exception:  # observability stack unavailable: stay usable
+        pass
+
+
+_register_provider()

@@ -1,0 +1,226 @@
+"""Versioned replay/reward buffer for the post-training loop.
+
+Trajectories arrive from the rollout tier stamped with the
+``weight_version`` that produced them; rewards are computed on add by a
+pluggable reward fn (programmatic pattern match or model-scored);
+sampling is deterministic under the buffer's seed and staleness-bounded
+— trajectories more than ``staleness_limit`` versions behind the
+trainer's current version are evicted, counted, and never trained on.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["Trajectory", "ReplayBuffer", "pattern_reward",
+           "model_scored_reward"]
+
+_traj_ids = itertools.count(1)
+
+
+class Trajectory:
+    """One rollout: the prompt, the generated tokens, the behavior
+    logprobs they were sampled under, and the weight version that
+    produced them (the staleness / importance-weighting key)."""
+
+    __slots__ = ("prompt", "tokens", "logprobs", "weight_version",
+                 "reward", "token_rewards", "id", "meta")
+
+    def __init__(self, prompt: Sequence[int], tokens: Sequence[int],
+                 logprobs: Sequence[float], weight_version: int,
+                 reward: float = 0.0,
+                 token_rewards: Optional[Sequence[float]] = None,
+                 meta: Optional[Dict[str, Any]] = None):
+        self.prompt = [int(t) for t in prompt]
+        self.tokens = [int(t) for t in tokens]
+        self.logprobs = [float(x) for x in logprobs]
+        if len(self.logprobs) != len(self.tokens):
+            raise ValueError(
+                f"{len(self.tokens)} tokens but "
+                f"{len(self.logprobs)} behavior logprobs")
+        self.weight_version = int(weight_version)
+        self.reward = float(reward)
+        self.token_rewards = ([float(x) for x in token_rewards]
+                              if token_rewards is not None else None)
+        self.id = next(_traj_ids)
+        self.meta = dict(meta or {})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"prompt": self.prompt, "tokens": self.tokens,
+                "logprobs": self.logprobs,
+                "weight_version": self.weight_version,
+                "reward": self.reward,
+                "token_rewards": self.token_rewards,
+                "meta": self.meta}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Trajectory":
+        return cls(d["prompt"], d["tokens"], d["logprobs"],
+                   d["weight_version"], reward=d.get("reward", 0.0),
+                   token_rewards=d.get("token_rewards"),
+                   meta=d.get("meta"))
+
+    def __repr__(self):
+        return (f"Trajectory(id={self.id}, v={self.weight_version}, "
+                f"len={len(self.tokens)}, reward={self.reward:.3f})")
+
+
+# ---------------------------------------------------------------------------
+# reward functions — (traj) -> (scalar_reward, per_token_rewards | None)
+# ---------------------------------------------------------------------------
+
+def pattern_reward(pattern: Sequence[int]) -> Callable:
+    """Programmatic reward for the drill's cyclic-pattern task: given a
+    prompt ending inside ``pattern``, each generated token scores 1.0
+    when it is the next pattern element and 0.0 otherwise; the scalar
+    reward is the mean. Per-token credit keeps the gradient useful even
+    for greedy (zero-exploration) rollouts."""
+    pat = [int(t) for t in pattern]
+    if len(set(pat)) != len(pat):
+        raise ValueError("pattern tokens must be unique")
+
+    def fn(traj: Trajectory) -> Tuple[float, List[float]]:
+        last = traj.prompt[-1]
+        try:
+            j = pat.index(last)
+        except ValueError:
+            return 0.0, [0.0] * len(traj.tokens)
+        per = [1.0 if t == pat[(j + 1 + i) % len(pat)] else 0.0
+               for i, t in enumerate(traj.tokens)]
+        return (sum(per) / len(per) if per else 0.0), per
+
+    return fn
+
+
+def model_scored_reward(model) -> Callable:
+    """Model-scored reward: mean log-likelihood of the generated tokens
+    under a frozen scorer model (``model(ids) -> logits [B,S,V]``). The
+    RLHF-shaped alternative to a programmatic check."""
+
+    def fn(traj: Trajectory) -> Tuple[float, List[float]]:
+        if not traj.tokens:
+            return 0.0, []
+        from ..hapi.model import _as_tensor
+
+        full = np.asarray(traj.prompt + traj.tokens,
+                          dtype=np.int64)[None, :]
+        logits = np.asarray(model(_as_tensor(full)), dtype=np.float64)[0]
+        # logprob of token at position p comes from logits at p-1
+        lse = np.log(np.exp(logits - logits.max(-1, keepdims=True))
+                     .sum(-1)) + logits.max(-1)
+        per = []
+        for i, t in enumerate(traj.tokens):
+            p = len(traj.prompt) + i
+            per.append(float(logits[p - 1, t] - lse[p - 1]))
+        return float(np.mean(per)), per
+
+    return fn
+
+
+# ---------------------------------------------------------------------------
+# the buffer
+# ---------------------------------------------------------------------------
+
+class ReplayBuffer:
+    """Bounded, versioned trajectory store.
+
+    - ``add(traj)`` computes the reward (when a ``reward_fn`` is set)
+      and appends; past ``capacity`` the oldest entries fall off.
+    - ``sample(n, current_version=...)`` first evicts everything more
+      than ``staleness_limit`` versions behind ``current_version``,
+      then draws ``n`` trajectories without replacement (uniformly,
+      from the buffer's own seeded RNG — same seed, same adds, same
+      sample order).
+    """
+
+    def __init__(self, capacity: int = 4096, seed: int = 0,
+                 staleness_limit: Optional[int] = None,
+                 reward_fn: Optional[Callable] = None,
+                 name: str = "replay"):
+        self.name = str(name)
+        self.capacity = int(capacity)
+        self.staleness_limit = (int(staleness_limit)
+                                if staleness_limit is not None else None)
+        self.reward_fn = reward_fn
+        self._rng = np.random.default_rng(int(seed))
+        from ..analysis.lockdep import lock as _named_lock  # lazy
+
+        self._lock = _named_lock(
+            f"post_training.buffer.ReplayBuffer[{name}]._lock")
+        self._items: List[Trajectory] = []
+        self._counters: Dict[str, int] = {
+            "added": 0, "sampled": 0, "evicted_stale": 0,
+            "evicted_capacity": 0,
+        }
+        self._t_created = time.time()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def add(self, traj: Trajectory) -> Trajectory:
+        if self.reward_fn is not None and traj.token_rewards is None:
+            reward, per = self.reward_fn(traj)
+            traj.reward = float(reward)
+            traj.token_rewards = ([float(x) for x in per]
+                                  if per is not None else None)
+        with self._lock:
+            self._items.append(traj)
+            self._counters["added"] += 1
+            while len(self._items) > self.capacity:
+                self._items.pop(0)
+                self._counters["evicted_capacity"] += 1
+        return traj
+
+    def _evict_stale_locked(self, current_version: Optional[int]) -> None:
+        if current_version is None or self.staleness_limit is None:
+            return
+        floor = int(current_version) - self.staleness_limit
+        kept = [t for t in self._items if t.weight_version >= floor]
+        self._counters["evicted_stale"] += len(self._items) - len(kept)
+        self._items = kept
+
+    def sample(self, n: int,
+               current_version: Optional[int] = None) -> List[Trajectory]:
+        with self._lock:
+            self._evict_stale_locked(current_version)
+            if not self._items:
+                return []
+            k = min(int(n), len(self._items))
+            idx = self._rng.choice(len(self._items), size=k, replace=False)
+            out = [self._items[i] for i in sorted(int(i) for i in idx)]
+            self._counters["sampled"] += len(out)
+            return out
+
+    def mean_reward(self, last: Optional[int] = None) -> float:
+        with self._lock:
+            items = self._items[-int(last):] if last else self._items
+            if not items:
+                return 0.0
+            return float(np.mean([t.reward for t in items]))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            versions: Dict[int, int] = {}
+            for t in self._items:
+                versions[t.weight_version] = \
+                    versions.get(t.weight_version, 0) + 1
+            newest = max(versions) if versions else 0
+            stale = (float(np.mean([newest - t.weight_version
+                                    for t in self._items]))
+                     if self._items else 0.0)
+            return {
+                "name": self.name, "depth": len(self._items),
+                "capacity": self.capacity,
+                "staleness_limit": self.staleness_limit,
+                "mean_reward": (float(np.mean([t.reward
+                                               for t in self._items]))
+                                if self._items else 0.0),
+                "version_histogram": {str(k): versions[k]
+                                      for k in sorted(versions)},
+                "mean_staleness": round(stale, 3),
+                **dict(self._counters),
+            }

@@ -1,0 +1,246 @@
+"""The trainer half of the post-training loop: a policy-gradient
+objective over ``elastic_fit``, fed round-by-round from the rollout
+process through the control-plane ``TCPStore``, publishing every
+weight update through a ``WeightPublisher``.
+
+Off-policy correction: each trained token carries the BEHAVIOR logprob
+it was sampled under (from the serving fleet's ledger) and the weight
+version that produced it. The loss importance-weights by
+``exp(clip(current_logprob - behavior_logprob))`` — stop-gradient on
+the ratio, REINFORCE on the logprob — so rollouts that are a version
+behind the trainer are still usable, just down/up-weighted by how far
+the policy has moved.
+
+Batch wire format (one store key per round, JSON):
+    ids  [B, L]     int64   prompt + generated tokens, right-padded
+    y    [B, L, 5]  float32 per-position (target, behavior_lp,
+                            advantage, mask, supervised) — mask=1 on
+                            positions that predict a trained token;
+                            supervised=1 marks prompt-continuation
+                            positions trained as plain weighted CE
+                            (importance ratio pinned to 1), the
+                            rejection-sampling half of the objective
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .buffer import Trajectory
+
+__all__ = ["make_rl_batch", "make_rl_loss", "StoreBatchDataset",
+           "WeightPushCallback", "rl_fit", "put_batch"]
+
+
+# ---------------------------------------------------------------------------
+# batch packing (rollout process side)
+# ---------------------------------------------------------------------------
+
+def make_rl_batch(trajs: Sequence[Trajectory], seq_len: int,
+                  baseline: float = 0.0, prompt_weight: float = 1.0
+                  ) -> Tuple[np.ndarray, np.ndarray]:
+    """Pack trajectories into the ``(ids, y)`` wire batch. Position
+    ``p`` of ``ids`` predicts the token at ``p+1``, so generated token
+    ``j`` (absolute position ``len(prompt)+j``) is supervised at
+    ``p = len(prompt)+j-1``. Advantage is the per-token reward against
+    a constant ``baseline`` — matches push their logprob up,
+    mismatches push it down, and a fully-converged batch keeps
+    reinforcing the right tokens instead of going silent.
+
+    When ``prompt_weight > 0``, the prompt's own continuation positions
+    (``p`` in ``0..len(prompt)-2``) are packed as SUPERVISED targets
+    (``sup=1``, advantage ``prompt_weight``, behavior 0): the prompt is
+    verified-correct pattern data, so distilling it keeps the policy
+    anchored in contexts greedy rollouts never explore."""
+    B, L = len(trajs), int(seq_len)
+    ids = np.zeros((B, L), dtype=np.int64)
+    y = np.zeros((B, L, 5), dtype=np.float32)
+    for b, tr in enumerate(trajs):
+        full = tr.prompt + tr.tokens
+        ids[b, :min(L, len(full))] = full[:L]
+        if prompt_weight > 0:
+            for p in range(min(len(tr.prompt) - 1, L)):
+                y[b, p] = (full[p + 1], 0.0, float(prompt_weight),
+                           1.0, 1.0)
+        per = tr.token_rewards
+        if per is None:
+            per = [tr.reward] * len(tr.tokens)
+        for j, tok in enumerate(tr.tokens):
+            p = len(tr.prompt) + j - 1
+            if p < 0 or p >= L:
+                continue
+            y[b, p] = (tok, tr.logprobs[j],
+                       float(per[j]) - float(baseline), 1.0, 0.0)
+    return ids, y
+
+
+def make_rl_loss(ratio_clip: float = 2.0) -> Callable:
+    """The hapi-shaped loss ``fn(logits, y) -> scalar``: masked
+    importance-weighted REINFORCE on generated tokens, plain weighted
+    cross-entropy on supervised (``sup=1``) positions — the importance
+    ratio is pinned to 1 there because the target never came from the
+    behavior policy (see module docstring)."""
+    c = float(ratio_clip)
+
+    def rl_loss(logits, y):
+        from .. import ops
+        from ..nn import functional as F
+        from ..ops import manipulation as man
+
+        vocab = int(logits.shape[-1])
+        logp = F.log_softmax(logits.astype("float32"), axis=-1)
+        tgt = y[:, :, 0].astype("int64")
+        beh, adv = y[:, :, 1], y[:, :, 2]
+        mask, sup = y[:, :, 3], y[:, :, 4]
+        lp = ops.sum(logp * man.one_hot(tgt, vocab), axis=-1)  # [B,L]
+        # stop-gradient importance ratio: the correction is a WEIGHT,
+        # clipped in log space so a stale behavior policy cannot blow
+        # up a single token's gradient
+        ratio = ops.exp(ops.clip(lp - beh, -c, c)).detach()
+        w = ratio * (1.0 - sup) + sup
+        num = ops.sum(w * adv * lp * mask)
+        den = ops.clip(ops.sum(mask), 1.0, None)
+        return -(num / den)
+
+    return rl_loss
+
+
+# ---------------------------------------------------------------------------
+# store-backed feed: rollout process -> trainer process
+# ---------------------------------------------------------------------------
+
+def _batch_key(prefix: str, k: int) -> str:
+    return f"{prefix}/batch/{k}"
+
+
+def put_batch(store, prefix: str, k: int, ids: np.ndarray,
+              y: np.ndarray) -> None:
+    """Publish round ``k``'s packed batch (rollout-process side)."""
+    store.set(_batch_key(prefix, k), json.dumps(
+        {"ids": ids.tolist(), "y": y.tolist()}))
+
+
+class StoreBatchDataset:
+    """The trainer's dataset view over the store: ``rounds`` rollout
+    rounds of ``batch_size`` rows each, where reading a row of round
+    ``k`` BLOCKS on the store key until the rollout process publishes
+    it. With ``steps_per_round > 1`` each round's batch is replayed
+    that many consecutive global steps (inner optimisation on a fixed
+    batch) before the loop advances to — and blocks on — the next
+    round. The loader's prefetch thread parks on the next key while
+    the train step runs: the natural rollout->train pipeline, no
+    polling loop."""
+
+    def __init__(self, store, prefix: str, rounds: int, batch_size: int,
+                 seq_len: int, steps_per_round: int = 1):
+        self.store = store
+        self.prefix = str(prefix)
+        self.rounds = int(rounds)
+        self.batch_size = int(batch_size)
+        self.seq_len = int(seq_len)
+        self.steps_per_round = max(1, int(steps_per_round))
+        self._cache: Tuple[int, np.ndarray, np.ndarray] = (-1, None, None)
+
+    def __len__(self) -> int:
+        return self.rounds * self.steps_per_round * self.batch_size
+
+    def __getitem__(self, i: int):
+        step, r = divmod(int(i), self.batch_size)
+        k = step // self.steps_per_round
+        ck, ids, y = self._cache
+        if ck != k:
+            key = _batch_key(self.prefix, k)
+            self.store.wait([key])
+            d = json.loads(self.store.get(key).decode())
+            ids = np.asarray(d["ids"], dtype=np.int64)
+            y = np.asarray(d["y"], dtype=np.float32)
+            self._cache = (k, ids, y)
+        return ids[r], y[r]
+
+
+# ---------------------------------------------------------------------------
+# weight push callback (trainer side)
+# ---------------------------------------------------------------------------
+
+class WeightPushCallback:
+    """hapi callback: after every ``push_every``-th trained batch,
+    snapshot the live GPT params and publish them as the next weight
+    version (plus a store marker the rollout process can watch).
+    Duck-typed for hapi's CallbackList (set_model/set_params)."""
+
+    def __init__(self, publisher, *, store=None, prefix: str = "ptq",
+                 base_version: int = 0, push_every: int = 1):
+        self.publisher = publisher
+        self.store = store
+        self.prefix = str(prefix)
+        self.base_version = int(base_version)
+        self.push_every = max(1, int(push_every))
+        self.pushed: List[int] = []
+        self.model = None
+        self.params: Dict[str, Any] = {}
+        self._step = 0
+
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        if self._step % self.push_every:
+            return
+        from ..serving.generation import (_extract_gpt_params,
+                                          flatten_gpt_params)
+
+        flat = flatten_gpt_params(_extract_gpt_params(self.model.network))
+        ver = self.base_version + len(self.pushed) + 1
+        loss = float(np.asarray((logs or {}).get("loss", 0.0)))
+        self.publisher.publish(flat, version=ver,
+                               meta={"step": self._step, "loss": loss})
+        self.pushed.append(ver)
+        if self.store is not None:
+            self.store.set(f"{self.prefix}/pushed", str(ver))
+            self.store.set(f"{self.prefix}/loss/{ver}", repr(loss))
+
+
+# ---------------------------------------------------------------------------
+# the trainer entry
+# ---------------------------------------------------------------------------
+
+def rl_fit(build: Callable, *, store, publisher, rounds: int,
+           batch_size: int, seq_len: int, ratio_clip: float = 2.0,
+           prefix: str = "ptq", base_version: int = 0,
+           steps_per_round: int = 1, push_every: Optional[int] = None,
+           fit_kw: Optional[Dict] = None) -> Dict[str, Any]:
+    """Run the RL objective under ``elastic_fit``: ``build(ctx)``
+    returns ``{"network", "optimizer"}`` (a ``GPTForCausalLM`` + its
+    optimizer); the dataset, loss, and weight-push callback are wired
+    here. Each rollout round trains ``steps_per_round`` global steps on
+    its batch, then publishes one streamed weight version
+    (``push_every`` defaults to ``steps_per_round`` — one push per
+    round). Returns elastic_fit's result dict plus ``pushed`` (the
+    published version list)."""
+    from ..distributed.fleet.runtime import elastic_fit
+
+    spr = max(1, int(steps_per_round))
+    push_cb = WeightPushCallback(publisher, store=store, prefix=prefix,
+                                 base_version=base_version,
+                                 push_every=(spr if push_every is None
+                                             else push_every))
+
+    def _build(ctx):
+        parts = dict(build(ctx))
+        parts["loss"] = make_rl_loss(ratio_clip)
+        parts["dataset"] = StoreBatchDataset(store, prefix, rounds,
+                                             batch_size, seq_len,
+                                             steps_per_round=spr)
+        parts["callbacks"] = list(parts.get("callbacks") or []) + [push_cb]
+        return parts
+
+    out = elastic_fit(_build, global_batch=batch_size, epochs=1,
+                      replan=False, fit_kw=fit_kw)
+    out["pushed"] = list(push_cb.pushed)
+    return out

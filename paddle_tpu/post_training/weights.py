@@ -1,0 +1,579 @@
+"""The streaming weight-distribution service: trainer -> replicas.
+
+``WeightPublisher`` holds the last few published weight versions and
+serves them over the serving fleet's length-prefixed frame protocol;
+``WeightSubscriber`` polls it from each replica, pulls new versions in
+digest-verified chunks, and applies them in place through
+``engine.swap_weights()`` — a weight push costs seconds, not a respawn.
+
+The protocol is PULL-based and resumable by construction:
+
+    subscriber                      publisher
+    ----------                      ---------
+    {"op": "head"}             ->   {"version": latest or 0}
+    {"op": "manifest", v}      ->   {names, digest, n_chunks, ...}
+    {"op": "chunk", v, index}  ->   {data: b64, sha}     (one per ask)
+
+Each chunk is SHA-256 verified on receipt and the assembled blob
+against the manifest digest, so a corrupted transfer is rejected, not
+applied. A subscriber that loses its connection mid-transfer keeps the
+chunks it already verified and, on reconnect, asks only for the
+missing ones (the resume path). Because the publisher only ever sends
+one chunk per request, a slow subscriber back-pressures ITSELF — its
+next ask waits on its own socket — while the publisher's select loop
+keeps serving everyone else from per-connection output buffers.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import itertools
+import json
+import os
+import select
+import socket
+import struct
+import threading
+import time
+from collections import OrderedDict
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..serving.fleet import recv_frame, send_frame, _json_default
+
+__all__ = ["WeightPublisher", "WeightSubscriber", "pack_state",
+           "unpack_state"]
+
+
+# ---------------------------------------------------------------------------
+# state (de)serialization: flat {name: array} <-> one contiguous blob
+# ---------------------------------------------------------------------------
+
+def _np_dtype(spec: str) -> np.dtype:
+    try:
+        return np.dtype(spec)
+    except TypeError:
+        import ml_dtypes  # bf16 et al (always present under jax)
+
+        return np.dtype(getattr(ml_dtypes, spec))
+
+
+def pack_state(state: Dict[str, Any]) -> Tuple[bytes, List[Dict]]:
+    """Flat ``{name: array}`` -> (blob, manifest names). Names are
+    sorted so the same state always packs to the same bytes (and the
+    same digest)."""
+    names: List[Dict[str, Any]] = []
+    parts: List[bytes] = []
+    off = 0
+    for k in sorted(state):
+        a = np.ascontiguousarray(np.asarray(state[k]))
+        raw = a.tobytes()
+        names.append({"name": str(k), "dtype": str(a.dtype),
+                      "shape": list(a.shape), "offset": off,
+                      "size": len(raw)})
+        parts.append(raw)
+        off += len(raw)
+    return b"".join(parts), names
+
+
+def unpack_state(blob: bytes, names: List[Dict]) -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    for m in names:
+        seg = blob[m["offset"]:m["offset"] + m["size"]]
+        arr = np.frombuffer(seg, dtype=_np_dtype(m["dtype"]))
+        out[m["name"]] = arr.reshape(m["shape"]).copy()
+    return out
+
+
+def _sha(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# publisher
+# ---------------------------------------------------------------------------
+
+class WeightPublisher:
+    """Trainer-side version store + frame-protocol server.
+
+    ``publish(state)`` snapshots the state into chunked, digest-indexed
+    form; subscribers pull it at their own pace. The serve loop is ONE
+    thread (``pt-posttrain-pub-<name>``) multiplexing every connection
+    with non-blocking sockets and per-connection output buffers — a
+    subscriber that stops reading stalls only its own buffer (bounded;
+    past the cap it is disconnected), never the loop.
+    """
+
+    def __init__(self, name: str = "trainer", host: str = "127.0.0.1",
+                 chunk_bytes: int = 1 << 20, keep_versions: int = 2,
+                 max_outbuf: int = 64 << 20):
+        self.name = str(name)
+        self.chunk_bytes = int(chunk_bytes)
+        self.keep_versions = max(1, int(keep_versions))
+        self.max_outbuf = int(max_outbuf)
+        self._listen = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind((host, 0))
+        self._listen.listen(16)
+        self._listen.setblocking(False)
+        self.host, self.port = self._listen.getsockname()
+        from ..analysis.lockdep import lock as _named_lock  # lazy
+
+        self._lock = _named_lock(
+            f"post_training.weights.WeightPublisher[{name}]._lock")
+        self._versions: "OrderedDict[int, Dict[str, Any]]" = OrderedDict()
+        self._latest = 0
+        self._counters: Dict[str, int] = {}
+        self._conns: Dict[socket.socket, bytearray] = {}
+        self._outbuf: Dict[socket.socket, bytearray] = {}
+        self._wake_r, self._wake_w = os.pipe()
+        os.set_blocking(self._wake_r, False)
+        os.set_blocking(self._wake_w, False)
+        self._stopped = False
+        self._thread: Optional[threading.Thread] = None
+        # test seam: serve N more chunk requests, then drop that
+        # connection without replying (the mid-transfer crash drill)
+        self.drop_after_chunks: Optional[int] = None
+
+    # -- lifecycle ------------------------------------------------------------
+    @property
+    def endpoint(self) -> Tuple[str, int]:
+        return (self.host, self.port)
+
+    def start(self) -> "WeightPublisher":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._serve, daemon=True,
+                name=f"pt-posttrain-pub-{self.name}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        try:
+            os.write(self._wake_w, b"x")
+        except OSError:
+            pass
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- publishing -----------------------------------------------------------
+    def publish(self, state: Dict[str, Any],
+                version: Optional[int] = None,
+                meta: Optional[Dict[str, Any]] = None) -> int:
+        """Snapshot ``state`` as a new version (monotonic; defaults to
+        latest+1) and retire versions beyond ``keep_versions``. Returns
+        the published version number."""
+        t0 = time.monotonic()
+        blob, names = pack_state(state)
+        chunks = [blob[i:i + self.chunk_bytes]
+                  for i in range(0, len(blob), self.chunk_bytes)] or [b""]
+        rec = {
+            "names": names, "digest": _sha(blob),
+            "chunks": chunks, "sha": [_sha(c) for c in chunks],
+            "meta": dict(meta or {}), "t_publish": time.time(),
+            "nbytes": len(blob),
+        }
+        with self._lock:
+            ver = int(version) if version is not None else self._latest + 1
+            if ver <= self._latest and ver in self._versions:
+                raise ValueError(f"version {ver} already published")
+            self._versions[ver] = rec
+            self._latest = max(self._latest, ver)
+            while len(self._versions) > self.keep_versions:
+                self._versions.popitem(last=False)
+            self._counters["published"] = \
+                self._counters.get("published", 0) + 1
+            self._counters["published_bytes"] = \
+                self._counters.get("published_bytes", 0) + len(blob)
+            self._last_pack_ms = round((time.monotonic() - t0) * 1e3, 2)
+        return ver
+
+    def latest_version(self) -> int:
+        with self._lock:
+            return self._latest
+
+    def corrupt_chunk_for_test(self, version: int, index: int) -> None:
+        """Flip bytes in a stored chunk WITHOUT updating its digest —
+        the digest-mismatch rejection drill."""
+        with self._lock:
+            rec = self._versions[int(version)]
+            c = bytearray(rec["chunks"][int(index)])
+            c[0] = c[0] ^ 0xFF if c else 0
+            rec["chunks"][int(index)] = bytes(c)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "name": self.name, "latest_version": self._latest,
+                "held_versions": sorted(self._versions),
+                "conns": len(self._conns),
+                **dict(self._counters),
+            }
+
+    # -- serve loop -----------------------------------------------------------
+    def _serve(self) -> None:
+        while not self._stopped:
+            with self._lock:
+                wl = [c for c, b in self._outbuf.items() if b]
+            rl = [self._listen, self._wake_r] + list(self._conns)
+            try:
+                rs, ws, _ = select.select(rl, wl, [], 0.1)
+            except OSError:
+                rs, ws = [], []
+            for s in rs:
+                if s is self._listen:
+                    try:
+                        conn, _ = self._listen.accept()
+                    except OSError:
+                        continue
+                    conn.setblocking(False)
+                    self._conns[conn] = bytearray()
+                    self._outbuf[conn] = bytearray()
+                elif s is self._wake_r:
+                    try:
+                        os.read(self._wake_r, 4096)
+                    except OSError:
+                        pass
+                else:
+                    self._readable(s)
+            for s in ws:
+                self._writable(s)
+        for c in list(self._conns):
+            self._drop(c)
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+        for fd in (self._wake_r, self._wake_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+
+    def _drop(self, conn) -> None:
+        self._conns.pop(conn, None)
+        with self._lock:
+            self._outbuf.pop(conn, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _readable(self, conn) -> None:
+        try:
+            data = conn.recv(65536)
+        except BlockingIOError:
+            return
+        except OSError:
+            data = b""
+        if not data:
+            self._drop(conn)
+            return
+        buf = self._conns.get(conn)
+        if buf is None:
+            return
+        buf += data
+        while len(buf) >= 4:
+            (n,) = struct.unpack(">I", bytes(buf[:4]))
+            if len(buf) < 4 + n:
+                break
+            msg = json.loads(bytes(buf[4:4 + n]).decode())
+            del buf[:4 + n]
+            if not self._handle(conn, msg):
+                return  # connection dropped mid-parse
+
+    def _send(self, conn, obj: Dict[str, Any]) -> bool:
+        data = json.dumps(obj, separators=(",", ":"),
+                          default=_json_default).encode()
+        frame = struct.pack(">I", len(data)) + data
+        with self._lock:
+            buf = self._outbuf.get(conn)
+            if buf is None:
+                return False
+            if len(buf) + len(frame) > self.max_outbuf:
+                over = True
+            else:
+                buf += frame
+                over = False
+        if over:  # pathological non-reader: disconnect, it can resume
+            self._counters["slow_disconnects"] = \
+                self._counters.get("slow_disconnects", 0) + 1
+            self._drop(conn)
+            return False
+        self._writable(conn)
+        return True
+
+    def _writable(self, conn) -> None:
+        while True:
+            with self._lock:
+                buf = self._outbuf.get(conn)
+                if not buf:
+                    return
+                pending = bytes(buf[:262144])
+            try:
+                sent = conn.send(pending)  # pd-lint: disable=CC001
+            except (BlockingIOError, InterruptedError):
+                return  # kernel buffer full: select's writable set owns it
+            except OSError:
+                self._drop(conn)
+                return
+            with self._lock:
+                buf = self._outbuf.get(conn)
+                if buf is None:
+                    return
+                del buf[:sent]
+
+    def _handle(self, conn, msg: Dict[str, Any]) -> bool:
+        op, rid = msg.get("op"), msg.get("rid")
+        if op == "head":
+            with self._lock:
+                latest = self._latest
+            return self._send(conn, {"rid": rid, "event": "reply",
+                                     "version": latest})
+        if op == "manifest":
+            ver = int(msg.get("version", 0))
+            with self._lock:
+                rec = self._versions.get(ver)
+                if rec is not None:
+                    reply = {"rid": rid, "event": "reply",
+                             "version": ver, "names": rec["names"],
+                             "digest": rec["digest"],
+                             "n_chunks": len(rec["chunks"]),
+                             "meta": rec["meta"],
+                             "t_publish": rec["t_publish"],
+                             "nbytes": rec["nbytes"],
+                             "chunk_bytes": self.chunk_bytes}
+                else:
+                    reply = None
+            if reply is None:
+                return self._send(conn, {
+                    "rid": rid, "event": "error", "kind": "VersionGone",
+                    "msg": f"version {ver} not held"})
+            return self._send(conn, reply)
+        if op == "chunk":
+            ver, idx = int(msg.get("version", 0)), int(msg.get("index", -1))
+            if self.drop_after_chunks is not None:
+                self.drop_after_chunks -= 1
+                if self.drop_after_chunks < 0:
+                    self.drop_after_chunks = None
+                    self._drop(conn)  # the mid-transfer crash seam
+                    return False
+            with self._lock:
+                rec = self._versions.get(ver)
+                chunk = sha = None
+                if rec is not None and 0 <= idx < len(rec["chunks"]):
+                    chunk, sha = rec["chunks"][idx], rec["sha"][idx]
+                    self._counters["chunks_served"] = \
+                        self._counters.get("chunks_served", 0) + 1
+                    self._counters["bytes_served"] = \
+                        self._counters.get("bytes_served", 0) + len(chunk)
+            if chunk is None:
+                return self._send(conn, {
+                    "rid": rid, "event": "error", "kind": "VersionGone",
+                    "msg": f"version {ver} chunk {idx} not held"})
+            return self._send(conn, {
+                "rid": rid, "event": "reply", "version": ver,
+                "index": idx, "sha": sha,
+                "data": base64.b64encode(chunk).decode()})
+        return self._send(conn, {"rid": rid, "event": "error",
+                                 "kind": "BadRequest",
+                                 "msg": f"unknown op {op!r}"})
+
+
+# ---------------------------------------------------------------------------
+# subscriber
+# ---------------------------------------------------------------------------
+
+class WeightSubscriber:
+    """Replica-side puller: polls the publisher's head, pulls any newer
+    version chunk-by-chunk (verifying each against its SHA-256 and the
+    assembled blob against the manifest digest), and applies it through
+    ``engine.swap_weights(state, version=...)`` — or a plain
+    ``on_update(state, version, meta)`` callback when no engine is
+    given. Partial transfers survive connection loss: verified chunks
+    are kept keyed by (version, digest) and only the missing ones are
+    re-pulled after reconnect."""
+
+    def __init__(self, host: str, port: int, *, engine=None,
+                 on_update: Optional[Callable] = None,
+                 name: str = "sub", poll_interval: float = 0.25,
+                 rpc_timeout_s: float = 30.0):
+        if engine is None and on_update is None:
+            raise ValueError("need an engine or an on_update callback")
+        self.endpoint = (str(host), int(port))
+        self.engine = engine
+        self.on_update = on_update
+        self.name = str(name)
+        self.poll_interval = float(poll_interval)
+        self._rpc_timeout = float(rpc_timeout_s)
+        from ..analysis.lockdep import lock as _named_lock  # lazy
+
+        self._lock = _named_lock(
+            f"post_training.weights.WeightSubscriber[{name}]._lock")
+        self._sock: Optional[socket.socket] = None
+        self._rid = itertools.count(1)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.applied_version = int(getattr(engine, "weight_version", 0)
+                                   or 0)
+        self._failed_version: Optional[int] = None  # apply() refused it
+        self._partial: Optional[Dict[str, Any]] = None
+        self._counters: Dict[str, int] = {}
+        self._last: Dict[str, Any] = {}
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "WeightSubscriber":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name=f"pt-posttrain-sub-{self.name}")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._close_sock()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None
+
+    def alive(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.fetch_once()
+            except Exception:
+                self._counters["poll_errors"] = \
+                    self._counters.get("poll_errors", 0) + 1
+                self._close_sock()
+            self._stop.wait(self.poll_interval)
+
+    # -- transport ------------------------------------------------------------
+    def _close_sock(self) -> None:
+        s, self._sock = self._sock, None
+        if s is not None:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def _rpc(self, op: str, **kw) -> Dict[str, Any]:
+        if self._sock is None:
+            self._sock = socket.create_connection(self.endpoint,
+                                                  timeout=5)
+            self._sock.settimeout(self._rpc_timeout)
+        msg = {"op": op, "rid": next(self._rid)}
+        msg.update(kw)
+        try:
+            send_frame(self._sock, msg)
+            frame = recv_frame(self._sock)
+        except (OSError, ValueError):
+            self._close_sock()
+            raise ConnectionError(f"publisher {self.endpoint} lost")
+        if frame is None:
+            self._close_sock()
+            raise ConnectionError(f"publisher {self.endpoint} closed")
+        if frame.get("event") == "error":
+            raise RuntimeError(
+                f"{frame.get('kind')}: {frame.get('msg')}")
+        return frame
+
+    # -- one poll -------------------------------------------------------------
+    def fetch_once(self) -> Optional[int]:
+        """Check head; transfer + apply a newer version if there is
+        one. Returns the newly applied version, else None. Raises on
+        connection loss (the loop retries; verified chunks persist)."""
+        head = int(self._rpc("head").get("version", 0))
+        if head <= self.applied_version or head == self._failed_version:
+            return None
+        man = self._rpc("manifest", version=head)
+        ver, digest = int(man["version"]), str(man["digest"])
+        n_chunks = int(man["n_chunks"])
+        with self._lock:
+            part = self._partial
+            if part is None or part["version"] != ver or \
+                    part["digest"] != digest:
+                part = {"version": ver, "digest": digest, "chunks": {}}
+                self._partial = part
+            elif part["chunks"]:
+                self._counters["resumed_transfers"] = \
+                    self._counters.get("resumed_transfers", 0) + 1
+        t0 = time.monotonic()
+        for idx in range(n_chunks):
+            with self._lock:
+                if idx in part["chunks"]:
+                    continue  # verified before the connection loss
+            reply = self._rpc("chunk", version=ver, index=idx)
+            raw = base64.b64decode(reply["data"])
+            if _sha(raw) != reply["sha"]:
+                self._counters["chunk_rejects"] = \
+                    self._counters.get("chunk_rejects", 0) + 1
+                raise ConnectionError(f"chunk {idx} hash mismatch")
+            with self._lock:
+                part["chunks"][idx] = raw
+                self._counters["chunks_fetched"] = \
+                    self._counters.get("chunks_fetched", 0) + 1
+        blob = b"".join(part["chunks"][i] for i in range(n_chunks))
+        if _sha(blob) != digest:
+            # corrupted at rest on the publisher: refuse to apply and
+            # drop the partial so a republish transfers cleanly
+            with self._lock:
+                self._partial = None
+            self._counters["digest_rejects"] = \
+                self._counters.get("digest_rejects", 0) + 1
+            raise RuntimeError(f"version {ver} digest mismatch")
+        state = unpack_state(blob, man["names"])
+        t_apply = time.monotonic()
+        try:
+            if self.engine is not None:
+                self.engine.swap_weights(state, version=ver)
+            else:
+                self.on_update(state, ver, man.get("meta") or {})
+        except Exception:
+            self._failed_version = ver  # do not spin on a bad version
+            self._counters["apply_errors"] = \
+                self._counters.get("apply_errors", 0) + 1
+            raise
+        now = time.monotonic()
+        with self._lock:
+            self.applied_version = ver
+            self._partial = None
+            self._counters["applies"] = self._counters.get("applies", 0) + 1
+            self._last = {
+                "version": ver, "nbytes": int(man.get("nbytes", 0)),
+                "transfer_ms": round((t_apply - t0) * 1e3, 2),
+                "apply_ms": round((now - t_apply) * 1e3, 2),
+                # publisher + subscriber share the drill host: wall
+                # clock delta IS the push latency
+                "push_latency_ms": round(
+                    (time.time() - float(man.get("t_publish", 0))) * 1e3,
+                    2),
+            }
+        return ver
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            part = self._partial
+            return {
+                "name": self.name,
+                "applied_version": self.applied_version,
+                "partial_chunks": len(part["chunks"]) if part else 0,
+                "last": dict(self._last),
+                **dict(self._counters),
+            }

@@ -91,6 +91,10 @@ class EngineBase:
         self._fenced = False
         self._thread: Optional[threading.Thread] = None
         self._flight_rec = None  # lazily-resolved process flight recorder
+        # monotonically increasing weight generation this engine serves.
+        # 0 = the weights the engine was constructed with; bumped by
+        # swap_weights() (the post-training weight-push fast path).
+        self.weight_version = 0
 
     def _flight(self):
         """The process flight recorder (created on first use) so executed
@@ -150,6 +154,18 @@ class EngineBase:
     def __exit__(self, *exc):
         self.close()
         return False
+
+    def swap_weights(self, state, version: Optional[int] = None,
+                     timeout: Optional[float] = None) -> int:
+        """Replace the served weights IN PLACE between batches — the
+        weight-push fast path (seconds, not a respawn). In-flight
+        requests finish bit-identically on the version they started on:
+        the swap applies only at a step boundary with zero active work.
+        Returns the new ``weight_version``. Subclasses that can swap
+        implement it; the base refuses (callers fall back to
+        ``rolling_restart``)."""
+        raise NotImplementedError(
+            f"{type(self).__name__} does not support in-place weight swap")
 
     def fence(self) -> None:
         """Stop admitting NEW work while queued + in-flight requests run
@@ -229,6 +245,7 @@ class EngineBase:
     def _stats_base(self) -> Dict[str, Any]:
         snap = self.metrics.snapshot()
         snap["name"] = self.name
+        snap["weight_version"] = self.weight_version
         rt = self.retrace_events()
         if rt is not None:
             snap["retrace_events"] = rt

@@ -288,6 +288,7 @@ class _ReplicaServer:
         self._hung = False
         self._shutdown = False
         self._store_failures = 0
+        self._subscriber = None              # weight-service subscriber
 
     # -- outbound (called from engine worker threads) -------------------------
     def _post(self, conn, frame: Dict[str, Any]) -> None:
@@ -385,6 +386,11 @@ class _ReplicaServer:
             self._listen.close()
         except OSError:
             pass
+        if self._subscriber is not None:
+            try:
+                self._subscriber.stop()
+            except Exception:
+                pass
         try:
             self.engine.close(drain=True, timeout=10)
         except Exception:
@@ -431,6 +437,15 @@ class _ReplicaServer:
                     hasattr(self.engine, "set_speculative"):
                 self.engine.set_speculative(bool(msg["spec_decode"]))
             self._post(conn, {"rid": rid, "event": "reply", "ok": True})
+        elif op == "subscribe_weights":
+            try:
+                self._start_subscriber(msg)
+                self._post(conn, {"rid": rid, "event": "reply",
+                                  "ok": True})
+            except Exception as e:
+                self._post(conn, {"rid": rid, "event": "error",
+                                  "kind": type(e).__name__,
+                                  "msg": str(e)[:300]})
         elif op == "drain":
             self.engine.fence()
             self._post(conn, {"rid": rid, "event": "reply",
@@ -493,13 +508,23 @@ class _ReplicaServer:
 
     def _do_submit(self, conn, rid, msg) -> None:
         post = partial(self._post, conn)
+        kw: Dict[str, Any] = {}
+        if msg.get("logprobs"):
+            # behavior-logprob requests: each token frame carries the
+            # per-token logprob alongside the token (the rollout
+            # trajectory ledger), and the done frame the full vector
+            kw["return_logprobs"] = True
+            kw["on_token"] = lambda t, lp, _p=post, _r=rid: _p(
+                {"rid": _r, "event": "token", "t": int(t),
+                 "lp": float(lp)})
+        else:
+            kw["on_token"] = lambda t, _p=post, _r=rid: _p(
+                {"rid": _r, "event": "token", "t": int(t)})
         try:
             fut = self.engine.submit(
                 np.asarray(msg["prompt"], dtype=np.int64),
                 int(msg.get("max_new_tokens", 16)),
-                deadline_ms=msg.get("deadline_ms"),
-                on_token=lambda t, _p=post, _r=rid: _p(
-                    {"rid": _r, "event": "token", "t": int(t)}))
+                deadline_ms=msg.get("deadline_ms"), **kw)
         except Exception as e:
             post({"rid": rid, "event": "error", "kind": type(e).__name__,
                   "msg": str(e)[:300]})
@@ -515,8 +540,14 @@ class _ReplicaServer:
             post({"rid": rid, "event": "error", "kind": type(e).__name__,
                   "msg": str(e)[:300]})
         else:
-            post({"rid": rid, "event": "done",
-                  "seq": [int(x) for x in res]})
+            if isinstance(res, tuple):  # (seq, logprobs)
+                seq, lps = res
+                post({"rid": rid, "event": "done",
+                      "seq": [int(x) for x in seq],
+                      "lp": [float(x) for x in lps]})
+            else:
+                post({"rid": rid, "event": "done",
+                      "seq": [int(x) for x in res]})
 
     def _probe_reply(self, msg) -> Dict[str, Any]:
         eng = self.engine
@@ -526,6 +557,7 @@ class _ReplicaServer:
             if hasattr(eng, "kv_headroom") else 1.0,
             "p95": float(eng.metrics.latency_percentile(95)),
             "seq": self._seq,
+            "weight_version": int(getattr(eng, "weight_version", 0) or 0),
         }
         if hasattr(eng, "_active"):
             try:
@@ -539,6 +571,26 @@ class _ReplicaServer:
             except Exception:
                 reply["match"] = 0
         return reply
+
+    def _start_subscriber(self, msg: Dict[str, Any]) -> None:
+        """Attach this replica to a WeightPublisher (post_training
+        weight service): a subscriber thread pulls new weight versions
+        and applies them in place through ``engine.swap_weights``. The
+        supervisor re-sends the endpoint after every respawn, so
+        idempotence on (host, port) matters here."""
+        from ..post_training.weights import WeightSubscriber  # lazy
+
+        host, port = str(msg["host"]), int(msg["port"])
+        if self._subscriber is not None:
+            if self._subscriber.endpoint == (host, port) and \
+                    self._subscriber.alive():
+                return
+            self._subscriber.stop()
+        sub = WeightSubscriber(
+            host, port, engine=self.engine, name=self.name,
+            poll_interval=float(msg.get("poll_s", 0.25)))
+        sub.start()
+        self._subscriber = sub
 
 
 def replica_main() -> int:
@@ -674,11 +726,19 @@ class ReplicaClient:
         if ev == "token":
             if p.on_token is not None:
                 try:
-                    p.on_token(int(frame["t"]))
+                    if "lp" in frame:  # logprob-carrying token stream
+                        p.on_token(int(frame["t"]), float(frame["lp"]))
+                    else:
+                        p.on_token(int(frame["t"]))
                 except Exception:
                     pass
         elif ev == "done":
-            p.future.set_result(np.asarray(frame["seq"], dtype=np.int64))
+            seq = np.asarray(frame["seq"], dtype=np.int64)
+            if "lp" in frame:
+                p.future.set_result(
+                    (seq, np.asarray(frame["lp"], dtype=np.float32)))
+            else:
+                p.future.set_result(seq)
         elif ev == "reply":
             p.future.set_result(frame)
         elif ev == "error":
@@ -726,7 +786,7 @@ class ReplicaClient:
     # -- engine-shaped surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               on_token=None) -> Future:
+               on_token=None, return_logprobs: bool = False) -> Future:
         # client-side validation: a malformed REQUEST raises here — the
         # replica stays healthy and must not be fenced for it
         prompt = np.asarray(prompt_ids)
@@ -745,11 +805,14 @@ class ReplicaClient:
                     f"replica {self.name} connection lost")
             self._pending[rid] = _Pending(fut, on_token=on_token,
                                           streaming=True)
+        msg = {"op": "submit", "rid": rid,
+               "prompt": [int(x) for x in prompt],
+               "max_new_tokens": int(max_new_tokens),
+               "deadline_ms": deadline_ms}
+        if return_logprobs:
+            msg["logprobs"] = True
         try:
-            self._send({"op": "submit", "rid": rid,
-                        "prompt": [int(x) for x in prompt],
-                        "max_new_tokens": int(max_new_tokens),
-                        "deadline_ms": deadline_ms})
+            self._send(msg)
         except Exception:
             with self._lock:
                 self._pending.pop(rid, None)
@@ -801,6 +864,21 @@ class ReplicaClient:
         except Exception:
             return False
 
+    def weight_version(self) -> int:
+        """The weight generation the replica currently serves (probe-
+        cached); -1 when unknown."""
+        try:
+            return int(self._probe().get("weight_version", -1))
+        except Exception:
+            return -1
+
+    def subscribe_weights(self, host: str, port: int,
+                          poll_interval: float = 0.25) -> None:
+        """Point the replica at a WeightPublisher endpoint; it pulls
+        and applies new versions in place via engine.swap_weights()."""
+        self._rpc("subscribe_weights", host=str(host), port=int(port),
+                  poll_s=float(poll_interval), timeout=10)
+
     def stats(self) -> Dict[str, Any]:
         return self._rpc("stats").get("stats", {})
 
@@ -839,31 +917,38 @@ class _Assignment:
     dispatched with (original prompt + tokens already streamed to the
     client at dispatch time) — the dedup baseline."""
 
-    __slots__ = ("req", "replica", "prefix", "tokens", "fut",
-                 "t_dispatch", "t_last", "hedge", "cancelled")
+    __slots__ = ("req", "replica", "prefix", "tokens", "lps", "fut",
+                 "t_dispatch", "t_last", "hedge", "cancelled", "repin")
 
     def __init__(self, req: "FleetRequest", replica: str,
-                 prefix: List[int], hedge: bool = False):
+                 prefix: List[int], hedge: bool = False,
+                 repin: bool = False):
         self.req = req
         self.replica = replica
         self.prefix = prefix
         self.tokens: List[int] = []
+        self.lps: List[float] = []     # behavior logprobs (want_lp)
         self.fut: Optional[Future] = None
         self.t_dispatch = time.monotonic()
         self.t_last = self.t_dispatch  # last token progress (hedge clock)
         self.hedge = hedge
         self.cancelled = False
+        # a cross-version re-prefill: no same-weight-version survivor
+        # existed, so this assignment restarts from the prompt alone
+        # and is deduped against the ledger BY POSITION
+        self.repin = repin
 
 
 class FleetRequest:
     __slots__ = ("id", "prompt", "max_new", "deadline", "deadline_ms",
                  "tenant", "priority", "future", "emitted", "on_token",
                  "primary", "hedge", "replays", "t_submit", "done",
-                 "stream_lock", "delivered")
+                 "stream_lock", "delivered", "want_lp", "emitted_lp",
+                 "weight_version")
 
     def __init__(self, rid: int, prompt: List[int], max_new: int,
                  deadline_ms: Optional[float], tenant: str, priority: int,
-                 on_token=None):
+                 on_token=None, want_lp: bool = False):
         self.id = rid
         self.prompt = prompt
         self.max_new = int(max_new)
@@ -873,7 +958,13 @@ class FleetRequest:
         self.tenant = tenant
         self.priority = int(priority)
         self.future: Future = Future()
+        self.future._pt_req = self     # rollout tier reads the version pin
         self.emitted: List[int] = []   # generated tokens streamed so far
+        self.want_lp = bool(want_lp)
+        self.emitted_lp: List[float] = []  # behavior-logprob ledger
+        # weight generation the emitted prefix was produced under (the
+        # replay version pin): None until first dispatch, -1 = unknown
+        self.weight_version: Optional[int] = None
         self.on_token = on_token
         self.primary: Optional[_Assignment] = None
         self.hedge: Optional[_Assignment] = None
@@ -993,6 +1084,10 @@ class ServingFleet:
         self._closed = False
         self._monitor: Optional[threading.Thread] = None
         self._dispatcher: Optional[threading.Thread] = None
+        # post-training weight service: remembered publisher endpoint
+        # (re-sent to every respawned replica) + in-process subscribers
+        self._weights_endpoint: Optional[Tuple[str, int, float]] = None
+        self._local_subs: Dict[str, Any] = {}
         self._register_provider()
 
     # -- provider -------------------------------------------------------------
@@ -1016,12 +1111,21 @@ class ServingFleet:
             reps = {}
             beats = dict(self.sm._beats)
             for h in self._handles:
+                wv = None
+                if h.client is not None:
+                    if h.external:
+                        wv = getattr(h.client, "weight_version", None)
+                        if callable(wv):
+                            wv = None  # only plain attributes, no I/O
+                    else:  # cached probe value only: no RPC under lock
+                        wv = h.client._probe_cache.get("weight_version")
                 reps[h.name] = {
                     "state": h.state.value,
                     "incarnation": h.incarnation,
                     "inflight": len(h.inflight),
                     "routed": h.routed,
                     "routed_since_ready": h.routed_since_ready,
+                    "weight_version": wv,
                     "last_beat_age_s": round(now - beats[h.idx], 3)
                     if h.idx in beats else None,
                 }
@@ -1112,6 +1216,12 @@ class ServingFleet:
         for th in (self._monitor, self._dispatcher):
             if th is not None:
                 th.join(timeout=5)
+        for sub in list(self._local_subs.values()):
+            try:
+                sub.stop()
+            except Exception:
+                pass
+        self._local_subs.clear()
         for h in self._handles:
             c = h.client
             if c is not None and not h.external:
@@ -1227,6 +1337,9 @@ class ServingFleet:
                 client.set_spec(False)
             except Exception:
                 pass
+        # a respawned replica rejoins the weight stream: without the
+        # re-subscribe it would serve stale weights forever
+        self._subscribe_one(h, client)
 
     # -- the monitor loops ----------------------------------------------------
     # TWO threads on purpose: supervision (beats, exits, staleness,
@@ -1393,20 +1506,30 @@ class ServingFleet:
             self._inc("restarts")
 
     # -- assignment lifecycle -------------------------------------------------
-    def _on_tok(self, asg: _Assignment, t: int) -> None:
+    def _on_tok(self, asg: _Assignment, t: int, lp=None) -> None:
         """One streamed token from a replica. Only the PRIMARY
         assignment advances the client-visible ledger — the dedup rule
-        that makes failover exactly-once per token."""
+        that makes failover exactly-once per token. A cross-version
+        re-prefill (``asg.repin``) re-walks positions the ledger
+        already holds; those dedup BY POSITION instead of extending."""
         deliver = False
         with self._lock:
             req = asg.req
             if asg.cancelled or req.done:
                 return
             asg.tokens.append(int(t))
+            if lp is not None:
+                asg.lps.append(float(lp))
             asg.t_last = time.monotonic()
             if asg is req.primary:
-                req.emitted.append(int(t))
-                deliver = True
+                idx = (len(asg.prefix) - len(req.prompt)) + \
+                    len(asg.tokens) - 1
+                if idx == len(req.emitted):
+                    req.emitted.append(int(t))
+                    if req.want_lp:
+                        req.emitted_lp.append(
+                            0.0 if lp is None else float(lp))
+                    deliver = True
         if deliver:
             self._deliver_stream(req)
 
@@ -1426,9 +1549,16 @@ class ServingFleet:
                     if req.delivered >= len(req.emitted):
                         return
                     t = req.emitted[req.delivered]
+                    lp = None
+                    if req.want_lp and \
+                            req.delivered < len(req.emitted_lp):
+                        lp = req.emitted_lp[req.delivered]
                     req.delivered += 1
                 try:
-                    cb(int(t))
+                    if req.want_lp:
+                        cb(int(t), lp)
+                    else:
+                        cb(int(t))
                 except Exception:
                     pass
 
@@ -1439,8 +1569,12 @@ class ServingFleet:
         else:
             self._assignment_failed(asg, exc)
 
-    def _assignment_completed(self, asg: _Assignment, seq) -> None:
+    def _assignment_completed(self, asg: _Assignment, res) -> None:
         cancel_target: Optional[Tuple[Any, Future]] = None
+        if isinstance(res, tuple):  # (seq, behavior logprobs)
+            seq, seq_lp = res
+        else:
+            seq, seq_lp = res, None
         with self._lock:
             req = asg.req
             for h in self._handles:
@@ -1448,13 +1582,25 @@ class ServingFleet:
                     h.inflight.pop(req.id, None)
             if req.done or asg.cancelled:
                 return
+            gen_prefix = len(asg.prefix) - len(req.prompt)
             full_gen = list(asg.prefix[len(req.prompt):]) + \
                 [int(t) for t in seq[len(asg.prefix):]]
             if full_gen[:len(req.emitted)] != req.emitted:
-                # greedy determinism should make this impossible; trust
-                # the completed result over the partial stream
-                self._inc("stream_mismatch")
+                # greedy determinism makes this impossible WITHIN one
+                # weight version; a cross-version re-prefill (repin)
+                # may legitimately diverge — either way the completed
+                # result is authoritative over the partial stream
+                self._inc("version_restitch" if asg.repin
+                          else "stream_mismatch")
             req.emitted = full_gen
+            if req.want_lp:
+                # rebuild the logprob ledger the same way: ledger
+                # entries for the dispatch prefix + this assignment's
+                # logprobs for everything it generated
+                tail = [] if seq_lp is None else \
+                    [float(x) for x in seq_lp]
+                req.emitted_lp = \
+                    list(req.emitted_lp[:gen_prefix]) + tail
             other = req.hedge if asg is req.primary else req.primary
             if other is not None and other is not asg:
                 other.cancelled = True
@@ -1478,14 +1624,24 @@ class ServingFleet:
                 cancel_target[0].cancel(cancel_target[1])
             except Exception:
                 pass
-        result = np.asarray(list(req.prompt) + req.emitted,
-                            dtype=np.int64)
-        if not req.future.done():
-            req.future.set_result(result)
+        self._set_result(req)
         self.metrics.observe_latency(
             (time.monotonic() - req.t_submit) * 1e3)
         self.metrics.mark_done()
         self._inc("completed")
+
+    def _set_result(self, req: FleetRequest) -> None:
+        """Resolve the request future from the ledger (safe outside the
+        lock once ``req.done`` — the ledger no longer mutates)."""
+        if req.future.done():
+            return
+        result = np.asarray(list(req.prompt) + req.emitted,
+                            dtype=np.int64)
+        if req.want_lp:
+            req.future.set_result(
+                (result, np.asarray(req.emitted_lp, dtype=np.float32)))
+        else:
+            req.future.set_result(result)
 
     def _assignment_failed(self, asg: _Assignment, exc: Exception) -> None:
         with self._lock:
@@ -1565,20 +1721,18 @@ class ServingFleet:
                 # everything was already streamed; only the done frame
                 # was lost in the crash — complete from the ledger
                 self._finish_locked(req)
-                result = np.asarray(list(req.prompt) + req.emitted,
-                                    dtype=np.int64)
+                ledger_done = True
             else:
-                result = None
+                ledger_done = False
             exclude = {dead.replica} if dead is not None else set()
             if req.hedge is not None:
                 # the hedge keeps racing on its replica: the replayed
                 # primary must land elsewhere (one assignment per
                 # replica per request — the inflight map's key)
                 exclude.add(req.hedge.replica)
-        if result is not None:
+        if ledger_done:
             self._deliver_stream(req)  # any undelivered ledger tail
-            if not req.future.done():
-                req.future.set_result(result)
+            self._set_result(req)
             self._inc("completed")
             self._inc("replayed_complete")
             return
@@ -1612,8 +1766,26 @@ class ServingFleet:
             with self._lock:
                 if req.done:
                     return True
+                pin = req.weight_version if req.emitted else None
                 prefix = list(req.prompt) + list(req.emitted)
                 remaining = req.max_new - len(req.emitted)
+            repin = False
+            if pin is not None and pin >= 0:
+                # stitch-replay must be VERSION-PURE: resuming
+                # prompt+emitted onto a replica serving different
+                # weights would continue a v-N prefix under v-M — a
+                # sequence neither version produces. Prefer a same-
+                # version survivor; with none left, re-prefill from the
+                # prompt alone on the new version (position-deduped
+                # against the streamed ledger, counted below).
+                vers = [self._replica_version(c) for _h, c in cands]
+                same = [i for i, v in enumerate(vers) if v == pin]
+                if same:
+                    cands = [cands[i] for i in same]
+                else:
+                    repin = True
+                    prefix = list(req.prompt)
+                    remaining = req.max_new
             if remaining <= 0:
                 self._replay(req, None, count=False)
                 return True
@@ -1634,7 +1806,8 @@ class ServingFleet:
             progressed = False
             for i in order:
                 h, client = cands[i]
-                asg = _Assignment(req, h.name, prefix, hedge=hedge)
+                asg = _Assignment(req, h.name, prefix, hedge=hedge,
+                                  repin=repin)
                 with self._lock:
                     if req.done:
                         return True
@@ -1645,10 +1818,15 @@ class ServingFleet:
                         req.hedge = asg
                     else:
                         req.primary = asg
+                kw: Dict[str, Any] = {}
+                if req.want_lp:
+                    # only pass the kwarg when asked: the test seam's
+                    # engine-shaped stubs keep their narrow signature
+                    kw["return_logprobs"] = True
                 try:
                     fut = client.submit(
                         parr, remaining, deadline_ms=deadline_ms,
-                        on_token=partial(self._on_tok, asg))
+                        on_token=partial(self._on_tok, asg), **kw)
                 except Exception as e:
                     kind = classify_submit_error(e)
                     with self._lock:
@@ -1666,20 +1844,44 @@ class ServingFleet:
                     progressed = True
                     break
                 asg.fut = fut
+                wv = self._replica_version(client)  # probe-cached RPC:
+                # outside the lock (CC001)
                 with self._lock:
                     h.inflight[req.id] = asg
                     h.routed += 1
                     h.routed_since_ready += 1
+                    if not hedge and \
+                            (repin or len(prefix) == len(req.prompt)):
+                        # the emitted prefix (re)starts under THIS
+                        # replica's weights: (re)pin the version
+                        req.weight_version = wv
+                    if repin:
+                        self._inc("version_reprefill")
                 fut.add_done_callback(partial(self._asg_done_cb, asg))
                 return True
             if not progressed:
                 return False
 
+    @staticmethod
+    def _replica_version(client) -> int:
+        """Best-effort weight generation a replica serves: the probe-
+        cached RPC accessor on ReplicaClient, the plain attribute on an
+        in-process engine; -1 when unknowable."""
+        try:
+            wv = getattr(client, "weight_version", None)
+            if callable(wv):
+                wv = wv()
+            if wv is None:
+                return -1
+            return int(wv)
+        except Exception:
+            return -1
+
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                tenant: str = "default",
                deadline_ms: Optional[float] = None, priority: int = 1,
-               on_token=None) -> Future:
+               on_token=None, return_logprobs: bool = False) -> Future:
         """Route one prompt through the fleet. The future resolves to
         the full sequence (prompt + generated, np.int64) and SURVIVES
         replica failure: a fenced replica's in-flight work replays onto
@@ -1688,7 +1890,10 @@ class ServingFleet:
         ``priority`` feeds stage-3 brownout shedding: work below
         ``brownout_keep_priority`` (default 1) is sheddable — the
         default priority 1 opts OUT, so only explicitly low-priority
-        traffic is ever dropped."""
+        traffic is ever dropped. With ``return_logprobs=True`` the
+        future resolves to ``(full_seq, behavior_logprobs)`` (the
+        per-token logprob ledger, float32, replay-identical across
+        failover) and ``on_token`` receives ``(token, logprob)``."""
         prompt = np.asarray(prompt_ids).reshape(-1)
         if prompt.size == 0 or \
                 not np.issubdtype(prompt.dtype, np.integer):
@@ -1725,7 +1930,8 @@ class ServingFleet:
             req = FleetRequest(next(self._req_no),
                                [int(x) for x in prompt], clamped,
                                deadline_ms, tenant, priority,
-                               on_token=on_token)
+                               on_token=on_token,
+                               want_lp=return_logprobs)
             self._requests[req.id] = req
             self._inflight_total += 1
             self._tenant_inflight[tenant] = \
@@ -1841,6 +2047,105 @@ class ServingFleet:
             return {"stage": self._brownout,
                     "name": BROWNOUT_STAGES[self._brownout],
                     "history": list(self._brownout_hist)}
+
+    # -- weight distribution (post-training push path) ------------------------
+    def subscribe_weights(self, host: str, port: int,
+                          poll_interval: float = 0.25) -> None:
+        """Point every replica at a ``WeightPublisher`` endpoint: each
+        replica runs a subscriber that pulls new weight versions and
+        applies them in place via ``engine.swap_weights()`` — a push
+        costs seconds, not a respawn. The endpoint is remembered, so a
+        replica that restarts (crash respawn OR rolling restart) is
+        re-subscribed at re-admission."""
+        with self._lock:
+            self._weights_endpoint = (str(host), int(port),
+                                      float(poll_interval))
+            targets = [(h, h.client) for h in self._handles
+                       if h.state is ReplicaState.READY
+                       and h.client is not None]
+        for h, client in targets:
+            self._subscribe_one(h, client)
+
+    def _subscribe_one(self, h: _ReplicaHandle, client) -> None:
+        """Attach ONE replica to the remembered publisher endpoint
+        (no-op without one). Process replicas get the subscribe RPC;
+        in-process seam engines get a local subscriber thread."""
+        with self._lock:
+            ep = self._weights_endpoint
+        if ep is None or client is None:
+            return
+        host, port, poll = ep
+        try:
+            if h.external:
+                from ..post_training.weights import WeightSubscriber
+
+                sub = self._local_subs.get(h.name)
+                if sub is not None and sub.endpoint == (host, port) \
+                        and sub.alive():
+                    return
+                if sub is not None:
+                    sub.stop()
+                sub = WeightSubscriber(host, port, engine=client,
+                                       name=h.name, poll_interval=poll)
+                sub.start()
+                self._local_subs[h.name] = sub
+            elif hasattr(client, "subscribe_weights"):
+                client.subscribe_weights(host, port, poll_interval=poll)
+            else:
+                return
+            self._inc("weight_subscribes")
+        except Exception:
+            self._inc("weight_subscribe_errors")
+
+    def replica_weight_versions(self) -> Dict[str, int]:
+        """Live per-replica weight versions (one probe RPC per ready
+        replica) — the rollout loop's barrier: after a publish, wait
+        until every ready replica serves the new version before the
+        next round. -1 marks a replica whose version is unknown."""
+        with self._lock:
+            targets = [(h.name, h.client) for h in self._handles
+                       if h.state is ReplicaState.READY
+                       and h.client is not None]
+        out: Dict[str, int] = {}
+        for name, client in targets:
+            wv = getattr(client, "weight_version", None)
+            try:
+                out[name] = int(wv() if callable(wv) else wv)
+            except Exception:
+                out[name] = -1
+        return out
+
+    def push_weights(self, state, version: Optional[int] = None) -> Dict:
+        """Directly swap ``state`` into every ready replica via
+        ``engine.swap_weights()`` (the in-process seam / test path —
+        process fleets push through the publisher/subscriber stream
+        instead). Replicas whose engine cannot swap in place fall back
+        to ``rolling_restart()``: the slow path costs a respawn, the
+        builder re-creating the engine with current weights."""
+        with self._lock:
+            targets = [(h, h.client) for h in self._handles
+                       if h.state is ReplicaState.READY
+                       and h.client is not None]
+        swapped: List[Dict[str, Any]] = []
+        fallback = False
+        for h, client in targets:
+            fn = getattr(client, "swap_weights", None)
+            if fn is None:
+                fallback = True
+                continue
+            try:
+                ver = fn(state, version=version)
+                swapped.append({"replica": h.name, "version": int(ver)})
+            except NotImplementedError:
+                fallback = True
+            except Exception as e:
+                swapped.append({"replica": h.name,
+                                "error": str(e)[:200]})
+        self._inc("weight_pushes")
+        out: Dict[str, Any] = {"swapped": swapped, "fallback": fallback}
+        if fallback:
+            out["rolled"] = self.rolling_restart()
+        return out
 
     # -- rolling restart ------------------------------------------------------
     def rolling_restart(self, drain_timeout_s: Optional[float] = None,

@@ -38,12 +38,13 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from .base import (BadRequest, DeadlineExceeded, EngineBase, _oom_guard,
-                   _tracer)
+from .base import (BadRequest, DeadlineExceeded, EngineBase, EngineClosed,
+                   _oom_guard, _tracer)
 from .paged_kv import PagedKVPool, PoolExhausted, token_blocks
 from .speculative import greedy_accept
 
-__all__ = ["GenerationConfig", "GenerationEngine"]
+__all__ = ["GenerationConfig", "GenerationEngine", "flatten_gpt_params",
+           "nest_gpt_params"]
 
 _GEN_NO = itertools.count(1)
 
@@ -87,17 +88,20 @@ class GenerationConfig:
 class _GenRequest:
     __slots__ = ("prompt", "max_new_tokens", "future", "t_submit",
                  "generated", "trace", "t_decode0", "deadline",
-                 "blocks", "total_blocks", "on_token")
+                 "blocks", "total_blocks", "on_token", "logprobs",
+                 "want_logprobs")
 
     def __init__(self, prompt, max_new_tokens, future, t_submit,
-                 deadline=None, on_token=None):
+                 deadline=None, on_token=None, want_logprobs=False):
         self.prompt = prompt
         self.max_new_tokens = max_new_tokens
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline  # absolute monotonic seconds, or None
         self.on_token = on_token  # per-token stream callback, or None
+        self.want_logprobs = bool(want_logprobs)
         self.generated: List[int] = []
+        self.logprobs: List[float] = []  # behavior logprob per token
         self.trace = None      # request-scoped trace id
         self.t_decode0 = None  # decode-phase start (prefill done)
         # immutable paging facts, computed ONCE at submit (the admission
@@ -149,6 +153,35 @@ def _extract_gpt_params(model):
              "fc_out_w": a(L.fc_out.weight), "fc_out_b": a(L.fc_out.bias)}
             for L in g.layers],
     }
+
+
+def flatten_gpt_params(tree) -> Dict[str, Any]:
+    """Flatten the engine param pytree to ``{dotted_name: array}`` — the
+    wire shape the post-training weight service streams (stable names,
+    no nesting to re-derive on the far side)."""
+    flat = {"embed": tree["embed"], "pos": tree["pos"],
+            "lnf_w": tree["lnf_w"], "lnf_b": tree["lnf_b"]}
+    for i, L in enumerate(tree["layers"]):
+        for k, v in L.items():
+            flat[f"layers.{i}.{k}"] = v
+    return flat
+
+
+def nest_gpt_params(flat) -> Dict[str, Any]:
+    """Inverse of :func:`flatten_gpt_params`."""
+    tree: Dict[str, Any] = {"layers": []}
+    layers: Dict[int, Dict[str, Any]] = {}
+    for name, v in flat.items():
+        if name.startswith("layers."):
+            _, idx, key = name.split(".", 2)
+            layers.setdefault(int(idx), {})[key] = v
+        else:
+            tree[name] = v
+    for i in sorted(layers):
+        if i != len(tree["layers"]):
+            raise ValueError(f"non-contiguous layer index {i}")
+        tree["layers"].append(layers[i])
+    return tree
 
 
 def _build_decode_step(cfg, max_slots: int, max_len: int, donate: bool,
@@ -299,7 +332,13 @@ def _build_window_step(cfg, max_slots: int, n_blocks: int, page_len: int,
         xf = ln(x, params["lnf_w"], params["lnf_b"])
         logits = xf @ params["embed"].T                        # [S, W, vocab]
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return nxt, new_k, new_v
+        # behavior logprob of the greedy pick at every window position —
+        # the post-training ledger rides it (f32: bf16 logits renormalize
+        # poorly and these numbers cross processes)
+        lf = logits.astype(jnp.float32)
+        logp = (jnp.max(lf, axis=-1) -
+                jax.scipy.special.logsumexp(lf, axis=-1))      # [S, W] f32
+        return nxt, logp, new_k, new_v
 
     from ..jit import persistent_cache
 
@@ -416,6 +455,10 @@ class GenerationEngine(EngineBase):
                     donate_argnums=(0,) if donate else (), label=ilabel))
 
         self._slots = [_Slot(B) for _ in range(S)]
+        # in-place weight push (post-training): a pending swap applies at
+        # the first ZERO-ACTIVE step boundary — admission pauses while it
+        # pends so in-flight requests finish on the version they started
+        self._pending_swap = None  # (params_tree, version, Future) or None
         # memory truth: the page pool's K/V bytes (plus the draft model's
         # slot arena) ride in the `memory` provider — the fixed device
         # buffers continuous batching holds
@@ -482,7 +525,7 @@ class GenerationEngine(EngineBase):
             [b for b in self.config.prefill_buckets]
         for W in sorted(set(sizes)):
             tokens = jnp.zeros((S, W), jnp.int32)
-            _n, self._pool.k, self._pool.v = self._window(W)(
+            _n, _lp, self._pool.k, self._pool.v = self._window(W)(
                 self._params, self._pool.k, self._pool.v, tables, tokens,
                 lengths)
         if self.spec_k:
@@ -509,7 +552,7 @@ class GenerationEngine(EngineBase):
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               on_token=None) -> "Future":
+               on_token=None, return_logprobs: bool = False) -> "Future":
         """Queue one prompt (1-D int array). The future resolves to the
         full sequence (prompt + generated) as a 1-D np.int64 array. A
         ``deadline_ms`` bounds QUEUE time: expired requests are shed with
@@ -517,7 +560,15 @@ class GenerationEngine(EngineBase):
         slots earliest-deadline-first. ``on_token(t)`` (optional) fires
         once per emitted token IN ORDER, before the future resolves — the
         streaming seam the fleet RPC uses for replay/dedup bookkeeping;
-        callbacks run on the engine worker thread and must be cheap."""
+        callbacks run on the engine worker thread and must be cheap.
+
+        ``return_logprobs=True`` makes the future resolve to ``(full_seq,
+        logprobs)`` — a float32 array, one behavior logprob per GENERATED
+        token (the greedy pick's log-softmax under the weights that
+        emitted it) — and calls ``on_token(t, lp)`` with two arguments.
+        This is the post-training trajectory ledger: a replayed-after-
+        failover request re-derives the same logprobs because greedy
+        decoding re-walks the same tokens under the same weights."""
         self.metrics.inc("requests_total")
         fut: Future = Future()
         prompt = np.asarray(prompt_ids)
@@ -561,7 +612,8 @@ class GenerationEngine(EngineBase):
         deadline = None if deadline_ms is None \
             else t_submit + deadline_ms / 1000.0
         req = _GenRequest(prompt.astype(np.int64), int(max_new_tokens), fut,
-                          t_submit, deadline, on_token=on_token)
+                          t_submit, deadline, on_token=on_token,
+                          want_logprobs=return_logprobs)
         req.blocks = token_blocks(req.prompt, self._pl)
         req.total_blocks = needed
         tr = _tracer()
@@ -594,6 +646,91 @@ class GenerationEngine(EngineBase):
 
     def speculative_enabled(self) -> bool:
         return bool(self.spec_k) and self._spec_on
+
+    # -- in-place weight push (post-training fast path) -----------------------
+    def _coerce_swap_state(self, state) -> Dict[str, Any]:
+        """Validate an incoming weight set against the live tree and land
+        it device-ready. Accepts a ``GPTForCausalLM``, the nested param
+        pytree, or the flat ``{dotted_name: array}`` wire shape."""
+        import jax.numpy as jnp
+
+        if hasattr(state, "gpt"):
+            state = _extract_gpt_params(state)
+        if "layers" not in state:
+            state = nest_gpt_params(dict(state))
+
+        def conv(old, new, path):
+            if new is None:
+                raise ValueError(f"swap_weights: missing param {path!r}")
+            arr = jnp.asarray(np.asarray(new), dtype=old.dtype)
+            if arr.shape != old.shape:
+                raise ValueError(
+                    f"swap_weights: {path!r} shape {arr.shape} != live "
+                    f"shape {old.shape}")
+            return arr
+
+        if len(state.get("layers", ())) != len(self._params["layers"]):
+            raise ValueError(
+                f"swap_weights: {len(state.get('layers', ()))} layers != "
+                f"live {len(self._params['layers'])}")
+        new = {k: conv(v, state.get(k), k)
+               for k, v in self._params.items() if k != "layers"}
+        new["layers"] = [
+            {k: conv(v, state["layers"][i].get(k), f"layers.{i}.{k}")
+             for k, v in L.items()}
+            for i, L in enumerate(self._params["layers"])]
+        return new
+
+    def swap_weights(self, state, version: Optional[int] = None,
+                     timeout: Optional[float] = None) -> int:
+        """Replace the TARGET model's served weights in place — the
+        weight-push fast path (seconds, not a respawn). The swap is
+        staged and applied by the worker at the first step boundary with
+        zero active slots: admission pauses while it pends, so every
+        in-flight request finishes bit-identically on the weight version
+        it started on, and the first request admitted afterwards runs
+        the new version. The prefix cache is dropped at the boundary
+        (old-version KV pages are garbage under new weights). The draft
+        model keeps its weights — it only PROPOSES; the swapped target
+        verifies every token, so output correctness is version-pure
+        (only acceptance rate can drift). Returns the new
+        ``weight_version`` once applied."""
+        params = self._coerce_swap_state(state)
+        fut: Future = Future()
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine closed")
+            if self._pending_swap is not None:
+                raise RuntimeError("a weight swap is already pending")
+            ver = int(version) if version is not None \
+                else self.weight_version + 1
+            self._pending_swap = (params, ver, fut)
+            self._cond.notify_all()
+            started = self._thread is not None
+        if not started:
+            self._apply_swap()  # no worker: nothing in flight to drain
+        return fut.result(timeout=120.0 if timeout is None else timeout)
+
+    def _apply_swap(self) -> None:
+        """Land the staged weights (worker thread at a zero-active
+        boundary, or inline when no worker runs)."""
+        with self._cond:
+            pend, self._pending_swap = self._pending_swap, None
+        if pend is None:
+            return
+        params, ver, fut = pend
+        try:
+            self._params = params
+            trie = self._pool.trie
+            if trie is not None:  # cached prefixes are old-version KV
+                trie.release_all(self._pool.allocator)
+            self.weight_version = ver
+            self.metrics.inc("weight_swaps")
+            if not fut.done():
+                fut.set_result(ver)
+        except Exception as e:  # pragma: no cover - validation ran already
+            if not fut.done():
+                fut.set_exception(e)
 
     # -- router probes --------------------------------------------------------
     def kv_headroom(self) -> float:
@@ -656,9 +793,14 @@ class GenerationEngine(EngineBase):
 
     def _worker(self):
         while True:
+            # a staged weight swap lands at the first zero-active step
+            # boundary (admission pauses below until it does, so the
+            # active set drains and in-flight work stays version-pure)
+            if self._pending_swap is not None and not self._active():
+                self._apply_swap()
             # admit queued prompts into free slots (join mid-flight,
             # earliest deadline first, bounded by KV page headroom)
-            while True:
+            while self._pending_swap is None:
                 free = next((i for i, s in enumerate(self._slots)
                              if s.req is None), None)
                 if free is None:
@@ -687,6 +829,10 @@ class GenerationEngine(EngineBase):
             if not active:
                 with self._cond:
                     if self._closed and not self._queue:
+                        pend, self._pending_swap = self._pending_swap, None
+                        if pend is not None and not pend[2].done():
+                            pend[2].set_exception(
+                                EngineClosed("engine closed"))
                         return
                     if not self._queue:
                         # untimed: submit/close notify — no idle polling
@@ -765,11 +911,12 @@ class GenerationEngine(EngineBase):
         tables[slot_no] = s.table
         with _oom_guard("generation", label=f"serving:{self.name}:prefill",
                         engine=self.name, bucket=W):
-            nxt, self._pool.k, self._pool.v = self._window(W)(
+            nxt, lp, self._pool.k, self._pool.v = self._window(W)(
                 self._params, self._pool.k, self._pool.v,
                 jnp.asarray(tables), jnp.asarray(tokens),
                 jnp.asarray(lengths))
         first = int(np.asarray(nxt)[slot_no, len(suffix) - 1])
+        first_lp = float(np.asarray(lp)[slot_no, len(suffix) - 1])
         # draft model prefills the WHOLE prompt through its own forward
         # (the draft is small; its dense slot arena has no prefix cache)
         if self.spec_k:
@@ -798,16 +945,21 @@ class GenerationEngine(EngineBase):
         s.length = p
         s.last_token = first
         s.t0 = t1  # slot residency opens (occupancy track)
-        self._note_token(req, first)
+        self._note_token(req, first, first_lp)
         self._emit_finish_check(slot_no)
 
-    def _note_token(self, req: _GenRequest, t: int) -> None:
-        """One emitted token: record it and fire the stream callback (a
-        client callback must never sink the decode batch)."""
+    def _note_token(self, req: _GenRequest, t: int, lp: float) -> None:
+        """One emitted token: record it (token + behavior logprob) and
+        fire the stream callback (a client callback must never sink the
+        decode batch)."""
         req.generated.append(int(t))
+        req.logprobs.append(float(lp))
         if req.on_token is not None:
             try:
-                req.on_token(int(t))
+                if req.want_logprobs:
+                    req.on_token(int(t), float(lp))
+                else:
+                    req.on_token(int(t))
             except Exception:
                 pass
 
@@ -876,11 +1028,12 @@ class GenerationEngine(EngineBase):
                     cur = nd
             with _oom_guard("generation", label=f"serving:{self.name}:decode",
                             engine=self.name, step=self._decode_no):
-                nxt, self._pool.k, self._pool.v = self._window(W)(
+                nxt, lp, self._pool.k, self._pool.v = self._window(W)(
                     self._params, self._pool.k, self._pool.v,
                     jnp.asarray(tables), jnp.asarray(tokens),
                     jnp.asarray(lengths))
         n = np.asarray(nxt)  # [S, W] target argmax at each window position
+        lpn = np.asarray(lp)  # [S, W] its behavior logprob (f32)
         fr = self._flight()
         if fr is not None:  # decode steps land in the flight ring
             fr.record_serving_step(self.name, "decode",
@@ -906,10 +1059,13 @@ class GenerationEngine(EngineBase):
                     self._fam_spec.inc((self.name, "accepted"), adv - 1)
             else:
                 emit = [int(n[i, 0])]
-            for t in emit:
+            # every emitted token e IS the target argmax at window
+            # position e (greedy_accept admits a draft token only when it
+            # equals n[i, e]), so lpn[i, e] is its behavior logprob
+            for e, t in enumerate(emit):
                 s.length += 1
                 s.last_token = t
-                self._note_token(s.req, t)
+                self._note_token(s.req, t, lpn[i, e])
                 emitted_total += 1
                 if self._emit_finish_check(i):
                     break
@@ -934,7 +1090,11 @@ class GenerationEngine(EngineBase):
         full = np.concatenate([req.prompt,
                                np.asarray(req.generated, dtype=np.int64)])
         if not req.future.done():
-            req.future.set_result(full)
+            if req.want_logprobs:
+                req.future.set_result(
+                    (full, np.asarray(req.logprobs, dtype=np.float32)))
+            else:
+                req.future.set_result(full)
         now = time.monotonic()
         self.metrics.observe_latency((now - req.t_submit) * 1e3)
         self.metrics.inc("responses_total")

@@ -239,6 +239,24 @@ class TestHardenedHeartbeat:
         finally:
             store.close()
 
+    def test_wait_per_call_timeout_override(self):
+        """wait(keys, timeout=...) expires on its own deadline and leaves the
+        connection re-armed with the store-level timeout (the post-training
+        drill polls round keys this way while checking trainer liveness)."""
+        from paddle_tpu.distributed.store import TCPStore
+
+        store = TCPStore(is_master=True, world_size=1, timeout=900)
+        try:
+            t0 = time.time()
+            with pytest.raises(TimeoutError, match="1s"):
+                store.wait(["never-set"], timeout=1)
+            assert time.time() - t0 < 10, "per-call timeout was ignored"
+            store.set("present", b"1")
+            store.wait(["present"], timeout=1)  # satisfied wait, no raise
+            assert store.get("present") == b"1"  # connection still healthy
+        finally:
+            store.close()
+
 
 # ---------------------------------------------------------------------------
 # sync_peers barrier diagnostics (satellite 2)

@@ -354,6 +354,22 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_concurrency_lint.py \
 PT_LOCKDEP=1 python tools/resilience_drill.py || exit 1
 JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/serving_fleet_drill.py || exit 1
 
+echo "== post-training gate (ISSUE-17: rollout -> reward -> train -> publish) =="
+# the weight-distribution service (roundtrip bit-equality, per-chunk +
+# whole-blob digest rejection, mid-transfer crash -> resumed transfer,
+# backpressure, engine apply), behavior-logprob streams (crash-mid-
+# stream parity), version-pinned replay (no cross-version stitch),
+# buffer/reward/trainer units — then the REAL 3-process RL drill:
+# 2 serving replicas + 1 elastic_fit trainer streaming weight versions;
+# reward improves on the pattern task, r1 crashes mid-rollout with zero
+# lost/duplicated tokens, the final push lands under load and every
+# in-flight request finishes bit-identically on a single version; the
+# lockdep-armed re-run must stay cycle-free
+JAX_PLATFORMS=cpu python -m pytest tests/test_post_training.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/rl_drill.py || exit 1
+JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/rl_drill.py || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
