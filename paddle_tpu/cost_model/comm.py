@@ -31,7 +31,9 @@ from typing import Any, Dict, Optional
 __all__ = ["LinkModel", "LINK_TABLES", "link_model_for", "ring_factor",
            "reduce_scatter_factor", "all_to_all_factor",
            "all_gather_factor", "calibrate_from_counters",
-           "save_calibration", "load_calibration", "calibration_path"]
+           "save_calibration", "load_calibration", "calibration_path",
+           "kv_ship_seconds", "kv_reprefill_seconds",
+           "kv_migration_crossover"]
 
 
 @dataclass(frozen=True)
@@ -132,6 +134,64 @@ def all_gather_factor(n: int) -> float:
     """Ring all-gather (ZeRO param materialization): each rank receives
     (n-1)/n of the payload."""
     return (n - 1) / n if n > 1 else 0.0
+
+
+# -- KV page migration (disaggregated prefill/decode serving) -----------------
+# Prices the ship-pages-vs-re-prefill decision: moving a prompt's paged
+# KV across replicas costs bytes on the replica-to-replica link (the
+# host link on a CPU fleet, DCN/ICI on a real one) plus a fixed RPC
+# round-trip charge; recomputing it costs the prompt's prefill FLOPs.
+# The crossover prompt length is where shipping starts winning — the
+# bench's measured ratio validates the same quantities end-to-end.
+
+def kv_ship_seconds(lm: LinkModel, wire_bytes: float,
+                    rpc_overhead_s: float = 2e-3) -> float:
+    """Wall-clock to ship ``wire_bytes`` of packed KV pages between two
+    replicas: bytes over the inter-replica link plus a per-transfer
+    RPC/staging charge (export head + chunk round trips + install
+    commit)."""
+    return float(wire_bytes) / lm.host_bytes_per_s + \
+        float(rpc_overhead_s)
+
+
+def kv_reprefill_seconds(lm: LinkModel, prompt_tokens: int,
+                         flops_per_token: float) -> float:
+    """Wall-clock to RECOMPUTE a prompt's KV on the target replica: the
+    prefill FLOPs at the link model's effective peak, plus one
+    executable dispatch."""
+    return (float(prompt_tokens) * float(flops_per_token)
+            ) / lm.peak_flops + lm.dispatch_s
+
+
+def kv_migration_crossover(lm: LinkModel, page_len: int,
+                           bytes_per_page: float,
+                           flops_per_token: float,
+                           quantized: bool = False,
+                           max_pages: int = 4096) -> Dict[str, Any]:
+    """The planner's migration policy input: for each prompt size find
+    whether shipping the pages beats re-prefilling, and the crossover
+    page count (smallest page count where ship wins; None when
+    re-prefill always wins inside ``max_pages``). ``quantized`` halves
+    the transit bytes (int8 per-page scales are noise next to the
+    payload)."""
+    scale = 0.5 if quantized else 1.0
+    crossover = None
+    for n in range(1, int(max_pages) + 1):
+        ship = kv_ship_seconds(lm, n * bytes_per_page * scale)
+        pre = kv_reprefill_seconds(lm, n * page_len, flops_per_token)
+        if ship < pre:
+            crossover = n
+            break
+    sample = crossover or int(max_pages)
+    return {
+        "crossover_pages": crossover,
+        "ship_s": kv_ship_seconds(
+            lm, sample * bytes_per_page * scale),
+        "reprefill_s": kv_reprefill_seconds(
+            lm, sample * page_len, flops_per_token),
+        "quantized": bool(quantized),
+        "bytes_per_page": float(bytes_per_page) * scale,
+    }
 
 
 _COLLECTIVE_OP_MARKERS = ("all-reduce", "all-gather", "all-to-all",

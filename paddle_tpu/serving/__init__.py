@@ -45,9 +45,14 @@ from .fleet import (  # noqa: F401
     BrownoutShed, ReplicaClient, ServingFleet, ServingFleetPolicy,
 )
 from .generation import GenerationConfig, GenerationEngine  # noqa: F401
+from .kv_transfer import (  # noqa: F401
+    FleetKVCache, KVMigrationStats, pack_kv_pages, prompt_cache_key,
+    unpack_kv_pages,
+)
 from .metrics import LatencyWindow, MetricsRegistry  # noqa: F401
 from .paged_kv import (  # noqa: F401
-    PageAllocator, PagedKVPool, PoolExhausted, PrefixCache, token_blocks,
+    HostPagePool, PageAllocator, PagedKVPool, PoolExhausted, PrefixCache,
+    token_blocks,
 )
 from .router import ReplicaRouter, RouterConfig, TenantQuotaExceeded  # noqa: F401
 from .speculative import greedy_accept, rejection_sample  # noqa: F401
@@ -59,7 +64,9 @@ __all__ = [
     "ServingFleet", "ServingFleetPolicy", "ReplicaClient", "BrownoutShed",
     "ReplicaFault", "RequestCancelled",
     "PageAllocator", "PrefixCache", "PagedKVPool", "PoolExhausted",
-    "token_blocks", "greedy_accept", "rejection_sample",
+    "HostPagePool", "token_blocks", "greedy_accept", "rejection_sample",
+    "FleetKVCache", "KVMigrationStats", "pack_kv_pages",
+    "unpack_kv_pages", "prompt_cache_key",
     "MetricsRegistry", "LatencyWindow",
     "QueueFull", "DeadlineExceeded", "EngineClosed", "BadRequest",
 ]

@@ -31,7 +31,16 @@ backoff restart) to an Orca/vLLM-style continuous-batching tier:
   non-interactive deadline classes, (3) shed the lowest-priority work;
 - **rolling restarts**: ``rolling_restart()`` drains one replica at a
   time (fence-new-work -> finish in-flight -> restart -> warm ->
-  re-admit) for zero-downtime config/weight rollouts.
+  re-admit) for zero-downtime config/weight rollouts;
+- **disaggregated prefill/decode** (``pools=``): a prefill replica
+  runs exactly one token (filling paged KV for the prompt), the fleet
+  ships the pages to a decode replica over the same frame protocol
+  (chunked, SHA-256-verified, optionally int8-quantized in transit —
+  ``serving/kv_transfer.py``), installs them into its ``PagedKVPool``
+  and continues the stream bit-identically; failover gains a
+  ship-pages fast path (``failover_ship`` vs ``failover_reprefill``),
+  and a supervisor-side ``FleetKVCache`` keeps warm payloads for
+  repeat prompts.
 
 Chaos drill: ``tools/serving_fleet_drill.py`` (CI-gated). Deterministic
 fault kinds (``replica_crash@name&seq``, ``replica_hang@name&seq``,
@@ -62,6 +71,8 @@ import numpy as np
 
 from .base import (BadRequest, DeadlineExceeded, EngineClosed, QueueFull,
                    ReplicaFault, RequestCancelled)
+from .kv_transfer import (FleetKVCache, KVMigrationStats,
+                          prompt_cache_key)
 from .metrics import MetricsRegistry
 from .router import RouterConfig, classify_submit_error, score_candidates
 
@@ -289,6 +300,13 @@ class _ReplicaServer:
         self._shutdown = False
         self._store_failures = 0
         self._subscriber = None              # weight-service subscriber
+        # KV page-migration staging (disaggregated prefill/decode):
+        # export handles -> chunk lists, install handles -> partial
+        # uploads. Both bounded FIFO — an abandoned transfer can never
+        # pin memory.
+        self._kv_handle = 0
+        self._kv_out: Dict[int, List[Dict[str, Any]]] = {}
+        self._kv_in: Dict[int, Dict[str, Any]] = {}
 
     # -- outbound (called from engine worker threads) -------------------------
     def _post(self, conn, frame: Dict[str, Any]) -> None:
@@ -446,6 +464,16 @@ class _ReplicaServer:
                 self._post(conn, {"rid": rid, "event": "error",
                                   "kind": type(e).__name__,
                                   "msg": str(e)[:300]})
+        elif op == "kv_export":
+            self._kv_export(conn, rid, msg)
+        elif op == "kv_chunk":
+            self._kv_chunk(conn, rid, msg)
+        elif op == "kv_install_begin":
+            self._kv_install_begin(conn, rid, msg)
+        elif op == "kv_install_chunk":
+            self._kv_install_chunk(conn, rid, msg)
+        elif op == "kv_install_commit":
+            self._kv_install_commit(conn, rid, msg)
         elif op == "drain":
             self.engine.fence()
             self._post(conn, {"rid": rid, "event": "reply",
@@ -571,6 +599,110 @@ class _ReplicaServer:
             except Exception:
                 reply["match"] = 0
         return reply
+
+    # -- kv page migration (disaggregated prefill/decode) ---------------------
+    # The worker round trip blocks the event loop; that is bounded by
+    # the engine worker's op drain (one step), far inside the heartbeat
+    # grace window — pages for one prompt are small next to weights.
+    def _kv_export(self, conn, rid, msg) -> None:
+        from .kv_transfer import chunk_blob, pack_kv_pages  # lazy
+
+        try:
+            npages, k_st, v_st = self.engine.export_kv_pages(
+                np.asarray(msg["prompt"], dtype=np.int64))
+            blob, manifest, meta = pack_kv_pages(
+                k_st, v_st, quantize=bool(msg.get("quantize")))
+            chunks = chunk_blob(blob,
+                                int(msg.get("chunk_bytes", 1 << 20)))
+        except Exception as e:
+            self._post(conn, {"rid": rid, "event": "error",
+                              "kind": type(e).__name__,
+                              "msg": str(e)[:300]})
+            return
+        self._kv_handle += 1
+        handle = self._kv_handle
+        self._kv_out[handle] = chunks
+        while len(self._kv_out) > 8:     # bounded staging, oldest out
+            self._kv_out.pop(min(self._kv_out))
+        reply = {"rid": rid, "event": "reply", "handle": handle,
+                 "nchunks": len(chunks), "manifest": manifest}
+        reply.update(meta)
+        self._post(conn, reply)
+
+    def _kv_chunk(self, conn, rid, msg) -> None:
+        chunks = self._kv_out.get(msg.get("handle"))
+        idx = int(msg.get("idx", -1))
+        if chunks is None or not 0 <= idx < len(chunks):
+            self._post(conn, {"rid": rid, "event": "error",
+                              "kind": "KeyError",
+                              "msg": f"kv export handle/chunk "
+                                     f"{msg.get('handle')}/{idx}"})
+            return
+        ch = dict(chunks[idx])
+        ch.update(rid=rid, event="reply")
+        self._post(conn, ch)
+
+    def _kv_install_begin(self, conn, rid, msg) -> None:
+        self._kv_handle += 1
+        handle = self._kv_handle
+        self._kv_in[handle] = {
+            "prompt": [int(x) for x in msg["prompt"]],
+            "manifest": msg["manifest"], "digest": msg.get("digest"),
+            "nchunks": int(msg["nchunks"]), "chunks": {}}
+        while len(self._kv_in) > 8:
+            self._kv_in.pop(min(self._kv_in))
+        self._post(conn, {"rid": rid, "event": "reply",
+                          "handle": handle})
+
+    def _kv_install_chunk(self, conn, rid, msg) -> None:
+        import base64
+        import hashlib
+
+        st = self._kv_in.get(msg.get("handle"))
+        if st is None:
+            self._post(conn, {"rid": rid, "event": "error",
+                              "kind": "KeyError",
+                              "msg": "unknown kv install handle"})
+            return
+        idx = int(msg["idx"])
+        raw = base64.b64decode(msg["data"])
+        if hashlib.sha256(raw).hexdigest() != msg.get("sha"):
+            # reject NOW: the shipper resends just this chunk
+            self._post(conn, {"rid": rid, "event": "error",
+                              "kind": "ValueError",
+                              "msg": f"kv chunk {idx} digest mismatch"})
+            return
+        st["chunks"][idx] = {"idx": idx, "data": msg["data"],
+                             "sha": msg["sha"]}
+        self._post(conn, {"rid": rid, "event": "reply", "ok": True,
+                          "have": len(st["chunks"])})
+
+    def _kv_install_commit(self, conn, rid, msg) -> None:
+        from .kv_transfer import assemble_chunks, unpack_kv_pages
+
+        st = self._kv_in.pop(msg.get("handle"), None)
+        t0 = time.monotonic()
+        try:
+            if st is None:
+                raise KeyError("unknown kv install handle")
+            if len(st["chunks"]) != st["nchunks"]:
+                raise ValueError(
+                    f"kv install incomplete: {len(st['chunks'])}/"
+                    f"{st['nchunks']} chunks")
+            blob = assemble_chunks(
+                [st["chunks"][i] for i in range(st["nchunks"])],
+                digest=st.get("digest"))
+            k_st, v_st = unpack_kv_pages(blob, st["manifest"])
+            installed = self.engine.install_kv_pages(
+                np.asarray(st["prompt"], dtype=np.int64), k_st, v_st)
+        except Exception as e:
+            self._post(conn, {"rid": rid, "event": "error",
+                              "kind": type(e).__name__,
+                              "msg": str(e)[:300]})
+            return
+        self._post(conn, {"rid": rid, "event": "reply",
+                          "installed": int(installed),
+                          "ms": round((time.monotonic() - t0) * 1e3, 3)})
 
     def _start_subscriber(self, msg: Dict[str, Any]) -> None:
         """Attach this replica to a WeightPublisher (post_training
@@ -885,6 +1017,70 @@ class ReplicaClient:
     def set_spec(self, enabled: bool) -> None:
         self._rpc("config", spec_decode=bool(enabled), timeout=5)
 
+    # -- kv page migration ----------------------------------------------------
+    def kv_export(self, prompt_ids, quantize: bool = False,
+                  chunk_bytes: int = 1 << 20) -> Dict[str, Any]:
+        """Pull the packed KV pages backing ``prompt_ids`` from this
+        replica's prefix cache: a head RPC stages the blob replica-side,
+        then each chunk is pulled and digest-verified (one resend per
+        bad chunk — the PR-17 weight-transfer shape). Returns the
+        payload dict ``kv_install`` accepts."""
+        import base64
+        import hashlib
+
+        prompt = [int(x) for x in np.asarray(prompt_ids).reshape(-1)]
+        head = self._rpc("kv_export", prompt=prompt,
+                         quantize=bool(quantize),
+                         chunk_bytes=int(chunk_bytes))
+        parts: List[bytes] = []
+        for i in range(int(head["nchunks"])):
+            raw = None
+            for _attempt in range(2):
+                ch = self._rpc("kv_chunk", handle=head["handle"], idx=i)
+                got = base64.b64decode(ch["data"])
+                if hashlib.sha256(got).hexdigest() == ch.get("sha"):
+                    raw = got
+                    break
+            if raw is None:
+                raise ReplicaFault(
+                    f"replica {self.name} kv chunk {i} digest mismatch")
+            parts.append(raw)
+        blob = b"".join(parts)
+        if hashlib.sha256(blob).hexdigest() != head["digest"]:
+            raise ReplicaFault(
+                f"replica {self.name} kv blob digest mismatch")
+        return {"prompt": prompt, "manifest": head["manifest"],
+                "digest": head["digest"], "data": blob,
+                "npages": int(head["npages"]),
+                "wire_bytes": int(head["wire_bytes"]),
+                "fp32_bytes": int(head["fp32_bytes"]),
+                "quantized": bool(head["quantized"])}
+
+    def kv_install(self, payload: Dict[str, Any],
+                   chunk_bytes: int = 1 << 20) -> Dict[str, Any]:
+        """Ship a ``kv_export`` payload into this replica's paged pool
+        (begin -> digest-verified chunks, one resend each -> commit:
+        the replica assembles, dequantizes if needed, writes the pages
+        and adopts them into its prefix trie). Returns
+        ``{"installed": npages, "ms": install_ms}``."""
+        from .kv_transfer import chunk_blob  # lazy
+
+        chunks = chunk_blob(payload["data"], int(chunk_bytes))
+        head = self._rpc("kv_install_begin", prompt=payload["prompt"],
+                         manifest=payload["manifest"],
+                         digest=payload["digest"], nchunks=len(chunks))
+        for ch in chunks:
+            for attempt in range(2):
+                try:
+                    self._rpc("kv_install_chunk",
+                              handle=head["handle"], **ch)
+                    break
+                except ReplicaFault:
+                    if attempt or not self._alive:
+                        raise
+        return self._rpc("kv_install_commit", handle=head["handle"],
+                         timeout=60)
+
     def drain(self) -> None:
         self._rpc("drain", timeout=5)
 
@@ -918,11 +1114,12 @@ class _Assignment:
     client at dispatch time) — the dedup baseline."""
 
     __slots__ = ("req", "replica", "prefix", "tokens", "lps", "fut",
-                 "t_dispatch", "t_last", "hedge", "cancelled", "repin")
+                 "t_dispatch", "t_last", "hedge", "cancelled", "repin",
+                 "stage")
 
     def __init__(self, req: "FleetRequest", replica: str,
                  prefix: List[int], hedge: bool = False,
-                 repin: bool = False):
+                 repin: bool = False, stage: str = "decode"):
         self.req = req
         self.replica = replica
         self.prefix = prefix
@@ -937,6 +1134,10 @@ class _Assignment:
         # existed, so this assignment restarts from the prompt alone
         # and is deduped against the ledger BY POSITION
         self.repin = repin
+        # "prefill" marks a pool-split first leg: the assignment stops
+        # after ONE token (the prompt's paged KV is now hot on this
+        # replica) and hands the request to the migration queue
+        self.stage = stage
 
 
 class FleetRequest:
@@ -944,7 +1145,7 @@ class FleetRequest:
                  "tenant", "priority", "future", "emitted", "on_token",
                  "primary", "hedge", "replays", "t_submit", "done",
                  "stream_lock", "delivered", "want_lp", "emitted_lp",
-                 "weight_version")
+                 "weight_version", "kv_payload")
 
     def __init__(self, rid: int, prompt: List[int], max_new: int,
                  deadline_ms: Optional[float], tenant: str, priority: int,
@@ -965,6 +1166,9 @@ class FleetRequest:
         # weight generation the emitted prefix was produced under (the
         # replay version pin): None until first dispatch, -1 = unknown
         self.weight_version: Optional[int] = None
+        # the shipped KV payload (pool mode): retained so failover can
+        # re-install pages on a survivor instead of re-prefilling
+        self.kv_payload: Optional[Dict[str, Any]] = None
         self.on_token = on_token
         self.primary: Optional[_Assignment] = None
         self.hedge: Optional[_Assignment] = None
@@ -985,11 +1189,12 @@ class _ReplicaHandle:
     __slots__ = ("idx", "name", "state", "proc", "client", "incarnation",
                  "restart_at", "count_restart", "t_launch", "inflight",
                  "routed", "routed_since_ready", "log_path", "external",
-                 "fence_rec")
+                 "fence_rec", "pool")
 
     def __init__(self, idx: int, name: str, external=None):
         self.idx = idx
         self.name = name
+        self.pool: Optional[str] = None   # "prefill"/"decode"/None
         self.state = ReplicaState.LAUNCHING
         self.proc: Optional[subprocess.Popen] = None
         self.client = external   # ReplicaClient, or the in-process engine
@@ -1038,7 +1243,11 @@ class ServingFleet:
                  extra_env: Optional[Dict[str, str]] = None,
                  eos_token_id: Optional[int] = None,
                  replicas: Optional[Sequence[Any]] = None,
-                 name: str = "serving_fleet"):
+                 name: str = "serving_fleet",
+                 pools: Optional[Dict[str, Sequence[str]]] = None,
+                 kv_transit: str = "fp32",
+                 kv_cache_bytes: int = 256 << 20,
+                 min_ship_tokens: int = 8):
         from ..distributed.fleet.runtime import FleetStateMachine
 
         if replicas is None and not builder:
@@ -1064,6 +1273,34 @@ class ServingFleet:
             self._handles = [_ReplicaHandle(i, n)
                              for i, n in enumerate(names)]
         self._external = replicas is not None
+        # disaggregated prefill/decode: pools maps pool name ->
+        # replica names; unlisted replicas belong to no pool and serve
+        # only as the empty-pool fallback
+        if kv_transit not in ("fp32", "int8"):
+            raise ValueError("kv_transit must be 'fp32' or 'int8'")
+        self.kv_transit = kv_transit
+        self.min_ship_tokens = int(min_ship_tokens)
+        self._pools_enabled = bool(pools)
+        if pools:
+            by_name = {h.name: h for h in self._handles}
+            assigned: Dict[str, str] = {}
+            for pool_name, members in pools.items():
+                if pool_name not in ("prefill", "decode"):
+                    raise ValueError(f"unknown pool {pool_name!r} "
+                                     "(expected 'prefill'/'decode')")
+                for m in members:
+                    if m not in by_name:
+                        raise ValueError(f"pool {pool_name!r} names "
+                                         f"unknown replica {m!r}")
+                    if m in assigned:
+                        raise ValueError(
+                            f"replica {m!r} is in two pools")
+                    assigned[m] = pool_name
+                    by_name[m].pool = pool_name
+        self._kv_stats = KVMigrationStats()
+        self._kv_cache = FleetKVCache(
+            capacity_bytes=int(kv_cache_bytes))
+        self._migrations: deque = deque()  # (req, prefill replica name)
         self.sm = FleetStateMachine(len(self._handles),
                                     self.policy.fleet_policy(),
                                     now=time.time())
@@ -1096,8 +1333,22 @@ class ServingFleet:
             from ..observability import register_provider
 
             register_provider("serving_fleet", self.provider_snapshot)
+            register_provider("kv_migration", self.kv_migration_snapshot)
         except Exception:
             pass
+
+    def kv_migration_snapshot(self) -> Dict[str, Any]:
+        """The page-migration view: pages/bytes shipped, transit-
+        quantized fraction, install latency, the failover ship-vs-
+        reprefill split, and the fleet-wide warm cache."""
+        snap = self._kv_stats.snapshot()
+        snap["transit"] = self.kv_transit
+        snap["warm_cache"] = self._kv_cache.stats()
+        with self._lock:
+            snap["pools"] = {h.name: h.pool for h in self._handles
+                             if h.pool is not None}
+            snap["pending_migrations"] = len(self._migrations)
+        return snap
 
     def _inc(self, counter: str, n: int = 1) -> None:
         self._counters[counter] = self._counters.get(counter, 0) + n
@@ -1122,6 +1373,7 @@ class ServingFleet:
                 reps[h.name] = {
                     "state": h.state.value,
                     "incarnation": h.incarnation,
+                    "pool": h.pool,
                     "inflight": len(h.inflight),
                     "routed": h.routed,
                     "routed_since_ready": h.routed_since_ready,
@@ -1213,6 +1465,7 @@ class ServingFleet:
             live = list(self._requests.values())
             self._requests.clear()
             self._unplaced.clear()
+            self._migrations.clear()
         for th in (self._monitor, self._dispatcher):
             if th is not None:
                 th.join(timeout=5)
@@ -1360,6 +1613,7 @@ class ServingFleet:
             try:
                 self._check_hedges()
                 self._eval_brownout(time.time())
+                self._drain_migrations()
                 self._drain_unplaced()
             except Exception:
                 pass
@@ -1601,24 +1855,42 @@ class ServingFleet:
                     [float(x) for x in seq_lp]
                 req.emitted_lp = \
                     list(req.emitted_lp[:gen_prefix]) + tail
-            other = req.hedge if asg is req.primary else req.primary
-            if other is not None and other is not asg:
-                other.cancelled = True
-                owner = self._handle_by_name(other.replica)
-                if owner is not None:
-                    owner.inflight.pop(req.id, None)
-                if other.fut is not None and owner is not None and \
-                        owner.client is not None and \
-                        hasattr(owner.client, "cancel"):
-                    cancel_target = (owner.client, other.fut)
-                self._inc("hedge_cancelled")
-            if asg.hedge:
-                self._inc("hedge_wins")
-            self._finish_locked(req)
+            handoff = False
+            if asg.stage == "prefill":
+                work_left = len(req.emitted) < req.max_new and not (
+                    self.eos_token_id is not None and req.emitted and
+                    req.emitted[-1] == self.eos_token_id)
+                if work_left:
+                    # the prefill leg is done — the prompt's paged KV
+                    # is hot on this replica. Hand the request to the
+                    # migration queue (ship pages -> decode pool)
+                    # instead of finishing it; the dispatcher thread
+                    # owns the blocking transfer RPCs.
+                    handoff = True
+                    req.primary = None
+                    self._migrations.append((req, asg.replica))
+            if not handoff:
+                other = req.hedge if asg is req.primary else req.primary
+                if other is not None and other is not asg:
+                    other.cancelled = True
+                    owner = self._handle_by_name(other.replica)
+                    if owner is not None:
+                        owner.inflight.pop(req.id, None)
+                    if other.fut is not None and owner is not None and \
+                            owner.client is not None and \
+                            hasattr(owner.client, "cancel"):
+                        cancel_target = (owner.client, other.fut)
+                    self._inc("hedge_cancelled")
+                if asg.hedge:
+                    self._inc("hedge_wins")
+                self._finish_locked(req)
         # undelivered tail (a hedge win bulk-delivers it) goes through
         # the ordered per-request delivery path, BEFORE the future
         # resolves
         self._deliver_stream(req)
+        if handoff:
+            self._inc("prefill_handoffs")
+            return
         if cancel_target is not None:
             try:
                 cancel_target[0].cancel(cancel_target[1])
@@ -1736,31 +2008,190 @@ class ServingFleet:
             self._inc("completed")
             self._inc("replayed_complete")
             return
-        if not self._dispatch(req, exclude=exclude):
+        prefer = self._ship_failover(req, exclude) if count else None
+        if prefer is not None:
+            ok = self._dispatch(req, exclude=exclude, pool="decode",
+                                prefer=prefer)
+        else:
+            ok = self._place(req, exclude=exclude)
+        if not ok:
+            with self._lock:
+                if not req.done:
+                    self._unplaced.append(req)
+
+    def _ship_failover(self, req: FleetRequest,
+                       exclude=()) -> Optional[str]:
+        """The stitch-replay fast path: when the request still holds a
+        shipped KV payload, install it on a survivor BEFORE the replay
+        dispatch — the survivor's prefix cache absorbs the prompt pages
+        and the replay re-prefills only the emitted suffix (bytes
+        instead of recompute). Returns the preferred survivor name, or
+        None (classic re-prefill)."""
+        with self._lock:
+            payload = req.kv_payload
+        if payload is None:
+            self._kv_stats.note_failover(ship=False)
+            self._inc("failover_reprefill")
+            return None
+        pool = "decode" if self._pools_enabled else None
+        for h, client in self._candidates(exclude=exclude, pool=pool):
+            try:
+                rep = self._kv_push(client, payload)
+            except Exception:
+                continue
+            self._kv_stats.note_failover(ship=True)
+            self._kv_stats.note_ship(
+                payload["npages"], payload["wire_bytes"],
+                payload["fp32_bytes"], payload["quantized"])
+            self._kv_stats.note_install(float(rep.get("ms", 0.0)))
+            self._inc("failover_ship")
+            return h.name
+        self._kv_stats.note_failover(ship=False)
+        self._inc("failover_reprefill")
+        return None
+
+    # -- kv page migration (the prefill -> decode handoff) --------------------
+    def _drain_migrations(self) -> None:
+        while True:
+            with self._lock:
+                if not self._migrations:
+                    return
+                req, src = self._migrations.popleft()
+                if req.done:
+                    continue
+            self._migrate_and_continue(req, src)
+
+    def _kv_pull(self, client, prompt: List[int],
+                 quantize: bool) -> Dict[str, Any]:
+        """Export the packed pages for ``prompt`` from a replica: the
+        chunked RPC on process replicas, a direct pack through the
+        in-process seam."""
+        if hasattr(client, "kv_export"):
+            return client.kv_export(prompt, quantize=quantize)
+        from .kv_transfer import pack_kv_pages  # lazy
+
+        _n, k_st, v_st = client.export_kv_pages(
+            np.asarray(prompt, dtype=np.int64))
+        blob, manifest, meta = pack_kv_pages(k_st, v_st,
+                                             quantize=quantize)
+        return {"prompt": [int(x) for x in prompt],
+                "manifest": manifest, "digest": meta["digest"],
+                "data": blob, "npages": int(meta["npages"]),
+                "wire_bytes": int(meta["wire_bytes"]),
+                "fp32_bytes": int(meta["fp32_bytes"]),
+                "quantized": bool(meta["quantized"])}
+
+    def _kv_push(self, client, payload: Dict[str, Any]) -> Dict[str, Any]:
+        if hasattr(client, "kv_install"):
+            return client.kv_install(payload)
+        from .kv_transfer import unpack_kv_pages  # lazy
+
+        t0 = time.monotonic()
+        k_st, v_st = unpack_kv_pages(payload["data"],
+                                     payload["manifest"])
+        installed = client.install_kv_pages(
+            np.asarray(payload["prompt"], dtype=np.int64), k_st, v_st)
+        return {"installed": int(installed),
+                "ms": round((time.monotonic() - t0) * 1e3, 3)}
+
+    def _migrate_and_continue(self, req: FleetRequest, src: str) -> None:
+        """Move a prefilled request onto the decode pool: pull the
+        packed pages from the prefill replica (or the fleet warm
+        cache), install them on the best decode replica, then dispatch
+        the decode leg preferring that replica. EVERY failure mode
+        falls back to plain dispatch — the decode replica re-prefills
+        ``prompt + first token`` and the stream stays bit-identical,
+        just slower."""
+        quantize = self.kv_transit == "int8"
+        key = prompt_cache_key(req.prompt, 1)  # whole-prompt identity
+        payload = self._kv_cache.get(key) if key is not None else None
+        if payload is not None:
+            self._kv_stats.note_warm_hit()
+        else:
+            with self._lock:
+                h = self._handle_by_name(src)
+                client = h.client if h is not None and \
+                    h.state is ReplicaState.READY else None
+            if client is not None:
+                try:
+                    payload = self._kv_pull(
+                        client, list(req.prompt), quantize)
+                    self._kv_stats.note_export()
+                    if key is not None:
+                        self._kv_cache.put(key, payload)
+                except Exception:
+                    payload = None
+        prefer = None
+        if payload is not None:
+            pool = "decode" if self._pools_enabled else None
+            cands = self._candidates(exclude={src}, pool=pool)
+            parr = np.asarray(req.prompt, dtype=np.int64)
+            try:
+                scores, _m = score_candidates(
+                    self.router_config, parr,
+                    [c for _h, c in cands], pool=pool)
+                order = sorted(range(len(cands)),
+                               key=scores.__getitem__)
+            except Exception:
+                order = list(range(len(cands)))
+            for i in order:
+                h, client = cands[i]
+                try:
+                    rep = self._kv_push(client, payload)
+                except Exception:
+                    continue
+                prefer = h.name
+                self._kv_stats.note_ship(
+                    payload["npages"], payload["wire_bytes"],
+                    payload["fp32_bytes"], payload["quantized"])
+                self._kv_stats.note_install(float(rep.get("ms", 0.0)))
+                with self._lock:
+                    req.kv_payload = payload
+                self._inc("migrations")
+                break
+        if prefer is None:
+            self._kv_stats.note_fallback()
+            self._inc("migrate_fallback")
+        if not self._dispatch(
+                req, pool="decode" if self._pools_enabled else None,
+                prefer=prefer):
             with self._lock:
                 if not req.done:
                     self._unplaced.append(req)
 
     # -- dispatch -------------------------------------------------------------
-    def _candidates(self, exclude=()) -> List[Tuple[_ReplicaHandle, Any]]:
+    def _candidates(self, exclude=(), pool: Optional[str] = None
+                    ) -> List[Tuple[_ReplicaHandle, Any]]:
         """(handle, client) pairs captured atomically — a concurrent
         fence nulls ``h.client``, so the submit below must use the
         reference taken HERE (a submit on a just-fenced client fails
-        with the fault shape and the loop moves on)."""
+        with the fault shape and the loop moves on). With ``pool`` set
+        (split fleets) only that pool's replicas qualify; an EMPTY pool
+        falls back to every ready replica (counted) — a dead prefill
+        tier degrades to the classic fused path, not unavailability."""
         with self._lock:
-            return [(h, h.client) for h in self._handles
-                    if h.state is ReplicaState.READY
-                    and h.client is not None and h.name not in exclude]
+            ready = [(h, h.client) for h in self._handles
+                     if h.state is ReplicaState.READY
+                     and h.client is not None and h.name not in exclude]
+            if pool is not None and self._pools_enabled:
+                pooled = [(h, c) for h, c in ready if h.pool == pool]
+                if pooled:
+                    return pooled
+                if ready:
+                    self._inc("pool_fallback")
+            return ready
 
     def _dispatch(self, req: FleetRequest, exclude=(),
-                  hedge: bool = False) -> bool:
+                  hedge: bool = False, pool: Optional[str] = None,
+                  cap_new: Optional[int] = None, stage: str = "decode",
+                  prefer: Optional[str] = None) -> bool:
         """Place one request (or its hedge) on the best ready replica —
         the router's load/affinity scoring over live probes, plus the
         fence-and-retry loop with classified errors. Returns False when
         no replica could take it (caller queues it)."""
         tried: set = set(exclude)
         while True:
-            cands = self._candidates(exclude=tried)
+            cands = self._candidates(exclude=tried, pool=pool)
             if not cands:
                 return False
             with self._lock:
@@ -1786,6 +2217,10 @@ class ServingFleet:
                     repin = True
                     prefix = list(req.prompt)
                     remaining = req.max_new
+            if cap_new is not None:
+                # the prefill leg: emit exactly one token — the point
+                # is the paged KV it leaves behind, not the stream
+                remaining = min(remaining, int(cap_new))
             if remaining <= 0:
                 self._replay(req, None, count=False)
                 return True
@@ -1799,15 +2234,21 @@ class ServingFleet:
             parr = np.asarray(prefix, dtype=np.int64)
             try:
                 scores, _m = score_candidates(
-                    self.router_config, parr, [c for _h, c in cands])
+                    self.router_config, parr, [c for _h, c in cands],
+                    pool=pool)
             except Exception:
                 scores = [float(i) for i in range(len(cands))]
             order = sorted(range(len(cands)), key=scores.__getitem__)
+            if prefer is not None:
+                # the migration path already installed this request's
+                # pages on `prefer`: try it first, scores after
+                pi = [i for i in order if cands[i][0].name == prefer]
+                order = pi + [i for i in order if i not in pi]
             progressed = False
             for i in order:
                 h, client = cands[i]
                 asg = _Assignment(req, h.name, prefix, hedge=hedge,
-                                  repin=repin)
+                                  repin=repin, stage=stage)
                 with self._lock:
                     if req.done:
                         return True
@@ -1877,6 +2318,28 @@ class ServingFleet:
         except Exception:
             return -1
 
+    def _place(self, req: FleetRequest, exclude=()) -> bool:
+        """Route one request through the pool topology: a fresh request
+        starts on the prefill pool, capped to ONE token (the leg that
+        fills paged KV), then migrates to the decode pool; anything
+        with streamed progress, short prompts not worth a ship, and
+        unsplit fleets go straight to the decode path."""
+        if self._pools_enabled and not req.emitted and not req.done \
+                and req.max_new > 1 \
+                and len(req.prompt) >= self.min_ship_tokens:
+            with self._lock:
+                has_prefill = any(
+                    h.pool == "prefill"
+                    and h.state is ReplicaState.READY
+                    and h.name not in exclude for h in self._handles)
+            if has_prefill:
+                return self._dispatch(req, exclude=exclude,
+                                      pool="prefill", cap_new=1,
+                                      stage="prefill")
+        return self._dispatch(
+            req, exclude=exclude,
+            pool="decode" if self._pools_enabled else None)
+
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                tenant: str = "default",
@@ -1937,7 +2400,7 @@ class ServingFleet:
             self._tenant_inflight[tenant] = \
                 self._tenant_inflight.get(tenant, 0) + 1
             self._inc("requests")
-        if not self._dispatch(req):
+        if not self._place(req):
             with self._lock:
                 if not req.done:
                     self._unplaced.append(req)
@@ -1958,7 +2421,7 @@ class ServingFleet:
                 self._fail_request(req, DeadlineExceeded(
                     "deadline expired while awaiting a replica"))
                 continue
-            if not self._dispatch(req):
+            if not self._place(req):
                 with self._lock:
                     if not req.done:
                         self._unplaced.appendleft(req)
@@ -1978,6 +2441,7 @@ class ServingFleet:
             due = [r for r in self._requests.values()
                    if not r.done and r.hedge is None
                    and r.primary is not None and r.primary.fut is not None
+                   and r.primary.stage != "prefill"
                    and (now - r.primary.t_last) * 1e3 >= hedge_ms]
         for req in due:
             with self._lock:
@@ -1985,7 +2449,9 @@ class ServingFleet:
                         req.primary is None:
                     continue
                 exclude = {req.primary.replica}
-            if self._dispatch(req, exclude=exclude, hedge=True):
+            if self._dispatch(req, exclude=exclude, hedge=True,
+                              pool="decode" if self._pools_enabled
+                              else None):
                 with self._lock:
                     if req.hedge is not None:
                         self._inc("hedges")

@@ -40,7 +40,8 @@ import numpy as np
 
 from .base import (BadRequest, DeadlineExceeded, EngineBase, EngineClosed,
                    _oom_guard, _tracer)
-from .paged_kv import PagedKVPool, PoolExhausted, token_blocks
+from .paged_kv import (HostPagePool, PagedKVPool, PoolExhausted,
+                       token_blocks)
 from .speculative import greedy_accept
 
 __all__ = ["GenerationConfig", "GenerationEngine", "flatten_gpt_params",
@@ -69,7 +70,8 @@ class GenerationConfig:
                  max_queue: int = 256, eos_token_id: Optional[int] = None,
                  donate_cache: bool = True, page_len: int = 16,
                  num_pages: Optional[int] = None, prefix_cache: bool = True,
-                 draft_model=None, spec_tokens: int = 4):
+                 draft_model=None, spec_tokens: int = 4,
+                 warm_pool_bytes: int = 0, warm_admit_threshold: int = 2):
         self.max_slots = int(max_slots)
         self.max_seq_len = max_seq_len  # None: model max_position_embeddings
         self.prefill_buckets = tuple(sorted({int(b)
@@ -83,6 +85,11 @@ class GenerationConfig:
         self.prefix_cache = bool(prefix_cache)
         self.draft_model = draft_model       # GPTForCausalLM or None
         self.spec_tokens = int(spec_tokens)  # draft proposals per round
+        # warm tier: evicted prefix pages spill (int8) to host RAM and
+        # restore instead of re-prefilling. 0 = off (the default keeps
+        # the device tier bit-exact; int8 restores are approximate KV)
+        self.warm_pool_bytes = int(warm_pool_bytes)
+        self.warm_admit_threshold = int(warm_admit_threshold)
 
 
 class _GenRequest:
@@ -402,9 +409,19 @@ class GenerationEngine(EngineBase):
         if num_pages is None:
             # every slot's worst case + two cached prefixes' worth + scratch
             num_pages = S * B + 2 * B + 1
+        warm = None
+        if self.config.warm_pool_bytes and self.config.prefix_cache:
+            warm = HostPagePool(
+                capacity_bytes=self.config.warm_pool_bytes,
+                admit_threshold=self.config.warm_admit_threshold)
         self._pool = PagedKVPool(mcfg.num_hidden_layers, num_pages, pl,
                                  nh, hd, dtype,
-                                 prefix_cache=self.config.prefix_cache)
+                                 prefix_cache=self.config.prefix_cache,
+                                 warm_pool=warm)
+        # cross-thread ops the worker must execute (the allocator and
+        # the arenas are worker-owned): (fn, Future) pairs — the KV
+        # export/install seam the page shipper rides
+        self._ops: deque = deque()
 
         import jax
 
@@ -486,6 +503,19 @@ class GenerationEngine(EngineBase):
         self._t_start = time.monotonic()
         self.metrics.gauge("slot_occupancy", self.slot_occupancy)
         self.metrics.gauge("kv_headroom", self.kv_headroom)
+        # prefix-cache truth (hits/misses/evictions) rides the snapshot
+        # so pd_top / render_snapshot show the warm-tier tuning baseline
+        self.metrics.gauge("prefix_cache", self._prefix_cache_stats)
+
+    def _prefix_cache_stats(self) -> Dict[str, Any]:
+        trie = self._pool.trie
+        if trie is None:
+            return {}
+        st = trie.stats()
+        st["misses"] = st["lookups"] - st["hits"]
+        if self._pool.warm is not None:
+            st["warm"] = self._pool.warm.stats()
+        return st
 
     # -- executables ----------------------------------------------------------
     def _window(self, W: int):
@@ -752,6 +782,109 @@ class GenerationEngine(EngineBase):
                                   limit=(len(prompt) - 1) // self._pl)
         return trie.match_len(blocks) * self._pl
 
+    # -- KV page transfer (disaggregated prefill/decode) ----------------------
+    def _run_on_worker(self, fn, timeout: float = 60.0):
+        """Run ``fn()`` on the engine worker thread and return its result
+        — the allocator and the K/V arenas are worker-owned, so export/
+        install must serialize with decode at a step boundary. Runs
+        inline when no worker thread exists yet."""
+        with self._cond:
+            if self._closed:
+                raise EngineClosed("engine closed")
+            started = self._thread is not None
+            if started:
+                fut: Future = Future()
+                self._ops.append((fn, fut))
+                self._cond.notify_all()
+        if not started:
+            return fn()
+        return fut.result(timeout=timeout)
+
+    def _drain_ops(self) -> None:
+        """Execute queued cross-thread ops (worker thread, step boundary)."""
+        while True:
+            with self._cond:
+                if not self._ops:
+                    return
+                fn, fut = self._ops.popleft()
+            try:
+                res = fn()
+            except Exception as e:
+                if not fut.done():
+                    fut.set_exception(e)
+            else:
+                if not fut.done():
+                    fut.set_result(res)
+
+    def export_kv_pages(self, prompt_ids):
+        """Read the cached KV of ``prompt_ids``' full prompt blocks out of
+        the page pool as host arrays — the page shipper's source side.
+        Returns ``(n_pages, k_stacks, v_stacks)`` with per-layer
+        ``[n, page_len, heads, dim]`` stacks. Raises ``KeyError`` when the
+        prompt's blocks are not all cached (caller falls back to
+        re-prefill)."""
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        blocks = token_blocks(prompt, self._pl)
+
+        def _export():
+            trie = self._pool.trie
+            if trie is None:
+                raise KeyError("prefix cache disabled: nothing to export")
+            if not blocks:
+                return 0, [], []
+            pages = trie.match(blocks, self._pl, self._pool.allocator)
+            try:
+                if len(pages) < len(blocks):
+                    raise KeyError(
+                        f"only {len(pages)}/{len(blocks)} prompt blocks "
+                        f"cached — cannot export")
+                k_stacks, v_stacks = self._pool.read_pages(pages)
+                return len(pages), k_stacks, v_stacks
+            finally:
+                for pg in pages:
+                    self._pool.allocator.release(pg)
+
+        out = self._run_on_worker(_export)
+        self.metrics.inc("kv_exports")
+        return out
+
+    def install_kv_pages(self, prompt_ids, k_stacks, v_stacks) -> int:
+        """Install shipped page CONTENTS for ``prompt_ids``' full prompt
+        blocks: allocate pages, scatter-write the K/V, and adopt the
+        chain into the prefix cache — the page shipper's sink side. The
+        next submit sharing this prompt prefix reuses the pages instead
+        of prefilling. Returns pages newly adopted (blocks already
+        cached keep their pages — first writer wins)."""
+        prompt = np.asarray(prompt_ids).reshape(-1)
+        blocks = token_blocks(prompt, self._pl)
+        n = len(blocks)
+        got = int(k_stacks[0].shape[0]) if k_stacks else 0
+        if got != n:
+            raise BadRequest(
+                f"{got} shipped pages != {n} full prompt blocks")
+
+        def _install():
+            trie = self._pool.trie
+            if trie is None:
+                raise BadRequest("prefix cache disabled: cannot install")
+            if n == 0:
+                return 0
+            pages = self._pool.allocate(n)
+            try:
+                self._pool.write_pages(pages, k_stacks, v_stacks)
+                adopted = trie.insert(blocks, pages, self._pool.allocator)
+            finally:
+                # the trie holds its own refs on adopted pages; ours drop
+                # (unadopted duplicates free harmlessly here)
+                for pg in pages:
+                    self._pool.allocator.release(pg)
+            return adopted
+
+        out = self._run_on_worker(_install)
+        self.metrics.inc("kv_installs")
+        self.metrics.inc("kv_pages_installed", out)
+        return out
+
     # -- the continuous-batching loop -----------------------------------------
     def _active(self) -> List[int]:
         return [i for i, s in enumerate(self._slots) if s.req is not None]
@@ -793,6 +926,10 @@ class GenerationEngine(EngineBase):
 
     def _worker(self):
         while True:
+            # cross-thread ops (KV export/install) land at the step
+            # boundary, before admission — an installed prefix is
+            # visible to the very next admit
+            self._drain_ops()
             # a staged weight swap lands at the first zero-active step
             # boundary (admission pauses below until it does, so the
             # active set drains and in-flight work stays version-pure)
@@ -833,9 +970,14 @@ class GenerationEngine(EngineBase):
                         if pend is not None and not pend[2].done():
                             pend[2].set_exception(
                                 EngineClosed("engine closed"))
+                        while self._ops:
+                            _fn, fut = self._ops.popleft()
+                            if not fut.done():
+                                fut.set_exception(
+                                    EngineClosed("engine closed"))
                         return
-                    if not self._queue:
-                        # untimed: submit/close notify — no idle polling
+                    if not self._queue and not self._ops:
+                        # untimed: submit/close/op notify — no idle polling
                         self._cond.wait()
                 continue
             try:
@@ -875,6 +1017,11 @@ class GenerationEngine(EngineBase):
         trie = self._pool.trie
         all_blocks = req.blocks
         if trie is not None:
+            if self._pool.warm is not None:
+                # warm tier: restore spilled pages for this chain before
+                # matching, so a previously-evicted prefix costs a host
+                # dequantize instead of a re-prefill
+                self._pool.warm_restore(all_blocks[: (p - 1) // pl])
             shared_pages = trie.match(all_blocks[: (p - 1) // pl], pl,
                                       self._pool.allocator)
         m = len(shared_pages)

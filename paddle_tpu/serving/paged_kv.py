@@ -34,7 +34,7 @@ import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 __all__ = ["PoolExhausted", "PageAllocator", "PrefixCache", "PagedKVPool",
-           "token_blocks"]
+           "HostPagePool", "token_blocks"]
 
 
 class PoolExhausted(RuntimeError):
@@ -100,7 +100,10 @@ class PageAllocator:
         if n > len(self._free):
             raise PoolExhausted(
                 f"need {n} pages, {len(self._free)} free "
-                f"of {self.usable_pages}")
+                f"({self.live_pages} live) of {self.usable_pages} usable "
+                f"[pool={self.num_pages} incl. scratch, "
+                f"alloc_total={self.alloc_total}, "
+                f"free_total={self.free_total}]")
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._ref[p] = 1
@@ -190,6 +193,15 @@ class PrefixCache:
     def _key(parent, block) -> Tuple:
         return (parent, block)
 
+    @staticmethod
+    def chain_key(blocks: Sequence[Tuple[int, ...]]):
+        """The trie key of chain ``blocks`` (deterministic — computable
+        without trie state, so warm-tier keys survive eviction)."""
+        parent = None
+        for block in blocks:
+            parent = (parent, block)
+        return parent
+
     # -- reads ----------------------------------------------------------------
     def match(self, blocks: Sequence[Tuple[int, ...]], page_len: int,
               allocator: Optional[PageAllocator] = None) -> List[int]:
@@ -258,9 +270,14 @@ class PrefixCache:
                 parent = key
         return adopted
 
-    def evict(self, n_pages: int, allocator: PageAllocator) -> int:
+    def evict(self, n_pages: int, allocator: PageAllocator,
+              on_evict=None) -> int:
         """Free up to ``n_pages`` pages by dropping LRU leaves whose page
-        has no holder besides the trie (ref == 1). Returns pages freed."""
+        has no holder besides the trie (ref == 1). Returns pages freed.
+
+        ``on_evict(key, page)`` — if given — is called for each victim
+        BEFORE its page is released, while the page contents are still
+        valid: the warm-tier spill hook."""
         freed = 0
         with self._lock:
             while freed < n_pages:
@@ -274,6 +291,8 @@ class PrefixCache:
                         victim = node
                 if victim is None:
                     break
+                if on_evict is not None:
+                    on_evict(victim.key, victim.page)
                 del self._nodes[victim.key]
                 if victim.parent is not None:
                     self._nodes[victim.parent].children -= 1
@@ -303,17 +322,124 @@ class PrefixCache:
                                       max(self.lookup_tokens, 1), 4)}
 
 
+class HostPagePool:
+    """Replica-local warm tier: evicted prefix-cache pages spill here.
+
+    Page contents live in host RAM, int8-quantized with per-page scales
+    (~4x cheaper than device-resident fp32).  Admission is frequency
+    gated — a chain key must be *seen* ``admit_threshold`` times before
+    its bytes are kept (the PR-14 ``HotRowCache`` ghost-counter pattern)
+    — and residency is LRU under a byte budget.  Keys are deterministic
+    trie chain keys (``PrefixCache.chain_key``) so a warm page can be
+    restored into a fresh trie after eviction.
+    """
+
+    def __init__(self, capacity_bytes: int = 64 << 20,
+                 admit_threshold: int = 2, ghost_cap: int = 2048):
+        from collections import OrderedDict
+
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self.capacity_bytes = int(capacity_bytes)
+        self.admit_threshold = int(admit_threshold)
+        self.ghost_cap = int(ghost_cap)
+        self._entries = OrderedDict()   # key -> (k_q, k_s, v_q, v_s, nbytes)
+        self._bytes = 0
+        self._ghost: Dict[Any, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.admits = 0
+        self.rejects = 0
+        self.evictions = 0
+        self.restores = 0
+        self._lock = _named_lock("serving.HostPagePool._lock")
+
+    def note_access(self, key) -> None:
+        with self._lock:
+            self._ghost[key] = self._ghost.get(key, 0) + 1
+            if len(self._ghost) > self.ghost_cap:
+                self._ghost = {k: v // 2 for k, v in self._ghost.items()
+                               if v // 2 > 0}
+
+    def put(self, key, k_layers, v_layers) -> bool:
+        """Spill one page (per-layer ``[page_len, heads, dim]`` arrays)."""
+        import numpy as np
+
+        from .kv_transfer import quantize_page
+
+        with self._lock:
+            seen = self._ghost.get(key, 0)
+        if key is None or seen < self.admit_threshold:
+            with self._lock:
+                self.rejects += 1
+            return False
+        k_q, k_s, v_q, v_s = [], [], [], []
+        nbytes = 0
+        for arr in k_layers:
+            q, s = quantize_page(np.asarray(arr))
+            k_q.append(q); k_s.append(s); nbytes += q.nbytes
+        for arr in v_layers:
+            q, s = quantize_page(np.asarray(arr))
+            v_q.append(q); v_s.append(s); nbytes += q.nbytes
+        with self._lock:
+            if key in self._entries:
+                self._entries.move_to_end(key)
+                return True
+            if nbytes > self.capacity_bytes:
+                self.rejects += 1
+                return False
+            while self._bytes + nbytes > self.capacity_bytes and self._entries:
+                _, old = self._entries.popitem(last=False)
+                self._bytes -= old[4]
+                self.evictions += 1
+            self._entries[key] = (k_q, k_s, v_q, v_s, nbytes)
+            self._bytes += nbytes
+            self.admits += 1
+            return True
+
+    def get(self, key, dtype=None):
+        """Dequantized ``(k_layers, v_layers)`` for ``key``, or None."""
+        from .kv_transfer import dequantize_page
+
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                self.misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self.hits += 1
+            k_q, k_s, v_q, v_s, _ = ent
+        import numpy as np
+
+        dt = dtype or np.float32
+        return ([dequantize_page(q, s, dt) for q, s in zip(k_q, k_s)],
+                [dequantize_page(q, s, dt) for q, s in zip(v_q, v_s)])
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            total = self.hits + self.misses
+            return {"entries": len(self._entries), "bytes": self._bytes,
+                    "capacity_bytes": self.capacity_bytes,
+                    "hits": self.hits, "misses": self.misses,
+                    "hit_rate": round(self.hits / total, 4) if total else 0.0,
+                    "admits": self.admits, "rejects": self.rejects,
+                    "evictions": self.evictions, "restores": self.restores}
+
+
 class PagedKVPool:
     """The device half: per-layer K/V page arenas + the control plane.
 
     ``allocate(n)`` serves from the free list, evicting LRU prefix-cache
     entries when short — so a hot serving process naturally trades cold
-    cached prefixes for live requests.
+    cached prefixes for live requests. With a ``warm_pool``, evicted
+    prefix pages spill (int8) to host RAM and can be restored by
+    ``warm_restore`` instead of re-prefilling.
     """
 
     def __init__(self, num_layers: int, num_pages: int, page_len: int,
                  num_heads: int, head_dim: int, dtype,
-                 prefix_cache: bool = True):
+                 prefix_cache: bool = True,
+                 warm_pool: Optional[HostPagePool] = None):
         import jax.numpy as jnp
 
         self.page_len = int(page_len)
@@ -321,6 +447,7 @@ class PagedKVPool:
         self.allocator = PageAllocator(num_pages)
         self.trie: Optional[PrefixCache] = PrefixCache() if prefix_cache \
             else None
+        self.warm = warm_pool
         self.k = [jnp.zeros((num_pages, page_len, num_heads, head_dim),
                             dtype) for _ in range(num_layers)]
         self.v = [jnp.zeros((num_pages, page_len, num_heads, head_dim),
@@ -331,8 +458,60 @@ class PagedKVPool:
         """n pages, evicting cached prefixes if the free list is short."""
         short = n - self.allocator.free_pages
         if short > 0 and self.trie is not None:
-            self.trie.evict(short, self.allocator)
+            self.trie.evict(short, self.allocator,
+                            on_evict=self._spill if self.warm is not None
+                            else None)
         return self.allocator.alloc(n)
+
+    def _spill(self, key, page: int) -> None:
+        """Warm-tier spill hook: page contents -> host RAM (int8)."""
+        import numpy as np
+
+        self.warm.note_access(key)
+        k_layers = [np.asarray(a[page]) for a in self.k]
+        v_layers = [np.asarray(a[page]) for a in self.v]
+        self.warm.put(key, k_layers, v_layers)
+
+    def warm_restore(self, blocks: Sequence[Tuple[int, ...]]) -> int:
+        """Extend the trie's cached chain for ``blocks`` from the warm
+        tier: for each block past the device-resident match depth with a
+        warm hit, allocate a page, dequantize-write its contents, and
+        adopt it into the trie. Returns pages restored."""
+        if self.trie is None or self.warm is None or not blocks:
+            return 0
+        import numpy as np
+
+        depth = self.trie.match_len(blocks)
+        # note accesses for the whole tail so repeat traffic becomes
+        # admittable even before anything is ever spilled
+        for j in range(depth, len(blocks)):
+            self.warm.note_access(PrefixCache.chain_key(blocks[:j + 1]))
+        if depth >= len(blocks):
+            return 0
+        chain_pages = self.trie.match(blocks[:depth], self.page_len)
+        restored = 0
+        for j in range(depth, len(blocks)):
+            key = PrefixCache.chain_key(blocks[:j + 1])
+            ent = self.warm.get(key, dtype=self.k[0].dtype)
+            if ent is None:
+                break
+            try:
+                page = self.allocate(1)[0]
+            except PoolExhausted:
+                break
+            k_layers, v_layers = ent
+            self.write_pages([page],
+                             [kl[np.newaxis] for kl in k_layers],
+                             [vl[np.newaxis] for vl in v_layers])
+            chain_pages.append(page)
+            adopted = self.trie.insert(blocks[:j + 1], chain_pages,
+                                       self.allocator)
+            self.allocator.release(page)  # trie owns it now
+            if not adopted:
+                break  # raced: an identical chain landed first
+            self.warm.restores += 1
+            restored += 1
+        return restored
 
     def can_allocate(self, n: int) -> bool:
         free = self.allocator.free_pages
@@ -370,6 +549,36 @@ class PagedKVPool:
         self.k = [fn(a, s, d) for a in self.k]
         self.v = [fn(a, s, d) for a in self.v]
 
+    # -- page transfer (export / install) -------------------------------------
+    def read_pages(self, pages: Sequence[int]):
+        """Page CONTENTS as per-layer host arrays ``[n, page_len, h, d]``
+        (the export path). Caller must hold refs on ``pages``."""
+        import jax.numpy as jnp
+        import numpy as np
+
+        idx = jnp.asarray(list(pages), dtype=jnp.int32)
+        return ([np.asarray(a[idx]) for a in self.k],
+                [np.asarray(a[idx]) for a in self.v])
+
+    def write_pages(self, pages: Sequence[int], k_stacks, v_stacks) -> None:
+        """Scatter-write page CONTENTS into the arenas (the install
+        path). ``k_stacks[li]``/``v_stacks[li]`` are ``[n, page_len, h,
+        d]`` arrays; data is cast to the arena dtype."""
+        import jax
+        import jax.numpy as jnp
+
+        fn = getattr(self, "_install_fn", None)
+        if fn is None:
+            def put(arena, idx, data):
+                return arena.at[idx].set(data)
+
+            fn = self._install_fn = jax.jit(put)
+        idx = jnp.asarray(list(pages), dtype=jnp.int32)
+        self.k = [fn(a, idx, jnp.asarray(d, dtype=a.dtype))
+                  for a, d in zip(self.k, k_stacks)]
+        self.v = [fn(a, idx, jnp.asarray(d, dtype=a.dtype))
+                  for a, d in zip(self.v, v_stacks)]
+
     # -- observability --------------------------------------------------------
     def bytes(self) -> int:
         return sum(int(a.nbytes) for a in self.k) + \
@@ -384,4 +593,6 @@ class PagedKVPool:
                "headroom": round(a.free_pages / max(a.usable_pages, 1), 4)}
         if self.trie is not None:
             out["prefix"] = self.trie.stats()
+        if self.warm is not None:
+            out["warm"] = self.warm.stats()
         return out

@@ -72,14 +72,24 @@ def classify_submit_error(e: BaseException) -> str:
 
 
 def score_candidates(cfg: "RouterConfig", prompt,
-                     candidates: Sequence[Any]
+                     candidates: Sequence[Any],
+                     pool: Optional[str] = None
                      ) -> Tuple[List[float], List[int]]:
     """(score, matched-prefix-tokens) per candidate, lower score wins —
     the load/affinity dispatch policy shared by ``ReplicaRouter`` (thread
     replicas) and ``ServingFleet`` (process replicas). The prefix match
     is probed ONCE here and reused for the affinity accounting — a
     post-submit probe would count the request's own just-inserted blocks
-    as a hit."""
+    as a hit.
+
+    ``pool`` specializes the formula for a disaggregated fleet:
+    ``"prefill"`` replicas are picked for the compute-bound first leg —
+    queue depth dominates (a deep queue head-of-line-blocks the whole
+    prefill) and KV pressure barely matters (pages are shipped out
+    right after); ``"decode"`` replicas are picked for where the pages
+    LAND — KV headroom and prefix/page affinity dominate (the request
+    lives there for its whole decode). ``None`` keeps the classic fused
+    weighting."""
     p = max(len(prompt), 1)
     # the prefix-match probe runs FIRST: for an RPC-backed replica it
     # is the combined probe whose reply also carries queue depth /
@@ -102,12 +112,21 @@ def score_candidates(cfg: "RouterConfig", prompt,
     p95s = [r.metrics.latency_percentile(95) for r in candidates]
     p95_hi = max(max(p95s), 1e-9)
     q_hi = max(max(depths), 1)
+    if pool == "prefill":
+        wq, wm, wl, wa = 2.0 * cfg.w_queue, 0.1 * cfg.w_memory, \
+            cfg.w_latency, 0.5 * cfg.w_affinity
+    elif pool == "decode":
+        wq, wm, wl, wa = 0.5 * cfg.w_queue, 2.0 * cfg.w_memory, \
+            cfg.w_latency, 2.0 * cfg.w_affinity
+    else:
+        wq, wm, wl, wa = cfg.w_queue, cfg.w_memory, cfg.w_latency, \
+            cfg.w_affinity
     scores = []
     for r, d, p95, match in zip(candidates, depths, p95s, matches):
-        s = cfg.w_queue * (d / q_hi) \
-            + cfg.w_memory * (1.0 - r.kv_headroom()) \
-            + cfg.w_latency * (p95 / p95_hi) \
-            - cfg.w_affinity * (match / p)
+        s = wq * (d / q_hi) \
+            + wm * (1.0 - r.kv_headroom()) \
+            + wl * (p95 / p95_hi) \
+            - wa * (match / p)
         scores.append(s)
     return scores, matches
 

@@ -1,0 +1,562 @@
+"""ISSUE 18: disaggregated prefill/decode serving with KV-page
+migration and the fleet-wide tiered prefix cache.
+
+Covers the acceptance surface without paying for processes where the
+logic is pure or in-process: pack/unpack bit-exactness (fp32) and the
+int8 parity/byte-ratio contract, the chunked wire discipline (per-chunk
+SHA, whole-blob digest, corruption rejection), ghost-gated admission
+and LRU residency in both warm tiers (``FleetKVCache``, the replica's
+``HostPagePool``), pool-aware dispatch scoring, the cost model's
+ship-vs-reprefill crossover, the fleet's prefill->decode handoff state
+machine (in-process replicas, every failure mode falling back to
+re-prefill), and engine-level export/install loopback bit-identity
+(slow).  The real 3-process migration protocol is drilled end to end
+by ``tools/kv_migration_drill.py`` (ci.sh kv-migration gate).
+"""
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import serving
+from paddle_tpu.cost_model.comm import (
+    LinkModel, kv_migration_crossover, kv_reprefill_seconds,
+    kv_ship_seconds, link_model_for,
+)
+from paddle_tpu.serving import ServingFleet, ServingFleetPolicy
+from paddle_tpu.serving.kv_transfer import (
+    FleetKVCache, KVMigrationStats, assemble_chunks, chunk_blob,
+    dequantize_page, pack_kv_pages, prompt_cache_key, quantize_page,
+    unpack_kv_pages,
+)
+from paddle_tpu.serving.metrics import MetricsRegistry
+from paddle_tpu.serving.paged_kv import HostPagePool
+from paddle_tpu.serving.router import RouterConfig, score_candidates
+
+
+def _pages(npages=3, layers=2, pl=8, heads=2, dim=4, seed=0):
+    rng = np.random.RandomState(seed)
+    k = [rng.randn(npages, pl, heads, dim).astype(np.float32)
+         for _ in range(layers)]
+    v = [rng.randn(npages, pl, heads, dim).astype(np.float32)
+         for _ in range(layers)]
+    return k, v
+
+
+# -- pack / quantize / chunk (pure) -------------------------------------------
+
+def test_pack_unpack_fp32_bit_exact():
+    k, v = _pages()
+    blob, manifest, meta = pack_kv_pages(k, v)
+    assert meta["npages"] == 3 and meta["layers"] == 2
+    assert not meta["quantized"]
+    assert meta["wire_bytes"] == meta["fp32_bytes"] == len(blob)
+    k2, v2 = unpack_kv_pages(blob, manifest)
+    for a, b in zip(k + v, k2 + v2):
+        np.testing.assert_array_equal(a, b)     # byte-exact, not close
+
+
+def test_pack_unpack_int8_parity_and_wire_ratio():
+    k, v = _pages(seed=1)
+    blob, manifest, meta = pack_kv_pages(k, v, quantize=True)
+    assert meta["quantized"]
+    # the transit contract: int8 + per-page scales <= 0.55x fp32 bytes
+    assert meta["wire_bytes"] <= 0.55 * meta["fp32_bytes"]
+    k2, v2 = unpack_kv_pages(blob, manifest)
+    for a, b in zip(k + v, k2 + v2):
+        assert b.dtype == a.dtype
+        # per-page symmetric int8: error bounded by scale/2 per element
+        scale = np.abs(a).max(axis=(1, 2, 3), keepdims=True) / 127.0
+        assert np.all(np.abs(a - b) <= scale / 2 + 1e-7)
+
+
+def test_quantize_page_zero_and_roundtrip():
+    q, s = quantize_page(np.zeros((4, 2, 2), np.float32))
+    assert s > 0                                # never divides by zero
+    np.testing.assert_array_equal(dequantize_page(q, s), 0.0)
+    a = np.linspace(-3, 3, 16, dtype=np.float32).reshape(4, 2, 2)
+    q, s = quantize_page(a)
+    assert q.dtype == np.int8 and np.abs(dequantize_page(q, s) - a).max() \
+        <= s / 2 + 1e-7
+
+
+def test_chunk_assemble_digest_and_corruption():
+    blob = bytes(range(256)) * 700              # several chunks
+    chunks = chunk_blob(blob, chunk_bytes=50_000)
+    assert len(chunks) == 4
+    digest = None
+    _b, _m, meta = pack_kv_pages(*_pages(npages=1, layers=1))
+    digest = meta["digest"]                     # digest shape sanity
+    assert len(digest) == 64
+    import hashlib
+    whole = hashlib.sha256(blob).hexdigest()
+    # out-of-order delivery reassembles by idx
+    got = assemble_chunks(list(reversed(chunks)), digest=whole)
+    assert got == blob
+    # a corrupted chunk is rejected by its per-chunk SHA
+    bad = [dict(c) for c in chunks]
+    import base64
+    raw = bytearray(base64.b64decode(bad[2]["data"]))
+    raw[0] ^= 0xFF
+    bad[2]["data"] = base64.b64encode(bytes(raw)).decode("ascii")
+    with pytest.raises(ValueError, match="SHA mismatch"):
+        assemble_chunks(bad)
+    # a missing chunk breaks the sequence
+    with pytest.raises(ValueError, match="sequence broken"):
+        assemble_chunks(chunks[:1] + chunks[2:])
+    # whole-blob digest catches a consistent-but-wrong reassembly
+    with pytest.raises(ValueError, match="digest mismatch"):
+        assemble_chunks(chunks, digest="0" * 64)
+
+
+def test_prompt_cache_key_full_page_identity():
+    assert prompt_cache_key([1, 2, 3], 4) is None       # < 1 full page
+    a = prompt_cache_key([1, 2, 3, 4, 5], 4)
+    b = prompt_cache_key([1, 2, 3, 4, 9], 4)            # same full page
+    assert a == b and a is not None
+    assert prompt_cache_key([1, 2, 3, 5, 5], 4) != a    # differs in-page
+    assert prompt_cache_key([1, 2, 3, 4], 2) != \
+        prompt_cache_key([1, 2, 3, 4], 4)               # page_len keyed
+
+
+# -- warm tiers (ghost-gated admission, LRU residency) ------------------------
+
+def test_fleet_kv_cache_ghost_admission_lru_and_stats():
+    c = FleetKVCache(capacity_bytes=300, admit_threshold=2)
+    pay = lambda n: {"data": b"x" * n}
+    # 1st put only feeds the ghost counter; 2nd is admitted
+    assert not c.put("a", pay(100))
+    assert c.get("a") is None
+    assert c.put("a", pay(100))
+    assert c.get("a") is not None
+    # capacity eviction is LRU: admit b and c (2 puts each), then touch
+    # a so b becomes LRU, then admit d -> b evicted
+    for k in ("b", "c"):
+        c.put(k, pay(100))
+        assert c.put(k, pay(100))
+    assert c.get("a") is not None
+    c.put("d", pay(100))
+    assert c.put("d", pay(100))
+    st = c.stats()
+    assert st["entries"] == 3 and st["bytes"] == 300
+    assert st["evictions"] >= 1 and st["admits"] == 4
+    assert c.get("b") is None                   # the LRU victim
+    # an over-capacity payload is never admitted
+    c.put("huge", pay(1000))
+    assert not c.put("huge", pay(1000))
+    assert c.get(None) is None and not c.put(None, pay(1))
+
+
+def test_host_page_pool_ghost_gate_and_quantized_residency():
+    hp = HostPagePool(capacity_bytes=1 << 20, admit_threshold=2)
+    k = [np.random.RandomState(0).randn(8, 2, 4).astype(np.float32)]
+    v = [np.random.RandomState(1).randn(8, 2, 4).astype(np.float32)]
+    assert not hp.put(("x",), k, v)             # unseen: ghost-rejected
+    hp.note_access(("x",))
+    hp.note_access(("x",))
+    assert hp.put(("x",), k, v)
+    got = hp.get(("x",))
+    assert got is not None
+    k2, v2 = got
+    assert np.abs(k2[0] - k[0]).max() < 0.02    # int8 parity bound
+    assert np.abs(v2[0] - v[0]).max() < 0.02
+    assert hp.stats()["bytes"] < k[0].nbytes + v[0].nbytes  # int8 resident
+
+
+def test_kv_migration_stats_snapshot():
+    s = KVMigrationStats()
+    s.note_ship(4, 100, 400, quantized=True)
+    s.note_ship(2, 200, 200, quantized=False)
+    s.note_install(3.0)
+    s.note_install(5.0)
+    s.note_export()
+    s.note_warm_hit()
+    s.note_fallback()
+    s.note_failover(ship=True)
+    s.note_failover(ship=False)
+    snap = s.snapshot()
+    assert snap["ships"] == 2 and snap["pages_shipped"] == 6
+    assert snap["wire_bytes"] == 300 and snap["fp32_bytes"] == 600
+    assert snap["transit_quantized_fraction"] == 0.5
+    assert snap["install_ms_avg"] == 4.0
+    assert snap["failover_ship"] == 1 and snap["failover_reprefill"] == 1
+    assert snap["migrate_fallback"] == 1 and snap["warm_hits"] == 1
+
+
+# -- pool-aware dispatch scoring ----------------------------------------------
+
+class _Cand:
+    def __init__(self, name, depth=0, headroom=1.0, match=0):
+        self.name = name
+        self.metrics = MetricsRegistry()
+        self._d, self._h, self._m = depth, headroom, match
+
+    def queue_depth(self):
+        return self._d
+
+    def kv_headroom(self):
+        return self._h
+
+    def prefix_match_tokens(self, prompt, blocks=None):
+        return self._m
+
+
+def test_score_candidates_pool_weighting():
+    cfg = RouterConfig()
+    prompt = np.arange(16)
+    deep = _Cand("deep", depth=10, headroom=0.9)
+    tight = _Cand("tight", depth=1, headroom=0.05, match=16)
+    # prefill pool: queue depth dominates, KV pressure barely matters ->
+    # the shallow-queue replica wins even with no headroom
+    s, _ = score_candidates(cfg, prompt, [deep, tight], pool="prefill")
+    assert s[1] < s[0]
+    # decode pool: headroom + affinity dominate; a page-holding replica
+    # with moderate queue beats an empty cold one
+    holder = _Cand("holder", depth=3, headroom=0.6, match=16)
+    cold = _Cand("cold", depth=0, headroom=0.7)
+    s, m = score_candidates(cfg, prompt, [cold, holder], pool="decode")
+    assert s[1] < s[0] and m == [0, 16]
+    # None keeps the fused weighting (back-compat with ReplicaRouter)
+    s_none, _ = score_candidates(cfg, prompt, [cold, holder])
+    s_dec, _ = score_candidates(cfg, prompt, [cold, holder], pool="decode")
+    assert s_none != s_dec
+
+
+# -- cost model: migration vs re-prefill crossover ----------------------------
+
+def test_kv_ship_and_reprefill_pricing_monotone():
+    lm = link_model_for("cpu-host")
+    assert kv_ship_seconds(lm, 2 << 20) > kv_ship_seconds(lm, 1 << 20)
+    assert kv_reprefill_seconds(lm, 512, 1e6) > \
+        kv_reprefill_seconds(lm, 256, 1e6)
+    assert kv_ship_seconds(lm, 0) > 0           # RPC overhead floor
+
+
+def test_kv_migration_crossover_shape_and_quantize_shift():
+    lm = link_model_for("cpu-host")
+    out = kv_migration_crossover(lm, page_len=8, bytes_per_page=1 << 16,
+                                 flops_per_token=5e7)
+    assert set(out) >= {"crossover_pages", "ship_s", "reprefill_s"}
+    n = out["crossover_pages"]
+    assert n is not None and n >= 1
+    # int8 halves the wire bytes: the crossover can only move EARLIER
+    qout = kv_migration_crossover(lm, page_len=8, bytes_per_page=1 << 16,
+                                  flops_per_token=5e7, quantized=True)
+    assert qout["crossover_pages"] is not None
+    assert qout["crossover_pages"] <= n
+    # a link too slow to ever win reports None, not a bogus page count
+    slow = LinkModel(name="slowlink", peak_flops=1e15,
+                     host_bytes_per_s=1e3, dispatch_s=0.0)
+    assert kv_migration_crossover(slow, page_len=8,
+                                  bytes_per_page=1 << 20,
+                                  flops_per_token=1.0,
+                                  max_pages=64)["crossover_pages"] is None
+
+
+# -- the fleet's handoff state machine (in-process replicas) ------------------
+
+class _FakeReplica:
+    """GenerationEngine-shaped stub (no export/install: every migration
+    takes the re-prefill fallback, which is the path under test)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.metrics = MetricsRegistry()
+        self.jobs = []            # (prompt, max_new, on_token, future)
+        self.cancelled = []
+        self.spec = True
+
+    def start(self):
+        return self
+
+    def close(self, drain=True):
+        pass
+
+    def restart(self):
+        pass
+
+    def fence(self):
+        pass
+
+    def drain(self):
+        pass
+
+    def health(self):
+        return True
+
+    def queue_depth(self):
+        return len(self.jobs)
+
+    def stats(self):
+        return self.metrics.snapshot()
+
+    def kv_headroom(self):
+        return 1.0
+
+    def prefix_match_tokens(self, prompt, blocks=None):
+        return 0
+
+    def set_speculative(self, on):
+        self.spec = on
+
+    def cancel(self, fut):
+        self.cancelled.append(fut)
+        return False
+
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               on_token=None):
+        fut = Future()
+        self.jobs.append((np.asarray(prompt), int(max_new_tokens),
+                          on_token, fut))
+        return fut
+
+    def finish_job(self, i=0):
+        prompt, mx, cb, fut = self.jobs.pop(i)
+        toks = [int(prompt[-1]) + 1 + j for j in range(mx)]
+        for t in toks:
+            if cb:
+                cb(t)
+        fut.set_result(np.asarray(list(prompt) + toks, np.int64))
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _pooled_fleet(min_ship_tokens=4, **kw):
+    pol = ServingFleetPolicy(poll_interval=0.02, hedge_ms=None)
+    pre, d0, d1 = (_FakeReplica(n) for n in ("pre", "d0", "d1"))
+    fleet = ServingFleet(
+        replicas=[pre, d0, d1],
+        pools={"prefill": ["pre"], "decode": ["d0", "d1"]},
+        policy=pol, min_ship_tokens=min_ship_tokens, **kw).start()
+    return fleet, pre, (d0, d1)
+
+
+def test_fleet_pool_validation():
+    reps = [_FakeReplica("a"), _FakeReplica("b")]
+    with pytest.raises(ValueError, match="unknown replica"):
+        ServingFleet(replicas=reps, pools={"prefill": ["zz"],
+                                           "decode": ["b"]})
+    with pytest.raises(ValueError, match="pool"):
+        ServingFleet(replicas=reps, pools={"prefil": ["a"]})
+    with pytest.raises(ValueError, match="two pools"):
+        ServingFleet(replicas=reps, pools={"prefill": ["a"],
+                                           "decode": ["a", "b"]})
+    with pytest.raises(ValueError, match="kv_transit"):
+        ServingFleet(replicas=reps, kv_transit="fp16")
+
+
+def test_fleet_prefill_leg_caps_one_token_then_decode_continues():
+    """The handoff contract: a fresh request lands on the prefill pool
+    capped to ONE token; the decode leg carries prompt+that token and
+    the REMAINING budget; the stream is exactly-once; stubs without an
+    export surface take the re-prefill fallback (counted)."""
+    fleet, pre, (d0, d1) = _pooled_fleet()
+    try:
+        streamed = []
+        fut = fleet.submit([7, 8, 9, 10], max_new_tokens=4,
+                           on_token=streamed.append)
+        assert _wait(lambda: pre.jobs)
+        p, mx, _cb, _f = pre.jobs[0]
+        assert p.tolist() == [7, 8, 9, 10] and mx == 1
+        assert not d0.jobs and not d1.jobs      # decode waits for handoff
+        pre.finish_job()                        # emits token 11
+        assert _wait(lambda: d0.jobs or d1.jobs)
+        dec = d0 if d0.jobs else d1
+        dp, dmx, _dc, _df = dec.jobs[0]
+        assert dp.tolist() == [7, 8, 9, 10, 11]  # prompt + prefill token
+        assert dmx == 3                          # remaining budget only
+        dec.finish_job()
+        out = fut.result(timeout=10)
+        assert out.tolist() == [7, 8, 9, 10, 11, 12, 13, 14]
+        assert streamed == [11, 12, 13, 14]      # exactly-once stream
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["prefill_handoffs"] == 1
+        assert snap["counters"]["migrate_fallback"] == 1  # no export seam
+        assert snap["replicas"]["pre"]["pool"] == "prefill"
+        assert snap["replicas"]["d0"]["pool"] == "decode"
+        mig = fleet.kv_migration_snapshot()
+        assert mig["migrate_fallback"] == 1 and mig["ships"] == 0
+        assert mig["pools"] == {"pre": "prefill", "d0": "decode",
+                                "d1": "decode"}
+    finally:
+        fleet.close()
+
+
+def test_fleet_short_prompt_and_single_token_skip_prefill_pool():
+    fleet, pre, (d0, d1) = _pooled_fleet(min_ship_tokens=8)
+    try:
+        f1 = fleet.submit([1, 2, 3], max_new_tokens=4)   # short prompt
+        f2 = fleet.submit([1, 2, 3, 4, 5, 6, 7, 8],
+                          max_new_tokens=1)              # nothing to ship
+        assert _wait(lambda: len(d0.jobs) + len(d1.jobs) == 2)
+        assert not pre.jobs
+        for r in (d0, d1):
+            while r.jobs:
+                r.finish_job()
+        f1.result(timeout=10)
+        f2.result(timeout=10)
+        assert "prefill_handoffs" not in \
+            fleet.provider_snapshot()["counters"]
+    finally:
+        fleet.close()
+
+
+def test_fleet_empty_prefill_pool_degrades_to_fused_path():
+    """A dead prefill tier must not strand traffic: requests fall back
+    to direct decode-pool dispatch (counted), streams still complete."""
+    pol = ServingFleetPolicy(poll_interval=0.02, hedge_ms=None)
+    pre, d0 = _FakeReplica("pre"), _FakeReplica("d0")
+    fleet = ServingFleet(replicas=[pre, d0],
+                         pools={"prefill": ["pre"], "decode": ["d0"]},
+                         policy=pol, min_ship_tokens=4).start()
+    try:
+        fleet.fence_replica("pre", cause="test_kill")
+        fut = fleet.submit([5, 6, 7, 8], max_new_tokens=2)
+        assert _wait(lambda: d0.jobs)
+        p, mx, _cb, _f = d0.jobs[0]
+        assert p.tolist() == [5, 6, 7, 8] and mx == 2    # fused leg
+        d0.finish_job()
+        assert fut.result(timeout=10).tolist() == [5, 6, 7, 8, 9, 10]
+    finally:
+        fleet.close()
+
+
+def test_kv_migration_provider_on_hub():
+    from paddle_tpu import observability as obs
+
+    fleet, pre, (d0, d1) = _pooled_fleet()
+    try:
+        hub = obs.snapshot()["kv_migration"]
+        assert hub["transit"] == "fp32"
+        assert hub["warm_cache"]["entries"] == 0
+        assert hub["pending_migrations"] == 0
+        assert hub["pools"]["pre"] == "prefill"
+    finally:
+        fleet.close()
+
+
+# -- real-engine integration (slow legs; the ci.sh gate runs them) ------------
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    """1-layer GPT trained to continue the repeating 0..7 pattern."""
+    import paddle_tpu.optimizer as opt
+    from paddle_tpu import jit
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    cfg = GPTConfig(vocab_size=32, hidden_size=32, num_hidden_layers=1,
+                    num_attention_heads=2, max_position_embeddings=64,
+                    dtype="float32")
+    paddle.seed(0)
+    model = GPTForCausalLM(cfg)
+    optimizer = opt.AdamW(learning_rate=3e-3,
+                          parameters=model.parameters())
+    step = jit.TrainStep(model, lambda m, x, y: m(x, labels=y),
+                         optimizer)
+    pattern = np.tile(np.arange(8), 8)
+    ids = paddle.to_tensor(pattern[None, :].astype("int64"))
+    for _ in range(80):
+        loss = step(ids, ids)
+    assert float(loss) < 0.1
+    return model, pattern
+
+
+def _mk_engine(model, name):
+    return serving.GenerationEngine(
+        model, serving.GenerationConfig(max_slots=2, max_seq_len=48,
+                                        page_len=8,
+                                        prefill_buckets=(8, 16, 24, 32,
+                                                         40)),
+        name=name)
+
+
+@pytest.mark.slow  # real engine compiles; ci.sh kv-migration gate runs it
+def test_engine_export_install_loopback_bit_identical(tiny_lm):
+    """The page shipper's engine seam: export the prompt's pages from
+    one engine, install into another, and the continuation stream is
+    bit-identical to an uninterrupted single-engine decode — through
+    BOTH transits (fp32 byte-exact install, int8 dequantized)."""
+    model, pattern = tiny_lm
+    src = _mk_engine(model, "kvm_src").start()
+    dst = _mk_engine(model, "kvm_dst").start()
+    ref_eng = _mk_engine(model, "kvm_ref").start()
+    try:
+        prompt = pattern[:32].astype("int64")   # 4 full pages
+        ref = ref_eng.submit(prompt, max_new_tokens=9).result(
+            timeout=300).tolist()
+        first = src.submit(prompt, max_new_tokens=1).result(timeout=300)
+        t0 = int(first[32])
+        assert t0 == ref[32]
+        with pytest.raises(KeyError):           # uncached prompt: no export
+            src.export_kv_pages(np.arange(16, 32, dtype=np.int64))
+        n, k_st, v_st = src.export_kv_pages(prompt)
+        assert n == 4 and k_st[0].shape == (4, 8, 2, 16)
+        # fp32 transit is byte-exact end to end
+        blob, manifest, _meta = pack_kv_pages(k_st, v_st)
+        k2, v2 = unpack_kv_pages(blob, manifest)
+        assert dst.install_kv_pages(prompt, k2, v2) == 4
+        cont = dst.submit(np.append(prompt, t0).astype("int64"),
+                          max_new_tokens=8).result(timeout=300)
+        assert cont.tolist() == ref             # bit-identical stream
+        # the decode leg ran on a full prefix hit, not a re-prefill
+        st = dst.stats()["kv_pages"]["prefix"]
+        assert st["hits"] >= 1 and st["hit_tokens"] >= 32
+        assert dst.metrics.counter("kv_installs") == 1
+        assert src.metrics.counter("kv_exports") >= 1
+        # installing the same prompt again adopts nothing (first writer
+        # wins), and never leaks pages
+        assert dst.install_kv_pages(prompt, k2, v2) == 0
+        alloc = dst._pool.allocator
+        alloc.check()
+    finally:
+        for e in (src, dst, ref_eng):
+            e.close()
+
+
+@pytest.mark.slow  # real engines behind an in-process pooled fleet
+def test_inprocess_pooled_fleet_migration_bit_identical(tiny_lm):
+    """A split fleet over REAL engines (in-process seam): the prefill
+    replica fills pages, the supervisor ships them to a decode replica,
+    and the stream equals the engine's own uninterrupted greedy decode.
+    Repeats of the same prompt then hit the fleet-wide warm tier."""
+    model, pattern = tiny_lm
+    ref_eng = _mk_engine(model, "kvm_fref").start()
+    pre = _mk_engine(model, "kvm_fpre")
+    d0 = _mk_engine(model, "kvm_fd0")
+    fleet = ServingFleet(
+        replicas=[pre, d0],
+        pools={"prefill": ["kvm_fpre"], "decode": ["kvm_fd0"]},
+        policy=ServingFleetPolicy(poll_interval=0.02, hedge_ms=None),
+        min_ship_tokens=8)
+    fleet.start()
+    try:
+        prompt = pattern[:32].astype("int64")
+        ref = ref_eng.submit(prompt, max_new_tokens=9).result(
+            timeout=300).tolist()
+        outs = [fleet.submit(prompt, max_new_tokens=9).result(
+            timeout=300).tolist() for _ in range(3)]
+        for out in outs:
+            assert out == ref                    # bit-identical stream
+        snap = fleet.provider_snapshot()
+        assert snap["counters"]["prefill_handoffs"] == 3
+        assert snap["counters"]["migrations"] == 3
+        assert snap["counters"].get("migrate_fallback", 0) == 0
+        mig = fleet.kv_migration_snapshot()
+        assert mig["ships"] == 3 and mig["pages_shipped"] == 12
+        assert mig["installs"] == 3
+        # warm tier: put #1 ghost-rejected, #2 admitted, #3 a hit —
+        # only the first two migrations export from the prefill replica
+        assert mig["warm_hits"] == 1 and mig["exports"] == 2
+        assert mig["warm_cache"]["entries"] == 1
+    finally:
+        fleet.close()
+        ref_eng.close()

@@ -562,3 +562,92 @@ def test_router_end_to_end_fleet_with_replica_fault(tiny_lm):
         assert "fleet_a" in st["down"]
         assert router.queue_depth() == 0           # drained, not stuck
         assert st["replicas"]["fleet_b"]["responses"] >= 4
+
+
+# -- property-style invariants (ISSUE 18 satellite) ---------------------------
+
+def test_pool_exhausted_carries_allocator_state():
+    """The exception IS the diagnostic: need/free/live/usable and the
+    lifetime alloc/free totals, so an OOM log line is actionable without
+    a debugger attached."""
+    a = PageAllocator(8)
+    held = a.alloc(5)
+    with pytest.raises(PoolExhausted) as ei:
+        a.alloc(4)
+    msg = str(ei.value)
+    assert "need 4 pages" in msg and "2 free" in msg
+    assert "(5 live) of 7 usable" in msg
+    assert "pool=8 incl. scratch" in msg and "alloc_total=5" in msg
+    for p in held:
+        a.release(p)
+    a.check()
+
+
+def test_token_blocks_roundtrip_property():
+    """For random prompts and page sizes: blocks tile the prompt's full
+    pages exactly, in order, each of length page_len — concatenating
+    them reconstructs the prompt's full-page prefix."""
+    rng = np.random.RandomState(7)
+    for _ in range(50):
+        n = int(rng.randint(0, 65))
+        pl = int(rng.randint(1, 17))
+        prompt = rng.randint(0, 1000, size=n)
+        blocks = token_blocks(prompt, pl)
+        assert len(blocks) == n // pl
+        assert all(len(b) == pl for b in blocks)
+        flat = [t for b in blocks for t in b]
+        assert flat == prompt[: (n // pl) * pl].tolist()
+        lim = int(rng.randint(0, len(blocks) + 1))
+        assert token_blocks(prompt, pl, limit=lim) == blocks[:lim]
+
+
+def test_allocator_random_ops_invariants_property():
+    """Seeded random walks over the FULL allocator surface — alloc,
+    release, retain, cow — keep every invariant ``check()`` audits:
+    free/live partition the pool, refcounts match holders, no page is
+    handed out twice, exhaustion never leaks."""
+    for seed in (0, 1, 2, 3):
+        rng = np.random.RandomState(seed)
+        a = PageAllocator(16)
+        refs = {}                     # page -> refs WE hold
+        for _ in range(300):
+            op = rng.rand()
+            held = [p for p, c in refs.items() if c > 0]
+            if op < 0.35:
+                n = int(rng.randint(1, 5))
+                try:
+                    for p in a.alloc(n):
+                        assert refs.get(p, 0) == 0, "page reissued"
+                        refs[p] = 1
+                except PoolExhausted:
+                    assert n > a.free_pages       # only a true OOM
+            elif op < 0.65 and held:
+                p = held[int(rng.randint(len(held)))]
+                a.release(p)
+                refs[p] -= 1
+            elif op < 0.85 and held:
+                p = held[int(rng.randint(len(held)))]
+                a.retain(p)
+                refs[p] += 1
+            elif held:
+                p = held[int(rng.randint(len(held)))]
+                try:
+                    new, copied = a.cow(p)
+                except PoolExhausted:
+                    continue
+                assert copied == (refs[p] > 1)
+                if copied:            # writer moved off the share
+                    refs[p] -= 1
+                    assert refs.get(new, 0) == 0
+                    refs[new] = 1
+            a.check()
+            live = sum(1 for c in refs.values() if c > 0)
+            assert a.live_pages == live
+            assert a.free_pages == a.usable_pages - live
+            for p, c in refs.items():
+                assert a.ref(p) == c
+        for p, c in refs.items():
+            for _ in range(c):
+                a.release(p)
+        a.check()
+        assert a.free_pages == a.usable_pages and a.live_pages == 0

@@ -370,6 +370,21 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_post_training.py -q \
 JAX_PLATFORMS=cpu python tools/rl_drill.py || exit 1
 JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/rl_drill.py || exit 1
 
+echo "== kv migration gate (ISSUE-18: disaggregated prefill/decode) =="
+# wire-format units (pack/unpack fp32 bit-exact, int8 <= 0.55x bytes,
+# chunk digests, ghost-gated fleet cache, pool-aware routing, cost
+# model), the slow engine loopback (export -> pack -> install on a
+# second engine, continuation BIT-identical) and in-process pooled
+# fleet — then the REAL 3-process drill: 1 prefill + 2 decode replicas,
+# every request migrated over the wire with zero re-prefill fallbacks,
+# a decode crash failed over by re-SHIPPING the retained pages, warm
+# repeats served from the fleet-wide host-RAM tier; lockdep-armed
+# re-run must stay cycle-free
+JAX_PLATFORMS=cpu python -m pytest tests/test_kv_migration.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/kv_migration_drill.py || exit 1
+JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/kv_migration_drill.py || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
