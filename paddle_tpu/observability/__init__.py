@@ -127,6 +127,9 @@ def _register_builtin_providers() -> None:
     histogram("request_latency_ms")
     histogram("queue_wait_ms")
     histogram("step_time_ms")
+    # time-to-first-token (GenerationEngine prefill exit) — the fleet SLO
+    # layer's TTFT percentiles come from these merged buckets
+    histogram("ttft_ms")
 
 
 _register_builtin_providers()

@@ -21,7 +21,8 @@ from typing import Any, Dict, Optional
 from .registry import hub
 
 __all__ = ["snapshot", "report", "prometheus_text", "serve", "stop_serving",
-           "dump", "render_snapshot"]
+           "dump", "render_snapshot", "emit_histogram",
+           "emit_counter_family"]
 
 
 def snapshot() -> Dict[str, Any]:
@@ -108,6 +109,15 @@ def render_snapshot(snap: Dict[str, Any]) -> str:
             continue
         if fam == "registries" and isinstance(body, dict):
             lines.append(_registries_table(body))
+            lines.append("")
+            continue
+        if fam == "fleet_telemetry" and isinstance(body, dict) \
+                and "replicas" in body:
+            lines.append(_fleet_table(body))
+            lines.append("")
+            continue
+        if fam == "slo" and isinstance(body, dict) and "pools" in body:
+            lines.append(_slo_table(body))
             lines.append("")
             continue
         if isinstance(body, dict) and body.get("type") == "histogram":
@@ -247,6 +257,75 @@ def _registries_table(body: Dict[str, Any]) -> str:
     return "\n".join(lines) if lines else "  (none)"
 
 
+def _fleet_table(body: Dict[str, Any]) -> str:
+    """The merged fleet view (``pd_top --fleet``): one row per replica
+    (state, pool, inflight, beat age, p95, KV headroom) and a fleet
+    totals line from the bucket-wise-merged histograms."""
+    lines = [f"  {'replica':<10} {'state':<10} {'pool':<8} {'inc':>3} "
+             f"{'infl':>5} {'beat_s':>7} {'p95_ms':>9} {'kv_head':>8} "
+             f"{'reqs':>7}"]
+    reps = body.get("replicas") or {}
+    for name in sorted(reps):
+        r = reps[name]
+
+        def _f(v, nd=3):
+            return "-" if v is None else round(float(v), nd)
+
+        lines.append(
+            f"  {name:<10} {str(r.get('state') or '-'):<10} "
+            f"{str(r.get('pool') or '-'):<8} "
+            f"{r.get('incarnation') if r.get('incarnation') is not None else '-':>3} "
+            f"{r.get('inflight') if r.get('inflight') is not None else '-':>5} "
+            f"{_f(r.get('beat_age_s')):>7} {_f(r.get('p95_ms')):>9} "
+            f"{_f(r.get('kv_headroom'), 4):>8} "
+            f"{r.get('requests') if r.get('requests') is not None else '-':>7}")
+    totals = body.get("totals") or {}
+    if totals:
+        lines.append(
+            f"  fleet: replicas={totals.get('replicas')} "
+            f"ready={totals.get('ready')} "
+            f"inflight={totals.get('inflight')} "
+            f"queue={totals.get('queue_depth')} "
+            f"requests={totals.get('requests')}"
+            + (f" kv_headroom_min={totals.get('kv_headroom_min')}"
+               if totals.get("kv_headroom_min") is not None else ""))
+    hists = body.get("histograms") or {}
+    lat = (hists.get("request_latency_ms") or {}).get("fleet")
+    if isinstance(lat, dict):
+        lines.append(f"  merged request_latency_ms: "
+                     f"count={lat.get('count')} sum={lat.get('sum')}ms")
+    errs = body.get("merge_errors") or []
+    for e in errs[:4]:
+        lines.append(f"  !! merge error: {e}")
+    return "\n".join(lines)
+
+
+def _slo_table(body: Dict[str, Any]) -> str:
+    """The burn-rate panel: target + window + per-pool current burn."""
+    lines = [f"  target={body.get('target_ms')}ms  "
+             f"objective={body.get('objective')}  "
+             f"window={body.get('window_s')}s  "
+             f"budget={body.get('error_budget')}"]
+    scopes = [("fleet", body.get("fleet"))] + \
+        sorted((body.get("pools") or {}).items())
+    for name, s in scopes:
+        if not isinstance(s, dict):
+            continue
+        lines.append(
+            f"  {name:<10} p95={s.get('p95_ms'):>9}ms "
+            f"p99={s.get('p99_ms'):>9}ms "
+            f"reqs={s.get('requests_window'):>6} "
+            f"err={s.get('error_rate')} "
+            f"burn={s.get('burn_rate')} "
+            f"{'OK' if s.get('compliant') else 'BURNING'}")
+    for key in ("queue_depth", "kv_headroom", "ttft"):
+        v = body.get(key)
+        if isinstance(v, dict):
+            row = " ".join(f"{k}={v[k]}" for k in sorted(v))
+            lines.append(f"  {key}: {row}")
+    return "\n".join(lines)
+
+
 def _fmt_bytes(n) -> str:
     try:
         n = float(n)
@@ -348,6 +427,46 @@ def _emit_sample(lines, name, value, labels: Optional[Dict[str, str]] = None):
         lines.append(f"pt_{name} {value}")
 
 
+def emit_histogram(lines, name: str, hist,
+                   labels: Optional[Dict[str, str]] = None) -> None:
+    """Native histogram samples (``_bucket{le=...}``/``_sum``/``_count``)
+    from a live ``Histogram`` or a ``snapshot()`` dict, with optional
+    EXTRA labels on every sample — the fleet exposition emits one labeled
+    series per replica (``replica``/``pool``) plus the unlabeled merged
+    aggregate through this one helper."""
+    from .registry import _hist_parts
+
+    bounds, counts, s, n = _hist_parts(hist)
+    base = dict(labels or {})
+    cum = 0
+    for le, c in zip(bounds, counts):
+        cum += c
+        _emit_sample(lines, f"{name}_bucket", cum, {**base, "le": str(le)})
+    _emit_sample(lines, f"{name}_bucket", cum + counts[-1],
+                 {**base, "le": "+Inf"})
+    _emit_sample(lines, f"{name}_sum", s, base or None)
+    _emit_sample(lines, f"{name}_count", n, base or None)
+
+
+def emit_counter_family(lines, name: str, fam,
+                        extra_labels: Optional[Dict[str, str]] = None
+                        ) -> None:
+    """Counter samples from a live ``CounterFamily`` or its
+    ``snapshot()`` dict (the lossless ``items`` rows), each label tuple
+    zipped against the family's ``label_names`` plus any extras."""
+    if isinstance(fam, dict):
+        label_names = list(fam.get("label_names") or ())
+        rows = [(tuple(k), v) for k, v in fam.get("items", [])]
+    else:
+        label_names = list(fam.label_names)
+        rows = fam.items()
+    lines.append(f"# TYPE pt_{_metric_name(name)}_total counter")
+    for key, val in rows:
+        labels = dict(extra_labels or {})
+        labels.update(zip(label_names, key))
+        _emit_sample(lines, f"{name}_total", val, labels or None)
+
+
 def _emit_tree(lines, base: str, obj, labels=None):
     """Numeric leaves of nested dicts become samples with dotted names
     flattened into the metric name."""
@@ -380,11 +499,7 @@ def prometheus_text() -> str:
                 _emit_sample(lines, f"{name}_total", val, labels)
         elif hist is not None:
             lines.append(f"# TYPE pt_{name} histogram")
-            for le, cum in hist.items():
-                _emit_sample(lines, f"{name}_bucket", cum, {"le": str(le)})
-            hs = hist.snapshot()
-            _emit_sample(lines, f"{name}_sum", hs["sum"])
-            _emit_sample(lines, f"{name}_count", hs["count"])
+            emit_histogram(lines, name, hist)
         else:
             lines.append(f"# TYPE pt_{name} gauge")
             _emit_tree(lines, name, snap[fam])

@@ -1,0 +1,466 @@
+"""Fleet-wide observability plane: merge per-process telemetry into one
+coherent feed and stitch cross-process traces under fleet trace ids.
+
+Everything here is PURE over snapshot dicts — no sockets, no store, no
+engine imports — so the supervisor's collector thread (serving/fleet.py)
+stays a thin scrape loop and every merge/SLO rule is unit-testable:
+
+- ``merge_replica_telemetry``: per-replica hub snapshots -> one merged
+  view. Histogram families merge bucket-wise (``Histogram.merge_snapshots``
+  — exact sum/count, mismatched edges rejected per family), counter
+  families re-key under ``(replica, pool, incarnation)`` label prefixes,
+  and per-replica probe rows (state, inflight, beat age, queue depth,
+  KV headroom) ride along for ``pd_top --fleet``.
+- ``histogram_quantile``: Prometheus-style linear interpolation over a
+  merged histogram snapshot — the ONLY latency-percentile source the SLO
+  layer uses (no supervisor-side sampling).
+- ``SloTracker``: target + window + current burn. Each ``update`` takes
+  the merged per-pool histograms, diffs the windowed good/total counts
+  and reports burn rate = error_rate / error_budget — the input surface
+  the autoscaler policy loop (ROADMAP direction 1) consumes.
+- ``FleetTraceCollector``: deduped store of finished traces pulled from
+  replicas (``trace`` RPC / heartbeat piggyback) plus the supervisor's
+  own ``fleet-*`` traces; one chrome-trace export where a migrated
+  request renders as a single trace spanning its real pids.
+- ``fleet_prometheus_text``: the label-aware exposition of the merged
+  feed (per-replica labeled series + unlabeled fleet aggregates).
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .registry import Histogram, _hist_parts, _named_lock
+
+__all__ = [
+    "histogram_quantile", "merge_replica_telemetry", "SloPolicy",
+    "SloTracker", "FleetTraceCollector", "fleet_prometheus_text",
+]
+
+
+# -- quantiles over merged histograms -----------------------------------------
+
+def histogram_quantile(snap, q: float) -> float:
+    """The φ-quantile (``q`` in [0, 1]) of a histogram snapshot, linearly
+    interpolated inside the containing bucket (the PromQL
+    ``histogram_quantile`` rule): the answer comes from MERGED bucket
+    counts alone — exactly as aggregatable as the buckets themselves.
+    Observations in the +Inf overflow clamp to the largest finite edge;
+    an empty histogram reports 0.0."""
+    bounds, counts, _s, n = _hist_parts(snap)
+    if n <= 0:
+        return 0.0
+    q = min(max(float(q), 0.0), 1.0)
+    target = q * n
+    cum = 0
+    for i, c in enumerate(counts[:-1]):
+        prev = cum
+        cum += c
+        if cum >= target and c > 0:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            return lo + (hi - lo) * ((target - prev) / c)
+    return bounds[-1]
+
+
+# -- telemetry merge ----------------------------------------------------------
+
+def _is_hist(v) -> bool:
+    return isinstance(v, dict) and v.get("type") == "histogram"
+
+
+def _is_counter_family(v) -> bool:
+    return isinstance(v, dict) and "items" in v and "label_names" in v
+
+
+def merge_replica_telemetry(replicas: Dict[str, Dict[str, Any]]
+                            ) -> Dict[str, Any]:
+    """Merge per-replica scrape results into the ``fleet_telemetry``
+    provider payload.
+
+    ``replicas`` maps replica name -> ``{"snapshot": <hub snapshot>,
+    "pool": str|None, "incarnation": int, "state": str, ...row fields}``
+    (row fields: ``inflight``, ``beat_age_s``, ``queue_depth``,
+    ``kv_headroom``, ``scrape_age_s`` — whatever the collector knows).
+
+    Histogram families merge bucket-wise across replicas AND per pool;
+    a replica whose bucket edges disagree with the rest of the fleet is
+    skipped for that family and counted in ``merge_errors`` (one bad
+    replica must not sink the feed). Counter families merge label-aware
+    under a ``(replica, pool, incarnation)`` prefix — per-replica
+    dimensions survive into the fleet exposition."""
+    hist_fams: Dict[str, Dict[str, Any]] = {}
+    counter_fams: Dict[str, Any] = {}
+    rows: Dict[str, Dict[str, Any]] = {}
+    merge_errors: List[str] = []
+
+    for name in sorted(replicas):
+        info = replicas[name]
+        snap = info.get("snapshot") or {}
+        pool = info.get("pool")
+        row = {k: info.get(k) for k in
+               ("pool", "incarnation", "state", "inflight", "beat_age_s",
+                "queue_depth", "kv_headroom", "scrape_age_s")}
+        row["pid"] = (snap.get("meta") or {}).get("pid")
+        for fam, body in snap.items():
+            if _is_hist(body):
+                hist_fams.setdefault(fam, {})[name] = body
+            elif _is_counter_family(body):
+                counter_fams.setdefault(fam, {})[name] = body
+        lat = snap.get("request_latency_ms")
+        if _is_hist(lat):
+            row["p95_ms"] = round(histogram_quantile(lat, 0.95), 3)
+            row["requests"] = lat.get("count", 0)
+        rows[name] = row
+
+    histograms: Dict[str, Any] = {}
+    for fam, per_replica in hist_fams.items():
+        groups: Dict[str, List] = {}
+        merged = None
+        ok_names = []
+        for name, snap in per_replica.items():
+            try:
+                merged = snap if merged is None else \
+                    Histogram.merge_snapshots([merged, snap])
+            except ValueError:
+                merge_errors.append(f"{fam}:{name}: bucket edge mismatch")
+                continue
+            ok_names.append(name)
+            pool = replicas[name].get("pool")
+            if pool:
+                groups.setdefault(pool, []).append(snap)
+        per_pool = {}
+        for pool, snaps in groups.items():
+            try:
+                per_pool[pool] = Histogram.merge_snapshots(snaps)
+            except ValueError:
+                merge_errors.append(f"{fam}:{pool}: bucket edge mismatch")
+        if merged is not None:
+            histograms[fam] = {
+                "fleet": merged, "per_pool": per_pool,
+                "per_replica": {n: per_replica[n] for n in ok_names}}
+
+    counters: Dict[str, Any] = {}
+    from .registry import CounterFamily  # local: no import cycle risk
+
+    for fam, per_replica in counter_fams.items():
+        base_labels = ()
+        for snap in per_replica.values():
+            if snap.get("label_names"):
+                base_labels = tuple(snap["label_names"])
+                break
+        out = CounterFamily(
+            fam, ("replica", "pool", "incarnation") + base_labels)
+        for name, snap in per_replica.items():
+            info = replicas[name]
+            prefix = (name, str(info.get("pool") or "-"),
+                      str(info.get("incarnation", 0)))
+            try:
+                out.merge(snap, prefix=prefix)
+            except ValueError:
+                merge_errors.append(f"{fam}:{name}: label arity mismatch")
+        counters[fam] = out.snapshot()
+
+    totals = {
+        "replicas": len(rows),
+        "ready": sum(1 for r in rows.values() if r.get("state") == "ready"),
+        "inflight": sum(int(r.get("inflight") or 0) for r in rows.values()),
+        "queue_depth": sum(int(r.get("queue_depth") or 0)
+                           for r in rows.values()),
+        "requests": sum(int(r.get("requests") or 0) for r in rows.values()),
+    }
+    heads = [float(r["kv_headroom"]) for r in rows.values()
+             if r.get("kv_headroom") is not None]
+    if heads:
+        totals["kv_headroom_min"] = round(min(heads), 4)
+        totals["kv_headroom_mean"] = round(sum(heads) / len(heads), 4)
+    return {"replicas": rows, "histograms": histograms,
+            "counters": counters, "totals": totals,
+            "merge_errors": merge_errors}
+
+
+# -- SLO signal layer ---------------------------------------------------------
+
+@dataclass
+class SloPolicy:
+    """The fleet latency SLO: ``objective`` of requests complete within
+    ``target_ms``, evaluated over a trailing ``window_s``. The target is
+    rounded UP to the nearest histogram bucket edge (bucket counts are
+    the only latency truth the fleet has); burn rate is
+    ``error_rate / (1 - objective)`` — 1.0 burns the budget exactly at
+    the sustainable rate, >1.0 eats into it."""
+
+    target_ms: float = 1000.0
+    objective: float = 0.99
+    window_s: float = 60.0
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("slo objective must be in (0, 1)")
+        if self.target_ms <= 0 or self.window_s <= 0:
+            raise ValueError("slo target/window must be positive")
+
+
+def _good_total(snap, target_ms: float) -> Tuple[int, int]:
+    """(observations <= the bucket edge covering target_ms, total)."""
+    bounds, counts, _s, n = _hist_parts(snap)
+    cum = 0
+    for b, c in zip(bounds, counts):
+        cum += c
+        if b >= target_ms:
+            return cum, n
+    return cum, n  # target beyond the largest edge: +Inf counts as bad
+
+
+class SloTracker:
+    """Windowed burn-rate accounting over the MERGED histogram feed.
+
+    Each ``update(now, per_pool, fleet, extras)`` appends one sample of
+    cumulative (good, total) counts per pool and reports the SLO view:
+    p95/p99 interpolated from the current merged buckets, plus windowed
+    error/burn rates from the oldest in-window sample to now. A replica
+    restart can step cumulative counts BACKWARD (its histograms reset);
+    deltas clamp at zero so a restart reads as silence, not negative
+    traffic."""
+
+    def __init__(self, policy: Optional[SloPolicy] = None):
+        self.policy = policy or SloPolicy()
+        self._samples: deque = deque(maxlen=4096)
+
+    def update(self, now: float,
+               per_pool: Dict[str, Dict[str, Any]],
+               fleet: Optional[Dict[str, Any]] = None,
+               extras: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        pol = self.policy
+        cur: Dict[str, Tuple[int, int]] = {}
+        views: Dict[str, Dict[str, Any]] = {}
+        scopes = dict(per_pool)
+        if fleet is not None:
+            scopes["_fleet"] = fleet
+        for scope, snap in scopes.items():
+            good, total = _good_total(snap, pol.target_ms)
+            cur[scope] = (good, total)
+            views[scope] = {
+                "p95_ms": round(histogram_quantile(snap, 0.95), 3),
+                "p99_ms": round(histogram_quantile(snap, 0.99), 3),
+                "count_total": total,
+            }
+        self._samples.append({"ts": float(now), "scopes": cur})
+        horizon = float(now) - pol.window_s
+        base = None
+        for s in self._samples:  # oldest in-window sample (or the newest
+            if s["ts"] >= horizon:  # older-than-window one as baseline)
+                base = s
+                break
+            base = s
+        for scope, (good, total) in cur.items():
+            b_good, b_total = (base["scopes"].get(scope, (0, 0))
+                               if base is not None else (0, 0))
+            d_total = max(total - b_total, 0)
+            d_good = min(max(good - b_good, 0), d_total)
+            errors = d_total - d_good
+            error_rate = errors / d_total if d_total else 0.0
+            budget = 1.0 - pol.objective
+            views[scope].update({
+                "requests_window": d_total,
+                "errors_window": errors,
+                "error_rate": round(error_rate, 6),
+                "burn_rate": round(error_rate / budget, 4),
+                "compliant": error_rate <= budget,
+            })
+        out = {
+            "target_ms": pol.target_ms, "objective": pol.objective,
+            "window_s": pol.window_s,
+            "error_budget": round(1.0 - pol.objective, 6),
+            "fleet": views.pop("_fleet", None),
+            "pools": views,
+        }
+        if extras:
+            out.update(extras)
+        return out
+
+
+# -- cross-process trace merge ------------------------------------------------
+
+def trace_group_key(trace: Dict[str, Any]) -> Optional[str]:
+    """The fleet trace id a finished-trace dict belongs to: its external
+    parent, or its own id when it IS the fleet-level trace."""
+    parent = trace.get("parent")
+    if parent:
+        return str(parent)
+    tid = str(trace.get("trace_id", ""))
+    return tid if tid.startswith("fleet-") else None
+
+
+class FleetTraceCollector:
+    """Supervisor-side store of finished traces from every process in
+    the fleet, deduped by trace id (the heartbeat piggyback re-publishes
+    until the ``trace`` RPC pull acks — the same trace may arrive on
+    both paths). ``export_chrome`` renders ONE chrome-trace file where
+    each real process is a chrome pid and every span's args carry the
+    fleet trace id — a migrated request reads left-to-right across the
+    supervisor row, the prefill replica row, and the decode replica
+    row."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = _named_lock("obs.fleet.FleetTraceCollector._lock")
+        self._traces: "OrderedDict[str, Dict]" = OrderedDict()
+        self._capacity = int(capacity)
+        self._dropped = 0
+
+    def add(self, traces: Sequence[Dict[str, Any]]) -> int:
+        """Ingest finished-trace dicts; returns how many were new."""
+        fresh = 0
+        with self._lock:
+            for t in traces or ():
+                tid = t.get("trace_id")
+                if not tid or tid in self._traces:
+                    continue
+                self._traces[tid] = t
+                fresh += 1
+            while len(self._traces) > self._capacity:
+                self._traces.popitem(last=False)
+                self._dropped += 1
+        return fresh
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            traces = list(self._traces.values())
+        groups = {}
+        pids = set()
+        for t in traces:
+            key = trace_group_key(t)
+            if key is not None:
+                groups.setdefault(key, []).append(t)
+            if t.get("pid"):
+                pids.add(t["pid"])
+        return {"traces": len(traces), "fleet_traces": len(groups),
+                "pids": len(pids), "dropped": self._dropped}
+
+    def merged(self, fleet_id: Optional[str] = None
+               ) -> Dict[str, List[Dict[str, Any]]]:
+        """Traces grouped by fleet trace id (the supervisor's fleet-level
+        trace plus every replica leg parented under it)."""
+        with self._lock:
+            traces = list(self._traces.values())
+        out: Dict[str, List[Dict[str, Any]]] = {}
+        for t in traces:
+            key = trace_group_key(t)
+            if key is None:
+                continue
+            if fleet_id is not None and key != fleet_id:
+                continue
+            out.setdefault(key, []).append(t)
+        return out
+
+    def span_pids(self, fleet_id: str) -> Dict[int, List[str]]:
+        """pid -> span names under one fleet trace — the drill's
+        ≥3-distinct-pids assertion reads straight off this."""
+        out: Dict[int, List[str]] = {}
+        for t in self.merged(fleet_id).get(fleet_id, []):
+            pid = int(t.get("pid") or 0)
+            names = [s["name"] for s in t.get("spans", [])]
+            out.setdefault(pid, []).extend(names)
+        return out
+
+    def chrome_events(self) -> List[Dict]:
+        with self._lock:
+            traces = list(self._traces.values())
+        events: List[Dict] = []
+        named_pids: Dict[int, str] = {}
+        tids: Dict[int, int] = {}
+        for t in traces:
+            fleet = trace_group_key(t)
+            pid = int(t.get("pid") or 0)
+            engine = t.get("engine", "?")
+            kind = t.get("kind", "request")
+            label = "supervisor" if kind == "fleet" else engine
+            if pid not in named_pids:
+                named_pids[pid] = label
+                events.append({"ph": "M", "pid": pid,
+                               "name": "process_name",
+                               "args": {"name": f"{label} (pid {pid})"}})
+            tids[pid] = tids.get(pid, 0) + 1
+            tid = tids[pid]
+            events.append({"ph": "M", "pid": pid, "tid": tid,
+                           "name": "thread_name",
+                           "args": {"name": f"{engine} {t['trace_id']}"}})
+            base_args = {"trace_id": t["trace_id"], "ok": t.get("ok")}
+            if fleet:
+                base_args["fleet"] = fleet
+            for s in t.get("spans", []):
+                events.append({
+                    "ph": "X", "pid": pid, "tid": tid, "name": s["name"],
+                    "ts": s["t0"] * 1e6, "dur": s["dur_us"], "cat": kind,
+                    "args": {**base_args, **s.get("args", {})}})
+            for s in t.get("slots", []):
+                events.append({
+                    "ph": "X", "pid": pid, "tid": tid,
+                    "name": f"slot{s.get('slot')}",
+                    "ts": s["t0"] * 1e6, "dur": s["dur_us"], "cat": "slot",
+                    "args": {**base_args, **s.get("args", {})}})
+        return events
+
+    def export_chrome(self, path: str) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"displayTimeUnit": "ms",
+                       "traceEvents": self.chrome_events()}, f)
+        return path
+
+
+# -- label-aware Prometheus exposition ----------------------------------------
+
+def fleet_prometheus_text(merged: Dict[str, Any],
+                          slo: Optional[Dict[str, Any]] = None) -> str:
+    """Text exposition (0.0.4) of the MERGED fleet feed: every histogram
+    family emits the bucket-wise fleet aggregate unlabeled plus one
+    labeled series per replica (``replica``/``pool``) — the fleet
+    ``_sum``/``_count`` equal the per-replica sums exactly because they
+    were merged bucket-wise from the same snapshots. Merged counter
+    families keep their ``(replica, pool, incarnation, ...)`` labels;
+    the SLO view lands as ``pt_fleet_slo_*`` gauges."""
+    from .exposition import emit_counter_family, emit_histogram
+
+    lines: List[str] = []
+    for fam in sorted(merged.get("histograms", {})):
+        body = merged["histograms"][fam]
+        lines.append(f"# TYPE pt_{fam} histogram")
+        emit_histogram(lines, fam, body["fleet"])
+        for name in sorted(body.get("per_replica", {})):
+            pool = (merged.get("replicas", {}).get(name) or {}).get("pool")
+            emit_histogram(lines, fam, body["per_replica"][name],
+                           labels={"replica": name,
+                                   "pool": str(pool or "-")})
+    for fam in sorted(merged.get("counters", {})):
+        emit_counter_family(lines, fam, merged["counters"][fam])
+    if slo:
+        lines.append("# TYPE pt_fleet_slo gauge")
+        for scope_name, scope in [("fleet", slo.get("fleet"))] + \
+                sorted((slo.get("pools") or {}).items()):
+            if not isinstance(scope, dict):
+                continue
+            labels = {} if scope_name == "fleet" else {"pool": scope_name}
+            for k in ("p95_ms", "p99_ms", "error_rate", "burn_rate",
+                      "requests_window"):
+                v = scope.get(k)
+                if isinstance(v, (int, float)):
+                    lines.append(_sample(f"fleet_slo_{k}", v, labels))
+    totals = merged.get("totals") or {}
+    for k, v in sorted(totals.items()):
+        if isinstance(v, (int, float)):
+            lines.append(_sample(f"fleet_{k}", v, {}))
+    return "\n".join(lines) + "\n"
+
+
+def _sample(name: str, value, labels: Dict[str, str]) -> str:
+    from .exposition import _emit_sample
+
+    lines: List[str] = []
+    _emit_sample(lines, name, value, labels or None)
+    return lines[0] if lines else ""

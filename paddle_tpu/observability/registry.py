@@ -29,7 +29,8 @@ import threading
 import time
 import weakref
 from collections import deque
-from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, \
+    Union
 
 import numpy as np
 
@@ -251,15 +252,47 @@ class CounterFamily:
 
     def snapshot(self) -> Dict[str, Any]:
         """JSON-able view; keys are '|'-joined label tuples for DISPLAY —
-        consumers needing exact labels use ``items()`` (true tuples)."""
+        consumers needing exact labels use ``items()`` (true tuples) or
+        the lossless ``items`` rows carried here (the cross-process merge
+        feed: a '|' inside a label value survives the wire)."""
         with self._lock:
-            rows = {"|".join(k) if k else "total": v
-                    for k, v in self._values.items()}
-        return {"label_names": list(self.label_names), "values": rows}
+            items = list(self._values.items())
+        rows = {"|".join(k) if k else "total": v for k, v in items}
+        return {"label_names": list(self.label_names), "values": rows,
+                "items": [[list(k), v] for k, v in items]}
 
     def items(self):
         with self._lock:
             return list(self._values.items())
+
+    def merge(self, other, prefix: Sequence[str] = ()) -> None:
+        """Label-aware merge: add every row of ``other`` into this family
+        with ``prefix`` labels PREPENDED — the fleet-merge shape (a
+        replica's ``(op,)`` rows land here as ``(replica, pool, op)``).
+
+        ``other`` may be another ``CounterFamily``, an ``items()`` list,
+        or a ``snapshot()`` dict (its lossless ``items`` rows). Counters
+        are add-only, so merging preserves monotonicity as long as each
+        source is itself scraped monotonically. When this family declares
+        ``label_names``, a merged row of the wrong arity is a wiring bug
+        and raises."""
+        if isinstance(other, CounterFamily):
+            rows = other.items()
+        elif isinstance(other, dict):
+            rows = [(tuple(k), v) for k, v in other.get("items", [])]
+        else:
+            rows = [(tuple(k), v) for k, v in other]
+        prefix = tuple(str(p) for p in prefix)
+        want = len(self.label_names) if self.label_names else None
+        with self._lock:
+            for key, val in rows:
+                full = prefix + tuple(str(k) for k in key)
+                if want is not None and len(full) != want:
+                    raise ValueError(
+                        f"counter family {self.name!r}: merged row "
+                        f"{full!r} does not match label schema "
+                        f"{self.label_names}")
+                self._values[full] = self._values.get(full, 0) + val
 
     def reset(self) -> None:
         with self._lock:
@@ -325,15 +358,97 @@ class Histogram:
             cum += c
             buckets[str(le)] = cum
         buckets["+Inf"] = cum + counts[-1]
+        # ``bounds``/``raw``/``sum_exact`` are the merge feed: per-bucket
+        # (non-cumulative) counts plus the unrounded sum, so a fleet-level
+        # merge of replica snapshots reproduces sum/count EXACTLY
         return {"type": "histogram", "buckets": buckets,
                 "sum": round(s, 3), "count": n,
-                "avg": round(s / n, 3) if n else 0.0}
+                "avg": round(s / n, 3) if n else 0.0,
+                "bounds": list(self.bounds), "raw": counts,
+                "sum_exact": s}
+
+    def merge(self, other) -> None:
+        """Add another histogram's observations into this one — the
+        "mergeable across processes" claim made real. ``other`` is a
+        ``Histogram`` or a ``snapshot()`` dict; both carry per-bucket
+        counts over explicit bounds. Bucket-wise addition of per-bucket
+        counts keeps the cumulative view monotonic and sum/count exact;
+        MISMATCHED bucket edges cannot be merged faithfully and raise."""
+        bounds, counts, s, n = _hist_parts(other)
+        if tuple(bounds) != self.bounds:
+            raise ValueError(
+                f"histogram {self.name!r}: cannot merge bucket edges "
+                f"{tuple(bounds)} into {self.bounds}")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += s
+            self._n += n
+
+    @staticmethod
+    def merge_snapshots(snaps: Sequence[Dict[str, Any]]
+                        ) -> Dict[str, Any]:
+        """Merge histogram ``snapshot()`` dicts (e.g. one per replica)
+        into one snapshot-shaped dict without touching any live
+        histogram. All inputs must share bucket edges (mismatch raises
+        ``ValueError``); the merged sum/count is the exact element-wise
+        total of the inputs."""
+        snaps = list(snaps)
+        if not snaps:
+            raise ValueError("merge_snapshots: need at least one snapshot")
+        bounds, counts, s, n = _hist_parts(snaps[0])
+        counts = list(counts)
+        for snap in snaps[1:]:
+            b2, c2, s2, n2 = _hist_parts(snap)
+            if list(b2) != list(bounds):
+                raise ValueError(
+                    f"histogram merge: mismatched bucket edges "
+                    f"{list(b2)} vs {list(bounds)}")
+            for i, c in enumerate(c2):
+                counts[i] += c
+            s += s2
+            n += n2
+        cum, buckets = 0, {}
+        for le, c in zip(bounds, counts):
+            cum += c
+            buckets[str(le)] = cum
+        buckets["+Inf"] = cum + counts[-1]
+        return {"type": "histogram", "buckets": buckets,
+                "sum": round(s, 3), "count": n,
+                "avg": round(s / n, 3) if n else 0.0,
+                "bounds": list(bounds), "raw": counts, "sum_exact": s}
 
     def reset(self) -> None:
         with self._lock:
             self._counts = [0] * (len(self.bounds) + 1)
             self._sum = 0.0
             self._n = 0
+
+
+def _hist_parts(h) -> Tuple[List[float], List[int], float, int]:
+    """(bounds, per-bucket counts incl. +Inf, exact sum, count) from a
+    live ``Histogram`` or a ``snapshot()`` dict. Snapshots without the
+    ``raw`` feed (older dumps) de-cumulate their bucket map."""
+    if isinstance(h, Histogram):
+        with h._lock:
+            return list(h.bounds), list(h._counts), h._sum, h._n
+    if not isinstance(h, dict):
+        raise TypeError(f"expected Histogram or snapshot dict, got "
+                        f"{type(h).__name__}")
+    n = int(h.get("count", 0))
+    s = float(h.get("sum_exact", h.get("sum", 0.0)))
+    if "bounds" in h and "raw" in h:
+        return [float(b) for b in h["bounds"]], \
+            [int(c) for c in h["raw"]], s, n
+    buckets = h.get("buckets", {})
+    bounds = [float(k) for k in buckets if k != "+Inf"]
+    counts, prev = [], 0
+    for b in bounds:
+        cum = int(buckets[str(b)])
+        counts.append(cum - prev)
+        prev = cum
+    counts.append(int(buckets.get("+Inf", prev)) - prev)
+    return bounds, counts, s, n
 
 
 class Hub:

@@ -37,9 +37,9 @@ def _us(t_monotonic: float) -> float:
 
 class _Trace:
     __slots__ = ("trace_id", "engine", "kind", "t0", "spans", "done",
-                 "ok", "meta")
+                 "ok", "meta", "parent")
 
-    def __init__(self, trace_id, engine, kind, t0, meta):
+    def __init__(self, trace_id, engine, kind, t0, meta, parent=None):
         self.trace_id = trace_id
         self.engine = engine
         self.kind = kind
@@ -48,6 +48,7 @@ class _Trace:
         self.done = False
         self.ok: Optional[bool] = None
         self.meta = meta
+        self.parent = parent
 
 
 class RequestTracer:
@@ -64,11 +65,21 @@ class RequestTracer:
 
     # -- recording ------------------------------------------------------------
     def start(self, engine: str, kind: str = "request",
-              t0: Optional[float] = None, **meta) -> str:
-        """Open a trace; returns its ID (carried by the request object)."""
-        trace_id = f"{os.getpid():x}-{next(self._seq):x}"
+              t0: Optional[float] = None, parent: Optional[str] = None,
+              trace_id: Optional[str] = None, **meta) -> str:
+        """Open a trace; returns its ID (carried by the request object).
+
+        ``parent`` is an EXTERNAL trace context (e.g. the supervisor-
+        minted ``fleet-<id>``): this process's spans nest under it when a
+        fleet collector merges traces across processes. ``trace_id``
+        overrides the minted pid-local id — the supervisor uses the fleet
+        context itself as its own trace id, so its routing spans and the
+        replicas' parented spans share one key."""
+        if trace_id is None:
+            trace_id = f"{os.getpid():x}-{next(self._seq):x}"
         tr = _Trace(trace_id, engine, kind,
-                    time.monotonic() if t0 is None else t0, meta)
+                    time.monotonic() if t0 is None else t0, meta,
+                    parent=parent)
         with self._lock:
             self._live[trace_id] = tr
             self._counts["started"] += 1
@@ -122,17 +133,54 @@ class RequestTracer:
             return {**self._counts, "live": len(self._live),
                     "ring": len(self._done), "slot_ring": len(self._slots)}
 
+    @staticmethod
+    def _export(tr: "_Trace", slots: Optional[List[Dict]] = None) -> Dict:
+        out = {"trace_id": tr.trace_id, "engine": tr.engine,
+               "kind": tr.kind, "ok": tr.ok, "meta": dict(tr.meta),
+               "parent": tr.parent, "pid": os.getpid(),
+               "spans": [dict(s) for s in tr.spans]}
+        if slots is not None:
+            out["slots"] = slots
+        return out
+
     def traces(self, engine: Optional[str] = None) -> List[Dict]:
         """Finished traces (oldest first), JSON-able."""
         with self._lock:
             done = list(self._done)
-        out = []
-        for tr in done:
-            if engine is not None and tr.engine != engine:
-                continue
-            out.append({"trace_id": tr.trace_id, "engine": tr.engine,
-                        "kind": tr.kind, "ok": tr.ok, "meta": dict(tr.meta),
-                        "spans": [dict(s) for s in tr.spans]})
+        return [self._export(tr) for tr in done
+                if engine is None or tr.engine == engine]
+
+    def drain_finished(self, max_n: int = 64,
+                       require_parent: bool = False,
+                       prefix: Optional[str] = None) -> List[Dict]:
+        """Pop up to ``max_n`` finished traces (oldest first) as JSON-able
+        dicts — the fleet-collector pull: a drained trace leaves the
+        local ring, so the supervisor's merged store owns it from here.
+        ``require_parent`` selects only externally-parented traces (a
+        replica ships fleet requests, never its local-only work);
+        ``prefix`` selects on the trace id (the supervisor drains its own
+        ``fleet-*`` traces). Matching slot-residency spans ride along
+        inside each trace dict (they nest under the fleet trace too)."""
+        with self._lock:
+            keep, out = deque(maxlen=self._done.maxlen), []
+            slots_by_trace: Dict[str, List[Dict]] = {}
+            for s in self._slots:
+                tid = s.get("trace_id")
+                if tid is not None:
+                    slots_by_trace.setdefault(tid, []).append(dict(s))
+            for tr in self._done:
+                wanted = len(out) < max_n
+                if wanted and require_parent and tr.parent is None:
+                    wanted = False
+                if wanted and prefix is not None and \
+                        not tr.trace_id.startswith(prefix):
+                    wanted = False
+                if wanted:
+                    out.append(self._export(
+                        tr, slots=slots_by_trace.get(tr.trace_id, [])))
+                else:
+                    keep.append(tr)
+            self._done = keep
         return out
 
     def chrome_events(self) -> List[Dict]:
@@ -166,6 +214,7 @@ class RequestTracer:
                     "ts": _us(s["t0"]), "dur": s["dur_us"],
                     "cat": tr.kind,
                     "args": {"trace_id": tr.trace_id, "ok": tr.ok,
+                             **({"parent": tr.parent} if tr.parent else {}),
                              **s["args"]},
                 })
         for s in slots:

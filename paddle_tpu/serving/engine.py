@@ -317,8 +317,8 @@ class ServingEngine(EngineBase):
         return self
 
     # -- submission -----------------------------------------------------------
-    def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None
-               ) -> "Future":
+    def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None,
+               trace_parent: Optional[str] = None) -> "Future":
         """Enqueue one request (per-sample arrays, no batch dim); returns a
         future resolving to the per-sample outputs (batch dim stripped).
 
@@ -344,6 +344,7 @@ class ServingEngine(EngineBase):
         # admission span is the validation/enqueue work just done
         tr = _tracer()
         req.trace = tr.start(self.name, kind="serve",
+                             parent=trace_parent,
                              deadline_ms=deadline_ms)
         tr.span(req.trace, "admission", t_submit, time.monotonic())
         try:

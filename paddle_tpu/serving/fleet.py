@@ -70,7 +70,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from .base import (BadRequest, DeadlineExceeded, EngineClosed, QueueFull,
-                   ReplicaFault, RequestCancelled)
+                   ReplicaFault, RequestCancelled, _tracer)
 from .kv_transfer import (FleetKVCache, KVMigrationStats,
                           prompt_cache_key)
 from .metrics import MetricsRegistry
@@ -166,6 +166,15 @@ class ServingFleetPolicy:
     brownout_clamp_tokens: int = 8
     interactive_deadline_ms: float = 2000.0
     brownout_keep_priority: int = 1    # stage 3 sheds priority < this
+    # fleet observability plane (docs/observability.md "Fleet plane"):
+    # the collector thread scrapes each replica's hub snapshot +
+    # finished traces every telemetry_interval_s; the SLO layer derives
+    # burn rate from the merged request-latency histograms against
+    # target_ms at the given objective over a sliding window
+    telemetry_interval_s: float = 2.0
+    slo_target_ms: float = 1000.0
+    slo_objective: float = 0.99
+    slo_window_s: float = 60.0
 
     def fleet_policy(self):
         """The FleetStateMachine view of these knobs."""
@@ -307,6 +316,15 @@ class _ReplicaServer:
         self._kv_handle = 0
         self._kv_out: Dict[int, List[Dict[str, Any]]] = {}
         self._kv_in: Dict[int, Dict[str, Any]] = {}
+        # fleet trace flush: finished fleet-parented traces buffered
+        # here, published opportunistically on heartbeat frames (crash-
+        # adjacent spans survive to the supervisor) and drained by the
+        # `trace` RPC pull. The supervisor dedups by trace id, so both
+        # delivery paths may overlap safely.
+        self._pending_traces: List[Dict[str, Any]] = []
+        self._trace_seq = 0        # bumps when new traces arrive
+        self._trace_pub_seq = -1   # last seq published on a beat
+        self._takes_trace: Optional[bool] = None  # engine.submit kwarg?
 
     # -- outbound (called from engine worker threads) -------------------------
     def _post(self, conn, frame: Dict[str, Any]) -> None:
@@ -349,11 +367,34 @@ class _ReplicaServer:
 
         _publish(self._store, self._key(leaf), value)
 
+    def _drain_traces(self) -> None:
+        """Move finished fleet-parented traces from the process tracer
+        into the bounded publish buffer (oldest dropped past 256)."""
+        try:
+            got = _tracer().drain_finished(max_n=64, require_parent=True)
+        except Exception:
+            return
+        if got:
+            self._pending_traces.extend(got)
+            if len(self._pending_traces) > 256:
+                del self._pending_traces[:len(self._pending_traces) - 256]
+            self._trace_seq += 1
+
     def _beat(self, now: float) -> None:
         if self._store is None or self._hung:
             return
         try:
             self._publish("beat", {"ts": now, "seq": self._seq})
+            # piggyback: a bounded batch of finished traces rides each
+            # beat WITHOUT clearing the buffer (a crash between beats
+            # loses nothing already published; the RPC pull clears)
+            self._drain_traces()
+            if self._pending_traces and \
+                    self._trace_seq != self._trace_pub_seq:
+                self._publish("traces", {"seq": self._trace_seq,
+                                         "traces":
+                                         self._pending_traces[-16:]})
+                self._trace_pub_seq = self._trace_seq
             self._store_failures = 0
         except Exception:
             # a dead control plane means nobody will fence or restart
@@ -464,6 +505,25 @@ class _ReplicaServer:
                 self._post(conn, {"rid": rid, "event": "error",
                                   "kind": type(e).__name__,
                                   "msg": str(e)[:300]})
+        elif op == "telemetry":
+            # the fleet scrape: this replica's full observability-hub
+            # snapshot (histograms carry exact sums/raw buckets for the
+            # supervisor's bucket-wise merge) + our pid
+            try:
+                from ..observability import snapshot as _hub_snapshot
+
+                snap = _hub_snapshot()
+            except Exception as e:
+                snap = {"error": str(e)[:200]}
+            self._post(conn, {"rid": rid, "event": "reply",
+                              "telemetry": snap, "pid": os.getpid()})
+        elif op == "trace":
+            # the collector pull: everything pending, buffer cleared
+            # (the beat piggyback republishes only NEW arrivals)
+            self._drain_traces()
+            batch, self._pending_traces = self._pending_traces, []
+            self._post(conn, {"rid": rid, "event": "reply",
+                              "traces": batch, "pid": os.getpid()})
         elif op == "kv_export":
             self._kv_export(conn, rid, msg)
         elif op == "kv_chunk":
@@ -548,6 +608,12 @@ class _ReplicaServer:
         else:
             kw["on_token"] = lambda t, _p=post, _r=rid: _p(
                 {"rid": _r, "event": "token", "t": int(t)})
+        trace = msg.get("trace")
+        if trace and self._engine_takes_trace():
+            # the fleet trace context: this request's engine spans
+            # (admission/queue/prefill/decode, slot residency) nest
+            # under the supervisor-minted fleet-<id>
+            kw["trace_parent"] = str(trace)
         try:
             fut = self.engine.submit(
                 np.asarray(msg["prompt"], dtype=np.int64),
@@ -559,6 +625,19 @@ class _ReplicaServer:
             return
         self._futs[rid] = fut
         fut.add_done_callback(partial(self._req_done, rid, post))
+
+    def _engine_takes_trace(self) -> bool:
+        """Does this engine's submit() accept ``trace_parent``? Checked
+        once — a custom builder with a narrow signature keeps working."""
+        if self._takes_trace is None:
+            try:
+                import inspect
+
+                self._takes_trace = "trace_parent" in \
+                    inspect.signature(self.engine.submit).parameters
+            except (TypeError, ValueError):
+                self._takes_trace = False
+        return self._takes_trace
 
     def _req_done(self, rid, post, fut) -> None:
         self._futs.pop(rid, None)
@@ -607,6 +686,7 @@ class _ReplicaServer:
     def _kv_export(self, conn, rid, msg) -> None:
         from .kv_transfer import chunk_blob, pack_kv_pages  # lazy
 
+        t0 = time.monotonic()
         try:
             npages, k_st, v_st = self.engine.export_kv_pages(
                 np.asarray(msg["prompt"], dtype=np.int64))
@@ -624,6 +704,19 @@ class _ReplicaServer:
         self._kv_out[handle] = chunks
         while len(self._kv_out) > 8:     # bounded staging, oldest out
             self._kv_out.pop(min(self._kv_out))
+        if msg.get("trace"):
+            # the export work, visible from THIS pid in the merged
+            # fleet trace (the supervisor records the wire span)
+            try:
+                tr = _tracer()
+                tid = tr.start(self.name, kind="kv_export",
+                               parent=str(msg["trace"]), t0=t0)
+                tr.span(tid, "kv_pack", t0, time.monotonic(),
+                        npages=int(npages), chunks=len(chunks),
+                        wire_bytes=int(meta.get("wire_bytes", 0)))
+                tr.finish(tid, ok=True)
+            except Exception:
+                pass
         reply = {"rid": rid, "event": "reply", "handle": handle,
                  "nchunks": len(chunks), "manifest": manifest}
         reply.update(meta)
@@ -648,7 +741,8 @@ class _ReplicaServer:
         self._kv_in[handle] = {
             "prompt": [int(x) for x in msg["prompt"]],
             "manifest": msg["manifest"], "digest": msg.get("digest"),
-            "nchunks": int(msg["nchunks"]), "chunks": {}}
+            "nchunks": int(msg["nchunks"]), "chunks": {},
+            "trace": msg.get("trace"), "t0": time.monotonic()}
         while len(self._kv_in) > 8:
             self._kv_in.pop(min(self._kv_in))
         self._post(conn, {"rid": rid, "event": "reply",
@@ -700,6 +794,18 @@ class _ReplicaServer:
                               "kind": type(e).__name__,
                               "msg": str(e)[:300]})
             return
+        if st.get("trace"):
+            try:
+                tr = _tracer()
+                tb = float(st.get("t0") or t0)
+                tid = tr.start(self.name, kind="kv_install",
+                               parent=str(st["trace"]), t0=tb)
+                tr.span(tid, "kv_install", tb, time.monotonic(),
+                        installed=int(installed),
+                        nchunks=int(st["nchunks"]))
+                tr.finish(tid, ok=True)
+            except Exception:
+                pass
         self._post(conn, {"rid": rid, "event": "reply",
                           "installed": int(installed),
                           "ms": round((time.monotonic() - t0) * 1e3, 3)})
@@ -723,6 +829,19 @@ class _ReplicaServer:
             poll_interval=float(msg.get("poll_s", 0.25)))
         sub.start()
         self._subscriber = sub
+        if msg.get("trace"):
+            # weight-push frames carry the fleet ops context too: the
+            # subscribe lands as a marker span from this pid
+            try:
+                tr = _tracer()
+                t0 = time.monotonic()
+                tid = tr.start(self.name, kind="weights",
+                               parent=str(msg["trace"]), t0=t0)
+                tr.span(tid, "subscribe", t0, time.monotonic(),
+                        host=host, port=port)
+                tr.finish(tid, ok=True)
+            except Exception:
+                pass
 
 
 def replica_main() -> int:
@@ -918,7 +1037,8 @@ class ReplicaClient:
     # -- engine-shaped surface ------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               on_token=None, return_logprobs: bool = False) -> Future:
+               on_token=None, return_logprobs: bool = False,
+               trace_parent: Optional[str] = None) -> Future:
         # client-side validation: a malformed REQUEST raises here — the
         # replica stays healthy and must not be fenced for it
         prompt = np.asarray(prompt_ids)
@@ -943,6 +1063,8 @@ class ReplicaClient:
                "deadline_ms": deadline_ms}
         if return_logprobs:
             msg["logprobs"] = True
+        if trace_parent:
+            msg["trace"] = str(trace_parent)
         try:
             self._send(msg)
         except Exception:
@@ -1005,11 +1127,24 @@ class ReplicaClient:
             return -1
 
     def subscribe_weights(self, host: str, port: int,
-                          poll_interval: float = 0.25) -> None:
+                          poll_interval: float = 0.25,
+                          trace: Optional[str] = None) -> None:
         """Point the replica at a WeightPublisher endpoint; it pulls
         and applies new versions in place via engine.swap_weights()."""
+        kw: Dict[str, Any] = {}
+        if trace:
+            kw["trace"] = str(trace)
         self._rpc("subscribe_weights", host=str(host), port=int(port),
-                  poll_s=float(poll_interval), timeout=10)
+                  poll_s=float(poll_interval), timeout=10, **kw)
+
+    def telemetry(self) -> Dict[str, Any]:
+        """This replica's full observability-hub snapshot + its pid —
+        the fleet telemetry scrape input."""
+        return self._rpc("telemetry", timeout=10)
+
+    def pull_traces(self) -> List[Dict[str, Any]]:
+        """Drain the replica's finished fleet-parented traces."""
+        return list(self._rpc("trace", timeout=10).get("traces") or [])
 
     def stats(self) -> Dict[str, Any]:
         return self._rpc("stats").get("stats", {})
@@ -1019,7 +1154,8 @@ class ReplicaClient:
 
     # -- kv page migration ----------------------------------------------------
     def kv_export(self, prompt_ids, quantize: bool = False,
-                  chunk_bytes: int = 1 << 20) -> Dict[str, Any]:
+                  chunk_bytes: int = 1 << 20,
+                  trace: Optional[str] = None) -> Dict[str, Any]:
         """Pull the packed KV pages backing ``prompt_ids`` from this
         replica's prefix cache: a head RPC stages the blob replica-side,
         then each chunk is pulled and digest-verified (one resend per
@@ -1029,9 +1165,12 @@ class ReplicaClient:
         import hashlib
 
         prompt = [int(x) for x in np.asarray(prompt_ids).reshape(-1)]
+        kw: Dict[str, Any] = {}
+        if trace:
+            kw["trace"] = str(trace)
         head = self._rpc("kv_export", prompt=prompt,
                          quantize=bool(quantize),
-                         chunk_bytes=int(chunk_bytes))
+                         chunk_bytes=int(chunk_bytes), **kw)
         parts: List[bytes] = []
         for i in range(int(head["nchunks"])):
             raw = None
@@ -1054,10 +1193,12 @@ class ReplicaClient:
                 "npages": int(head["npages"]),
                 "wire_bytes": int(head["wire_bytes"]),
                 "fp32_bytes": int(head["fp32_bytes"]),
-                "quantized": bool(head["quantized"])}
+                "quantized": bool(head["quantized"]),
+                "chunks": int(head["nchunks"])}
 
     def kv_install(self, payload: Dict[str, Any],
-                   chunk_bytes: int = 1 << 20) -> Dict[str, Any]:
+                   chunk_bytes: int = 1 << 20,
+                   trace: Optional[str] = None) -> Dict[str, Any]:
         """Ship a ``kv_export`` payload into this replica's paged pool
         (begin -> digest-verified chunks, one resend each -> commit:
         the replica assembles, dequantizes if needed, writes the pages
@@ -1066,9 +1207,13 @@ class ReplicaClient:
         from .kv_transfer import chunk_blob  # lazy
 
         chunks = chunk_blob(payload["data"], int(chunk_bytes))
+        kw: Dict[str, Any] = {}
+        if trace:
+            kw["trace"] = str(trace)
         head = self._rpc("kv_install_begin", prompt=payload["prompt"],
                          manifest=payload["manifest"],
-                         digest=payload["digest"], nchunks=len(chunks))
+                         digest=payload["digest"], nchunks=len(chunks),
+                         **kw)
         for ch in chunks:
             for attempt in range(2):
                 try:
@@ -1145,7 +1290,7 @@ class FleetRequest:
                  "tenant", "priority", "future", "emitted", "on_token",
                  "primary", "hedge", "replays", "t_submit", "done",
                  "stream_lock", "delivered", "want_lp", "emitted_lp",
-                 "weight_version", "kv_payload")
+                 "weight_version", "kv_payload", "trace")
 
     def __init__(self, rid: int, prompt: List[int], max_new: int,
                  deadline_ms: Optional[float], tenant: str, priority: int,
@@ -1169,6 +1314,11 @@ class FleetRequest:
         # the shipped KV payload (pool mode): retained so failover can
         # re-install pages on a survivor instead of re-prefilling
         self.kv_payload: Optional[Dict[str, Any]] = None
+        # the fleet-level trace context (``fleet-<pid>-<rid>``): ONE id
+        # for this request's whole cross-process life — the supervisor
+        # records its routing/wire spans under it and every frame RPC
+        # carries it so replica-side spans nest under the same key
+        self.trace: Optional[str] = None
         self.on_token = on_token
         self.primary: Optional[_Assignment] = None
         self.hedge: Optional[_Assignment] = None
@@ -1183,6 +1333,27 @@ class FleetRequest:
         self.stream_lock = _named_lock(
             "serving.fleet.FleetRequest.stream_lock")
         self.delivered = 0
+
+
+_TRACE_KW: Dict[type, bool] = {}
+
+
+def _takes_trace_kw(client) -> bool:
+    """Does this client's submit() accept ``trace_parent``? Cached per
+    type — ReplicaClient always does; the test seam's engine-shaped
+    stubs keep their narrow signatures (the ``return_logprobs`` rule)."""
+    cls = type(client)
+    ok = _TRACE_KW.get(cls)
+    if ok is None:
+        try:
+            import inspect
+
+            ok = "trace_parent" in \
+                inspect.signature(cls.submit).parameters
+        except (TypeError, ValueError, AttributeError):
+            ok = False
+        _TRACE_KW[cls] = ok
+    return ok
 
 
 class _ReplicaHandle:
@@ -1247,7 +1418,8 @@ class ServingFleet:
                  pools: Optional[Dict[str, Sequence[str]]] = None,
                  kv_transit: str = "fp32",
                  kv_cache_bytes: int = 256 << 20,
-                 min_ship_tokens: int = 8):
+                 min_ship_tokens: int = 8,
+                 prom_path: Optional[str] = None):
         from ..distributed.fleet.runtime import FleetStateMachine
 
         if replicas is None and not builder:
@@ -1325,6 +1497,31 @@ class ServingFleet:
         # (re-sent to every respawned replica) + in-process subscribers
         self._weights_endpoint: Optional[Tuple[str, int, float]] = None
         self._local_subs: Dict[str, Any] = {}
+        # fleet observability plane: the collector thread scrapes each
+        # replica's hub snapshot + finished traces, merges histograms
+        # bucket-wise, and derives the SLO signals (docs/observability.md
+        # "Fleet plane"). All merged state lives behind _tele_lock —
+        # never held across an RPC.
+        from ..observability.fleet import (FleetTraceCollector, SloPolicy,
+                                          SloTracker)
+        from ..analysis.lockdep import lock as _named_lock
+
+        self._tele_lock = _named_lock(
+            "serving.fleet.ServingFleet._tele_lock")
+        self._fleet_tele: Dict[str, Any] = {}
+        self._slo_snap: Dict[str, Any] = {}
+        self._slo = SloTracker(SloPolicy(
+            target_ms=self.policy.slo_target_ms,
+            objective=self.policy.slo_objective,
+            window_s=self.policy.slo_window_s))
+        self.traces = FleetTraceCollector()
+        self._trace_batch_seen: Dict[Tuple[int, int], Any] = {}
+        self._scrapes = 0
+        self._collector: Optional[threading.Thread] = None
+        if prom_path is None and log_dir:
+            prom_path = os.path.join(log_dir, "fleet_metrics.prom")
+        self.prom_path = prom_path
+        self._prom_last = ""
         self._register_provider()
 
     # -- provider -------------------------------------------------------------
@@ -1334,6 +1531,12 @@ class ServingFleet:
 
             register_provider("serving_fleet", self.provider_snapshot)
             register_provider("kv_migration", self.kv_migration_snapshot)
+            # the fleet plane: merged telemetry + SLO signals (reads of
+            # collector-owned state only — no RPC inside a provider)
+            register_provider("fleet_telemetry",
+                              self.fleet_telemetry_snapshot)
+            register_provider("slo", self.slo_snapshot)
+            register_provider("fleet_trace", self.traces.snapshot)
         except Exception:
             pass
 
@@ -1429,6 +1632,10 @@ class ServingFleet:
             target=self._dispatch_loop,
             name=f"pt-fleet-dispatch-{self.name}", daemon=True)
         self._dispatcher.start()
+        self._collector = threading.Thread(
+            target=self._telemetry_loop,
+            name=f"pt-fleet-telemetry-{self.name}", daemon=True)
+        self._collector.start()
         if wait_ready and not self._external:
             self.wait_ready(timeout=timeout
                             or self.policy.start_timeout_s)
@@ -1466,7 +1673,7 @@ class ServingFleet:
             self._requests.clear()
             self._unplaced.clear()
             self._migrations.clear()
-        for th in (self._monitor, self._dispatcher):
+        for th in (self._monitor, self._dispatcher, self._collector):
             if th is not None:
                 th.join(timeout=5)
         for sub in list(self._local_subs.values()):
@@ -1513,6 +1720,9 @@ class ServingFleet:
         for req in live:
             if not req.future.done():
                 req.future.set_exception(EngineClosed("fleet closed"))
+            # close the fleet trace too — an unfinished trace would pin
+            # the tracer's live table forever
+            self._finish_trace(req, ok=False, error="EngineClosed")
 
     # -- spawning -------------------------------------------------------------
     def _spawn(self, h: _ReplicaHandle) -> None:
@@ -1520,7 +1730,7 @@ class ServingFleet:
         keys, fresh log). The worker publishes its RPC port only after
         ``engine.warmup()`` — readiness means warmed buckets."""
         h.incarnation += 1
-        for leaf in ("port", "beat"):
+        for leaf in ("port", "beat", "traces"):
             key = f"svfleet/{h.name}/{h.incarnation}/{leaf}"
             self._store.delete_key(key)
             self._store.delete_key(f"{key}/published")
@@ -1667,6 +1877,20 @@ class ServingFleet:
                 continue
             self._beat_payload[h.idx] = ts
             self.sm.heartbeat(h.idx, now)
+            # beat-piggybacked trace batches (the crash-adjacent flush
+            # path): probed only when the beat advanced — bounded store
+            # traffic — and deduped per (replica, incarnation) on the
+            # batch seq; the collector dedups again by trace id
+            tb = _probe_json(
+                self._store, f"svfleet/{h.name}/{h.incarnation}/traces")
+            if tb and tb.get("seq") != \
+                    self._trace_batch_seen.get((h.idx, h.incarnation)):
+                self._trace_batch_seen[(h.idx, h.incarnation)] = \
+                    tb.get("seq")
+                try:
+                    self.traces.add(tb.get("traces") or [])
+                except Exception:
+                    pass
 
     # -- fence + restart ------------------------------------------------------
     def _fence(self, h: _ReplicaHandle, cause: str,
@@ -1825,6 +2049,7 @@ class ServingFleet:
 
     def _assignment_completed(self, asg: _Assignment, res) -> None:
         cancel_target: Optional[Tuple[Any, Future]] = None
+        loser: Optional[_Assignment] = None
         if isinstance(res, tuple):  # (seq, behavior logprobs)
             seq, seq_lp = res
         else:
@@ -1880,6 +2105,7 @@ class ServingFleet:
                             owner.client is not None and \
                             hasattr(owner.client, "cancel"):
                         cancel_target = (owner.client, other.fut)
+                    loser = other
                     self._inc("hedge_cancelled")
                 if asg.hedge:
                     self._inc("hedge_wins")
@@ -1891,12 +2117,19 @@ class ServingFleet:
         if handoff:
             self._inc("prefill_handoffs")
             return
+        if loser is not None:
+            # the hedge loser's leg, marked cancelled under the SAME
+            # fleet id — a sibling of the winner's route span
+            self._trace_span(req, "hedge_loser", loser.t_dispatch,
+                             replica=loser.replica, cancelled=True,
+                             hedge=loser.hedge)
         if cancel_target is not None:
             try:
                 cancel_target[0].cancel(cancel_target[1])
             except Exception:
                 pass
         self._set_result(req)
+        self._finish_trace(req, ok=True)
         self.metrics.observe_latency(
             (time.monotonic() - req.t_submit) * 1e3)
         self.metrics.mark_done()
@@ -1955,6 +2188,27 @@ class ServingFleet:
                 return h
         return None
 
+    # -- fleet trace helpers (always best-effort: tracing must never
+    # fail a dispatch) ---------------------------------------------------------
+    def _trace_span(self, req: FleetRequest, name: str, t0: float,
+                    t1: Optional[float] = None, **args) -> None:
+        if req.trace is None:
+            return
+        try:
+            _tracer().span(req.trace, name, t0,
+                           time.monotonic() if t1 is None else t1, **args)
+        except Exception:
+            pass
+
+    def _finish_trace(self, req: FleetRequest, ok: bool, **meta) -> None:
+        if req.trace is None:
+            return
+        try:
+            _tracer().finish(req.trace, ok=ok, replays=req.replays,
+                             emitted=len(req.emitted), **meta)
+        except Exception:
+            pass
+
     def _fail_request(self, req: FleetRequest, exc: Exception) -> None:
         with self._lock:
             if req.done:
@@ -1962,6 +2216,7 @@ class ServingFleet:
             self._finish_locked(req)
         if not req.future.done():
             req.future.set_exception(exc)
+        self._finish_trace(req, ok=False, error=type(exc).__name__)
         self._inc("failed")
 
     def _finish_locked(self, req: FleetRequest) -> None:
@@ -2002,13 +2257,23 @@ class ServingFleet:
                 # primary must land elsewhere (one assignment per
                 # replica per request — the inflight map's key)
                 exclude.add(req.hedge.replica)
+        t_r = time.monotonic()
         if ledger_done:
+            # every token was already streamed: no replica span exists
+            # for this leg — only the supervisor's completion marker
+            self._trace_span(req, "replayed_complete", t_r, t_r,
+                             source=dead.replica if dead else None)
             self._deliver_stream(req)  # any undelivered ledger tail
             self._set_result(req)
+            self._finish_trace(req, ok=True, replayed_complete=True)
             self._inc("completed")
             self._inc("replayed_complete")
             return
         prefer = self._ship_failover(req, exclude) if count else None
+        self._trace_span(req, "replay", t_r,
+                         attempt=req.replays,
+                         source=dead.replica if dead else None,
+                         shipped=prefer is not None, counted=count)
         if prefer is not None:
             ok = self._dispatch(req, exclude=exclude, pool="decode",
                                 prefer=prefer)
@@ -2035,10 +2300,17 @@ class ServingFleet:
             return None
         pool = "decode" if self._pools_enabled else None
         for h, client in self._candidates(exclude=exclude, pool=pool):
+            t_w0 = time.monotonic()
             try:
-                rep = self._kv_push(client, payload)
+                rep = self._kv_push(client, payload, trace=req.trace)
             except Exception:
                 continue
+            self._trace_span(req, "wire_transfer", t_w0, dst=h.name,
+                             bytes=int(payload["wire_bytes"]),
+                             pages=int(payload["npages"]),
+                             chunks=int(payload.get("chunks", 1)),
+                             quantized=bool(payload["quantized"]),
+                             failover=True)
             self._kv_stats.note_failover(ship=True)
             self._kv_stats.note_ship(
                 payload["npages"], payload["wire_bytes"],
@@ -2061,13 +2333,18 @@ class ServingFleet:
                     continue
             self._migrate_and_continue(req, src)
 
-    def _kv_pull(self, client, prompt: List[int],
-                 quantize: bool) -> Dict[str, Any]:
+    def _kv_pull(self, client, prompt: List[int], quantize: bool,
+                 trace: Optional[str] = None) -> Dict[str, Any]:
         """Export the packed pages for ``prompt`` from a replica: the
         chunked RPC on process replicas, a direct pack through the
-        in-process seam."""
+        in-process seam. ``trace`` carries the fleet trace context over
+        the frame — the replica records its pack span under it."""
         if hasattr(client, "kv_export"):
-            return client.kv_export(prompt, quantize=quantize)
+            try:
+                return client.kv_export(prompt, quantize=quantize,
+                                        trace=trace)
+            except TypeError:
+                return client.kv_export(prompt, quantize=quantize)
         from .kv_transfer import pack_kv_pages  # lazy
 
         _n, k_st, v_st = client.export_kv_pages(
@@ -2079,11 +2356,16 @@ class ServingFleet:
                 "data": blob, "npages": int(meta["npages"]),
                 "wire_bytes": int(meta["wire_bytes"]),
                 "fp32_bytes": int(meta["fp32_bytes"]),
-                "quantized": bool(meta["quantized"])}
+                "quantized": bool(meta["quantized"]),
+                "chunks": 1}
 
-    def _kv_push(self, client, payload: Dict[str, Any]) -> Dict[str, Any]:
+    def _kv_push(self, client, payload: Dict[str, Any],
+                 trace: Optional[str] = None) -> Dict[str, Any]:
         if hasattr(client, "kv_install"):
-            return client.kv_install(payload)
+            try:
+                return client.kv_install(payload, trace=trace)
+            except TypeError:
+                return client.kv_install(payload)
         from .kv_transfer import unpack_kv_pages  # lazy
 
         t0 = time.monotonic()
@@ -2103,28 +2385,38 @@ class ServingFleet:
         ``prompt + first token`` and the stream stays bit-identical,
         just slower."""
         quantize = self.kv_transit == "int8"
+        t_w0 = time.monotonic()   # the wire-transfer span: pull -> push
+        reason = None             # why the migration fell back (if it did)
+        warm = False
         key = prompt_cache_key(req.prompt, 1)  # whole-prompt identity
         payload = self._kv_cache.get(key) if key is not None else None
         if payload is not None:
+            warm = True
             self._kv_stats.note_warm_hit()
         else:
             with self._lock:
                 h = self._handle_by_name(src)
                 client = h.client if h is not None and \
                     h.state is ReplicaState.READY else None
-            if client is not None:
+            if client is None:
+                reason = "no_source"
+            else:
                 try:
                     payload = self._kv_pull(
-                        client, list(req.prompt), quantize)
+                        client, list(req.prompt), quantize,
+                        trace=req.trace)
                     self._kv_stats.note_export()
                     if key is not None:
                         self._kv_cache.put(key, payload)
                 except Exception:
                     payload = None
+                    reason = "export_failed"
         prefer = None
         if payload is not None:
             pool = "decode" if self._pools_enabled else None
             cands = self._candidates(exclude={src}, pool=pool)
+            if not cands:
+                reason = "no_candidates"
             parr = np.asarray(req.prompt, dtype=np.int64)
             try:
                 scores, _m = score_candidates(
@@ -2137,10 +2429,20 @@ class ServingFleet:
             for i in order:
                 h, client = cands[i]
                 try:
-                    rep = self._kv_push(client, payload)
+                    rep = self._kv_push(client, payload,
+                                        trace=req.trace)
                 except Exception:
+                    reason = "install_failed"
                     continue
                 prefer = h.name
+                self._trace_span(
+                    req, "wire_transfer", t_w0, src="warm_cache"
+                    if warm else src, dst=h.name,
+                    bytes=int(payload["wire_bytes"]),
+                    pages=int(payload["npages"]),
+                    chunks=int(payload.get("chunks", 1)),
+                    quantized=bool(payload["quantized"]),
+                    install_ms=float(rep.get("ms", 0.0)))
                 self._kv_stats.note_ship(
                     payload["npages"], payload["wire_bytes"],
                     payload["fp32_bytes"], payload["quantized"])
@@ -2150,6 +2452,11 @@ class ServingFleet:
                 self._inc("migrations")
                 break
         if prefer is None:
+            # the fallback re-prefill leg, tagged with WHY the ship
+            # failed — the decode dispatch below re-prefills from the
+            # prompt and the stream stays bit-identical
+            self._trace_span(req, "migrate_fallback", t_w0, src=src,
+                             reason=reason or "no_payload")
             self._kv_stats.note_fallback()
             self._inc("migrate_fallback")
         if not self._dispatch(
@@ -2264,6 +2571,10 @@ class ServingFleet:
                     # only pass the kwarg when asked: the test seam's
                     # engine-shaped stubs keep their narrow signature
                     kw["return_logprobs"] = True
+                if req.trace is not None and _takes_trace_kw(client):
+                    # the fleet trace context rides the submit frame:
+                    # the replica's engine spans nest under fleet-<id>
+                    kw["trace_parent"] = req.trace
                 try:
                     fut = client.submit(
                         parr, remaining, deadline_ms=deadline_ms,
@@ -2285,6 +2596,10 @@ class ServingFleet:
                     progressed = True
                     break
                 asg.fut = fut
+                self._trace_span(req, "route", asg.t_dispatch,
+                                 replica=h.name, stage=stage,
+                                 hedge=hedge, repin=repin,
+                                 prefix_len=len(prefix))
                 wv = self._replica_version(client)  # probe-cached RPC:
                 # outside the lock (CC001)
                 with self._lock:
@@ -2395,11 +2710,22 @@ class ServingFleet:
                                deadline_ms, tenant, priority,
                                on_token=on_token,
                                want_lp=return_logprobs)
+            req.trace = f"fleet-{os.getpid():x}-{req.id:x}"
             self._requests[req.id] = req
             self._inflight_total += 1
             self._tenant_inflight[tenant] = \
                 self._tenant_inflight.get(tenant, 0) + 1
             self._inc("requests")
+        # the supervisor's own trace uses the fleet context AS its id:
+        # its routing/wire spans and every replica's parented spans
+        # share one key in the merged export
+        try:
+            _tracer().start(self.name, kind="fleet", trace_id=req.trace,
+                            t0=req.t_submit, rid=req.id, tenant=tenant,
+                            prompt_len=int(prompt.size),
+                            max_new_tokens=int(clamped))
+        except Exception:
+            pass
         if not self._place(req):
             with self._lock:
                 if not req.done:
@@ -2514,6 +2840,186 @@ class ServingFleet:
                     "name": BROWNOUT_STAGES[self._brownout],
                     "history": list(self._brownout_hist)}
 
+    # -- fleet telemetry + trace collector ------------------------------------
+    # ONE thread (pt-fleet-telemetry-<name>) owns scrape/merge/publish:
+    # per-replica RPCs run with NO fleet lock held (CC001 — a wedged
+    # replica costs one probe timeout, never a provider stall), the
+    # merged result is swapped in under _tele_lock, and the Prometheus
+    # file is rewritten only when its text changed.
+    def _telemetry_loop(self) -> None:
+        last = 0.0
+        while not self._closed:
+            now = time.time()
+            if now - last >= self.policy.telemetry_interval_s:
+                try:
+                    self._scrape_once(now)
+                except Exception:
+                    pass  # the feed must outlive any single bad scrape
+                last = now
+            time.sleep(self.policy.poll_interval)
+
+    def _collect_local_traces(self) -> None:
+        """Finished traces born in THIS process: the supervisor's own
+        fleet traces plus (in-process seam) engine traces parented under
+        them — both land in the collector exactly like a process
+        replica's pulled batch."""
+        try:
+            tr = _tracer()
+            self.traces.add(tr.drain_finished(max_n=256,
+                                              prefix="fleet-"))
+            self.traces.add(tr.drain_finished(max_n=256,
+                                              require_parent=True))
+        except Exception:
+            pass
+
+    def _scrape_once(self, now: float) -> None:
+        from ..observability import snapshot as hub_snapshot
+        from ..observability.fleet import merge_replica_telemetry
+
+        with self._lock:  # capture targets only; RPCs run below, unlocked
+            beats = dict(self.sm._beats)
+            targets = [(h.name, h.pool, h.incarnation, h.state.value,
+                        len(h.inflight), h.idx, h.client, h.external)
+                       for h in self._handles]
+        replicas: Dict[str, Dict[str, Any]] = {}
+        local_hub_done = False
+        for name, pool, inc, state, inflight, idx, client, ext in targets:
+            row: Dict[str, Any] = {
+                "pool": pool, "incarnation": inc, "state": state,
+                "inflight": inflight,
+                "beat_age_s": round(now - beats[idx], 3)
+                if idx in beats else None,
+            }
+            if client is not None and state == "ready":
+                try:
+                    row["queue_depth"] = int(client.queue_depth())
+                    if hasattr(client, "kv_headroom"):
+                        row["kv_headroom"] = float(client.kv_headroom())
+                except Exception:
+                    pass
+                if ext:
+                    # in-process seam: every engine shares THIS
+                    # process's hub — attach ONE snapshot total (to the
+                    # first ready row) or the merge double-counts
+                    if not local_hub_done:
+                        try:
+                            row["snapshot"] = hub_snapshot()
+                            local_hub_done = True
+                        except Exception:
+                            pass
+                else:
+                    try:
+                        rep = client.telemetry()
+                        row["snapshot"] = rep.get("telemetry") or {}
+                    except Exception as e:
+                        row["scrape_error"] = str(e)[:120]
+                    try:
+                        self.traces.add(client.pull_traces())
+                    except Exception:
+                        pass
+            replicas[name] = row
+        self._collect_local_traces()
+        merged = merge_replica_telemetry(replicas)
+        merged["scraped_at"] = now
+        merged["interval_s"] = self.policy.telemetry_interval_s
+        lat = merged.get("histograms", {}).get("request_latency_ms", {})
+        slo = self._slo.update(now, per_pool=lat.get("per_pool") or {},
+                               fleet=lat.get("fleet"),
+                               extras=self._slo_extras(merged))
+        with self._tele_lock:
+            self._scrapes += 1
+            merged["scrapes"] = self._scrapes
+            self._fleet_tele = merged
+            self._slo_snap = slo
+        self._write_prom(merged, slo)
+
+    @staticmethod
+    def _slo_extras(merged: Dict[str, Any]) -> Dict[str, Any]:
+        """Queue-depth / KV-headroom aggregates + TTFT percentiles —
+        the non-latency SLO inputs, all derived from the SAME merged
+        scrape (never supervisor-side sampling)."""
+        from ..observability.fleet import histogram_quantile
+
+        rows = merged.get("replicas", {})
+        qd: Dict[str, int] = {}
+        kv: Dict[str, float] = {}
+        for r in rows.values():
+            p = r.get("pool") or "unpooled"
+            if r.get("queue_depth") is not None:
+                qd[p] = qd.get(p, 0) + int(r["queue_depth"])
+            if r.get("kv_headroom") is not None:
+                kv[p] = min(kv.get(p, 1.0), float(r["kv_headroom"]))
+        ttft: Dict[str, float] = {}
+        tt = merged.get("histograms", {}).get("ttft_ms", {})
+        for scope, snap in [("fleet", tt.get("fleet"))] + \
+                list((tt.get("per_pool") or {}).items()):
+            if snap:
+                try:
+                    ttft[f"{scope}_p95_ms"] = round(
+                        histogram_quantile(snap, 0.95), 3)
+                except Exception:
+                    pass
+        return {"queue_depth": qd,
+                "kv_headroom": {p: round(v, 4) for p, v in kv.items()},
+                "ttft": ttft}
+
+    def _write_prom(self, merged: Dict[str, Any],
+                    slo: Dict[str, Any]) -> None:
+        """The fleet Prometheus endpoint-on-disk (atomic replace; a
+        scraper never reads a torn file)."""
+        path = self.prom_path
+        if not path:
+            return
+        from ..observability.fleet import fleet_prometheus_text
+
+        try:
+            text = fleet_prometheus_text(merged, slo)
+            if text == self._prom_last:
+                return
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(text)
+            os.replace(tmp, path)
+            self._prom_last = text
+        except Exception:
+            pass
+
+    def fleet_telemetry_snapshot(self) -> Dict[str, Any]:
+        """The last merged scrape (the ``fleet_telemetry`` provider)."""
+        with self._tele_lock:
+            return dict(self._fleet_tele)
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """The last SLO evaluation (the ``slo`` provider): per-pool
+        p95/p99 + burn rate from MERGED histograms only."""
+        with self._tele_lock:
+            return dict(self._slo_snap)
+
+    def scrape_now(self) -> Dict[str, Any]:
+        """One synchronous scrape+merge (tests/drills skip the interval
+        wait). Returns the merged fleet telemetry."""
+        self._scrape_once(time.time())
+        return self.fleet_telemetry_snapshot()
+
+    def export_fleet_trace(self, path: str) -> str:
+        """Pull outstanding traces from every replica AND this process,
+        then write ONE merged chrome trace (spans from every pid that
+        touched a fleet request, grouped under the fleet trace ids)."""
+        with self._lock:
+            targets = [h.client for h in self._handles
+                       if not h.external and h.client is not None
+                       and h.state is ReplicaState.READY]
+        for client in targets:
+            try:
+                self.traces.add(client.pull_traces())
+            except Exception:
+                pass
+        self._collect_local_traces()
+        return self.traces.export_chrome(path)
+
     # -- weight distribution (post-training push path) ------------------------
     def subscribe_weights(self, host: str, port: int,
                           poll_interval: float = 0.25) -> None:
@@ -2556,7 +3062,16 @@ class ServingFleet:
                 sub.start()
                 self._local_subs[h.name] = sub
             elif hasattr(client, "subscribe_weights"):
-                client.subscribe_weights(host, port, poll_interval=poll)
+                # weight-push frames carry a fleet ops context too: the
+                # replica's subscribe marker groups under it in the
+                # merged trace
+                try:
+                    client.subscribe_weights(
+                        host, port, poll_interval=poll,
+                        trace=f"fleet-weights-{os.getpid():x}")
+                except TypeError:
+                    client.subscribe_weights(host, port,
+                                             poll_interval=poll)
             else:
                 return
             self._inc("weight_subscribes")

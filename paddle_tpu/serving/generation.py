@@ -489,12 +489,16 @@ class GenerationEngine(EngineBase):
         # hub families: prefix-cache and speculative-decode truth for the
         # process-wide /metrics surface (per-engine labels)
         try:
-            from ..observability import family
+            from ..observability import family, histogram
 
             self._fam_prefix = family("prefix_cache", ("engine", "event"))
             self._fam_spec = family("speculative", ("engine", "event"))
+            # time-to-first-token: observed HERE (the replica knows when
+            # its first token left prefill), so the fleet's SLO layer can
+            # compute TTFT percentiles from merged buckets alone
+            self._hist_ttft = histogram("ttft_ms")
         except Exception:
-            self._fam_prefix = self._fam_spec = None
+            self._fam_prefix = self._fam_spec = self._hist_ttft = None
         # slot-occupancy history: (slot, t0, t1, tokens) per residency —
         # the timeline track behind the pd_top occupancy view and the
         # chrome-trace slots:<engine> process
@@ -582,7 +586,8 @@ class GenerationEngine(EngineBase):
     # -- submission -----------------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                deadline_ms: Optional[float] = None,
-               on_token=None, return_logprobs: bool = False) -> "Future":
+               on_token=None, return_logprobs: bool = False,
+               trace_parent: Optional[str] = None) -> "Future":
         """Queue one prompt (1-D int array). The future resolves to the
         full sequence (prompt + generated) as a 1-D np.int64 array. A
         ``deadline_ms`` bounds QUEUE time: expired requests are shed with
@@ -646,8 +651,12 @@ class GenerationEngine(EngineBase):
                           want_logprobs=return_logprobs)
         req.blocks = token_blocks(req.prompt, self._pl)
         req.total_blocks = needed
+        # ``trace_parent`` is the fleet-minted context carried over the
+        # submit frame: this engine's spans nest under it when the
+        # supervisor's collector merges traces across processes
         tr = _tracer()
         req.trace = tr.start(self.name, kind="generate",
+                             parent=trace_parent,
                              prompt_len=len(prompt),
                              max_new_tokens=int(max_new_tokens),
                              deadline_ms=deadline_ms)
@@ -1086,6 +1095,8 @@ class GenerationEngine(EngineBase):
         t1 = time.monotonic()
         _tracer().span(req.trace, "prefill", t0, t1, bucket=W,
                        prompt_len=p, slot=slot_no, prefix_blocks=m)
+        if self._hist_ttft is not None:
+            self._hist_ttft.observe((t1 - req.t_submit) * 1e3)
         req.t_decode0 = t1
 
         s.req = req

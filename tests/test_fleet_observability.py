@@ -1,0 +1,648 @@
+"""ISSUE 19: the fleet-wide observability plane.
+
+Pure-layer coverage for the merge API (``Histogram.merge`` /
+``merge_snapshots`` exactness + mismatched-edge rejection,
+``CounterFamily.merge`` label prefixing), the quantile/SLO math over
+merged buckets (burn rate, window diffs, restart clamp), the tracer's
+fleet-drain filters, and the supervisor-side ``FleetTraceCollector``
+(dedup, grouping, chrome export). Then the in-process fleet exercises
+the trace-context propagation edge cases the issue names: hedge
+first-wins (loser span cancelled under the same fleet id), failover
+replay (a new child leg), ledger-complete replay (NO re-dispatch span),
+and migrate_fallback (the fallback leg tagged with WHY). The real
+3-process plane is drilled end to end by ``tools/fleet_trace_drill.py``
+(ci.sh gate).
+"""
+import json
+import os
+import time
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability.fleet import (
+    FleetTraceCollector, SloPolicy, SloTracker, fleet_prometheus_text,
+    histogram_quantile, merge_replica_telemetry, trace_group_key,
+)
+from paddle_tpu.observability.registry import CounterFamily, Histogram
+from paddle_tpu.observability.trace import tracer
+from paddle_tpu.serving import ServingFleet, ServingFleetPolicy
+from paddle_tpu.serving.fleet import _ReplicaServer
+from paddle_tpu.serving.metrics import MetricsRegistry
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    """The process tracer is global; fleet tests key off "the one fleet
+    trace in the ring", so each test starts from an empty ring."""
+    tracer().reset()
+    yield
+    tracer().reset()
+
+
+# -- satellite: Histogram.merge / merge_snapshots as first-class API ----------
+
+def test_histogram_merge_exact_sum_count_and_monotonic_buckets():
+    a = Histogram("m", buckets=(1.0, 5.0, 25.0))
+    b = Histogram("m", buckets=(1.0, 5.0, 25.0))
+    for v in (0.5, 3.0, 7.0, 100.0):
+        a.observe(v)
+    for v in (2.0, 2.0, 30.0):
+        b.observe(v)
+    a.merge(b)
+    snap = a.snapshot()
+    assert snap["count"] == 7
+    assert snap["sum_exact"] == pytest.approx(144.5)  # exact, not rounded
+    # cumulative buckets stay monotonic and end at the total count
+    cums = [snap["buckets"][k] for k in ("1.0", "5.0", "25.0", "+Inf")]
+    assert cums == sorted(cums) and cums[-1] == 7
+    assert cums == [1, 4, 5, 7]
+
+
+def test_histogram_merge_snapshots_is_exact_elementwise_total():
+    snaps = []
+    for vals in ((0.1, 9.0), (2.5,), (50.0, 0.2, 0.3)):
+        h = Histogram("m", buckets=(1.0, 10.0))
+        for v in vals:
+            h.observe(v)
+        snaps.append(h.snapshot())
+    merged = Histogram.merge_snapshots(snaps)
+    assert merged["count"] == 6
+    assert merged["sum_exact"] == pytest.approx(0.1 + 9.0 + 2.5 + 50.0
+                                                + 0.2 + 0.3)
+    assert merged["buckets"]["+Inf"] == 6
+    # merging never mutates the inputs
+    assert snaps[0]["count"] == 2
+
+
+def test_histogram_merge_rejects_mismatched_bucket_edges():
+    a = Histogram("m", buckets=(1.0, 5.0))
+    b = Histogram("m", buckets=(1.0, 10.0))
+    with pytest.raises(ValueError, match="bucket edges"):
+        a.merge(b)
+    with pytest.raises(ValueError):
+        Histogram.merge_snapshots([a.snapshot(), b.snapshot()])
+    with pytest.raises(ValueError):
+        Histogram.merge_snapshots([])
+
+
+def test_counter_family_label_aware_merge_with_prefix():
+    src = CounterFamily("ev", ("op",))
+    src.inc(("add",), 2)
+    src.inc(("mul",), 1)
+    dst = CounterFamily("ev", ("replica", "pool", "incarnation", "op"))
+    dst.merge(src, prefix=("r0", "decode", "1"))
+    dst.merge(src.snapshot(), prefix=("r1", "decode", "0"))  # dict form too
+    assert dst.get(("r0", "decode", "1", "add")) == 2
+    assert dst.get(("r1", "decode", "0", "mul")) == 1
+    # a '|' inside a label value survives the snapshot round-trip
+    src2 = CounterFamily("ev", ("op",))
+    src2.inc(("a|b",), 5)
+    dst.merge(src2.snapshot(), prefix=("r2", "decode", "0"))
+    assert dst.get(("r2", "decode", "0", "a|b")) == 5
+    # wrong arity under declared label_names is a wiring bug
+    bad = CounterFamily("ev", ("op", "dtype"))
+    bad.inc(("add", "f32"))
+    with pytest.raises(ValueError):
+        dst.merge(bad, prefix=("r3", "decode", "0"))
+
+
+def test_histogram_quantile_interpolates_merged_buckets():
+    h = Histogram("m", buckets=(10.0, 20.0, 40.0))
+    for v in (5.0,) * 5 + (15.0,) * 4 + (100.0,):
+        h.observe(v)
+    snap = h.snapshot()
+    # p50 target=5 observations -> exactly the first bucket's edge
+    assert histogram_quantile(snap, 0.5) == pytest.approx(10.0)
+    # p90 -> 9th observation: end of the (10, 20] bucket
+    assert histogram_quantile(snap, 0.9) == pytest.approx(20.0)
+    # overflow clamps to the largest finite edge
+    assert histogram_quantile(snap, 1.0) == pytest.approx(40.0)
+    assert histogram_quantile(Histogram("e").snapshot(), 0.95) == 0.0
+
+
+# -- merge_replica_telemetry: the fleet_telemetry provider payload ------------
+
+def _replica_snap(latencies, pid, fam_rows=()):
+    h = Histogram("request_latency_ms", buckets=(1.0, 10.0, 100.0))
+    for v in latencies:
+        h.observe(v)
+    fam = CounterFamily("events", ("kind",))
+    for kind, n in fam_rows:
+        fam.inc((kind,), n)
+    return {"meta": {"pid": pid},
+            "request_latency_ms": h.snapshot(),
+            "events": fam.snapshot()}
+
+
+def test_merge_replica_telemetry_exact_labels_and_bad_edge_isolation():
+    replicas = {
+        "p0": {"snapshot": _replica_snap([0.5, 2.0], 101,
+                                         [("tok", 3)]),
+               "pool": "prefill", "incarnation": 0, "state": "ready",
+               "inflight": 1, "kv_headroom": 0.9},
+        "d0": {"snapshot": _replica_snap([5.0, 50.0, 0.1], 102,
+                                         [("tok", 7)]),
+               "pool": "decode", "incarnation": 2, "state": "ready",
+               "inflight": 0, "kv_headroom": 0.4},
+    }
+    merged = merge_replica_telemetry(replicas)
+    lat = merged["histograms"]["request_latency_ms"]
+    # EXACT: fleet sum/count equal the element-wise per-replica totals
+    assert lat["fleet"]["count"] == 5
+    assert lat["fleet"]["sum_exact"] == pytest.approx(57.6)
+    assert sum(s["count"] for s in lat["per_replica"].values()) == \
+        lat["fleet"]["count"]
+    assert set(lat["per_pool"]) == {"prefill", "decode"}
+    assert lat["per_pool"]["decode"]["count"] == 3
+    # counters re-keyed under (replica, pool, incarnation, ...) labels
+    ev = merged["counters"]["events"]
+    assert ev["label_names"] == ["replica", "pool", "incarnation", "kind"]
+    assert ev["values"]["p0|prefill|0|tok"] == 3
+    assert ev["values"]["d0|decode|2|tok"] == 7
+    # per-replica rows ride along for pd_top --fleet
+    assert merged["replicas"]["p0"]["pid"] == 101
+    assert merged["replicas"]["d0"]["requests"] == 3
+    assert merged["totals"]["replicas"] == 2
+    assert merged["totals"]["kv_headroom_min"] == pytest.approx(0.4)
+    # one replica with foreign bucket edges is skipped + counted, the
+    # feed survives
+    bad = Histogram("request_latency_ms", buckets=(2.0, 4.0))
+    bad.observe(1.0)
+    replicas["x9"] = {"snapshot": {"meta": {"pid": 103},
+                                   "request_latency_ms": bad.snapshot()},
+                      "pool": "decode", "incarnation": 0}
+    merged2 = merge_replica_telemetry(replicas)
+    lat2 = merged2["histograms"]["request_latency_ms"]
+    assert lat2["fleet"]["count"] == 5          # x9 excluded
+    assert "x9" not in lat2["per_replica"]
+    assert any("x9" in e for e in merged2["merge_errors"])
+
+
+# -- SLO signal layer ---------------------------------------------------------
+
+def test_slo_tracker_burn_rate_window_and_restart_clamp():
+    pol = SloPolicy(target_ms=10.0, objective=0.9, window_s=15.0)
+    trk = SloTracker(pol)
+    h = Histogram("lat", buckets=(10.0, 100.0))
+    view = trk.update(0.0, per_pool={}, fleet=h.snapshot())
+    assert view["fleet"]["burn_rate"] == 0.0 and view["fleet"]["compliant"]
+    # 8 good + 2 bad in-window: error_rate 0.2, budget 0.1 -> burn 2.0
+    for _ in range(8):
+        h.observe(1.0)
+    for _ in range(2):
+        h.observe(50.0)
+    view = trk.update(10.0, per_pool={"decode": h.snapshot()},
+                      fleet=h.snapshot(), extras={"queue_depth": {"x": 1}})
+    f = view["fleet"]
+    assert f["requests_window"] == 10 and f["errors_window"] == 2
+    assert f["burn_rate"] == pytest.approx(2.0)
+    assert not f["compliant"]
+    assert view["pools"]["decode"]["burn_rate"] == pytest.approx(2.0)
+    assert view["queue_depth"] == {"x": 1}      # extras ride at top level
+    assert view["error_budget"] == pytest.approx(0.1)
+    # a replica restart steps cumulative counts BACKWARD: deltas clamp
+    # to zero (silence), never negative traffic
+    fresh = Histogram("lat", buckets=(10.0, 100.0))
+    fresh.observe(1.0)
+    view = trk.update(20.0, per_pool={}, fleet=fresh.snapshot())
+    f = view["fleet"]
+    assert f["requests_window"] == 0 and f["errors_window"] == 0
+    assert f["burn_rate"] == 0.0 and f["compliant"]
+
+
+def test_slo_policy_validation():
+    with pytest.raises(ValueError):
+        SloPolicy(objective=1.0)
+    with pytest.raises(ValueError):
+        SloPolicy(target_ms=0.0)
+    with pytest.raises(ValueError):
+        SloPolicy(window_s=-1.0)
+
+
+# -- tracer fleet-drain filters ----------------------------------------------
+
+def test_tracer_drain_finished_filters_parent_and_prefix():
+    tr = tracer()
+    parented = tr.start("eng", parent="fleet-aa-1")
+    tr.span(parented, "prefill", 0.0, 0.001)
+    tr.finish(parented, ok=True)
+    fleet_own = tr.start("sup", kind="fleet", trace_id="fleet-aa-1")
+    tr.finish(fleet_own, ok=True)
+    plain = tr.start("eng")
+    tr.finish(plain, ok=True)
+    got = tr.drain_finished(require_parent=True)
+    assert [t["trace_id"] for t in got] == [parented]
+    assert got[0]["parent"] == "fleet-aa-1"
+    assert got[0]["pid"] == os.getpid()
+    assert [s["name"] for s in got[0]["spans"]] == ["prefill"]
+    got = tr.drain_finished(prefix="fleet-")
+    assert [t["trace_id"] for t in got] == ["fleet-aa-1"]
+    # the plain local trace stays in the ring — local-only work never
+    # ships to the fleet collector
+    assert [t["trace_id"] for t in tr.traces()] == [plain]
+
+
+def test_trace_collector_dedup_grouping_and_chrome_export(tmp_path):
+    col = FleetTraceCollector()
+    sup = {"trace_id": "fleet-aa-1", "engine": "fleet", "kind": "fleet",
+           "ok": True, "meta": {}, "parent": None, "pid": 1,
+           "spans": [{"name": "route", "t0": 0.0, "dur_us": 5.0,
+                      "args": {}}]}
+    rep = {"trace_id": "bb-7", "engine": "d0", "kind": "generate",
+           "ok": True, "meta": {}, "parent": "fleet-aa-1", "pid": 2,
+           "spans": [{"name": "decode", "t0": 0.0, "dur_us": 9.0,
+                      "args": {}}]}
+    assert trace_group_key(sup) == "fleet-aa-1"
+    assert trace_group_key(rep) == "fleet-aa-1"
+    assert col.add([sup, rep]) == 2
+    assert col.add([dict(rep)]) == 0            # dedup by trace id
+    merged = col.merged("fleet-aa-1")
+    assert len(merged["fleet-aa-1"]) == 2
+    pids = col.span_pids("fleet-aa-1")
+    assert pids == {1: ["route"], 2: ["decode"]}
+    snap = col.snapshot()
+    assert snap["fleet_traces"] == 1 and snap["traces"] == 2
+    path = col.export_chrome(str(tmp_path / "fleet_trace.json"))
+    doc = json.loads(open(path).read())
+    spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["pid"] for e in spans} == {1, 2}
+    assert all(e["args"]["fleet"] == "fleet-aa-1" for e in spans)
+
+
+def test_fleet_prometheus_text_labels_and_fleet_aggregate():
+    replicas = {
+        "p0": {"snapshot": _replica_snap([0.5], 11), "pool": "prefill",
+               "incarnation": 0, "state": "ready"},
+        "d0": {"snapshot": _replica_snap([5.0, 2.0], 12), "pool": "decode",
+               "incarnation": 0, "state": "ready"},
+    }
+    merged = merge_replica_telemetry(replicas)
+    slo = SloTracker(SloPolicy(target_ms=10.0)).update(
+        0.0, per_pool={}, fleet=merged["histograms"]
+        ["request_latency_ms"]["fleet"])
+    text = fleet_prometheus_text(merged, slo)
+    # unlabeled fleet aggregate + one labeled series per replica
+    assert 'pt_request_latency_ms_count 3' in text
+    assert 'replica="p0"' in text and 'pool="prefill"' in text
+    assert 'replica="d0"' in text and 'pool="decode"' in text
+    assert "pt_fleet_slo_p95_ms" in text
+    assert "pt_fleet_slo_burn_rate" in text
+    assert "pt_fleet_replicas 2" in text
+    # the labeled counts sum to the fleet count exactly
+    import re
+
+    labeled = [float(m) for m in re.findall(
+        r'pt_request_latency_ms_count\{[^}]*replica=[^}]*\} (\S+)', text)]
+    assert sum(labeled) == 3.0
+
+
+# -- _ReplicaServer heartbeat piggyback + pull RPCs (no real process) ---------
+
+class _Store:
+    """TCPStore-shaped stub for the `_publish` seam."""
+
+    def __init__(self):
+        self.kv = {}
+        self.counts = {}
+
+    def set(self, k, v):
+        self.kv[k] = v
+
+    def add(self, k, n):
+        self.counts[k] = self.counts.get(k, 0) + n
+        return self.counts[k]
+
+
+class _FakeReplica:
+    """GenerationEngine-shaped stub (the test_serving_fleet idiom)."""
+
+    def __init__(self, name):
+        self.name = name
+        self.metrics = MetricsRegistry()
+        self.jobs = []
+        self.cancelled = []
+        self.spec = True
+        self.restarts = 0
+
+    def start(self):
+        return self
+
+    def close(self, drain=True):
+        pass
+
+    def restart(self):
+        self.restarts += 1
+
+    def fence(self):
+        pass
+
+    def drain(self):
+        pass
+
+    def health(self):
+        return True
+
+    def queue_depth(self):
+        return len(self.jobs)
+
+    def stats(self):
+        return self.metrics.snapshot()
+
+    def kv_headroom(self):
+        return 1.0
+
+    def prefix_match_tokens(self, prompt, blocks=None):
+        return 0
+
+    def set_speculative(self, on):
+        self.spec = on
+
+    def cancel(self, fut):
+        self.cancelled.append(fut)
+        return False
+
+    def submit(self, prompt, max_new_tokens=16, deadline_ms=None,
+               on_token=None):
+        fut = Future()
+        self.jobs.append((np.asarray(prompt), int(max_new_tokens),
+                          on_token, fut))
+        return fut
+
+    def finish_job(self, i=0):
+        prompt, mx, cb, fut = self.jobs.pop(i)
+        toks = [int(prompt[-1]) + 1 + j for j in range(mx)]
+        for t in toks:
+            if cb:
+                cb(t)
+        fut.set_result(np.asarray(list(prompt) + toks, np.int64))
+
+
+def test_replica_server_beat_piggyback_and_trace_pull():
+    srv = _ReplicaServer("r0", _FakeReplica("r0"), store=_Store(),
+                         incarnation=2)
+    store = srv._store
+    key = "svfleet/r0/2/traces"
+    try:
+        tr = tracer()
+        tid = tr.start("r0", parent="fleet-aa-1")
+        tr.span(tid, "prefill", 0.0, 0.001)
+        tr.finish(tid, ok=True)
+        srv._beat(1.0)
+        batch = json.loads(store.kv[key])
+        assert batch["seq"] == 1
+        assert [t["trace_id"] for t in batch["traces"]] == [tid]
+        assert batch["traces"][0]["parent"] == "fleet-aa-1"
+        # publish-WITHOUT-clear: the buffer survives the beat (a crash
+        # between beats loses nothing already on the store)...
+        assert srv._pending_traces
+        # ...and an unchanged seq skips the republish
+        del store.kv[key]
+        srv._beat(2.0)
+        assert key not in store.kv
+        # the `trace` RPC pull drains the buffer and replies with pid
+        srv._handle(None, {"op": "trace", "rid": 9})
+        _conn, frame = srv._out.pop()
+        assert frame["event"] == "reply" and frame["rid"] == 9
+        assert [t["trace_id"] for t in frame["traces"]] == [tid]
+        assert frame["pid"] == os.getpid()
+        assert not srv._pending_traces
+        # unparented local traces never ship to the fleet
+        t2 = tr.start("r0")
+        tr.finish(t2, ok=True)
+        srv._drain_traces()
+        assert not srv._pending_traces
+        # the `telemetry` RPC returns the hub snapshot, pid-stamped
+        srv._handle(None, {"op": "telemetry", "rid": 10})
+        _conn, frame = srv._out.pop()
+        assert frame["rid"] == 10 and frame["pid"] == os.getpid()
+        assert frame["telemetry"]["meta"]["pid"] == os.getpid()
+        assert "request_latency_ms" in frame["telemetry"]
+    finally:
+        srv._listen.close()
+        os.close(srv._wake_r)
+        os.close(srv._wake_w)
+
+
+# -- in-process fleet: trace-context propagation edge cases -------------------
+
+def _mini_fleet(n=2, **policy_kw):
+    pol = ServingFleetPolicy(poll_interval=0.02, **policy_kw)
+    reps = [_FakeReplica(f"f{i}") for i in range(n)]
+    fleet = ServingFleet(replicas=reps, policy=pol).start()
+    return fleet, reps
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _one_fleet_trace(fleet):
+    """Poll until the supervisor's finished fleet trace lands in the
+    collector; returns (fleet_id, merged trace list)."""
+
+    def _landed():
+        fleet._collect_local_traces()
+        return bool(fleet.traces.merged())
+
+    assert _wait(_landed)
+    merged = fleet.traces.merged()
+    assert len(merged) == 1
+    fid, traces = next(iter(merged.items()))
+    assert fid.startswith(f"fleet-{os.getpid():x}-")
+    return fid, traces
+
+
+def _spans(traces, name=None):
+    out = [s for t in traces for s in t["spans"]]
+    return [s for s in out if name is None or s["name"] == name]
+
+
+def test_fleet_trace_route_span_and_finish_meta():
+    fleet, (a, b) = _mini_fleet()
+    try:
+        fut = fleet.submit([3, 4], max_new_tokens=2)
+        assert _wait(lambda: a.jobs or b.jobs)
+        (a if a.jobs else b).finish_job()
+        fut.result(timeout=10)
+        fid, traces = _one_fleet_trace(fleet)
+        sup = traces[0]
+        assert sup["kind"] == "fleet" and sup["ok"] is True
+        assert sup["meta"]["prompt_len"] == 2
+        assert sup["meta"]["emitted"] == 2 and sup["meta"]["replays"] == 0
+        (route,) = _spans(traces, "route")
+        assert route["args"]["replica"] in ("f0", "f1")
+        assert route["args"]["hedge"] is False
+    finally:
+        fleet.close()
+
+
+def test_fleet_hedge_first_wins_loser_span_cancelled_same_trace():
+    fleet, (a, b) = _mini_fleet(hedge_ms=100)
+    try:
+        fut = fleet.submit([1, 2], max_new_tokens=2)
+        assert _wait(lambda: a.jobs or b.jobs)
+        prim = a if a.jobs else b
+        other = b if prim is a else a
+        assert _wait(lambda: other.jobs, timeout=10)   # hedge fired
+        other.finish_job()                             # the hedge wins
+        fut.result(timeout=10)
+        fid, traces = _one_fleet_trace(fleet)
+        routes = _spans(traces, "route")
+        assert [r["args"]["hedge"] for r in routes] == [False, True]
+        (loser,) = _spans(traces, "hedge_loser")
+        assert loser["args"]["cancelled"] is True
+        assert loser["args"]["replica"] == prim.name
+        # both legs live under ONE fleet trace id
+        assert all(trace_group_key(t) == fid for t in traces)
+    finally:
+        fleet.close()
+
+
+def test_fleet_failover_replay_span_is_new_child_leg():
+    fleet, (a, b) = _mini_fleet()
+    try:
+        streamed = []
+        fut = fleet.submit([7, 8], max_new_tokens=3,
+                           on_token=streamed.append)
+        assert _wait(lambda: a.jobs or b.jobs)
+        holder = a if a.jobs else b
+        survivor = b if holder is a else a
+        _p, _m, cb, _f = holder.jobs[0]
+        cb(9)                                   # one token streamed...
+        fleet.fence_replica(holder.name, cause="test_crash")
+        assert _wait(lambda: survivor.jobs)
+        survivor.finish_job()
+        fut.result(timeout=10)
+        fid, traces = _one_fleet_trace(fleet)
+        (replay,) = _spans(traces, "replay")
+        assert replay["args"]["attempt"] == 1
+        assert replay["args"]["source"] == holder.name
+        # the replayed leg IS a new child span: two route dispatches
+        routes = _spans(traces, "route")
+        assert len(routes) == 2
+        assert routes[1]["args"]["replica"] == survivor.name
+        assert traces[0]["meta"]["replays"] == 1
+        assert not _spans(traces, "replayed_complete")
+    finally:
+        fleet.close()
+
+
+def test_fleet_ledger_complete_replay_emits_no_new_leg():
+    fleet, (a, b) = _mini_fleet()
+    try:
+        fut = fleet.submit([1], max_new_tokens=2)
+        assert _wait(lambda: a.jobs or b.jobs)
+        holder = a if a.jobs else b
+        survivor = b if holder is a else a
+        _p, _m, cb, _f = holder.jobs[0]
+        cb(5)
+        cb(6)                                   # full budget streamed
+        fleet.fence_replica(holder.name, cause="test_crash")
+        assert fut.result(timeout=10).tolist() == [1, 5, 6]
+        fid, traces = _one_fleet_trace(fleet)
+        (done,) = _spans(traces, "replayed_complete")
+        assert done["args"]["source"] == holder.name
+        # ledger-complete: the request never re-dispatched
+        assert len(_spans(traces, "route")) == 1
+        assert not survivor.jobs
+        assert traces[0]["meta"]["replayed_complete"] is True
+    finally:
+        fleet.close()
+
+
+def test_fleet_migrate_fallback_span_carries_reason():
+    pol = ServingFleetPolicy(poll_interval=0.02, hedge_ms=None)
+    pre, d0, d1 = (_FakeReplica(n) for n in ("pre", "d0", "d1"))
+    fleet = ServingFleet(
+        replicas=[pre, d0, d1],
+        pools={"prefill": ["pre"], "decode": ["d0", "d1"]},
+        policy=pol, min_ship_tokens=4).start()
+    try:
+        fut = fleet.submit([7, 8, 9, 10], max_new_tokens=4)
+        assert _wait(lambda: pre.jobs)
+        pre.finish_job()                        # prefill leg done
+        assert _wait(lambda: d0.jobs or d1.jobs)
+        (d0 if d0.jobs else d1).finish_job()
+        fut.result(timeout=10)
+        fid, traces = _one_fleet_trace(fleet)
+        # the stub has no export seam: the fallback re-prefill span is
+        # tagged with WHY the ship failed
+        (fb,) = _spans(traces, "migrate_fallback")
+        assert fb["args"]["reason"] == "export_failed"
+        assert fb["args"]["src"] == "pre"
+        routes = _spans(traces, "route")
+        assert len(routes) == 2                 # prefill leg + decode leg
+        assert routes[0]["args"]["replica"] == "pre"
+    finally:
+        fleet.close()
+
+
+def test_fleet_failed_request_trace_finishes_not_ok():
+    fleet, reps = _mini_fleet(n=1)
+    fut = fleet.submit(np.arange(3))
+    fleet.close()                               # fails the outstanding req
+    assert fut.exception(timeout=10) is not None
+    fleet._collect_local_traces()
+    merged = fleet.traces.merged()
+    assert len(merged) == 1
+    (traces,) = merged.values()
+    assert traces[0]["ok"] is False
+    assert traces[0]["meta"]["error"] == "EngineClosed"
+
+
+# -- scrape -> merge -> SLO -> exposition, end to end in-process --------------
+
+def test_fleet_scrape_now_merged_slo_providers_and_prom_file(tmp_path):
+    from paddle_tpu import observability as obs
+
+    prom = str(tmp_path / "fleet_metrics.prom")
+    pol = ServingFleetPolicy(poll_interval=0.02, slo_target_ms=500.0,
+                             slo_objective=0.95, slo_window_s=30.0)
+    reps = [_FakeReplica(f"f{i}") for i in range(2)]
+    fleet = ServingFleet(replicas=reps, policy=pol, prom_path=prom).start()
+    try:
+        fut = fleet.submit([3, 4], max_new_tokens=2)
+        assert _wait(lambda: any(r.jobs for r in reps))
+        next(r for r in reps if r.jobs).finish_job()
+        fut.result(timeout=10)
+        assert _wait(lambda: fleet.provider_snapshot()["counters"]
+                     .get("completed", 0) == 1)
+        merged = fleet.scrape_now()
+        rows = merged["replicas"]
+        assert set(rows) == {"f0", "f1"}
+        assert all(r["state"] == "ready" for r in rows.values())
+        assert all(r["pid"] == os.getpid() for r in rows.values()
+                   if r.get("pid"))
+        lat = merged["histograms"]["request_latency_ms"]
+        assert lat["fleet"]["count"] >= 1
+        # EXACT: the fleet count equals the per-replica total
+        assert lat["fleet"]["count"] == \
+            sum(s["count"] for s in lat["per_replica"].values())
+        assert lat["fleet"]["sum_exact"] == pytest.approx(
+            sum(s["sum_exact"] for s in lat["per_replica"].values()))
+        # the SLO view computes ONLY from merged buckets
+        slo = fleet.slo_snapshot()
+        assert slo["target_ms"] == 500.0 and slo["objective"] == 0.95
+        f = slo["fleet"]
+        assert f["count_total"] == lat["fleet"]["count"]
+        assert np.isfinite(f["burn_rate"]) and f["burn_rate"] >= 0.0
+        assert np.isfinite(f["p95_ms"])
+        # hub providers: the supervisor process exposes the fleet plane
+        hub = obs.snapshot()
+        assert hub["fleet_telemetry"]["totals"]["replicas"] == 2
+        assert hub["slo"]["fleet"]["count_total"] >= 1
+        assert "fleet_trace" in hub
+        # the exposition file landed, labeled + aggregated
+        text = open(prom).read()
+        assert 'replica="f0"' in text
+        assert "pt_request_latency_ms_count" in text
+        assert "pt_fleet_slo_burn_rate" in text
+    finally:
+        fleet.close()

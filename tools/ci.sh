@@ -385,6 +385,23 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_kv_migration.py -q \
 JAX_PLATFORMS=cpu python tools/kv_migration_drill.py || exit 1
 JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/kv_migration_drill.py || exit 1
 
+echo "== fleet observability gate (ISSUE-19: traces + merged telemetry + SLO) =="
+# merge-API units (bucket-wise Histogram.merge exactness, label-aware
+# CounterFamily.merge, quantile/burn math, tracer drain filters,
+# collector dedup) and the in-process trace edge cases (hedge loser
+# cancelled under the same fleet id, failover replay leg, ledger-
+# complete with no re-dispatch, migrate_fallback reason) — then the
+# REAL 3-process drill: one KV-migrated request renders as a single
+# merged chrome trace with spans from >=3 distinct pids under one
+# fleet trace id, the merged exposition carries per-replica labels
+# with the fleet sum/count EXACTLY equal to the per-replica total, and
+# the slo provider reports a finite burn rate; lockdep-armed re-run
+# must stay cycle-free
+JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_observability.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/fleet_trace_drill.py || exit 1
+JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/fleet_trace_drill.py || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \

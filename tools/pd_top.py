@@ -7,11 +7,17 @@ The `top(1)` of the telemetry hub (docs/observability.md):
     python tools/pd_top.py --port 9100                # live /snapshot
     python tools/pd_top.py --port 9100 --watch 2      # refresh every 2s
     python tools/pd_top.py --port 9100 --json         # raw JSON passthrough
+    python tools/pd_top.py --port 9100 --fleet        # fleet plane only
 
 The live mode talks to the stdlib endpoint started by
 ``observability.serve(port)`` / ``PT_METRICS_PORT=<port>``. Rendering is
 ``observability.render_snapshot`` — the same tables ``report()`` prints —
 so a dumped file and a live process look identical.
+
+``--fleet`` filters to the fleet observability plane (the supervisor
+process's ``fleet_telemetry`` + ``slo`` providers): per-replica rows
+(state, pool, inflight, beat age, p95, KV headroom), the fleet totals
+line, and the SLO burn table.
 """
 from __future__ import annotations
 
@@ -46,6 +52,22 @@ def _render(snap: dict) -> str:
         return json.dumps(snap, indent=1, default=str)
 
 
+_FLEET_FAMS = ("fleet_telemetry", "slo", "fleet_trace", "serving_fleet",
+               "kv_migration")
+
+
+def _fleet_filter(snap: dict) -> dict:
+    """Keep only the fleet-plane families (+ meta). An empty result
+    means the snapshot is not from a fleet supervisor process."""
+    out = {k: v for k, v in snap.items()
+           if k in _FLEET_FAMS or k == "meta"}
+    if not any(k in out for k in ("fleet_telemetry", "slo")):
+        out["fleet_telemetry"] = {
+            "error": "no fleet_telemetry/slo providers in this snapshot "
+                     "(point pd_top at the fleet SUPERVISOR process)"}
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="pd_top", description=__doc__,
@@ -59,12 +81,17 @@ def main(argv=None) -> int:
                     help="live mode: refresh every N seconds until ^C")
     ap.add_argument("--json", action="store_true",
                     help="print the raw snapshot JSON instead of tables")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet mode: only the merged fleet telemetry "
+                         "(per-replica rows + totals) and SLO tables")
     args = ap.parse_args(argv)
     if (args.path is None) == (args.port is None):
         ap.error("give exactly one of: a snapshot file, or --port")
     try:
         while True:
             snap = _load(args)
+            if args.fleet:
+                snap = _fleet_filter(snap)
             out = json.dumps(snap, indent=1, default=str) if args.json \
                 else _render(snap)
             if args.watch:
