@@ -28,7 +28,8 @@ import os
 from dataclasses import dataclass, replace, asdict
 from typing import Any, Dict, Optional
 
-__all__ = ["LinkModel", "LINK_TABLES", "link_model_for", "ring_factor",
+__all__ = ["LinkModel", "LINK_TABLES", "link_model_for",
+           "calibrated_link_model", "ring_factor",
            "reduce_scatter_factor", "all_to_all_factor",
            "all_gather_factor", "calibrate_from_counters",
            "save_calibration", "load_calibration", "calibration_path",
@@ -110,6 +111,23 @@ def link_model_for(topology: Optional[str] = None, **overrides) -> LinkModel:
             if cal:
                 base = base.override(**cal)
     return base.override(**overrides) if overrides else base
+
+
+def calibrated_link_model(topology: Optional[str] = None,
+                          **overrides) -> LinkModel:
+    """``link_model_for`` with the persisted calibration ALWAYS merged
+    (no ``PT_LINK_CALIBRATION`` gate): the explicit opt-in the online
+    tuner's live re-scoring uses — a runtime deciding whether to swap
+    the active plan must rank under measured link rates, while CI's
+    deterministic ranking assertions keep the env-gated path."""
+    lm = link_model_for(topology)
+    prof = load_calibration(topology or lm.name)
+    if prof:
+        cal = {k: float(v) for k, v in (prof.get("link") or {}).items()
+               if k in lm.to_dict() and k != "name"}
+        if cal:
+            lm = lm.override(**cal)
+    return lm.override(**overrides) if overrides else lm
 
 
 # -- bytes-on-wire multipliers ------------------------------------------------

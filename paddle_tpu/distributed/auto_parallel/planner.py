@@ -42,7 +42,7 @@ from ...cost_model.comm import (LinkModel, all_gather_factor,
 
 __all__ = ["ModelProfile", "PlanCandidate", "profile_model",
            "enumerate_candidates", "score_config", "plan", "apply_plan",
-           "normalize_config"]
+           "normalize_config", "rescore_candidates", "plan_digest"]
 
 AXES = ("dp", "mp", "pp", "cp", "ep", "sharding")
 
@@ -667,6 +667,64 @@ def plan(model, n_devices: Optional[int] = None,
                 f"expect OOM unless the budget was pessimistic")
         out = feasible + rest
     return out[:top_k] if top_k else out
+
+
+def plan_digest(config: Dict[str, Any]) -> str:
+    """Stable short identity of one plan config (the canonical config
+    key hashed) — what the online tuner's ledger and the ``tuner``
+    provider report as the active/proposed plan."""
+    import hashlib
+
+    key = _config_key(normalize_config(dict(config))
+                      if "mesh" not in config else config)
+    return hashlib.sha256(key.encode()).hexdigest()[:12]
+
+
+def rescore_candidates(profile: ModelProfile,
+                       candidates: Sequence,
+                       *, link: Optional[LinkModel] = None,
+                       hbm_bytes: Optional[float] = None,
+                       optimizer: Any = "adamw",
+                       fused_kernels=None,
+                       measured: Optional[Dict[str, float]] = None
+                       ) -> List[PlanCandidate]:
+    """Re-score an existing candidate list under LIVE conditions — the
+    online tuner's half of the loop.  ``candidates`` are
+    ``PlanCandidate``s or raw config dicts (the store-published plan
+    descriptors round-trip); ``link`` is typically
+    ``cost_model.comm.calibrated_link_model()``.
+
+    ``measured`` maps :func:`plan_digest` -> measured step seconds:
+    any candidate with a live measurement is ANCHORED to it (the
+    measurement refutes the model's prediction for that config — most
+    importantly the regressed ACTIVE plan, which must compete at its
+    real, degraded step time, not its optimistic modeled one).  Returns
+    feasible candidates first, each rank sorted by (predicted step,
+    canonical key) exactly like :func:`plan`."""
+    rescored = []
+    for c in candidates:
+        cfg = c.config if isinstance(c, PlanCandidate) else dict(c)
+        if not isinstance(c, PlanCandidate) and "config" in cfg:
+            cfg = dict(cfg["config"])  # a published to_dict() descriptor
+        cand = score_config(profile, cfg, link=link, hbm_bytes=hbm_bytes,
+                            optimizer=optimizer,
+                            fused_kernels=fused_kernels)
+        if measured:
+            m = measured.get(plan_digest(cand.config))
+            if m is not None and m > 0:
+                cand = PlanCandidate(
+                    config=cand.config, predicted_step_s=float(m),
+                    predicted_peak_bytes=cand.predicted_peak_bytes,
+                    feasible=cand.feasible,
+                    breakdown=dict(cand.breakdown, measured_anchor_s=m))
+        rescored.append(cand)
+    feasible = sorted([c for c in rescored if c.feasible],
+                      key=lambda c: (c.predicted_step_s,
+                                     _config_key(c.config)))
+    rest = sorted([c for c in rescored if not c.feasible],
+                  key=lambda c: (c.predicted_peak_bytes,
+                                 _config_key(c.config)))
+    return feasible + rest
 
 
 def install_plan(model, optimizer, cand: PlanCandidate, devices=None):

@@ -157,6 +157,11 @@ class FleetStateMachine:
         self._fence_reason = ""
         self._start_t = float(now)
         self._rank_restarts: Dict[int, int] = {}  # replica mode: per rank
+        # a PLANNED fence (online retune raised by a worker, mirrored by
+        # the supervisor probing the published reason) restarts the gang
+        # without spending crash budget — the gang-mode analogue of
+        # replica_restarted(count=False)
+        self.planned_fence = False
 
     # -- inputs ---------------------------------------------------------------
     def _event(self, event: str, now: float, **data) -> None:
@@ -263,6 +268,23 @@ class FleetStateMachine:
             return self._restart_decision(now)
         return FleetAction(kind="hold")
 
+    def worker_fence(self, now: float, reason: str) -> None:
+        """Adopt a fence the WORKERS raised themselves (online retune:
+        the plan tuner published ``retune:*`` before adding the fence
+        counter).  The gang moves to FENCED with NO eviction and the
+        restart is flagged planned.  Adopting BEFORE any drain fallout
+        lands matters: once rank 0 (which hosts the jax.distributed
+        coordination service) fast-exits ``EXIT_FENCED``, a still-
+        draining peer may be killed by the coordinator loss — that
+        death is drain mechanics, not a membership change, and must
+        spend neither eviction nor crash budget."""
+        if self.phase not in (FleetPhase.LAUNCHING, FleetPhase.RUNNING):
+            return
+        self.phase = FleetPhase.FENCED
+        self.planned_fence = True
+        self._fence_reason = reason
+        self._event("fence", now, dead=[], reason=reason)
+
     def _restart_decision(self, now: float) -> FleetAction:
         # a fence raised during LAUNCHING may leave ranks that never
         # registered at all: they are not survivors either
@@ -277,7 +299,8 @@ class FleetStateMachine:
                 kind="fail", dead=dead,
                 reason=f"{survivors} survivors < min_world="
                        f"{self.policy.min_world} ({self._fence_reason})")
-        if self.restarts >= self.policy.max_restarts:
+        if not self.planned_fence and \
+                self.restarts >= self.policy.max_restarts:
             self.phase = FleetPhase.FAILED
             self._event("fail", now, reason="restart_budget",
                         restarts=self.restarts)
@@ -286,9 +309,11 @@ class FleetStateMachine:
                 reason=f"restart budget exhausted "
                        f"({self.restarts}/{self.policy.max_restarts})")
         self.phase = FleetPhase.RESTARTING
-        backoff = self.policy.backoff_s(self.restarts + 1)
+        backoff = 0.0 if self.planned_fence \
+            else self.policy.backoff_s(self.restarts + 1)
         self._event("restart", now, world=survivors, dead=dead,
-                    restart_id=self.restarts + 1, backoff_s=backoff)
+                    restart_id=self.restarts + 1, backoff_s=backoff,
+                    planned=self.planned_fence)
         return FleetAction(kind="restart", dead=dead, world=survivors,
                            backoff_s=backoff)
 
@@ -357,8 +382,12 @@ class FleetStateMachine:
         self._event(event, now, **data)
 
     def restarted(self, now: float, world: int) -> None:
-        """The supervisor re-spawned the gang: reset per-generation state."""
-        self.restarts += 1
+        """The supervisor re-spawned the gang: reset per-generation state.
+        A planned (retune) fence rolls the generation without touching
+        the crash-restart budget."""
+        if not self.planned_fence:
+            self.restarts += 1
+        self.planned_fence = False
         self.gen += 1
         self.world = int(world)
         self.phase = FleetPhase.LAUNCHING
@@ -589,7 +618,13 @@ class ElasticFleet:
         plan = None
         if self.sm.gen not in self.plans:
             plan = _probe_json(self.store, f"fleet/{self.sm.gen}/plan")
-        return beats, plan
+        wfence = None
+        if self.sm.phase in (FleetPhase.LAUNCHING, FleetPhase.RUNNING):
+            reason = _probe_json(self.store,
+                                 f"fleet/{self.sm.gen}/fence_reason")
+            if isinstance(reason, str) and reason.startswith("retune:"):
+                wfence = reason
+        return beats, plan, wfence
 
     def _pump_heartbeats(self, now: float, beats: Dict[int, float],
                          plan) -> None:
@@ -609,9 +644,15 @@ class ElasticFleet:
 
     def fence(self, reason: str = "operator") -> None:
         """Raise the fence for the current generation: workers drain at
-        the next step boundary (or abandon a torn collective) and exit."""
-        self.store.add(f"fleet/{self.sm.gen}/fence", 1)
-        _publish(self.store, f"fleet/{self.sm.gen}/fence_reason", reason)
+        the next step boundary (or abandon a torn collective) and exit.
+        A reason already published for this generation wins — a worker
+        that raised the fence itself (online retune) named WHY, and the
+        supervisor's later mirror (e.g. ``gang_exited``) must not
+        overwrite it."""
+        gen = self.sm.gen
+        self.store.add(f"fleet/{gen}/fence", 1)
+        if _probe_json(self.store, f"fleet/{gen}/fence_reason") is None:
+            _publish(self.store, f"fleet/{gen}/fence_reason", reason)
 
     # -- the supervisor loop --------------------------------------------------
     def run(self, timeout: Optional[float] = None) -> Dict[str, Any]:
@@ -641,9 +682,20 @@ class ElasticFleet:
                     self.sm.phase = FleetPhase.FAILED
                     self.sm._event("fail", now, reason="coordinator_lost")
                 return self._finish("coordinator_lost", forensics=False)
-            beats, plan = self._poll_beats()  # store I/O: lock released
+            # store I/O: lock released
+            beats, plan, wfence = self._poll_beats()
             with self._lock:
                 self._pump_heartbeats(now, beats, plan)
+                if wfence is not None and recovery is None:
+                    # a WORKER raised this generation's fence (online
+                    # retune): adopt it now, before any drain fallout
+                    # lands — see FleetStateMachine.worker_fence
+                    self.sm.worker_fence(now, wfence)
+                    recovery = {"gen": self.sm.gen, "reason": wfence,
+                                "dead": [], "fence_t": now,
+                                "planned": True,
+                                "detect_ms": round(
+                                    (now - self._gen_t0) * 1e3, 1)}
                 exits = {e.rank: e.proc.poll() for e in self._ctx.entries}
                 act = self.sm.observe(now, exits)
             if act.kind == "hold":
@@ -657,8 +709,19 @@ class ElasticFleet:
                 continue
             if act.kind == "fence":
                 self.fence(act.reason)
-                recovery = {"gen": self.sm.gen, "reason": act.reason,
+                # the canonical reason is whatever is NOW published for
+                # this gen — a worker-raised retune fence keeps its name
+                # (and flags the restart as planned: no budget spent)
+                published = _probe_json(
+                    self.store, f"fleet/{self.sm.gen}/fence_reason")
+                reason = published if isinstance(published, str) \
+                    and published else act.reason
+                if reason.startswith("retune:"):
+                    with self._lock:
+                        self.sm.planned_fence = True
+                recovery = {"gen": self.sm.gen, "reason": reason,
                             "dead": act.dead, "fence_t": now,
+                            "planned": reason.startswith("retune:"),
                             "detect_ms": round((now - self._gen_t0) * 1e3,
                                                1)}
                 continue
@@ -1020,13 +1083,30 @@ class FleetWorkerContext:
         """Run the PR-9 planner for THIS generation's world size. Rank 0
         computes and publishes the pick; other ranks read it (one
         deterministic answer fleet-wide). Standalone mode plans locally.
-        """
+
+        An online-tuner override (``fleet/plan_override``, published by
+        the plan-rerank policy before it raised its retune fence) wins
+        over a fresh plan when its mesh still covers this generation's
+        world size — the tuner already re-scored the cached candidates
+        under live profiles; re-planning from priors here would undo the
+        swap the fence was raised FOR."""
         if self.store is None or self.rank == 0:
-            cand = replan_for_world(model, self.world, batch=batch,
-                                    sample_batch=sample_batch,
-                                    loss_fn=loss_fn, hbm_bytes=hbm_bytes,
-                                    **enum_kw)
-            desc = cand.to_dict() if hasattr(cand, "to_dict") else cand
+            desc = None
+            if self.store is not None:
+                ov = _probe_json(self.store, "fleet/plan_override")
+                if isinstance(ov, dict):
+                    mesh = ov.get("config", {}).get("mesh", {})
+                    total = 1
+                    for v in mesh.values():
+                        total *= int(v)
+                    if total == self.world:
+                        desc = ov
+            if desc is None:
+                cand = replan_for_world(model, self.world, batch=batch,
+                                        sample_batch=sample_batch,
+                                        loss_fn=loss_fn,
+                                        hbm_bytes=hbm_bytes, **enum_kw)
+                desc = cand.to_dict() if hasattr(cand, "to_dict") else cand
             if self.store is not None:
                 _publish(self.store, f"fleet/{self.gen}/plan", desc)
             return desc

@@ -37,7 +37,8 @@ from .registry import Histogram, _hist_parts, _named_lock
 
 __all__ = [
     "histogram_quantile", "merge_replica_telemetry", "SloPolicy",
-    "SloTracker", "FleetTraceCollector", "fleet_prometheus_text",
+    "SloTracker", "HistogramWindow", "FleetTraceCollector",
+    "fleet_prometheus_text",
 ]
 
 
@@ -221,14 +222,37 @@ class SloTracker:
     Each ``update(now, per_pool, fleet, extras)`` appends one sample of
     cumulative (good, total) counts per pool and reports the SLO view:
     p95/p99 interpolated from the current merged buckets, plus windowed
-    error/burn rates from the oldest in-window sample to now. A replica
-    restart can step cumulative counts BACKWARD (its histograms reset);
-    deltas clamp at zero so a restart reads as silence, not negative
-    traffic."""
+    error/burn rates from the oldest in-window sample to now.
+
+    Restart safety: a replica restart steps the merged cumulative counts
+    BACKWARD (the new incarnation's histograms start at zero).  Each
+    scope's series is monotonically REBASED — any backward step in good
+    or total accrues into a per-scope offset, so across a restart the
+    adjusted series is flat (the restart reads as a pause) and deltas
+    afterwards measure only genuine forward progress.  Without the
+    rebase a restart mid-window first mutes the window (clamped zero
+    deltas while counts climb back) and then, because good and total
+    recover at different rates, spikes the error/burn rate with
+    phantom errors — exactly the false signal the online tuner's
+    regression detector must never see."""
 
     def __init__(self, policy: Optional[SloPolicy] = None):
         self.policy = policy or SloPolicy()
         self._samples: deque = deque(maxlen=4096)
+        # scope -> [good_offset, total_offset, last_raw_good, last_raw_total]
+        self._rebase: Dict[str, List[int]] = {}
+
+    def _rebased(self, scope: str, good: int, total: int
+                 ) -> Tuple[int, int]:
+        st = self._rebase.get(scope)
+        if st is None:
+            st = self._rebase[scope] = [0, 0, good, total]
+        if total < st[3]:
+            st[1] += st[3] - total
+        if good < st[2]:
+            st[0] += st[2] - good
+        st[2], st[3] = good, total
+        return good + st[0], total + st[1]
 
     def update(self, now: float,
                per_pool: Dict[str, Dict[str, Any]],
@@ -242,12 +266,14 @@ class SloTracker:
             scopes["_fleet"] = fleet
         for scope, snap in scopes.items():
             good, total = _good_total(snap, pol.target_ms)
-            cur[scope] = (good, total)
             views[scope] = {
                 "p95_ms": round(histogram_quantile(snap, 0.95), 3),
                 "p99_ms": round(histogram_quantile(snap, 0.99), 3),
                 "count_total": total,
             }
+            # window math runs on the restart-rebased series; the raw
+            # total above stays the live merged count for drills/dash
+            cur[scope] = self._rebased(scope, good, total)
         self._samples.append({"ts": float(now), "scopes": cur})
         horizon = float(now) - pol.window_s
         base = None
@@ -281,6 +307,79 @@ class SloTracker:
         if extras:
             out.update(extras)
         return out
+
+
+class HistogramWindow:
+    """Trailing-window per-bucket deltas over a CUMULATIVE merged
+    histogram feed — the size-distribution input surface of the online
+    tuner (``paddle_tpu.tuning``).
+
+    Each ``update(now, snap)`` appends the current cumulative bucket
+    counts; ``delta()`` returns the per-bucket counts accrued inside the
+    trailing window.  Restart safety mirrors :class:`SloTracker`: a
+    replica restart steps merged cumulative bucket counts backward, so
+    every bucket series is monotonically rebased (backward steps accrue
+    into per-bucket offsets) — a restart reads as a pause, never as
+    negative or phantom traffic.  A bucket-layout change (different
+    edges after a reconfig) resets the window outright: deltas across
+    incompatible layouts are meaningless."""
+
+    def __init__(self, window_s: float = 60.0, maxlen: int = 4096):
+        self.window_s = float(window_s)
+        self._samples: deque = deque(maxlen=maxlen)
+        self._bounds: Optional[Tuple[float, ...]] = None
+        self._offsets: Optional[List[int]] = None
+        self._last_raw: Optional[List[int]] = None
+        self.rebases = 0
+
+    def update(self, now: float, snap) -> None:
+        """Fold one merged histogram snapshot (or ``None`` to skip)."""
+        if snap is None:
+            return
+        bounds, counts, _s, _n = _hist_parts(snap)
+        # counts carries one more entry than bounds (the +Inf bucket);
+        # surface it under an explicit inf edge so consumers see ALL mass
+        bounds = tuple(bounds) + (float("inf"),)
+        counts = [int(c) for c in counts]
+        if bounds != self._bounds:
+            self._bounds = bounds
+            self._offsets = [0] * len(counts)
+            self._last_raw = list(counts)
+            self._samples.clear()
+        assert self._offsets is not None and self._last_raw is not None
+        rebased_this_sample = False
+        for i, c in enumerate(counts):
+            if c < self._last_raw[i]:
+                self._offsets[i] += self._last_raw[i] - c
+                rebased_this_sample = True
+            self._last_raw[i] = c
+        if rebased_this_sample:
+            self.rebases += 1
+        adj = tuple(c + o for c, o in zip(counts, self._offsets))
+        self._samples.append((float(now), adj))
+
+    def delta(self, now: Optional[float] = None
+              ) -> Tuple[Tuple[float, ...], List[int]]:
+        """(bounds, per-bucket counts accrued in the trailing window).
+        Empty feed -> ``((), [])``."""
+        if not self._samples or self._bounds is None:
+            return (), []
+        newest_t, newest = self._samples[-1]
+        now = newest_t if now is None else float(now)
+        horizon = now - self.window_s
+        base = None
+        for t, counts in self._samples:  # oldest in-window (or newest
+            if t >= horizon:             # older-than-window) as baseline
+                base = counts
+                break
+            base = counts
+        assert base is not None
+        # the rebased series is monotone, so these never go negative
+        return self._bounds, [n - b for n, b in zip(newest, base)]
+
+    def total(self, now: Optional[float] = None) -> int:
+        _b, counts = self.delta(now)
+        return sum(counts)
 
 
 # -- cross-process trace merge ------------------------------------------------

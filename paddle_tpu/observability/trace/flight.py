@@ -57,7 +57,7 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from ..registry import family
 from ..timeline import timeline
@@ -205,6 +205,7 @@ class FlightRecorder:
         self._tl = timeline_obj if timeline_obj is not None else timeline()
         self._lock = threading.Lock()
         self._ring: deque = deque(maxlen=self.capacity)
+        self._total_steps = 0  # monotone; never wraps with the ring
         self._events: deque = deque(maxlen=self.capacity)
         self._anomalies: deque = deque(maxlen=64)
         self._dumps: List[Dict] = []
@@ -305,9 +306,24 @@ class FlightRecorder:
         with self._lock:
             prior = list(self._ring)
             self._ring.append(rec)
+            self._total_steps += 1
         reasons = self._detect(rec, prior)
         for r in reasons:
             self.trigger(r, step=rec)
+
+    def step_series(self, n: Optional[int] = None
+                    ) -> Tuple[int, List[float]]:
+        """The last ``n`` (default: all ringed) step wall-times as
+        ``(first_seq, [ms, ...])`` where ``first_seq`` is the monotone
+        index of the first returned sample — the online tuner's
+        incremental read (consume only samples past the last seq seen,
+        ring wraparound included)."""
+        with self._lock:
+            ring = list(self._ring)
+            total = self._total_steps
+        if n is not None:
+            ring = ring[-int(n):]
+        return total - len(ring), [r["ms"] for r in ring]
 
     def record_event(self, kind: str, **data) -> None:
         """Runtime events that belong in the ring next to the steps

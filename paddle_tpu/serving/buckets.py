@@ -28,21 +28,60 @@ class BucketSpec:
     - ``pad_value``: fill for padded rows/positions (0 is safe for token ids
       and for causal-attention tails — padded positions are masked off or
       causally unreachable from real ones).
+    - ``observed_floor``: smallest request size this spec claims to serve
+      (the online tuner passes the smallest OBSERVED size).  Any seq
+      bucket below it is dead weight — it can never be selected, it only
+      spends a warmed executable — so construction rejects it outright
+      instead of silently padding around it.
+
+    Both axes are validated, not repaired: entries must be positive
+    integers and free of duplicates (order-insensitive input is fine and
+    is canonicalized ascending; a duplicate is a spec author's error the
+    engine must surface, not fold away).  Derived specs from
+    ``paddle_tpu.tuning`` construct through this same path, so a bad
+    derivation fails HERE, before any executable is warmed.
     """
 
     def __init__(self, batch_sizes: Sequence[int] = (1, 2, 4, 8),
                  seq_lens: Optional[Sequence[int]] = None,
-                 seq_axis: int = 0, pad_value=0):
-        if not batch_sizes:
-            raise ValueError("BucketSpec: batch_sizes must be non-empty")
-        self.batch_sizes: Tuple[int, ...] = tuple(
-            sorted({int(b) for b in batch_sizes}))
-        if self.batch_sizes[0] < 1:
-            raise ValueError("BucketSpec: batch sizes must be >= 1")
+                 seq_axis: int = 0, pad_value=0,
+                 observed_floor: Optional[int] = None):
+        self.batch_sizes: Tuple[int, ...] = self._validated(
+            "batch_sizes", batch_sizes)
         self.seq_lens: Optional[Tuple[int, ...]] = (
-            tuple(sorted({int(s) for s in seq_lens})) if seq_lens else None)
+            self._validated("seq_lens", seq_lens, floor=observed_floor)
+            if seq_lens else None)
         self.seq_axis = int(seq_axis)
         self.pad_value = pad_value
+        self.observed_floor = (int(observed_floor)
+                               if observed_floor is not None else None)
+
+    @staticmethod
+    def _validated(name: str, sizes: Sequence[int],
+                   floor: Optional[int] = None) -> Tuple[int, ...]:
+        """One validation path for every bucket axis (hand-declared and
+        tuner-derived): positive ints, no duplicates, monotonic ascending
+        canonical form, nothing below the observed floor."""
+        if not sizes:
+            raise ValueError(f"BucketSpec: {name} must be non-empty")
+        vals = [int(s) for s in sizes]
+        if any(int(s) != s for s in sizes) or min(vals) < 1:
+            raise ValueError(
+                f"BucketSpec: {name} must be positive integers, got "
+                f"{tuple(sizes)}")
+        out = tuple(sorted(vals))
+        if len(out) != len(set(out)):
+            dups = sorted({v for v in vals if vals.count(v) > 1})
+            raise ValueError(
+                f"BucketSpec: duplicate {name} entries {dups} — each "
+                f"bucket is one warmed executable, declare it once")
+        if floor is not None and out[0] < int(floor):
+            below = tuple(v for v in out if v < int(floor))
+            raise ValueError(
+                f"BucketSpec: {name} buckets {below} are below the "
+                f"smallest observed size {int(floor)} — they can never "
+                f"be selected and only waste warmed executables")
+        return out
 
     @property
     def max_batch(self) -> int:

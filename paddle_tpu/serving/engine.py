@@ -38,6 +38,12 @@ from .buckets import BucketSpec
 __all__ = ["ServingConfig", "ServingEngine", "QueueFull", "DeadlineExceeded",
            "EngineClosed", "BadRequest"]
 
+# Raw (pre-padding) variable-dim request sizes for the online tuner's
+# bucket derivation; edges mirror generation.PROMPT_TOKEN_BUCKETS so
+# quantile-cover resolution matches across engine kinds.
+REQUEST_TOKEN_BUCKETS = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
+                         192, 256, 384, 512, 768, 1024, 1536, 2048, 4096)
+
 
 @dataclass
 class ServingConfig:
@@ -141,6 +147,15 @@ class ServingEngine(EngineBase):
         self._runner_factory = self._make_runner_factory(target)
         self._compiled: Dict[Tuple, Callable] = {}
         self._warmed = False
+        # request-size truth for the online tuner (variable-dim engines
+        # only): raw pre-padding seq sizes, fleet-mergeable fixed edges
+        try:
+            from ..observability import histogram
+
+            self._hist_req_tokens = histogram("request_tokens",
+                                              REQUEST_TOKEN_BUCKETS)
+        except Exception:
+            self._hist_req_tokens = None
         # memory truth: this engine's executable footprint (padded input
         # working set per warmed bucket) rides in the `memory` provider
         try:
@@ -316,6 +331,47 @@ class ServingEngine(EngineBase):
         self._warmed = True
         return self
 
+    def respec(self, buckets: BucketSpec) -> "ServingEngine":
+        """Swap the bucket spec LIVE with the zero-retrace invariant
+        intact: every runner the new spec can route to is AOT-warmed
+        BEFORE the swap, outside the engine lock (compiles are seconds —
+        serving never stalls behind them), then the spec reference flips
+        under the lock at a batch boundary.
+
+        In-flight requests were padded under the OLD spec, so the warm
+        set also covers (new batch bucket x already-seen key) — a
+        request validated pre-swap executes post-swap without a fresh
+        compile.  Old runners stay cached: an executable is only memory,
+        a retrace is an SLO hole.  This is the single-process actuator;
+        multi-process fleets re-shape through ``ServingFleet.
+        apply_serving_shape`` (respawn + warm behind the rolling-restart
+        fence) instead."""
+        shapes = [shape for shape, _dt in self._specs]
+        fresh: Dict[Tuple, Callable] = {}
+
+        def warm(bb, key):
+            if (bb, key) in self._compiled or (bb, key) in fresh:
+                return
+            runner = self._runner_factory(bb, key)
+            dummies = [np.full((bb,) + shp, buckets.pad_value,
+                               dtype=_np_dtype(dt))
+                       for (dt, shp) in key]
+            runner(dummies)
+            fresh[(bb, key)] = runner
+            self.metrics.inc("respec_compiles")
+
+        for bb, concrete in buckets.warm_shapes(shapes):
+            warm(bb, tuple((dt, shp) for (_s, dt), shp
+                           in zip(self._specs, concrete)))
+        for _bb, key in list(self._compiled):
+            for bb in buckets.batch_sizes:
+                warm(bb, key)
+        with self._cond:
+            self._compiled.update(fresh)
+            self.buckets = buckets
+        self.metrics.inc("respecs")
+        return self
+
     # -- submission -----------------------------------------------------------
     def submit(self, inputs: Sequence, deadline_ms: Optional[float] = None,
                trace_parent: Optional[str] = None) -> "Future":
@@ -375,6 +431,12 @@ class ServingEngine(EngineBase):
                     raise BadRequest(
                         f"input {i}: dim {ax} is {a.shape[ax]}, expected {d}")
             if any(d is None for d in shape):  # only declared-variable dims
+                if self._hist_req_tokens is not None and \
+                        self.buckets.seq_axis < a.ndim:
+                    # raw size BEFORE padding (and before any reject):
+                    # the tuner derives buckets from what ARRIVES
+                    self._hist_req_tokens.observe(
+                        a.shape[self.buckets.seq_axis])
                 try:                           # ride the seq buckets
                     a = self.buckets.pad_sample_seq(a)
                 except ValueError as e:

@@ -857,6 +857,15 @@ def replica_main() -> int:
     if not spec:
         raise SystemExit("PT_REPLICA_BUILDER not set")
     engine = resolve_builder(spec)()
+    tuned = os.environ.get("PT_TUNED_SHAPE", "")
+    if tuned:
+        # online auto-tuner respec: the supervisor stamped a derived
+        # serving shape into the env before this (rolling-restart)
+        # respawn — apply it BEFORE warmup so the zero-retrace
+        # invariant holds over the new bucket family too
+        from ..tuning.serving_tuner import apply_tuned_shape
+
+        engine = apply_tuned_shape(engine, json.loads(tuned))
     if hasattr(engine, "warmup"):
         engine.warmup()  # warmed buckets BEFORE the ready publish
     engine.start()
@@ -3126,6 +3135,25 @@ class ServingFleet:
         out: Dict[str, Any] = {"swapped": swapped, "fallback": fallback}
         if fallback:
             out["rolled"] = self.rolling_restart()
+        return out
+
+    # -- online serving-shape retune ------------------------------------------
+    def apply_serving_shape(self, shape: Dict[str, Any]) -> Dict:
+        """Actuate a derived serving shape (the online tuner's bucket /
+        slot / miss-cap proposal) across the fleet with zero downtime:
+        stamp the shape into the replica spawn env and roll the fleet.
+        Each replica re-applies the shape and AOT-warms the NEW bucket
+        family before it re-publishes readiness, so the zero-retrace
+        invariant holds across the cutover. Planned roll: no restart
+        budget is spent."""
+        payload = json.dumps(shape, sort_keys=True)
+        with self._lock:
+            self.extra_env["PT_TUNED_SHAPE"] = payload
+        self.sm.note("serving_shape", time.time(),
+                     digest=shape.get("digest", ""))
+        self._inc("shape_applies")
+        out = self.rolling_restart()
+        out["shape"] = shape
         return out
 
     # -- rolling restart ------------------------------------------------------

@@ -55,6 +55,15 @@ _GEN_NO = itertools.count(1)
 # shedding still applies to explicit deadlines alone.
 _EDF_DEFAULT_HORIZON_S = 300.0
 
+# Size-distribution histograms the online tuner derives serving shapes
+# from. Edges must be fine enough that a quantile-cover over bucket
+# UPPER bounds still lands near the true p99 (derivation collapses each
+# bucket to its upper edge), and identical across every replica so the
+# fleet merge is exact.
+PROMPT_TOKEN_BUCKETS = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128,
+                        192, 256, 384, 512, 768, 1024, 1536, 2048, 4096)
+SLOT_BUCKETS = (0, 1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64, 128)
+
 
 def _injector():
     from ..distributed.resilience.faults import injector
@@ -497,8 +506,15 @@ class GenerationEngine(EngineBase):
             # its first token left prefill), so the fleet's SLO layer can
             # compute TTFT percentiles from merged buckets alone
             self._hist_ttft = histogram("ttft_ms")
+            # request-size / occupancy truth for the online tuner: the
+            # merged fleet feed of these two histograms is what derives
+            # prefill buckets and slot counts (paddle_tpu.tuning.shapes)
+            self._hist_prompt = histogram("prompt_tokens",
+                                          PROMPT_TOKEN_BUCKETS)
+            self._hist_slots = histogram("gen_active_slots", SLOT_BUCKETS)
         except Exception:
             self._fam_prefix = self._fam_spec = self._hist_ttft = None
+            self._hist_prompt = self._hist_slots = None
         # slot-occupancy history: (slot, t0, t1, tokens) per residency —
         # the timeline track behind the pd_top occupancy view and the
         # chrome-trace slots:<engine> process
@@ -617,6 +633,11 @@ class GenerationEngine(EngineBase):
             self.metrics.inc("errors_total")
             fut.set_exception(BadRequest("max_new_tokens must be >= 1"))
             return fut
+        # observed BEFORE the bucket check: the tuner must see the true
+        # request-size distribution, rejected oversizes included — a
+        # shape that keeps rejecting traffic is exactly what it fixes
+        if self._hist_prompt is not None:
+            self._hist_prompt.observe(len(prompt))
         bucket = self._prefill_bucket(len(prompt))
         if bucket is None:
             self.metrics.inc("errors_total")
@@ -989,6 +1010,10 @@ class GenerationEngine(EngineBase):
                         # untimed: submit/close/op notify — no idle polling
                         self._cond.wait()
                 continue
+            if self._hist_slots is not None:
+                # concurrent-occupancy sample per decode window: the
+                # distribution the tuner derives max_slots from
+                self._hist_slots.observe(len(active))
             try:
                 self._decode_once(active)
             except Exception as e:  # decode fault: fail the in-flight batch

@@ -64,6 +64,23 @@ def _fam():
     return _FAM
 
 
+_MISS_HIST = None  # lazily-bound "sparse_miss_rows" histogram
+
+# Per-lookup cold-miss counts: the distribution the online tuner derives
+# serving ``miss_caps`` from (quantile-cover over the merged fleet feed).
+SPARSE_MISS_BUCKETS = (0, 1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024,
+                       2048, 4096, 8192)
+
+
+def _miss_hist():
+    global _MISS_HIST
+    if _MISS_HIST is None:
+        from ..observability import histogram
+
+        _MISS_HIST = histogram("sparse_miss_rows", SPARSE_MISS_BUCKETS)
+    return _MISS_HIST
+
+
 _ABSTRACT_ZERO_OK = [False]
 
 
@@ -819,6 +836,12 @@ class ShardedEmbeddingTable:
                 len(miss_ids), dtype=np.int32)
             idx = src[inverse].astype(np.int32)
             cache_dev = self.cache.dev
+        # observed OUTSIDE the table lock (hub mutexes under _mu would
+        # order against every other provider); feeds miss-cap derivation
+        try:
+            _miss_hist().observe(float(len(miss_ids)))
+        except Exception:
+            pass
         rows = self._serve_fn(len(idx), miss_cap)(
             cache_dev, jnp.asarray(staged_np), jnp.asarray(idx))
         return np.asarray(rows).reshape(shape + (self.dim,))
@@ -859,6 +882,21 @@ class EmbeddingLookupTarget:
         self.table = table
         self._miss_caps = tuple(sorted(set(int(c) for c in miss_caps))) \
             if miss_caps else None
+
+    def set_miss_caps(self, miss_caps: Optional[Sequence[int]]) -> None:
+        """Replace the declared miss-capacity buckets (online retune).
+
+        Validated through the same path as serving batch buckets
+        (``BucketSpec._validated``): positive ints, no duplicates,
+        canonical ascending order. Only affects runners built AFTER the
+        call — already-warmed runners keep the cap family they compiled
+        against, so the swap is applied through an engine respec /
+        rolling restart, never mid-flight."""
+        if miss_caps is None:
+            self._miss_caps = None
+            return
+        from ..serving.buckets import BucketSpec
+        self._miss_caps = BucketSpec._validated("miss_caps", miss_caps)
 
     def caps_for(self, n_ids: int) -> Tuple[int, ...]:
         """Miss-capacity buckets for an ``n_ids`` request block. The

@@ -1,0 +1,45 @@
+"""Online auto-tuning: the runtime that retunes itself from live
+telemetry (docs/performance.md, "Online tuning").
+
+Three actuators behind one :class:`~paddle_tpu.tuning.policy.
+TuningPolicy` contract (observe -> propose -> apply-at-boundary ->
+measure -> keep-or-rollback):
+
+* :class:`~paddle_tpu.tuning.plan_tuner.ElasticPlanTuner` — re-ranks
+  the cached ``plan()`` candidates under live step-time measurements
+  and swaps the training fleet at a checkpoint-boundary fence.
+* :class:`~paddle_tpu.tuning.serving_tuner.ServingShapePolicy` —
+  derives serving buckets / generation slots / sparse miss-caps from
+  live request-size histograms and rolls them out through the
+  zero-downtime rolling-restart fence (AOT pre-warm before cutover).
+* The future autoscaler (ROADMAP direction 1) is just another policy.
+
+``PT_ONLINE_TUNING=0`` is the global kill-switch.
+"""
+from .detector import RegressionDetector
+from .policy import Proposal, TuningPolicy
+from .shapes import (derive_buckets_from_histogram,
+                     derive_slots_from_histogram, padding_waste,
+                     quantile_cover, shape_digest, sizes_from_histogram,
+                     weighted_quantile)
+from .tuner import OnlineTuner, tuning_enabled
+
+__all__ = [
+    "RegressionDetector", "Proposal", "TuningPolicy", "OnlineTuner",
+    "tuning_enabled", "quantile_cover", "weighted_quantile",
+    "padding_waste", "sizes_from_histogram",
+    "derive_buckets_from_histogram", "derive_slots_from_histogram",
+    "shape_digest",
+]
+
+
+def __getattr__(name):  # lazy: serving/fleet deps stay import-light
+    if name in ("ServingShapePolicy", "apply_tuned_shape"):
+        from . import serving_tuner
+
+        return getattr(serving_tuner, name)
+    if name == "ElasticPlanTuner":
+        from . import plan_tuner
+
+        return plan_tuner.ElasticPlanTuner
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,171 @@
+"""Sustained-regression detection over a step-time series.
+
+The online tuner must never act on a single slow step: GC pauses,
+checkpoint commits and page migrations all produce legitimate spikes.
+:class:`RegressionDetector` therefore keeps a ROBUST windowed baseline
+(median + MAD over recent healthy samples — elevated samples are
+excluded so the baseline cannot chase the regression it is trying to
+detect) and declares a regression only after ``sustain_n`` CONSECUTIVE
+elevated samples.  Recovery is hysteretic: once regressed, the detector
+returns to ``ok`` only after ``recover_n`` consecutive samples below a
+LOWER threshold (``recover_ratio < trigger_ratio``), so a series
+oscillating around the trigger line cannot flap the state.
+
+The class is pure (no clocks, no I/O): feed it milliseconds, read the
+state.  Both the flight-recorder-driven plan tuner and the unit matrix
+in ``tests/test_tuning.py`` drive this exact object.
+"""
+from __future__ import annotations
+
+import math
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+__all__ = ["RegressionDetector"]
+
+# 1.4826 * MAD estimates sigma for normally-distributed noise
+_MAD_SIGMA = 1.4826
+
+
+def _median(xs: List[float]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+class RegressionDetector:
+    """Three-state detector: ``warming`` -> ``ok`` <-> ``regressed``.
+
+    A sample is *elevated* when it exceeds EVERY guard at once:
+
+    * ``baseline * trigger_ratio``   (relative shift),
+    * ``baseline + min_abs_ms``      (absolute floor — a 3 ms baseline
+      tripling to 9 ms is noise, not a regression), and
+    * ``baseline + mad_k * sigma``   (noise-adaptive: a naturally noisy
+      series needs a larger excursion to count).
+
+    ``sustain_n`` consecutive elevated samples flip the state to
+    ``regressed``; a single spike (or any sub-``sustain_n`` burst)
+    resets the streak and never triggers.  Elevated samples are NOT
+    admitted to the baseline window, so the pre-regression baseline
+    stays frozen for the rescorer to compare against.
+    """
+
+    def __init__(self, *, baseline_window: int = 32, min_samples: int = 8,
+                 trigger_ratio: float = 1.3, min_abs_ms: float = 5.0,
+                 mad_k: float = 4.0, sustain_n: int = 5,
+                 recover_ratio: float = 1.1, recover_n: int = 5):
+        if not (1.0 < recover_ratio <= trigger_ratio):
+            raise ValueError(
+                f"need 1 < recover_ratio <= trigger_ratio for hysteresis, "
+                f"got recover={recover_ratio} trigger={trigger_ratio}")
+        if sustain_n < 2 or recover_n < 1:
+            raise ValueError("sustain_n must be >=2 (never single-spike) "
+                             "and recover_n >=1")
+        self.baseline_window = int(baseline_window)
+        self.min_samples = max(int(min_samples), 2)
+        self.trigger_ratio = float(trigger_ratio)
+        self.min_abs_ms = float(min_abs_ms)
+        self.mad_k = float(mad_k)
+        self.sustain_n = int(sustain_n)
+        self.recover_ratio = float(recover_ratio)
+        self.recover_n = int(recover_n)
+        self._healthy: Deque[float] = deque(maxlen=self.baseline_window)
+        self._elevated_run: Deque[float] = deque(maxlen=max(sustain_n, 64))
+        self._recover_streak = 0
+        self.state = "warming"
+        self.samples = 0
+        self.triggers = 0          # ok -> regressed transitions
+        self.recoveries = 0        # regressed -> ok transitions
+
+    # -- thresholds -----------------------------------------------------------
+    def baseline_ms(self) -> Optional[float]:
+        if len(self._healthy) < self.min_samples:
+            return None
+        return _median(list(self._healthy))
+
+    def _sigma(self) -> float:
+        xs = list(self._healthy)
+        med = _median(xs)
+        mad = _median([abs(x - med) for x in xs])
+        return _MAD_SIGMA * mad
+
+    def trigger_threshold_ms(self) -> Optional[float]:
+        base = self.baseline_ms()
+        if base is None:
+            return None
+        return max(base * self.trigger_ratio, base + self.min_abs_ms,
+                   base + self.mad_k * self._sigma())
+
+    def recover_threshold_ms(self) -> Optional[float]:
+        base = self.baseline_ms()
+        if base is None:
+            return None
+        return max(base * self.recover_ratio,
+                   base + 0.5 * self.min_abs_ms)
+
+    def regressed_ms(self) -> Optional[float]:
+        """Live measured step time while regressed: the median of the
+        elevated run — what the rescorer anchors the ACTIVE candidate
+        to (the model's prediction is refuted by measurement)."""
+        if not self._elevated_run:
+            return None
+        return _median(list(self._elevated_run))
+
+    # -- feed -----------------------------------------------------------------
+    def update(self, ms: float) -> str:
+        """Feed one step-time sample (milliseconds); returns the state."""
+        ms = float(ms)
+        if not math.isfinite(ms) or ms < 0:
+            return self.state
+        self.samples += 1
+        trig = self.trigger_threshold_ms()
+        if trig is None:  # still warming the baseline
+            self._healthy.append(ms)
+            if self.baseline_ms() is not None:
+                self.state = "ok"
+            return self.state
+
+        if self.state == "regressed":
+            rec = self.recover_threshold_ms()
+            if ms <= rec:
+                self._recover_streak += 1
+                if self._recover_streak >= self.recover_n:
+                    self.state = "ok"
+                    self.recoveries += 1
+                    self._elevated_run.clear()
+                    self._recover_streak = 0
+                    self._healthy.append(ms)
+            else:
+                self._recover_streak = 0
+                self._elevated_run.append(ms)
+            return self.state
+
+        # state == "ok"
+        if ms > trig:
+            self._elevated_run.append(ms)
+            if len(self._elevated_run) >= self.sustain_n:
+                self.state = "regressed"
+                self.triggers += 1
+                self._recover_streak = 0
+        else:
+            self._elevated_run.clear()
+            self._healthy.append(ms)
+        return self.state
+
+    def reset(self) -> None:
+        """Forget everything — called after an actuator changes the
+        config under measurement (old baseline no longer describes the
+        new config's step time)."""
+        self._healthy.clear()
+        self._elevated_run.clear()
+        self._recover_streak = 0
+        self.state = "warming"
+
+    def snapshot(self) -> Dict[str, object]:
+        return {"state": self.state, "samples": self.samples,
+                "baseline_ms": self.baseline_ms(),
+                "trigger_ms": self.trigger_threshold_ms(),
+                "regressed_ms": self.regressed_ms(),
+                "triggers": self.triggers, "recoveries": self.recoveries}

@@ -1,0 +1,322 @@
+"""Plan re-rank actuator: swap a training fleet onto a better parallel
+plan when live step times refute the active one.
+
+:class:`ElasticPlanTuner` runs inside the RANK-0 worker of an elastic
+gang (feed it ``on_step(ms)`` from a fit callback).  The loop:
+
+* **observe** — every completed step's wall time feeds a
+  :class:`~paddle_tpu.tuning.detector.RegressionDetector` (robust
+  windowed baseline + sustained-regression test; a single spike never
+  triggers).
+* **propose** — on a sustained regression, re-score the cached
+  ``plan()`` candidates under live conditions
+  (:func:`~paddle_tpu.distributed.auto_parallel.planner.
+  rescore_candidates` with the calibrated link model), ANCHORING the
+  active plan to its measured degraded step time.  A different feasible
+  candidate must win by ``margin`` against that measured anchor.
+* **apply at the boundary** — publish the winner as
+  ``fleet/plan_override`` and raise a ``retune:plan`` fence: every
+  worker drains to its committed checkpoint and exits
+  ``EXIT_FENCED``; the supervisor restarts the gang (planned — no
+  crash budget spent) and the next generation's ``replan()`` picks the
+  override up.  The swap happens exactly at a checkpoint boundary,
+  never mid-step.
+* **measure, keep-or-rollback** — the tuner state survives the fence
+  in the control-plane store.  The next generation's tuner measures
+  ``measure_steps`` steps under the new plan: median at or below
+  ``target_ms`` (the regressed measurement minus the margin) confirms
+  the win; otherwise the old plan is re-published and a
+  ``retune:rollback`` fence restores it, with the refuted digest
+  embargoed so the tuner never flaps.
+
+``PT_ONLINE_TUNING=0`` turns every verb into a no-op.
+"""
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+from .detector import RegressionDetector
+from .policy import Proposal, TuningPolicy
+from .tuner import tuning_enabled
+
+__all__ = ["ElasticPlanTuner", "PLAN_STATE_KEY", "PLAN_OVERRIDE_KEY"]
+
+PLAN_STATE_KEY = "fleet/tuner/plan_state"
+PLAN_OVERRIDE_KEY = "fleet/plan_override"
+
+
+def _fresh_state() -> Dict[str, Any]:
+    return {"phase": "idle", "active": None, "from_desc": None,
+            "from_digest": "", "to_digest": "", "reg_ms": 0.0,
+            "target_ms": 0.0, "proposal": None,
+            "counters": {"proposals": 0, "applies": 0, "keeps": 0,
+                         "rollbacks": 0},
+            "rejected": [], "cooldown_until": 0.0, "last_verdict": None}
+
+
+class ElasticPlanTuner(TuningPolicy):
+    """The plan actuator as a :class:`TuningPolicy`, self-driven per
+    step (it cannot ride the ``OnlineTuner`` thread: the apply boundary
+    kills this very process, so state persists in the fleet store and a
+    fresh instance in the next generation finishes the measurement).
+
+    ``candidates`` is the cached ``plan()`` output (``PlanCandidate``s
+    or their ``to_dict()`` descriptors) enumerated for THIS world size;
+    ``profile`` the matching ``ModelProfile``.  Construct on rank 0
+    only."""
+
+    name = "plan_rerank"
+    kind = "plan"
+
+    def __init__(self, ctx, profile, candidates: Sequence, *,
+                 margin: float = 0.2, measure_steps: int = 5,
+                 skip_steps: int = 2, cooldown_s: float = 10.0,
+                 detector: Optional[RegressionDetector] = None,
+                 link=None, hbm_bytes: Optional[float] = None,
+                 optimizer: Any = "adamw",
+                 register_provider_name: Optional[str] = "tuner"):
+        self.ctx = ctx
+        self.profile = profile
+        self.candidates = list(candidates)
+        self.margin = float(margin)
+        self.measure_steps = int(measure_steps)
+        self.skip_steps = int(skip_steps)
+        self.cooldown_s = float(cooldown_s)
+        self.detector = detector or RegressionDetector()
+        self.hbm_bytes = hbm_bytes
+        self.optimizer = optimizer
+        if link is None:
+            try:
+                from ..cost_model.comm import calibrated_link_model
+
+                link = calibrated_link_model()
+            except Exception:
+                link = None
+        self.link = link
+        self._state: Optional[Dict[str, Any]] = None
+        self._measure_ms: List[float] = []
+        self._fence_raised = False
+        if register_provider_name:
+            try:
+                from ..observability import register_provider
+
+                register_provider(register_provider_name, self.snapshot)
+            except Exception:
+                pass
+
+    # -- store plumbing -------------------------------------------------------
+    def _store(self):
+        return getattr(self.ctx, "store", None)
+
+    def _load(self) -> Dict[str, Any]:
+        if self._state is not None:
+            return self._state
+        st = None
+        store = self._store()
+        if store is not None:
+            from ..distributed.fleet.runtime import _probe_json
+
+            try:
+                st = _probe_json(store, PLAN_STATE_KEY)
+            except Exception:
+                st = None
+        self._state = dict(_fresh_state(), **st) if isinstance(st, dict) \
+            else _fresh_state()
+        if self._state["active"] is None:
+            self._state["active"] = self._active_digest_from_plan()
+        return self._state
+
+    def _save(self) -> None:
+        store = self._store()
+        if store is not None and self._state is not None:
+            from ..distributed.fleet.runtime import _publish
+
+            _publish(store, PLAN_STATE_KEY, self._state)
+
+    def _active_desc(self) -> Optional[Dict[str, Any]]:
+        """This generation's published plan descriptor."""
+        store = self._store()
+        if store is None:
+            return None
+        from ..distributed.fleet.runtime import _probe_json
+
+        try:
+            return _probe_json(store,
+                               f"fleet/{self.ctx.gen}/plan")
+        except Exception:
+            return None
+
+    def _active_digest_from_plan(self) -> str:
+        desc = self._active_desc()
+        if not isinstance(desc, dict):
+            return ""
+        from ..distributed.auto_parallel.planner import plan_digest
+
+        cfg = desc.get("config", desc)
+        try:
+            return plan_digest(cfg)
+        except Exception:
+            return ""
+
+    def _raise_fence(self, reason: str) -> None:
+        store = self._store()
+        if store is None:
+            return
+        from ..distributed.fleet.runtime import _publish
+
+        gen = self.ctx.gen
+        # reason FIRST: by the time any worker (or the supervisor) sees
+        # the fence counter, the planned "retune:*" name is probe-able
+        _publish(store, f"fleet/{gen}/fence_reason", reason)
+        store.add(f"fleet/{gen}/fence", 1)
+        self._fence_raised = True
+
+    # -- the per-step driver --------------------------------------------------
+    def on_step(self, ms: float) -> None:
+        """Feed one completed training step's wall time (rank 0)."""
+        if not tuning_enabled() or self._fence_raised:
+            return
+        st = self._load()
+        if st["phase"] == "measure":
+            self._measure(None, step_ms=float(ms))
+            return
+        state = self.detector.update(float(ms))
+        if state != "regressed":
+            return
+        if time.time() < float(st.get("cooldown_until", 0.0)):
+            return
+        prop = self.propose()
+        if prop is None:
+            # nothing wins under live conditions: hold off re-scoring
+            # every subsequent elevated step
+            st["cooldown_until"] = time.time() + self.cooldown_s
+            self._save()
+            return
+        st["counters"]["proposals"] += 1
+        self.apply(prop)
+
+    # -- policy verbs ---------------------------------------------------------
+    def observe(self, signals: Dict[str, Any]) -> None:
+        for ms in signals.get("step_ms", ()) or ():
+            self.on_step(float(ms))
+
+    def propose(self) -> Optional[Proposal]:
+        st = self._load()
+        reg_ms = self.detector.regressed_ms()
+        if not reg_ms:
+            return None
+        from ..distributed.auto_parallel.planner import (plan_digest,
+                                                         rescore_candidates)
+
+        active = st["active"] or self._active_digest_from_plan()
+        reg_s = reg_ms / 1e3
+        ranked = rescore_candidates(
+            self.profile, self.candidates, link=self.link,
+            hbm_bytes=self.hbm_bytes, optimizer=self.optimizer,
+            measured={active: reg_s})
+        target_ms = reg_ms * (1.0 - self.margin)
+        for c in ranked:
+            if not c.feasible:
+                break
+            d = plan_digest(c.config)
+            if d == active or d in st["rejected"]:
+                continue
+            # the challenger must beat the MEASURED degraded step time
+            # by the margin (model-predicted absolute scale is not
+            # trusted against wall clocks — the anchor is)
+            if c.predicted_step_s <= target_ms / 1e3:
+                return Proposal(
+                    policy=self.name, kind=self.kind,
+                    from_digest=active, to_digest=d,
+                    payload=c.to_dict() if hasattr(c, "to_dict")
+                    else {"config": dict(c.config)},
+                    predicted={"predicted_step_ms":
+                               round(c.predicted_step_s * 1e3, 3),
+                               "target_ms": round(target_ms, 3),
+                               "regressed_ms": round(reg_ms, 3),
+                               "baseline_ms":
+                               round(self.detector.baseline_ms() or 0.0,
+                                     3)})
+            break  # ranked: the first eligible candidate is the winner
+        return None
+
+    def apply(self, proposal: Proposal) -> bool:
+        """Publish the override and raise the planned fence — the swap
+        lands at the next checkpoint boundary in a fresh generation."""
+        if not tuning_enabled():
+            return False
+        store = self._store()
+        if store is None:
+            return False
+        st = self._load()
+        st.update(phase="measure", to_digest=proposal.to_digest,
+                  from_digest=proposal.from_digest,
+                  from_desc=self._active_desc(),
+                  reg_ms=proposal.predicted.get("regressed_ms", 0.0),
+                  target_ms=proposal.predicted.get("target_ms", 0.0),
+                  proposal=proposal.to_dict(),
+                  active=proposal.to_digest)
+        st["counters"]["applies"] += 1
+        self._save()
+        from ..distributed.fleet.runtime import _publish
+
+        _publish(store, PLAN_OVERRIDE_KEY, proposal.payload)
+        self._raise_fence("retune:plan")
+        return True
+
+    def _measure(self, _proposal, step_ms: Optional[float] = None
+                 ) -> Optional[bool]:
+        st = self._load()
+        if step_ms is not None:
+            self._measure_ms.append(step_ms)
+        if len(self._measure_ms) < self.skip_steps + self.measure_steps:
+            return None
+        med = statistics.median(self._measure_ms[self.skip_steps:])
+        kept = med <= float(st["target_ms"]) or st["target_ms"] <= 0
+        st["last_verdict"] = {"kept": bool(kept),
+                              "measured_ms": round(med, 3),
+                              "target_ms": st["target_ms"],
+                              "digest": st["to_digest"]}
+        st["cooldown_until"] = time.time() + self.cooldown_s
+        st["phase"] = "idle"
+        self._measure_ms = []
+        if kept:
+            st["counters"]["keeps"] += 1
+            st["active"] = st["to_digest"]
+            self._save()
+            return True
+        self.rollback(_proposal)
+        return False
+
+    def measure(self, proposal: Proposal) -> Optional[bool]:
+        return self._measure(proposal)
+
+    def rollback(self, _proposal) -> None:
+        """Re-publish the pre-swap plan and fence back onto it."""
+        st = self._load()
+        st["counters"]["rollbacks"] += 1
+        if st["to_digest"]:
+            st["rejected"] = sorted(set(st["rejected"])
+                                    | {st["to_digest"]})
+        st["active"] = st["from_digest"]
+        st["phase"] = "idle"
+        self._save()
+        store = self._store()
+        if store is not None and isinstance(st["from_desc"], dict):
+            from ..distributed.fleet.runtime import _publish
+
+            _publish(store, PLAN_OVERRIDE_KEY, st["from_desc"])
+            self._raise_fence("retune:rollback")
+
+    # -- provider surface -----------------------------------------------------
+    def active_digest(self) -> str:
+        st = self._load()
+        return st["active"] or ""
+
+    def snapshot(self) -> Dict[str, Any]:
+        st = dict(self._load())
+        st["detector"] = self.detector.snapshot()
+        st["enabled"] = tuning_enabled()
+        return st

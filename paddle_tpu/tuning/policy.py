@@ -1,0 +1,89 @@
+"""The ``TuningPolicy`` contract: observe -> propose -> apply-at-boundary
+-> measure -> keep-or-rollback.
+
+Every online actuator — the plan re-ranker, the serving-shape deriver,
+and the future autoscaler (ROADMAP direction 1) — is ONE policy behind
+this interface.  The :class:`~paddle_tpu.tuning.tuner.OnlineTuner`
+drives the state machine and owns the decision ledger; policies supply
+the domain logic and the boundary-safe apply/rollback mechanics.
+
+The contract, precisely:
+
+* ``observe(signals)`` — fold new telemetry in.  ``signals`` is the
+  tuner-assembled view (merged ``fleet_telemetry``, the ``slo``
+  snapshot, flight-recorder step series) so a policy never scrapes on
+  its own.
+* ``propose()`` — return a :class:`Proposal` when a better config wins
+  by the policy's margin, else ``None``.  Proposals are *predictions*:
+  they carry the measurable claim the post-apply window will test.
+* ``apply(proposal)`` — apply AT A BOUNDARY (checkpoint commit for
+  training plans, rolling-restart fence for serving shapes).  Returns
+  False if the boundary could not be taken; the tuner drops the
+  proposal and re-observes.
+* ``measure(proposal)`` — called repeatedly after a successful apply:
+  ``True`` = prediction confirmed (keep), ``False`` = refuted
+  (the tuner calls ``rollback``), ``None`` = measurement window still
+  filling.
+* ``rollback(proposal)`` — restore the pre-apply config through the
+  same boundary mechanism.  A rolled-back target is remembered by the
+  tuner so the identical proposal is not re-applied while the
+  cooldown holds.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+__all__ = ["Proposal", "TuningPolicy"]
+
+
+@dataclass
+class Proposal:
+    """One proposed config change plus the claim that justifies it."""
+    policy: str                     # proposing policy's name
+    kind: str                       # "plan" | "serving_shape" | ...
+    from_digest: str                # active config identity
+    to_digest: str                  # proposed config identity
+    payload: Any                    # what apply() needs (config/shape)
+    predicted: Dict[str, float] = field(default_factory=dict)
+    created_t: float = field(default_factory=time.monotonic)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"policy": self.policy, "kind": self.kind,
+                "from": self.from_digest, "to": self.to_digest,
+                "predicted": dict(self.predicted)}
+
+
+class TuningPolicy:
+    """Base policy: subclasses override the five verbs.  ``name`` keys
+    the ledger and the ``tuner`` provider; ``cooldown_s`` is the
+    minimum quiet period after a keep/rollback before this policy may
+    propose again (flap damping)."""
+
+    name = "policy"
+    cooldown_s = 30.0
+
+    def observe(self, signals: Dict[str, Any]) -> None:
+        """Fold the tuner-assembled telemetry view into policy state."""
+
+    def propose(self) -> Optional[Proposal]:
+        return None
+
+    def apply(self, proposal: Proposal) -> bool:
+        raise NotImplementedError
+
+    def measure(self, proposal: Proposal) -> Optional[bool]:
+        """True=confirmed, False=refuted, None=window still filling."""
+        return True
+
+    def rollback(self, proposal: Proposal) -> None:
+        raise NotImplementedError
+
+    def active_digest(self) -> str:
+        """Identity of the currently-applied config (provider surface)."""
+        return ""
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Extra policy-specific provider fields (optional)."""
+        return {}

@@ -1,0 +1,328 @@
+"""Serving-shape actuator: derive buckets/slots/miss-caps from live
+request distributions and apply them fleet-wide with zero downtime.
+
+Two halves:
+
+* :class:`ServingShapePolicy` — the supervisor-side
+  :class:`~paddle_tpu.tuning.policy.TuningPolicy`.  It folds the merged
+  ``fleet_telemetry`` histograms (``prompt_tokens``,
+  ``gen_active_slots``, ``request_tokens``, ``sparse_miss_rows``)
+  through restart-safe :class:`~paddle_tpu.observability.fleet.
+  HistogramWindow`s, derives a shape via quantile-cover
+  (:mod:`paddle_tpu.tuning.shapes`), and actuates it through
+  ``ServingFleet.apply_serving_shape`` — a rolling restart in which
+  every replica AOT-warms the NEW bucket family before re-admitting
+  traffic, so the zero-retrace invariant holds across the cutover.
+
+* :func:`apply_tuned_shape` — the replica-side respec, invoked by
+  ``replica_main`` when the supervisor stamped ``PT_TUNED_SHAPE`` into
+  the spawn env.  Duck-typed over the two engine families: a
+  generation engine (bucket/slot config baked at construction) is
+  REBUILT with the tuned config; a batch serving engine (respec-able
+  in place) gets a derived :class:`~paddle_tpu.serving.buckets.
+  BucketSpec`.  Both paths validate through ``BucketSpec`` — a bad
+  derivation fails before any executable is warmed.
+
+The measurable claim a shape proposal carries is its predicted padding
+waste over the observation window; the post-apply measurement window
+recomputes live waste under the new shape and the tuner keeps or rolls
+back on that evidence.
+"""
+from __future__ import annotations
+
+import copy
+import time
+from typing import Any, Dict, Optional, Tuple
+
+from .policy import Proposal, TuningPolicy
+from .shapes import (derive_buckets_from_histogram,
+                     derive_slots_from_histogram, padding_waste,
+                     shape_digest, sizes_from_histogram)
+
+__all__ = ["ServingShapePolicy", "apply_tuned_shape", "DECLARED_DIGEST"]
+
+# the identity of the hand-declared (un-tuned) shape: rollback target
+DECLARED_DIGEST = "declared"
+
+# histogram family -> shape field it derives
+_FAMILIES = ("prompt_tokens", "gen_active_slots", "request_tokens",
+             "sparse_miss_rows")
+
+
+def _validate_shape(shape: Dict[str, Any]) -> None:
+    """Every derived axis runs through the SAME BucketSpec validation
+    as a hand-declared spec (satellite contract): positive ints, no
+    duplicates, canonical ascending, floor respected."""
+    from ..serving.buckets import BucketSpec
+
+    floor = shape.get("observed_floor")
+    if shape.get("prefill_buckets"):
+        BucketSpec._validated("prefill_buckets",
+                              shape["prefill_buckets"], floor=floor)
+    if shape.get("seq_buckets"):
+        BucketSpec._validated("seq_buckets", shape["seq_buckets"],
+                              floor=floor)
+    if shape.get("miss_caps"):
+        BucketSpec._validated("miss_caps", shape["miss_caps"])
+    if "max_slots" in shape and int(shape["max_slots"]) < 1:
+        raise ValueError(
+            f"tuned shape: max_slots must be >= 1, got "
+            f"{shape['max_slots']}")
+
+
+def apply_tuned_shape(engine, shape: Dict[str, Any]):
+    """Replica-side respec: apply a derived serving shape to a freshly
+    built engine BEFORE warmup.  Returns the engine to serve (possibly
+    a rebuilt instance).  Unknown engine kinds pass through untouched —
+    a tuned fleet can mix respec-able and fixed-shape replicas."""
+    _validate_shape(shape)
+    cfg = getattr(engine, "config", None)
+    if cfg is not None and hasattr(cfg, "prefill_buckets"):
+        # generation engine: slots/pages/buckets are baked into the
+        # arenas at construction — rebuild with the tuned config
+        new_cfg = copy.copy(cfg)
+        if shape.get("prefill_buckets"):
+            new_cfg.prefill_buckets = tuple(
+                sorted({int(b) for b in shape["prefill_buckets"]}))
+        if shape.get("max_slots"):
+            new_cfg.max_slots = int(shape["max_slots"])
+            new_cfg.num_pages = None  # re-derive for the new slot count
+        name = getattr(engine, "name", None)
+        try:
+            return type(engine)(engine.model, new_cfg, name=name)
+        except TypeError:
+            return type(engine)(engine.model, new_cfg)
+    if hasattr(engine, "respec") and shape.get("seq_buckets"):
+        # batch serving engine: swap the BucketSpec in place (respec
+        # AOT-warms the new family before the swap)
+        from ..serving.buckets import BucketSpec
+
+        old = engine.buckets
+        spec = BucketSpec(
+            batch_sizes=tuple(shape.get("batch_buckets")
+                              or old.batch_sizes),
+            seq_lens=tuple(shape["seq_buckets"]),
+            seq_axis=old.seq_axis, pad_value=old.pad_value,
+            observed_floor=shape.get("observed_floor"))
+        engine.respec(spec)
+        return engine
+    tgt = getattr(engine, "target", None)
+    if shape.get("miss_caps") and hasattr(tgt, "set_miss_caps"):
+        tgt.set_miss_caps(shape["miss_caps"])
+    return engine
+
+
+class ServingShapePolicy(TuningPolicy):
+    """Derive serving shapes from live size distributions and roll them
+    out at the rolling-restart fence boundary.
+
+    ``declared`` is the hand-declared shape the fleet booted with (the
+    rollback target and the waste baseline); fields mirror the tuned
+    shape: ``prefill_buckets``, ``max_slots``, ``seq_buckets``,
+    ``miss_caps``.  A proposal is raised only when the derived shape
+    differs from the active one AND its predicted padding waste beats
+    the active shape's live waste by ``improve_margin`` on ``min_count``
+    or more in-window requests."""
+
+    name = "serving_shape"
+    kind = "serving_shape"
+
+    def __init__(self, fleet, declared: Optional[Dict[str, Any]] = None,
+                 *, window_s: float = 60.0, min_count: int = 50,
+                 q: float = 0.99, max_waste: float = 0.25,
+                 max_buckets: int = 8, align: int = 1,
+                 min_bucket: Optional[int] = None,
+                 max_size: Optional[int] = None,
+                 slot_headroom: int = 1,
+                 max_slots_cap: Optional[int] = None,
+                 improve_margin: float = 0.05,
+                 measure_count: int = 20,
+                 measure_timeout_s: float = 120.0,
+                 cooldown_s: float = 30.0):
+        from ..observability.fleet import HistogramWindow
+
+        self.fleet = fleet
+        self.declared = dict(declared or {})
+        self.window_s = float(window_s)
+        self.min_count = int(min_count)
+        self.q = float(q)
+        self.max_waste = float(max_waste)
+        self.max_buckets = int(max_buckets)
+        self.align = int(align)
+        self.min_bucket = min_bucket
+        self.max_size = max_size
+        self.slot_headroom = int(slot_headroom)
+        self.max_slots_cap = max_slots_cap
+        self.improve_margin = float(improve_margin)
+        self.measure_count = int(measure_count)
+        self.measure_timeout_s = float(measure_timeout_s)
+        self.cooldown_s = float(cooldown_s)
+        self._win = {f: HistogramWindow(window_s=self.window_s)
+                     for f in _FAMILIES}
+        self._active: Dict[str, Any] = dict(self.declared)
+        self._active_digest = DECLARED_DIGEST
+        self._prev: Optional[Dict[str, Any]] = None
+        self._prev_digest = DECLARED_DIGEST
+        self._applied_t: Optional[float] = None
+        self._measure_base: Optional[Dict[str, int]] = None
+
+    # -- observe --------------------------------------------------------------
+    def observe(self, signals: Dict[str, Any]) -> None:
+        merged = signals.get("fleet_telemetry") or {}
+        hists = merged.get("histograms", {})
+        now = time.monotonic()
+        for fam, win in self._win.items():
+            snap = (hists.get(fam) or {}).get("fleet")
+            win.update(now, snap)
+
+    # -- derivation -----------------------------------------------------------
+    def _window_sizes(self, family: str):
+        bounds, counts = self._win[family].delta()
+        return sizes_from_histogram(bounds, counts) if bounds else []
+
+    def _derive(self) -> Tuple[Optional[Dict[str, Any]], Dict[str, float]]:
+        """(shape, prediction) from the current windows — None when no
+        family has enough in-window mass to derive from."""
+        shape: Dict[str, Any] = {}
+        predicted: Dict[str, float] = {}
+        kw = dict(q=self.q, max_waste=self.max_waste,
+                  max_buckets=self.max_buckets, align=self.align,
+                  min_bucket=self.min_bucket, max_size=self.max_size)
+        for fam, field in (("prompt_tokens", "prefill_buckets"),
+                           ("request_tokens", "seq_buckets"),
+                           ("sparse_miss_rows", "miss_caps")):
+            bounds, counts = self._win[fam].delta()
+            if not bounds or sum(counts) < self.min_count:
+                continue
+            fam_kw = dict(kw)
+            if fam == "sparse_miss_rows":
+                # a zero-miss lookup still needs a (smallest) cap
+                fam_kw["min_bucket"] = max(int(self.min_bucket or 1), 1)
+            buckets = derive_buckets_from_histogram(bounds, counts,
+                                                    **fam_kw)
+            if buckets:
+                shape[field] = list(buckets)
+                sizes = sizes_from_histogram(bounds, counts)
+                predicted[f"{field}_waste"] = round(
+                    padding_waste(sizes, buckets), 4)
+                floor = min(s for s, _w in sizes)
+                shape["observed_floor"] = min(
+                    shape.get("observed_floor", floor), floor)
+        sb, sc = self._win["gen_active_slots"].delta()
+        if sb and sum(sc) >= self.min_count:
+            slots = derive_slots_from_histogram(
+                sb, sc, q=self.q, headroom=self.slot_headroom,
+                max_slots=self.max_slots_cap)
+            if slots:
+                shape["max_slots"] = int(slots)
+        if not shape:
+            return None, {}
+        # observed_floor below any derived bucket axis would make the
+        # spec self-rejecting for axes whose smallest observed size is
+        # larger; only keep a floor that every axis satisfies
+        floor = shape.get("observed_floor")
+        if floor is not None:
+            for f in ("prefill_buckets", "seq_buckets"):
+                if shape.get(f) and shape[f][0] < floor:
+                    shape.pop("observed_floor", None)
+                    break
+        shape["digest"] = shape_digest(
+            {k: v for k, v in shape.items() if k != "digest"})
+        return shape, predicted
+
+    def _live_waste(self) -> Dict[str, float]:
+        """Padding waste of the CURRENT window under the ACTIVE shape."""
+        out: Dict[str, float] = {}
+        for fam, field in (("prompt_tokens", "prefill_buckets"),
+                           ("request_tokens", "seq_buckets"),
+                           ("sparse_miss_rows", "miss_caps")):
+            buckets = self._active.get(field)
+            if not buckets:
+                continue
+            sizes = self._window_sizes(fam)
+            if sizes:
+                out[f"{field}_waste"] = round(
+                    padding_waste(sizes, buckets), 4)
+        return out
+
+    # -- propose --------------------------------------------------------------
+    def propose(self) -> Optional[Proposal]:
+        shape, predicted = self._derive()
+        if shape is None or shape["digest"] == self._active_digest:
+            return None
+        live = self._live_waste()
+        # the proposal must WIN: on every axis both shapes cover, the
+        # derived waste beats live by the margin on at least one axis
+        # and regresses none (axes the active shape doesn't declare are
+        # a free win — the derived shape covers a blind spot)
+        better, worse = False, False
+        for key, pw in predicted.items():
+            lw = live.get(key)
+            if lw is None:
+                better = True
+            elif pw <= lw - self.improve_margin:
+                better = True
+            elif pw > lw + self.improve_margin:
+                worse = True
+        if shape.get("max_slots") and \
+                shape["max_slots"] != self._active.get("max_slots"):
+            better = True
+        if worse or not better:
+            return None
+        return Proposal(policy=self.name, kind=self.kind,
+                        from_digest=self._active_digest,
+                        to_digest=shape["digest"], payload=shape,
+                        predicted=predicted)
+
+    # -- actuate --------------------------------------------------------------
+    def apply(self, proposal: Proposal) -> bool:
+        out = self.fleet.apply_serving_shape(proposal.payload)
+        if not out.get("ok"):
+            return False
+        self._prev, self._prev_digest = self._active, self._active_digest
+        self._active = dict(proposal.payload)
+        self._active_digest = proposal.to_digest
+        self._applied_t = time.monotonic()
+        # measurement restarts from the post-apply distribution only
+        self._measure_base = {
+            f: self._win[f].total() for f in _FAMILIES}
+        return True
+
+    def measure(self, proposal: Proposal) -> Optional[bool]:
+        assert self._applied_t is not None
+        fresh = 0
+        for fam in ("prompt_tokens", "request_tokens"):
+            base = (self._measure_base or {}).get(fam, 0)
+            fresh += max(self._win[fam].total() - base, 0)
+        if fresh < self.measure_count:
+            if time.monotonic() - self._applied_t > \
+                    self.measure_timeout_s:
+                return True  # no traffic to refute the claim: keep
+            return None
+        live = self._live_waste()
+        for key, pw in proposal.predicted.items():
+            lw = live.get(key)
+            if lw is not None and lw > pw + self.improve_margin:
+                return False  # live waste blew past the predicted claim
+        return True
+
+    def rollback(self, proposal: Proposal) -> None:
+        if self._prev_digest == DECLARED_DIGEST:
+            with self.fleet._lock:
+                self.fleet.extra_env.pop("PT_TUNED_SHAPE", None)
+            self.fleet.rolling_restart()
+        else:
+            assert self._prev is not None
+            self.fleet.apply_serving_shape(self._prev)
+        self._active = dict(self._prev or self.declared)
+        self._active_digest = self._prev_digest
+
+    # -- provider surface -----------------------------------------------------
+    def active_digest(self) -> str:
+        return self._active_digest
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"active_shape": {k: v for k, v in self._active.items()},
+                "window_counts": {f: self._win[f].total()
+                                  for f in _FAMILIES},
+                "live_waste": self._live_waste()}

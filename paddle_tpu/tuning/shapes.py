@@ -1,0 +1,205 @@
+"""Serving-shape derivation from live size distributions.
+
+The quantile-cover problem: given the observed request-size
+distribution (prompt token counts, sparse miss counts, active slot
+occupancy), pick the SMALLEST bucket set that
+
+* covers the p-quantile (every request at or below the p99 size fits
+  some bucket — the engine never rejects in-distribution traffic), and
+* bounds the padding-waste fraction (padded - real tokens as a share of
+  padded tokens) below ``max_waste``,
+
+under a ``max_buckets`` cap (each bucket is one AOT-compiled
+executable — buckets are not free).  The algorithm is greedy-split:
+start from the single covering bucket, repeatedly add the observed size
+whose addition removes the most padding, stop when the waste bound
+holds or the bucket budget is spent.  It is deterministic for a given
+weighted size multiset (ties break toward the smaller size), which is
+what makes derived shapes reproducible across replicas and restarts.
+
+Sizes may come in raw (``[(size, weight), ...]``) or as a cumulative
+histogram snapshot (``bounds``/``counts`` as merged fleet telemetry
+carries them) — histogram buckets are collapsed to their UPPER bound,
+so a histogram-derived cover is conservative by construction.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "quantile_cover", "weighted_quantile", "padding_waste",
+    "sizes_from_histogram", "derive_buckets_from_histogram",
+    "derive_slots_from_histogram", "shape_digest",
+]
+
+
+def _norm_sizes(sizes: Iterable) -> List[Tuple[int, float]]:
+    """Collapse to a sorted weighted multiset of positive int sizes."""
+    acc: Dict[int, float] = {}
+    for item in sizes:
+        if isinstance(item, (tuple, list)):
+            s, w = item
+        else:
+            s, w = item, 1.0
+        s = int(s)
+        w = float(w)
+        if s <= 0 or w <= 0:
+            continue
+        acc[s] = acc.get(s, 0.0) + w
+    return sorted(acc.items())
+
+
+def weighted_quantile(sizes: Iterable, q: float) -> Optional[int]:
+    """Smallest observed size with cumulative weight >= q (0<q<=1)."""
+    pairs = _norm_sizes(sizes)
+    if not pairs:
+        return None
+    total = sum(w for _s, w in pairs)
+    target = q * total
+    cum = 0.0
+    for s, w in pairs:
+        cum += w
+        if cum >= target - 1e-12:
+            return s
+    return pairs[-1][0]
+
+
+def padding_waste(sizes: Iterable, buckets: Sequence[int]) -> float:
+    """Padding-waste fraction of ``buckets`` over ``sizes``: padded
+    minus real, as a share of padded (0 = exact fit).  Sizes above the
+    largest bucket are EXCLUDED — they are rejected, not padded."""
+    bs = sorted(int(b) for b in buckets)
+    if not bs:
+        return 0.0
+    pad_tot = real_tot = 0.0
+    for s, w in _norm_sizes(sizes):
+        b = next((x for x in bs if x >= s), None)
+        if b is None:
+            continue
+        pad_tot += b * w
+        real_tot += s * w
+    return (pad_tot - real_tot) / pad_tot if pad_tot > 0 else 0.0
+
+
+def _align_up(x: int, align: int) -> int:
+    return ((int(x) + align - 1) // align) * align
+
+
+def quantile_cover(sizes: Iterable, *, q: float = 0.99,
+                   max_waste: float = 0.25, max_buckets: int = 8,
+                   align: int = 1, min_bucket: Optional[int] = None,
+                   max_size: Optional[int] = None) -> Tuple[int, ...]:
+    """Derive the smallest bucket set covering the ``q``-quantile of
+    ``sizes`` with padding waste <= ``max_waste`` (greedy-split under a
+    ``max_buckets`` cap; see module docstring).
+
+    ``align`` rounds every bucket up (page/lane granularity);
+    ``min_bucket`` floors the smallest bucket; ``max_size`` clamps the
+    covering bucket (an engine hard limit such as ``max_seq_len``).
+    Returns a sorted, deduplicated, strictly-increasing tuple — always
+    non-empty when any in-range size was observed.
+    """
+    if not (0.0 < q <= 1.0):
+        raise ValueError(f"q must be in (0, 1], got {q}")
+    if not (0.0 <= max_waste < 1.0):
+        raise ValueError(f"max_waste must be in [0, 1), got {max_waste}")
+    if max_buckets < 1:
+        raise ValueError("max_buckets must be >= 1")
+    align = max(int(align), 1)
+    pairs = _norm_sizes(sizes)
+    if max_size is not None:
+        pairs = [(s, w) for s, w in pairs if s <= int(max_size)]
+    if not pairs:
+        return ()
+    p_cut = weighted_quantile(pairs, q)
+    covered = [(s, w) for s, w in pairs if s <= p_cut]
+    cover = _align_up(p_cut, align)
+    if max_size is not None:
+        cover = min(cover, int(max_size))
+        cover = max(cover, p_cut)  # never un-cover the quantile
+    if min_bucket is not None:
+        cover = max(cover, int(min_bucket))
+    buckets = [cover]
+
+    def waste(bs: List[int]) -> float:
+        return padding_waste(covered, bs)
+
+    # candidate split points: observed (aligned) sizes below the cover
+    cands = sorted({_align_up(s, align) for s, _w in covered
+                    if _align_up(s, align) < cover
+                    and (min_bucket is None
+                         or _align_up(s, align) >= int(min_bucket))})
+    while waste(buckets) > max_waste and len(buckets) < max_buckets:
+        best, best_w = None, waste(buckets)
+        for c in cands:
+            if c in buckets:
+                continue
+            w = waste(sorted(buckets + [c]))
+            # strictly-better, ties toward the SMALLER size (c ascends)
+            if w < best_w - 1e-12:
+                best, best_w = c, w
+        if best is None:
+            break
+        buckets = sorted(buckets + [best])
+    return tuple(buckets)
+
+
+# ---------------------------------------------------------------------------
+# histogram adapters (merged fleet-telemetry snapshots)
+# ---------------------------------------------------------------------------
+
+def sizes_from_histogram(bounds: Sequence[float], counts: Sequence[float]
+                         ) -> List[Tuple[int, float]]:
+    """Weighted sizes from cumulative-free histogram parts: each bucket
+    collapses to its UPPER bound (conservative — derived buckets can
+    only over-cover).  The +Inf bucket collapses to the largest finite
+    bound: telemetry histograms are provisioned with a top bound above
+    any admissible request, so mass there is clamped, not invented."""
+    out: List[Tuple[int, float]] = []
+    finite = [b for b in bounds if math.isfinite(b)]
+    top = max(finite) if finite else None
+    for b, c in zip(bounds, counts):
+        if c <= 0:
+            continue
+        ub = b if math.isfinite(b) else top
+        if ub is None or ub <= 0:
+            continue
+        out.append((int(math.ceil(ub)), float(c)))
+    return out
+
+
+def derive_buckets_from_histogram(bounds: Sequence[float],
+                                  counts: Sequence[float], **kw
+                                  ) -> Tuple[int, ...]:
+    """``quantile_cover`` over a histogram delta (see
+    :func:`sizes_from_histogram` for the collapse rule)."""
+    return quantile_cover(sizes_from_histogram(bounds, counts), **kw)
+
+
+def derive_slots_from_histogram(bounds: Sequence[float],
+                                counts: Sequence[float], *,
+                                q: float = 0.99, headroom: int = 1,
+                                min_slots: int = 1,
+                                max_slots: Optional[int] = None
+                                ) -> Optional[int]:
+    """Generation slot count from the occupancy distribution: the
+    ``q``-quantile of concurrently-active slots plus ``headroom`` —
+    enough capacity that admission control, not slot exhaustion, is the
+    binding constraint at the tail."""
+    sizes = sizes_from_histogram(bounds, counts)
+    pq = weighted_quantile(sizes, q)
+    if pq is None:
+        return None
+    n = max(int(pq) + int(headroom), int(min_slots))
+    return min(n, int(max_slots)) if max_slots is not None else n
+
+
+def shape_digest(shape: Dict) -> str:
+    """Stable short digest of a serving-shape dict — the identity the
+    tuner ledger and the ``tuner`` provider report for active configs."""
+    import hashlib
+    import json
+
+    blob = json.dumps(shape, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:12]

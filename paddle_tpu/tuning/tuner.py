@@ -1,0 +1,221 @@
+"""The online-tuner driver: one loop, many policies, one ledger.
+
+``OnlineTuner`` periodically assembles a telemetry view from its signal
+sources (merged ``fleet_telemetry``, ``slo``, flight-recorder step
+series — whatever the host wires in), drives each registered
+:class:`~paddle_tpu.tuning.policy.TuningPolicy` through the
+observe -> propose -> apply -> measure -> keep-or-rollback state
+machine, and publishes every decision through the ``tuner``
+observability provider (proposals / applies / keeps / rollbacks /
+active config digests).
+
+Safety rails:
+
+* **Kill-switch** — ``PT_ONLINE_TUNING=0`` disables every actuation
+  path at the tick level; the provider still reports (``enabled:
+  false``) so a fleet with tuning off is visibly off, not silently
+  stuck.
+* **One in-flight proposal per policy** — a policy under measurement
+  cannot propose again; refuted proposals roll back through the same
+  boundary they applied through.
+* **Flap damping** — a rolled-back target digest is embargoed and each
+  keep/rollback starts the policy's ``cooldown_s`` quiet period.
+* **No blocking work under the ledger lock** — policy verbs (which may
+  fence fleets or roll restarts) run outside it; the lock guards only
+  bookkeeping, per the repo's CC-lint contract.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from .policy import Proposal, TuningPolicy
+
+__all__ = ["OnlineTuner", "tuning_enabled"]
+
+
+def tuning_enabled() -> bool:
+    """The ``PT_ONLINE_TUNING`` kill-switch (default: enabled).  Read
+    per-tick so an operator can flip a live process's behavior."""
+    return os.environ.get("PT_ONLINE_TUNING", "1") not in ("0", "false")
+
+
+class _PolicyState:
+    def __init__(self) -> None:
+        self.phase = "idle"                    # idle | measuring
+        self.proposal: Optional[Proposal] = None
+        self.cooldown_until = 0.0
+        self.rejected: List[str] = []          # embargoed target digests
+        self.counts = {"proposals": 0, "applies": 0, "keeps": 0,
+                       "rollbacks": 0, "apply_failures": 0, "errors": 0}
+
+
+class OnlineTuner:
+    """Drive ``policies`` every ``interval_s`` (call :meth:`tick`
+    yourself for deterministic tests/drills, or :meth:`start` the
+    ``pt-tuner-driver`` thread).  ``signal_sources`` maps signal names
+    to zero-arg callables; their results form the ``signals`` dict every
+    policy observes — single scrape per tick, shared by all policies."""
+
+    def __init__(self, policies: Sequence[TuningPolicy], *,
+                 signal_sources: Optional[Dict[str, Callable[[], Any]]]
+                 = None, interval_s: float = 5.0,
+                 provider_name: Optional[str] = "tuner"):
+        from ..analysis.lockdep import lock as _named_lock  # lazy: no cycle
+
+        self.policies = list(policies)
+        self.signal_sources = dict(signal_sources or {})
+        self.interval_s = float(interval_s)
+        self._state = {p.name: _PolicyState() for p in self.policies}
+        self._decisions: deque = deque(maxlen=128)
+        self._mu = _named_lock("tuning.tuner")
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.ticks = 0
+        if provider_name:
+            from ..observability import register_provider
+
+            register_provider(provider_name, self.snapshot)
+
+    # -- ledger ---------------------------------------------------------------
+    def _record(self, policy: TuningPolicy, event: str,
+                proposal: Optional[Proposal], **extra) -> None:
+        row = {"t": time.time(), "policy": policy.name, "event": event}
+        if proposal is not None:
+            row.update(proposal.to_dict())
+        row.update(extra)
+        with self._mu:
+            self._decisions.append(row)
+
+    # -- the loop -------------------------------------------------------------
+    def _signals(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, fn in self.signal_sources.items():
+            try:
+                out[name] = fn()
+            except Exception as e:  # a dead source must not stop tuning
+                out[name] = {"error": f"{type(e).__name__}: {e}"}
+        return out
+
+    def tick(self, now: Optional[float] = None) -> None:
+        """One full observe/propose/apply/measure pass (no-op when the
+        kill-switch is off)."""
+        if not tuning_enabled():
+            return
+        now = time.monotonic() if now is None else now
+        self.ticks += 1
+        signals = self._signals()
+        for policy in self.policies:
+            st = self._state[policy.name]
+            try:
+                policy.observe(signals)
+            except Exception:
+                st.counts["errors"] += 1
+                continue
+            if st.phase == "measuring":
+                self._measure(policy, st)
+            elif st.phase == "idle" and now >= st.cooldown_until:
+                self._propose(policy, st, now)
+
+    def _propose(self, policy: TuningPolicy, st: _PolicyState,
+                 now: float) -> None:
+        try:
+            prop = policy.propose()
+        except Exception:
+            st.counts["errors"] += 1
+            return
+        if prop is None or prop.to_digest in st.rejected:
+            return
+        st.counts["proposals"] += 1
+        self._record(policy, "propose", prop)
+        try:
+            applied = policy.apply(prop)
+        except Exception as e:
+            st.counts["errors"] += 1
+            self._record(policy, "apply_error", prop,
+                         error=f"{type(e).__name__}: {e}")
+            return
+        if not applied:
+            st.counts["apply_failures"] += 1
+            self._record(policy, "apply_skipped", prop)
+            return
+        st.counts["applies"] += 1
+        st.phase = "measuring"
+        st.proposal = prop
+        self._record(policy, "apply", prop)
+
+    def _measure(self, policy: TuningPolicy, st: _PolicyState) -> None:
+        prop = st.proposal
+        assert prop is not None
+        try:
+            verdict = policy.measure(prop)
+        except Exception:
+            st.counts["errors"] += 1
+            verdict = False  # an unmeasurable apply is an unsafe apply
+        if verdict is None:
+            return  # window still filling
+        if verdict:
+            st.counts["keeps"] += 1
+            self._record(policy, "keep", prop)
+        else:
+            try:
+                policy.rollback(prop)
+            except Exception as e:
+                st.counts["errors"] += 1
+                self._record(policy, "rollback_error", prop,
+                             error=f"{type(e).__name__}: {e}")
+            st.counts["rollbacks"] += 1
+            st.rejected.append(prop.to_digest)
+            self._record(policy, "rollback", prop)
+        st.phase = "idle"
+        st.proposal = None
+        st.cooldown_until = time.monotonic() + policy.cooldown_s
+
+    # -- lifecycle ------------------------------------------------------------
+    def start(self) -> "OnlineTuner":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.tick()
+                except Exception:
+                    pass  # the driver thread must survive any tick
+
+        self._thread = threading.Thread(target=run, name="pt-tuner-driver",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5)
+
+    # -- provider -------------------------------------------------------------
+    def snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            decisions = list(self._decisions)
+        pol: Dict[str, Any] = {}
+        for policy in self.policies:
+            st = self._state[policy.name]
+            row: Dict[str, Any] = dict(st.counts)
+            row["phase"] = st.phase
+            row["active"] = policy.active_digest()
+            if st.rejected:
+                row["rejected"] = list(st.rejected)
+            if st.proposal is not None:
+                row["in_flight"] = st.proposal.to_dict()
+            try:
+                row.update(policy.snapshot())
+            except Exception as e:
+                row["snapshot_error"] = f"{type(e).__name__}: {e}"
+            pol[policy.name] = row
+        return {"enabled": tuning_enabled(), "ticks": self.ticks,
+                "policies": pol, "decisions": decisions}

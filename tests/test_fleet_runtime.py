@@ -112,6 +112,32 @@ class TestFleetStateMachine:
         assert events.count("restart") == 1
         assert events[-1] == "complete"
 
+    def test_worker_fence_adopts_planned_drain(self):
+        """A worker-raised retune fence (online tuner): the supervisor
+        adopts it with NO eviction, and a peer that dies MID-DRAIN
+        (e.g. killed by the fenced rank-0 coordinator's fast exit) is
+        drain mechanics, not a membership change — the gang restarts
+        planned, full world, zero backoff, zero budget."""
+        sm = FleetStateMachine(2, _policy(min_world=2, max_restarts=0),
+                               now=0.0)
+        sm.heartbeat(0, 0.1)
+        sm.heartbeat(1, 0.1)
+        sm.worker_fence(1.0, "retune:plan")
+        assert sm.phase is FleetPhase.FENCED and sm.planned_fence
+        sm.worker_fence(1.1, "retune:plan")  # idempotent while FENCED
+        fences = [e for e in sm.timeline if e["event"] == "fence"]
+        assert len(fences) == 1 and fences[0]["reason"] == "retune:plan"
+        # rank 0 drains clean; rank 1 aborts under the coordinator loss
+        assert sm.observe(2.0, {0: EXIT_FENCED, 1: None}).kind == "hold"
+        act = sm.observe(3.0, {0: EXIT_FENCED, 1: -6})
+        assert act.kind == "restart" and act.world == 2
+        assert act.backoff_s == 0.0
+        assert not [e for e in sm.timeline if e["event"] == "evict"]
+        sm.restarted(4.0, 2)
+        # max_restarts=0, yet the planned roll went through: no budget
+        assert sm.restarts == 0 and sm.gen == 1
+        assert not sm.planned_fence  # consumed, not sticky
+
     def test_backoff_grows_exponentially_and_caps(self):
         p = _policy(backoff_base_s=0.5, backoff_max_s=2.0)
         assert p.backoff_s(1) == pytest.approx(0.5)

@@ -402,6 +402,24 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_fleet_observability.py -q \
 JAX_PLATFORMS=cpu python tools/fleet_trace_drill.py || exit 1
 JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/fleet_trace_drill.py || exit 1
 
+echo "== tuning gate (ISSUE-20: online auto-tuner closed loop) =="
+# detector matrix (single spike never triggers, sustained regression
+# does), quantile-cover property tests, restart-safe histogram
+# windows, BucketSpec validation on derived shapes, rescore/respec
+# units, OnlineTuner ledger + kill-switch — then the REAL multi-
+# process drill, three legs: (serving) a workload shift drives bucket
+# re-derivation applied through a rolling restart with bit-identical
+# replayed streams and a confirmed keep; (plan-keep) a scripted
+# slowdown trips the detector, the fleet fences PLANNED at a
+# checkpoint boundary (zero restart budget), swaps plans and keeps;
+# (plan-rollback) a persistent slowdown fails the post-apply measure
+# and rolls back to the original digest with the candidate embargoed;
+# lockdep-armed re-run must stay cycle-free
+JAX_PLATFORMS=cpu python -m pytest tests/test_tuning.py -q \
+    -p no:cacheprovider -p no:xdist -p no:randomly || exit 1
+JAX_PLATFORMS=cpu python tools/tuning_drill.py || exit 1
+JAX_PLATFORMS=cpu PT_LOCKDEP=1 python tools/tuning_drill.py || exit 1
+
 echo "== tier-1 test suite =="
 rm -f /tmp/_t1.log
 timeout -k 10 870 env JAX_PLATFORMS=cpu \
